@@ -1,0 +1,110 @@
+"""Engine configuration.
+
+Mirrors the reference's three-tier conf system keyed ``spark.auron.*``
+(``spark-extension/src/main/java/.../AuronConf.java:23-130`` and
+``auron-jni-bridge/src/conf.rs:32-111``): one typed source of truth the whole
+engine reads. Here it is a process-global dataclass with context overrides; a
+frontend (Spark plugin) would populate it from SparkConf.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+from typing import Optional
+
+
+@dataclasses.dataclass
+class Config:
+    # Rows per batch. The reference defaults to 10000 (AuronConf.BATCH_SIZE);
+    # we use a power of two because device buffers are padded to capacity
+    # buckets and XLA tiles like powers of two.
+    batch_size: int = 8192
+
+    # Suggested in-memory bytes per batch (reference: suggested_batch_mem_size,
+    # datafusion-ext-commons/src/lib.rs:74-118).
+    suggested_batch_mem_size: int = 8 << 20
+    suggested_batch_mem_size_kway_merge: int = 1 << 20
+
+    # Fraction of the process memory budget handed to the memory manager
+    # (reference: MEMORY_FRACTION=0.6, MemManager::init(total * fraction)).
+    memory_fraction: float = 0.6
+    # Total memory budget in bytes; None = derive from system.
+    memory_total: Optional[int] = None
+
+    # Device HBM budget for resident batch data (bytes). None = ask the device.
+    hbm_budget: Optional[int] = None
+
+    # Compression codec for shuffle/spill streams: "zstd" | "lz4" | "none".
+    # (reference: spark.auron.shuffle.compression.codec, default lz4; we default
+    # to zstd level 1 since the python lz4 binding is absent and libzstd is fast)
+    shuffle_compression_codec: str = "zstd"
+    spill_compression_codec: str = "zstd"
+    zstd_level: int = 1
+
+    # Byte-plane transpose of fixed-width columns before compression
+    # (reference: io/batch_serde.rs TransposeOpt — boosts ratios).
+    serde_transpose: bool = True
+
+    # Partial-agg adaptive skipping (reference: PARTIAL_AGG_SKIPPING_ENABLE,
+    # ratio 0.9 after 50k rows — agg_ctx.rs, AuronConf.java).
+    partial_agg_skipping_enable: bool = True
+    partial_agg_skipping_ratio: float = 0.9
+    partial_agg_skipping_min_rows: int = 50_000
+
+    # SortMergeJoin fallback threshold for shuffled-hash-join memory risk
+    # (reference: SMJ_FALLBACK_* in AuronConf.java).
+    smj_fallback_enable: bool = True
+    smj_fallback_rows_threshold: int = 10_000_000
+    smj_fallback_mem_size_threshold: int = 1 << 30
+
+    # Spill directory (reference spills via JVM OnHeapSpillManager or disk;
+    # we spill device->host->disk files here).
+    spill_dir: str = dataclasses.field(
+        default_factory=lambda: os.environ.get("BLAZE_TPU_SPILL_DIR", "/tmp/blaze_tpu_spill")
+    )
+
+    # Number of host worker threads for IO/decode (reference: tokio worker
+    # threads conf).
+    num_io_threads: int = 4
+
+    # Per-operator enable flags (reference: spark.auron.enable.<op>,
+    # AuronConverters.scala:99-140). Checked by the plan converter/session.
+    enabled_ops: dict = dataclasses.field(default_factory=dict)
+
+    # Capacity bucketing: device buffers are padded up to the next bucket to
+    # bound XLA recompilation. Buckets are powers of two >= min_capacity.
+    min_capacity: int = 256
+
+    def capacity_for(self, n: int) -> int:
+        cap = self.min_capacity
+        while cap < n:
+            cap <<= 1
+        return cap
+
+    def is_op_enabled(self, op: str) -> bool:
+        return self.enabled_ops.get(op, True)
+
+
+_GLOBAL = Config()
+
+
+def get_config() -> Config:
+    return _GLOBAL
+
+
+def set_config(cfg: Config):
+    global _GLOBAL
+    _GLOBAL = cfg
+
+
+@contextlib.contextmanager
+def config_override(**kwargs):
+    global _GLOBAL
+    old = _GLOBAL
+    _GLOBAL = dataclasses.replace(old, **kwargs)
+    try:
+        yield _GLOBAL
+    finally:
+        _GLOBAL = old

@@ -1,0 +1,25 @@
+"""Scalar function registry with Spark semantics.
+
+Reference: ``native-engine/datafusion-ext-functions`` (spark_strings,
+spark_dates, spark_hash, spark_make_decimal, ...) plus DataFusion built-ins
+the IR can name. Functions are registered as (device_fn | host_fn) pairs;
+the expression compiler picks the device path when all args are on device.
+"""
+
+from __future__ import annotations
+
+from blaze_tpu.ir import types as T
+
+# name -> result-type rule; populated alongside implementations.
+_TYPE_RULES = {}
+
+
+def infer_function_type(name: str, arg_types) -> T.DataType:
+    rule = _TYPE_RULES.get(name)
+    if rule is None:
+        raise NotImplementedError(f"unknown scalar function {name!r}")
+    return rule(arg_types) if callable(rule) else rule
+
+
+def register_type_rule(name: str, rule):
+    _TYPE_RULES[name] = rule

@@ -1,0 +1,26 @@
+"""Test fixture: force an 8-device virtual CPU mesh so distributed/sharding
+paths are exercised without TPU hardware (SURVEY.md §4: the reference runs
+its native-operator tests without a JVM; we run ours without a TPU)."""
+
+import os
+
+# Must be set before jax import.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+import blaze_tpu  # noqa: E402,F401  (enables x64)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, devs
+    return devs[:8]
