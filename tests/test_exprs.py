@@ -1,0 +1,330 @@
+import numpy as np
+import pyarrow as pa
+import pytest
+from decimal import Decimal
+
+from blaze_tpu.core import ColumnarBatch
+from blaze_tpu.exprs.compiler import ExprEvaluator
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import types as T
+
+
+def run(exprs, data, schema=None):
+    b = ColumnarBatch.from_pydict(data, schema)
+    ev = ExprEvaluator(exprs, b.schema)
+    cols = ev.evaluate(b)
+    out = ColumnarBatch(
+        T.Schema.of(*[(f"c{i}", c.dtype) for i, c in enumerate(cols)]), cols, b.num_rows
+    )
+    return out.to_pydict()
+
+
+def col(name):
+    return E.Column(name)
+
+
+def lit(v, t):
+    return E.Literal(v, t)
+
+
+def test_arith_nulls():
+    out = run(
+        [E.BinaryExpr(E.BinaryOp.ADD, col("a"), col("b")),
+         E.BinaryExpr(E.BinaryOp.MUL, col("a"), lit(10, T.I64))],
+        {"a": pa.array([1, None, 3], type=pa.int64()),
+         "b": pa.array([10, 20, None], type=pa.int64())},
+    )
+    assert out["c0"] == [11, None, None]
+    assert out["c1"] == [10, None, 30]
+
+
+def test_division_by_zero_is_null():
+    out = run(
+        [E.BinaryExpr(E.BinaryOp.DIV, col("a"), col("b")),
+         E.BinaryExpr(E.BinaryOp.MOD, col("a"), col("b"))],
+        {"a": pa.array([7, 8, -7], type=pa.int64()),
+         "b": pa.array([2, 0, 2], type=pa.int64())},
+    )
+    assert out["c0"] == [3, None, -3]  # java trunc division
+    assert out["c1"] == [1, None, -1]
+
+
+def test_float_division():
+    out = run(
+        [E.BinaryExpr(E.BinaryOp.DIV, col("a"), col("b"))],
+        {"a": pa.array([1.0, 5.0], type=pa.float64()),
+         "b": pa.array([4.0, 0.0], type=pa.float64())},
+    )
+    assert out["c0"] == [0.25, None]
+
+
+def test_comparisons_and_kleene_logic():
+    tbl = {"a": pa.array([1, 2, None], type=pa.int64())}
+    gt = E.BinaryExpr(E.BinaryOp.GT, col("a"), lit(1, T.I64))
+    out = run([gt], tbl)
+    assert out["c0"] == [False, True, None]
+    # (a > 1) AND null -> false where a<=1 (definite false), else null
+    null_b = lit(None, T.BOOL)
+    out = run([E.BinaryExpr(E.BinaryOp.AND, gt, null_b)], tbl)
+    assert out["c0"] == [False, None, None]
+    out = run([E.BinaryExpr(E.BinaryOp.OR, gt, null_b)], tbl)
+    assert out["c0"] == [None, True, None]
+
+
+def test_case_when():
+    expr = E.Case(
+        branches=[
+            (E.BinaryExpr(E.BinaryOp.LT, col("a"), lit(0, T.I64)), lit(-1, T.I64)),
+            (E.BinaryExpr(E.BinaryOp.EQ, col("a"), lit(0, T.I64)), lit(0, T.I64)),
+        ],
+        else_expr=lit(1, T.I64),
+    )
+    out = run([expr], {"a": pa.array([-5, 0, 7, None], type=pa.int64())})
+    assert out["c0"] == [-1, 0, 1, 1]  # null comparisons are not true -> else
+
+
+def test_case_no_else_gives_null():
+    expr = E.Case(
+        branches=[(E.BinaryExpr(E.BinaryOp.LT, col("a"), lit(0, T.I64)), lit(-1, T.I64))],
+    )
+    out = run([expr], {"a": pa.array([-5, 5], type=pa.int64())})
+    assert out["c0"] == [-1, None]
+
+
+def test_cast_float_to_int_java_semantics():
+    out = run(
+        [E.Cast(col("f"), T.I32)],
+        {"f": pa.array([3.9, -3.9, float("nan"), 1e30, -1e30], type=pa.float64())},
+    )
+    assert out["c0"] == [3, -3, 0, 2**31 - 1, -(2**31)]
+
+
+def test_cast_string_to_int():
+    out = run(
+        [E.Cast(col("s"), T.I64)],
+        {"s": pa.array([" 42 ", "3.7", "abc", None])},
+    )
+    assert out["c0"] == [42, 3, None, None]
+
+
+def test_cast_int_to_string():
+    out = run([E.Cast(col("a"), T.STRING)], {"a": pa.array([1, None], type=pa.int64())})
+    assert out["c0"] == ["1", None]
+
+
+def test_cast_double_to_string_java_format():
+    out = run([E.Cast(col("a"), T.STRING)],
+              {"a": pa.array([1.0, 2.5, float("nan")], type=pa.float64())})
+    assert out["c0"] == ["1.0", "2.5", "NaN"]
+
+
+def test_in_list_null_semantics():
+    tbl = {"a": pa.array([1, 4, None], type=pa.int64())}
+    out = run([E.InList(col("a"), [lit(1, T.I64), lit(2, T.I64)])], tbl)
+    assert out["c0"] == [True, False, None]
+    # list containing null: non-match -> null
+    out = run([E.InList(col("a"), [lit(1, T.I64), lit(None, T.I64)])], tbl)
+    assert out["c0"] == [True, None, None]
+
+
+def test_in_list_strings():
+    out = run(
+        [E.InList(col("s"), [lit("x", T.STRING), lit("y", T.STRING)])],
+        {"s": pa.array(["x", "z", None])},
+    )
+    assert out["c0"] == [True, False, None]
+
+
+def test_like():
+    out = run(
+        [E.Like(col("s"), "a%"), E.Like(col("s"), "_b"), E.Like(col("s"), "a%", negated=True)],
+        {"s": pa.array(["abc", "ab", "xb", None])},
+    )
+    assert out["c0"] == [True, True, False, None]
+    assert out["c1"] == [False, True, True, None]
+    assert out["c2"] == [False, False, True, None]
+
+
+def test_string_fast_paths():
+    out = run(
+        [E.StringStartsWith(col("s"), "ab"), E.StringEndsWith(col("s"), "c"),
+         E.StringContains(col("s"), "b")],
+        {"s": pa.array(["abc", "bcd", None])},
+    )
+    assert out["c0"] == [True, False, None]
+    assert out["c1"] == [True, False, None]
+    assert out["c2"] == [True, True, None]
+
+
+def test_is_null_not():
+    out = run(
+        [E.IsNull(col("a")), E.IsNotNull(col("a")), E.Not(E.IsNull(col("a")))],
+        {"a": pa.array([1, None], type=pa.int64())},
+    )
+    assert out["c0"] == [False, True]
+    assert out["c1"] == [True, False]
+    assert out["c2"] == [True, False]
+
+
+def test_scalar_functions_dates():
+    import datetime
+
+    out = run(
+        [E.ScalarFunction("year", [col("d")]), E.ScalarFunction("month", [col("d")]),
+         E.ScalarFunction("day", [col("d")]),
+         E.ScalarFunction("date_add", [col("d"), lit(10, T.I32)])],
+        {"d": pa.array([datetime.date(2001, 3, 17), datetime.date(1969, 12, 31), None],
+                       type=pa.date32())},
+    )
+    assert out["c0"] == [2001, 1969, None]
+    assert out["c1"] == [3, 12, None]
+    assert out["c2"] == [17, 31, None]
+    assert out["c3"] == [datetime.date(2001, 3, 27), datetime.date(1970, 1, 10), None]
+
+
+def test_civil_roundtrip_wide_range():
+    import jax.numpy as jnp
+
+    from blaze_tpu.exprs.functions import civil_from_days, days_from_civil
+
+    days = jnp.arange(-150000, 150000, 37)
+    y, m, d = civil_from_days(days)
+    back = days_from_civil(y, m, d)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(days).astype(np.int32))
+
+
+def test_string_functions():
+    out = run(
+        [E.ScalarFunction("upper", [col("s")]),
+         E.ScalarFunction("substring", [col("s"), lit(2, T.I32), lit(2, T.I32)]),
+         E.ScalarFunction("length", [col("s")]),
+         E.ScalarFunction("concat_ws", [lit("-", T.STRING), col("s"), col("t")])],
+        {"s": pa.array(["hello", None]), "t": pa.array(["x", "y"])},
+    )
+    assert out["c0"] == ["HELLO", None]
+    assert out["c1"] == ["el", None]
+    assert out["c2"] == [5, None]
+    assert out["c3"] == ["hello-x", "y"]  # concat_ws skips nulls
+
+
+def test_coalesce():
+    out = run(
+        [E.ScalarFunction("coalesce", [col("a"), col("b"), lit(0, T.I64)])],
+        {"a": pa.array([1, None, None], type=pa.int64()),
+         "b": pa.array([None, 5, None], type=pa.int64())},
+    )
+    assert out["c0"] == [1, 5, 0]
+
+
+def test_decimal_arith():
+    schema = T.Schema.of(("x", T.DecimalType(10, 2)), ("y", T.DecimalType(10, 2)))
+    data = {
+        "x": pa.array([Decimal("12.34"), Decimal("1.00")], type=pa.decimal128(10, 2)),
+        "y": pa.array([Decimal("0.66"), Decimal("3.00")], type=pa.decimal128(10, 2)),
+    }
+    add = E.BinaryExpr(E.BinaryOp.ADD, col("x"), col("y"), result_type=T.DecimalType(11, 2))
+    mul = E.BinaryExpr(E.BinaryOp.MUL, col("x"), col("y"), result_type=T.DecimalType(21, 4))
+    div = E.BinaryExpr(E.BinaryOp.DIV, col("x"), col("y"), result_type=T.DecimalType(17, 6))
+    out = run([add, mul, div], data, schema)
+    assert out["c0"] == [Decimal("13.00"), Decimal("4.00")]
+    assert out["c1"] == [Decimal("8.1444"), Decimal("3.0000")]
+    assert out["c2"] == [Decimal("18.696970"), Decimal("0.333333")]
+
+
+def test_decimal_overflow_nulls():
+    schema = T.Schema.of(("x", T.DecimalType(4, 0)))
+    data = {"x": pa.array([Decimal("9999"), Decimal("10")], type=pa.decimal128(4, 0))}
+    mul = E.BinaryExpr(E.BinaryOp.MUL, col("x"), col("x"), result_type=T.DecimalType(4, 0))
+    out = run([mul], data, schema)
+    assert out["c0"] == [None, Decimal("100")]
+
+
+def test_row_num():
+    b1 = ColumnarBatch.from_pydict({"a": [10, 20]})
+    b2 = ColumnarBatch.from_pydict({"a": [30]})
+    ev = ExprEvaluator([E.RowNum()], b1.schema)
+    c1 = ev.evaluate(b1)[0]
+    c2 = ev.evaluate(b2)[0]
+    assert np.asarray(c1.data[:2]).tolist() == [0, 1]
+    assert np.asarray(c2.data[:1]).tolist() == [2]
+
+
+def test_predicate_mask():
+    b = ColumnarBatch.from_pydict({"a": pa.array([1, 5, None, 7], type=pa.int64())})
+    ev = ExprEvaluator([E.BinaryExpr(E.BinaryOp.GT, col("a"), lit(2, T.I64))], b.schema)
+    mask = np.asarray(ev.evaluate_predicate(b))
+    assert mask[:4].tolist() == [False, True, False, True]
+    assert not mask[4:].any()
+
+
+def test_get_json_object():
+    out = run(
+        [E.ScalarFunction("get_json_object", [col("j"), lit("$.a.b", T.STRING)])],
+        {"j": pa.array(['{"a":{"b":42}}', '{"a":{}}', "notjson", None])},
+    )
+    assert out["c0"] == ["42", None, None, None]
+
+
+def test_named_struct_and_get_field():
+    ns = E.NamedStruct(["x", "y"], [col("a"), col("b")])
+    out = run(
+        [E.GetIndexedField(ns, E.Literal(1, T.I32))],
+        {"a": pa.array([1], type=pa.int64()), "b": pa.array(["s"])},
+    )
+    assert out["c0"] == ["s"]
+
+
+def test_decimal_times_int_keeps_scale():
+    schema = T.Schema.of(("x", T.DecimalType(7, 2)))
+    data = {"x": pa.array([Decimal("10.00"), None], type=pa.decimal128(7, 2))}
+    mul = E.BinaryExpr(E.BinaryOp.MUL, col("x"), lit(2, T.I32), result_type=T.DecimalType(9, 2))
+    out = run([mul], data, schema)
+    assert out["c0"] == [Decimal("20.00"), None]
+
+
+def test_decimal_times_float():
+    schema = T.Schema.of(("x", T.DecimalType(7, 2)))
+    data = {"x": pa.array([Decimal("10.00")], type=pa.decimal128(7, 2))}
+    mul = E.BinaryExpr(E.BinaryOp.MUL, col("x"), lit(0.5, T.F64), result_type=T.DecimalType(9, 2))
+    out = run([mul], data, schema)
+    assert out["c0"] == [Decimal("5.00")]
+
+
+def test_review_fixes():
+    import datetime
+
+    # host literal broadcast in concat/coalesce
+    out = run(
+        [E.ScalarFunction("concat", [col("s"), lit("-x", T.STRING)]),
+         E.ScalarFunction("coalesce", [col("s"), lit("z", T.STRING)])],
+        {"s": pa.array(["a", None, "c"])},
+    )
+    assert out["c0"] == ["a-x", None, "c-x"]
+    assert out["c1"] == ["a", "z", "c"]
+    # exact big-int string parse
+    out = run([E.Cast(col("s"), T.I64)],
+              {"s": pa.array(["9223372036854775807", "9007199254740993",
+                              "9223372036854775808"])})
+    assert out["c0"] == [9223372036854775807, 9007199254740993, None]
+    # ceil/floor on decimal
+    schema = T.Schema.of(("x", T.DecimalType(10, 2)))
+    data = {"x": pa.array([Decimal("1.23"), Decimal("-1.23")], type=pa.decimal128(10, 2))}
+    out = run([E.ScalarFunction("ceil", [col("x")]),
+               E.ScalarFunction("floor", [col("x")])], data, schema)
+    assert out["c0"] == [2, -1]
+    assert out["c1"] == [1, -2]
+    # round with negative scale on ints
+    out = run([E.ScalarFunction("round", [col("a"), lit(-2, T.I32)])],
+              {"a": pa.array([123, 4567, -250], type=pa.int64())})
+    assert out["c0"] == [100, 4600, -300]
+    # lpad with multi-char fill
+    out = run([E.ScalarFunction("lpad", [col("s"), lit(5, T.I32), lit("xy", T.STRING)])],
+              {"s": pa.array(["ab", "abcdef"])})
+    assert out["c0"] == ["xyxab", "abcde"]
+    # BCE date round trip
+    import jax.numpy as jnp
+    from blaze_tpu.exprs.functions import civil_from_days, days_from_civil
+    y = jnp.array([-2]); m = jnp.array([3]); d = jnp.array([1])
+    days = days_from_civil(y, m, d)
+    yy, mm, dd = civil_from_days(days)
+    assert (int(yy[0]), int(mm[0]), int(dd[0])) == (-2, 3, 1)
