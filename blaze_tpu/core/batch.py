@@ -171,6 +171,8 @@ class HostColumn(Column):
 
 
 def _arrow_to_column(arr: pa.Array, dt: T.DataType, capacity: int) -> Column:
+    from blaze_tpu.utils.device import is_device_dtype
+
     if isinstance(arr, pa.ChunkedArray):
         arr = arr.combine_chunks()
     n = len(arr)
@@ -180,7 +182,7 @@ def _arrow_to_column(arr: pa.Array, dt: T.DataType, capacity: int) -> Column:
         validity = unpack_bitmap(arr.buffers()[0], n, arr.offset)
         values = _decimal128_lo64(arr)
         return DeviceColumn.from_numpy(dt, values, validity, capacity)
-    if dt.is_fixed_width and not isinstance(dt, T.DecimalType):
+    if is_device_dtype(dt) and not isinstance(dt, T.DecimalType):
         validity = ~np.asarray(arr.is_null()) if arr.null_count else np.ones(n, dtype=bool)
         if isinstance(dt, T.BooleanType):
             values = unpack_bitmap(arr.buffers()[1], n, arr.offset)
@@ -246,12 +248,12 @@ class ColumnarBatch:
 
     @staticmethod
     def empty(schema: T.Schema, capacity: Optional[int] = None) -> "ColumnarBatch":
+        from blaze_tpu.utils.device import is_device_dtype
+
         cap = capacity or get_config().min_capacity
         cols: List[Column] = []
         for f in schema.fields:
-            if f.dtype.is_fixed_width and not (
-                isinstance(f.dtype, T.DecimalType) and not f.dtype.fits_int64
-            ):
+            if is_device_dtype(f.dtype):
                 cols.append(
                     DeviceColumn(
                         f.dtype,

@@ -58,9 +58,9 @@ class ExprError(Exception):
 
 
 def _is_device_type(dt: T.DataType) -> bool:
-    if isinstance(dt, T.DecimalType):
-        return dt.fits_int64
-    return dt.is_fixed_width
+    from blaze_tpu.utils.device import is_device_dtype
+
+    return is_device_dtype(dt)
 
 
 def _is_float(dt: T.DataType) -> bool:
@@ -324,8 +324,36 @@ class ExprEvaluator:
         }
         if op in fns:
             return HostVal(T.BOOL, fns[op](la, ra))
+        if op == B.AND:
+            return HostVal(T.BOOL, pc.and_kleene(la, ra))
+        if op == B.OR:
+            return HostVal(T.BOOL, pc.or_kleene(la, ra))
         if op == B.ADD and pa.types.is_large_string(la.type):
             return HostVal(T.STRING, pc.binary_join_element_wise(la, ra, pa.scalar("", type=pa.large_utf8())))
+        if pa.types.is_floating(la.type) or pa.types.is_floating(ra.type):
+            # exact f64 arithmetic on host (TPU demotes device f64 to f32)
+            lv = la.fill_null(0).to_numpy(zero_copy_only=False).astype(np.float64)
+            rv = ra.fill_null(0).to_numpy(zero_copy_only=False).astype(np.float64)
+            valid = (~np.asarray(pc.is_null(la))) & (~np.asarray(pc.is_null(ra)))
+            with np.errstate(all="ignore"):
+                if op == B.ADD:
+                    out = lv + rv
+                elif op == B.SUB:
+                    out = lv - rv
+                elif op == B.MUL:
+                    out = lv * rv
+                elif op == B.DIV:
+                    valid = valid & (rv != 0)
+                    out = lv / np.where(rv == 0, 1.0, rv)
+                elif op == B.MOD:
+                    valid = valid & (rv != 0)
+                    den = np.where(rv == 0, 1.0, rv)
+                    out = lv - np.trunc(lv / den) * den
+                else:
+                    raise ExprError(f"unsupported host float op {op}")
+            res_t = T.F64
+            return HostVal(res_t, pa.Array.from_pandas(out, mask=~valid,
+                                                       type=pa.float64()))
         raise ExprError(f"unsupported host binary op {op} on {la.type}")
 
     # -- unary / predicates ---------------------------------------------------

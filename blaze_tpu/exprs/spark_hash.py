@@ -426,6 +426,33 @@ def hash_batch(columns, num_rows: int, capacity: int, seed: int = 42,
             arr = col.array
             import pyarrow as pa
 
+            from blaze_tpu.ir import types as T
+
+            if pa.types.is_decimal(arr.type):
+                # Spark hashes wide decimals (p > 18) as the minimal
+                # big-endian two's-complement bytes of the unscaled
+                # BigInteger (java BigInteger.toByteArray)
+                scale = arr.type.scale
+                chunks, validity = [], []
+                for d in arr.to_pylist():
+                    if d is None:
+                        validity.append(False)
+                        chunks.append(b"")
+                    else:
+                        validity.append(True)
+                        u = int(d.scaleb(scale))
+                        nbytes = (u + (u < 0)).bit_length() // 8 + 1
+                        chunks.append(u.to_bytes(nbytes, "big", signed=True))
+                offsets = np.zeros(len(chunks) + 1, dtype=np.int64)
+                np.cumsum([len(b) for b in chunks], out=offsets[1:])
+                data = np.frombuffer(b"".join(chunks), dtype=np.uint8)
+                validity = np.array(validity, dtype=bool)
+                if is64:
+                    new = xxhash64_bytes_np(offsets, data, h)
+                else:
+                    new = murmur3_bytes_np(offsets, data, h)
+                h_host = np.where(validity, new, h)
+                continue
             if not (pa.types.is_large_string(arr.type) or pa.types.is_large_binary(arr.type)):
                 arr = arr.cast(pa.large_binary())
             offsets = np.frombuffer(arr.buffers()[1], dtype=np.int64,

@@ -266,9 +266,10 @@ class AggFunction(enum.Enum):
 
 
 class AggMode(enum.Enum):
-    PARTIAL = "partial"
-    PARTIAL_MERGE = "partial_merge"
-    FINAL = "final"
+    PARTIAL = "partial"          # raw input -> state output
+    PARTIAL_MERGE = "partial_merge"  # state input -> state output
+    FINAL = "final"              # state input -> value output
+    COMPLETE = "complete"        # raw input -> value output (single stage)
 
 
 class AggExecMode(enum.Enum):

@@ -293,17 +293,17 @@ class AggColumn:
 
 @dataclasses.dataclass
 class Agg(PlanNode):
-    """Hash/sort aggregation. Partial mode outputs grouping columns plus a
-    single opaque agg-state column named AGG_STATE_COL (the reference appends
-    binary column ``#9223372036854775807`` — agg/mod.rs:37, agg_ctx.rs:140)."""
+    """Hash/sort aggregation. Partial mode outputs grouping columns plus
+    *typed* per-agg state columns (named ``<agg>#<field>``) — a columnar
+    re-design of the reference's single opaque binary state column
+    ``#9223372036854775807`` (agg/mod.rs:37, agg_ctx.rs:140); see
+    blaze_tpu/ops/aggfns.py module docs for why."""
 
     child: PlanNode
     exec_mode: E.AggExecMode
     groupings: List[Tuple[str, E.Expr]]  # (output name, grouping expr)
     aggs: List[AggColumn]
     supports_partial_skipping: bool = False
-
-    AGG_STATE_COL = f"#{2**63 - 1}"
 
     @property
     def is_partial_output(self) -> bool:
@@ -312,17 +312,18 @@ class Agg(PlanNode):
         )
 
     @property
+    def input_is_partial(self) -> bool:
+        return bool(self.aggs) and all(
+            a.mode in (E.AggMode.PARTIAL_MERGE, E.AggMode.FINAL) for a in self.aggs
+        )
+
+    @property
     def output_schema(self):
-        ischema = self.child.output_schema
-        gfields = [
-            T.StructField(n, E.infer_type(e, ischema)) for n, e in self.groupings
-        ]
-        if self.is_partial_output:
-            return T.Schema(tuple(gfields) + (T.StructField(self.AGG_STATE_COL, T.BINARY),))
-        afields = [
-            T.StructField(a.name, E.infer_type(a.agg, ischema)) for a in self.aggs
-        ]
-        return T.Schema(tuple(gfields + afields))
+        from blaze_tpu.ir.aggstate import agg_output_schema
+
+        return agg_output_schema(self.child.output_schema, self.groupings,
+                                 self.aggs, self.input_is_partial,
+                                 self.is_partial_output)
 
 
 @dataclasses.dataclass

@@ -1,0 +1,161 @@
+"""Central memory manager with fair-share spill.
+
+Reference: ``datafusion-ext-plans/src/memmgr/mod.rs:36-457`` — a singleton
+managing registered ``MemConsumer``s; on usage updates it computes the
+per-consumer fair share ``total_managed / num_spillables`` and decides
+Spill / Wait / Nothing. Spills go to (JVM heap | disk) behind the ``Spill``
+trait (``memmgr/spill.rs``); here they go to compressed disk files (the
+device->host hop happens when the consumer serializes its state).
+
+Used by sort/agg/join/shuffle operators: they register as consumers, call
+``acquire``/``update`` as their state grows, and implement ``spill()``.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from typing import BinaryIO, List, Optional
+
+from blaze_tpu.config import Config, get_config
+
+
+class MemConsumer:
+    """Base for spillable operator state (reference: MemConsumer trait)."""
+
+    def __init__(self, name: str, spillable: bool = True):
+        self.name = name
+        self.spillable = spillable
+        self.mem_used = 0
+        self._manager: Optional["MemManager"] = None
+
+    def spill(self) -> int:
+        """Release memory by spilling state to disk; returns bytes freed."""
+        raise NotImplementedError
+
+    def update_mem_used(self, new_used: int):
+        if self._manager is not None:
+            self._manager.update(self, new_used)
+        else:
+            self.mem_used = new_used
+
+
+class MemManager:
+    _instance: Optional["MemManager"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, total: int):
+        self.total = total
+        self.consumers: List[MemConsumer] = []
+        self._mu = threading.RLock()
+        self.total_spilled_bytes = 0
+        self.spill_count = 0
+
+    # -- lifecycle ------------------------------------------------------------
+
+    @classmethod
+    def get_or_init(cls, conf: Optional[Config] = None) -> "MemManager":
+        with cls._lock:
+            if cls._instance is None:
+                conf = conf or get_config()
+                total = conf.memory_total
+                if total is None:
+                    try:
+                        pages = os.sysconf("SC_PHYS_PAGES")
+                        page = os.sysconf("SC_PAGE_SIZE")
+                        total = pages * page
+                    except (ValueError, OSError):
+                        total = 8 << 30
+                cls._instance = cls(int(total * conf.memory_fraction))
+            return cls._instance
+
+    @classmethod
+    def reset(cls):
+        with cls._lock:
+            cls._instance = None
+
+    def register(self, consumer: MemConsumer):
+        with self._mu:
+            consumer._manager = self
+            self.consumers.append(consumer)
+
+    def unregister(self, consumer: MemConsumer):
+        with self._mu:
+            consumer._manager = None
+            consumer.mem_used = 0
+            if consumer in self.consumers:
+                self.consumers.remove(consumer)
+
+    # -- accounting -----------------------------------------------------------
+
+    @property
+    def used(self) -> int:
+        with self._mu:
+            return sum(c.mem_used for c in self.consumers)
+
+    def fair_share(self) -> int:
+        with self._mu:
+            n = sum(1 for c in self.consumers if c.spillable) or 1
+            return self.total // n
+
+    def update(self, consumer: MemConsumer, new_used: int):
+        """Record new usage; trigger spills when over budget (reference:
+        MemManager::update_consumer_mem_used decision logic)."""
+        with self._mu:
+            consumer.mem_used = new_used
+            if self.used <= self.total:
+                return
+            share = self.fair_share()
+            # spill the over-share spillable consumers, largest first
+            over = sorted(
+                (c for c in self.consumers if c.spillable and c.mem_used > share),
+                key=lambda c: -c.mem_used,
+            )
+            for c in over:
+                if self.used <= self.total:
+                    break
+                freed = c.spill()
+                self.spill_count += 1
+                self.total_spilled_bytes += freed
+                c.mem_used = max(0, c.mem_used - freed)
+
+
+class SpillFile:
+    """One spill: a compressed batch stream in the spill dir (reference:
+    Spill trait + try_new_spill; we always use the disk backend)."""
+
+    def __init__(self, prefix: str = "spill"):
+        cfg = get_config()
+        os.makedirs(cfg.spill_dir, exist_ok=True)
+        fd, self.path = tempfile.mkstemp(prefix=prefix + "-", dir=cfg.spill_dir)
+        self._file: Optional[BinaryIO] = os.fdopen(fd, "w+b")
+        from blaze_tpu.io.batch_serde import BatchWriter
+
+        self.writer = BatchWriter(self._file, codec=cfg.spill_compression_codec)
+
+    def finish_write(self):
+        self._file.flush()
+
+    def read_batches(self):
+        from blaze_tpu.io.batch_serde import BatchReader
+
+        self._file.seek(0)
+        return BatchReader(self._file)
+
+    @property
+    def size(self) -> int:
+        return self.writer.bytes_written
+
+    def release(self):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+        if os.path.exists(self.path):
+            os.unlink(self.path)
+
+    def __del__(self):
+        try:
+            self.release()
+        except Exception:
+            pass

@@ -1,0 +1,66 @@
+"""Metric tree mirroring the plan tree.
+
+Reference: JVM ``MetricNode`` (MetricNode.scala) mirrored by the native
+``ExecutionPlanMetricsSet`` and pushed back at task end
+(``auron/src/metrics.rs``). Canonical names follow
+``NativeHelper.getDefaultNativeMetrics:94-125``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+
+class MetricNode:
+    def __init__(self, name: str, children: Optional[List["MetricNode"]] = None):
+        self.name = name
+        self.children = children or []
+        self.values: Dict[str, int] = {}
+
+    def add(self, metric: str, value: int):
+        self.values[metric] = self.values.get(metric, 0) + int(value)
+
+    def set(self, metric: str, value: int):
+        self.values[metric] = int(value)
+
+    def get(self, metric: str) -> int:
+        return self.values.get(metric, 0)
+
+    def child(self, i: int) -> "MetricNode":
+        while len(self.children) <= i:
+            self.children.append(MetricNode(f"{self.name}.child{len(self.children)}"))
+        return self.children[i]
+
+    def timer(self, metric: str) -> "Timer":
+        return Timer(self, metric)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "values": dict(self.values),
+            "children": [c.to_dict() for c in self.children],
+        }
+
+    def total(self, metric: str) -> int:
+        return self.get(metric) + sum(c.total(metric) for c in self.children)
+
+
+class Timer:
+    """Accumulates nanoseconds into a metric. The reference subtracts
+    downstream send-wait so self-time is accurate
+    (WrappedSender.exclude_time, execution_context.rs:705-730); here operator
+    generators naturally exclude consumer time because timing stops at yield.
+    """
+
+    def __init__(self, node: MetricNode, metric: str):
+        self.node = node
+        self.metric = metric
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        self.node.add(self.metric, time.perf_counter_ns() - self._t0)
+        return False

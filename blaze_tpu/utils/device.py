@@ -1,0 +1,42 @@
+"""Platform capability probes.
+
+TPU v5e has no native 64-bit: int64 is emulated exactly via 32-bit pairs
+(safe for decimals/longs/hashes), but **float64 is silently demoted to f32**
+(1e308 -> inf, 1e17+1 == 1e17). A Spark-exact engine cannot tolerate that,
+so the single choke point ``is_device_dtype`` routes Float64 columns to host
+(exact numpy compute) whenever the backend lacks real f64 — on CPU backends
+doubles stay on device. Everything that decides device-vs-host placement
+(batch construction, the expression compiler, agg accumulators, sort) must
+consult these helpers, never ``dtype.is_fixed_width`` directly.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from blaze_tpu.ir import types as T
+
+
+@functools.cache
+def supports_f64() -> bool:
+    import jax
+    import jax.numpy as jnp
+
+    if not jax.config.jax_enable_x64:
+        return False
+    try:
+        x = np.asarray(jnp.asarray(np.array([1e308], dtype=np.float64)))
+        return bool(np.isfinite(x[0]))
+    except Exception:
+        return False
+
+
+def is_device_dtype(dt: T.DataType) -> bool:
+    """Can a column of this type live on device with exact semantics?"""
+    if isinstance(dt, T.DecimalType):
+        return dt.fits_int64
+    if isinstance(dt, T.Float64Type):
+        return supports_f64()
+    return dt.is_fixed_width

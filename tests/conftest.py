@@ -4,8 +4,10 @@ its native-operator tests without a JVM; we run ours without a TPU)."""
 
 import os
 
-# Must be set before jax import.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax import. Force CPU: the suite validates semantics and
+# the 8-device sharding paths; TPU-specific behavior is covered by
+# scripts/tpu_smoke.py driven on real hardware.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -13,6 +15,10 @@ if "xla_force_host_platform_device_count" not in xla_flags:
     ).strip()
 
 import jax  # noqa: E402
+
+# The axon TPU plugin force-registers itself via jax.config at import time,
+# overriding JAX_PLATFORMS from the environment — pin the config directly.
+jax.config.update("jax_platforms", "cpu")
 
 import blaze_tpu  # noqa: E402,F401  (enables x64)
 

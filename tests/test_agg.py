@@ -1,0 +1,275 @@
+import numpy as np
+import pyarrow as pa
+import pytest
+from decimal import Decimal
+
+from blaze_tpu.config import config_override
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import nodes as N
+from blaze_tpu.ir import types as T
+from blaze_tpu.ops.agg import AggExec
+from blaze_tpu.ops.sort import SortExec
+from blaze_tpu.runtime.memmgr import MemManager
+from tests.util import collect, collect_pydict, mem_scan
+
+
+def col(n):
+    return E.Column(n)
+
+
+def agg_col(fn, args, mode, name, return_type=None):
+    return N.AggColumn(E.AggExpr(fn, args, return_type), mode, name)
+
+
+def _sorted_out(op, by):
+    tbl = collect(op).to_pydict()
+    order = sorted(range(len(tbl[by])), key=lambda i: (tbl[by][i] is None, tbl[by][i]))
+    return {k: [v[i] for i in order] for k, v in tbl.items()}
+
+
+F = E.AggFunction
+M = E.AggMode
+HASH = E.AggExecMode.HASH_AGG
+
+
+def test_final_agg_basic():
+    scan = mem_scan(
+        {
+            "k": pa.array([1, 2, 1, 2, 1], type=pa.int64()),
+            "v": pa.array([10, 20, 30, None, 50], type=pa.int64()),
+        },
+        num_batches=2,
+    )
+    op = AggExec(scan, HASH, [("k", col("k"))], [
+        agg_col(F.SUM, [col("v")], M.COMPLETE, "s"),
+        agg_col(F.COUNT, [col("v")], M.COMPLETE, "c"),
+        agg_col(F.MIN, [col("v")], M.COMPLETE, "mn"),
+        agg_col(F.MAX, [col("v")], M.COMPLETE, "mx"),
+        agg_col(F.AVG, [col("v")], M.COMPLETE, "a"),
+    ])
+    out = _sorted_out(op, "k")
+    assert out["k"] == [1, 2]
+    assert out["s"] == [90, 20]
+    assert out["c"] == [3, 1]
+    assert out["mn"] == [10, 20]
+    assert out["mx"] == [50, 20]
+    assert out["a"] == [30.0, 20.0]
+
+
+def test_partial_then_final_two_stage():
+    data = {
+        "k": pa.array(["x", "y", "x", None], type=pa.string()),
+        "v": pa.array([1.5, 2.5, 3.0, 4.0], type=pa.float64()),
+    }
+    scan = mem_scan(data, num_batches=2)
+    partial = AggExec(scan, HASH, [("k", col("k"))], [
+        agg_col(F.SUM, [col("v")], M.PARTIAL, "s"),
+        agg_col(F.AVG, [col("v")], M.PARTIAL, "a"),
+        agg_col(F.COUNT, [], M.PARTIAL, "c"),
+    ])
+    # partial output schema: k + typed state cols
+    assert partial.schema.names == ["k", "s#sum", "s#has", "a#sum", "a#count", "c#count"]
+    final = AggExec(partial, HASH, [("k", col("k"))], [
+        agg_col(F.SUM, [col("v")], M.FINAL, "s"),
+        agg_col(F.AVG, [col("v")], M.FINAL, "a"),
+        agg_col(F.COUNT, [], M.FINAL, "c"),
+    ])
+    out = _sorted_out(final, "k")
+    assert out["k"] == ["x", "y", None]
+    assert out["s"] == [4.5, 2.5, 4.0]
+    assert out["a"] == [2.25, 2.5, 4.0]
+    assert out["c"] == [2, 1, 1]
+
+
+def test_global_agg_no_groups():
+    scan = mem_scan({"v": pa.array([1, 2, 3], type=pa.int64())})
+    op = AggExec(scan, HASH, [], [
+        agg_col(F.SUM, [col("v")], M.COMPLETE, "s"),
+        agg_col(F.COUNT, [], M.COMPLETE, "c"),
+    ])
+    out = collect_pydict(op)
+    assert out == {"s": [6], "c": [3]}
+
+
+def test_global_agg_empty_input():
+    scan = mem_scan({"v": pa.array([], type=pa.int64())})
+    op = AggExec(scan, HASH, [], [
+        agg_col(F.SUM, [col("v")], M.COMPLETE, "s"),
+        agg_col(F.COUNT, [], M.COMPLETE, "c"),
+    ])
+    out = collect_pydict(op)
+    assert out == {"s": [None], "c": [0]}
+
+
+def test_decimal_sum_avg():
+    schema = T.Schema.of(("k", T.I32), ("v", T.DecimalType(7, 2)))
+    data = {
+        "k": pa.array([1, 1, 2], type=pa.int32()),
+        "v": pa.array([Decimal("1.10"), Decimal("2.05"), None], type=pa.decimal128(7, 2)),
+    }
+    scan = mem_scan(data, schema)
+    op = AggExec(scan, HASH, [("k", col("k"))], [
+        agg_col(F.SUM, [col("v")], M.COMPLETE, "s", T.DecimalType(17, 2)),
+        agg_col(F.AVG, [col("v")], M.COMPLETE, "a", T.DecimalType(11, 6)),
+    ])
+    out = _sorted_out(op, "k")
+    assert out["s"] == [Decimal("3.15"), None]
+    assert out["a"] == [Decimal("1.575000"), None]
+
+
+def test_first_and_collect():
+    data = {
+        "k": pa.array([1, 1, 2, 2], type=pa.int64()),
+        "v": pa.array([None, 7, 8, 9], type=pa.int64()),
+        "s": pa.array(["a", "b", "c", "c"]),
+    }
+    scan = mem_scan(data, num_batches=2)
+    op = AggExec(scan, HASH, [("k", col("k"))], [
+        agg_col(F.FIRST, [col("v")], M.COMPLETE, "f"),
+        agg_col(F.FIRST_IGNORES_NULL, [col("v")], M.COMPLETE, "fi"),
+        agg_col(F.COLLECT_LIST, [col("s")], M.COMPLETE, "cl"),
+        agg_col(F.COLLECT_SET, [col("s")], M.COMPLETE, "cs"),
+    ])
+    out = _sorted_out(op, "k")
+    assert out["f"] == [None, 8]
+    assert out["fi"] == [7, 8]
+    assert out["cl"] == [["a", "b"], ["c", "c"]]
+    assert out["cs"] == [["a", "b"], ["c"]]
+
+
+def test_min_max_strings():
+    data = {"k": pa.array([1, 1, 2], type=pa.int64()),
+            "s": pa.array(["pear", "apple", None])}
+    scan = mem_scan(data)
+    op = AggExec(scan, HASH, [("k", col("k"))], [
+        agg_col(F.MIN, [col("s")], M.COMPLETE, "mn"),
+        agg_col(F.MAX, [col("s")], M.COMPLETE, "mx"),
+    ])
+    out = _sorted_out(op, "k")
+    assert out["mn"] == ["apple", None]
+    assert out["mx"] == ["pear", None]
+
+
+def test_agg_spill():
+    rng = np.random.default_rng(0)
+    n = 30_000
+    keys = rng.integers(0, 5000, size=n)
+    vals = rng.integers(0, 100, size=n)
+    scan = mem_scan({"k": keys.tolist(), "v": vals.tolist()}, num_batches=12)
+    MemManager.reset()
+    with config_override(memory_total=1_500_000, memory_fraction=1.0):
+        op = AggExec(scan, HASH, [("k", col("k"))], [
+            agg_col(F.SUM, [col("v")], M.COMPLETE, "s"),
+            agg_col(F.COUNT, [], M.COMPLETE, "c"),
+        ])
+        out = _sorted_out(op, "k")
+    MemManager.reset()
+    import collections
+
+    expected_sum = collections.defaultdict(int)
+    expected_cnt = collections.defaultdict(int)
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        expected_sum[k] += v
+        expected_cnt[k] += 1
+    ks = sorted(expected_sum)
+    assert out["k"] == ks
+    assert out["s"] == [expected_sum[k] for k in ks]
+    assert out["c"] == [expected_cnt[k] for k in ks]
+
+
+def test_partial_skipping_passthrough():
+    # high-cardinality keys -> skipper engages, output stays correct after
+    # a final agg over the partials
+    n = 60_000
+    data = {"k": list(range(n)), "v": [1] * n}
+    scan = mem_scan(data, num_batches=8)
+    with config_override(partial_agg_skipping_min_rows=10_000):
+        partial = AggExec(scan, HASH, [("k", col("k"))],
+                          [agg_col(F.SUM, [col("v")], M.PARTIAL, "s")],
+                          supports_partial_skipping=True)
+        final = AggExec(partial, HASH, [("k", col("k"))],
+                        [agg_col(F.SUM, [col("v")], M.FINAL, "s")])
+        out = collect_pydict(final)
+    assert len(out["k"]) == n
+    assert sum(out["s"]) == n
+
+
+def test_bloom_filter_agg_and_probe():
+    scan = mem_scan({"v": pa.array([10, 20, 30], type=pa.int64())})
+    op = AggExec(scan, HASH, [], [agg_col(F.BLOOM_FILTER, [col("v")], M.COMPLETE, "bf")])
+    out = collect_pydict(op)
+    blob = out["bf"][0]
+    from blaze_tpu.ops.bloom import SparkBloomFilter
+
+    bf = SparkBloomFilter.deserialize(blob)
+    assert bf.might_contain_longs_np(np.array([10, 20, 30])).all()
+    assert not bf.might_contain_longs_np(np.arange(1000, 1100)).any()
+
+
+def test_wide_decimal_host_exact():
+    # decimal(20,2) exceeds int64 -> host object path must stay exact
+    schema = T.Schema.of(("k", T.I64), ("v", T.DecimalType(20, 2)))
+    data = {
+        "k": pa.array([1, 1, 2], type=pa.int64()),
+        "v": pa.array([Decimal("1.25"), Decimal("3.25"),
+                       Decimal("123456789012345678.99")], type=pa.decimal128(20, 2)),
+    }
+    scan = mem_scan(data, schema)
+    op = AggExec(scan, HASH, [("k", col("k"))], [
+        agg_col(F.SUM, [col("v")], M.COMPLETE, "s", T.DecimalType(30, 2)),
+        agg_col(F.AVG, [col("v")], M.COMPLETE, "a", T.DecimalType(24, 6)),
+        agg_col(F.MIN, [col("v")], M.COMPLETE, "mn"),
+        agg_col(F.MAX, [col("v")], M.COMPLETE, "mx"),
+    ])
+    out = _sorted_out(op, "k")
+    assert out["s"] == [Decimal("4.50"), Decimal("123456789012345678.99")]
+    assert out["a"] == [Decimal("2.250000"), Decimal("123456789012345678.990000")]
+    assert out["mn"] == [Decimal("1.25"), Decimal("123456789012345678.99")]
+    assert out["mx"] == [Decimal("3.25"), Decimal("123456789012345678.99")]
+
+
+def test_host_state_spill_reorder():
+    # spilled aggregation with a host-state fn: per-group values must follow
+    # their keys through the key-sorted spill emit
+    rng = np.random.default_rng(3)
+    n = 4000
+    keys = rng.integers(0, 500, size=n).tolist()
+    svals = [f"s{k:04d}-{i}" for i, k in enumerate(keys)]
+    scan = mem_scan({"k": keys, "s": svals}, num_batches=6)
+    MemManager.reset()
+    with config_override(memory_total=200_000, memory_fraction=1.0):
+        op = AggExec(scan, HASH, [("k", col("k"))], [
+            agg_col(F.MIN, [col("s")], M.COMPLETE, "mn"),
+            agg_col(F.SUM, [col("k")], M.COMPLETE, "ks"),
+        ])
+        out = _sorted_out(op, "k")
+    MemManager.reset()
+    import collections
+
+    exp_min = {}
+    exp_sum = collections.defaultdict(int)
+    for k, s in zip(keys, svals):
+        exp_min[k] = min(exp_min.get(k, s), s)
+        exp_sum[k] += k
+    ks = sorted(exp_min)
+    assert out["k"] == ks
+    assert out["mn"] == [exp_min[k] for k in ks]
+    assert out["ks"] == [exp_sum[k] for k in ks]
+
+
+def test_hash_wide_decimal_matches_binary():
+    from blaze_tpu.core.batch import HostColumn
+    from blaze_tpu.exprs import spark_hash as H
+
+    arr = pa.array([Decimal("12345678901234567890.12"), None],
+                   type=pa.decimal128(22, 2))
+    colh = HostColumn(T.DecimalType(22, 2), arr)
+    out = H.hash_batch([colh], 2, 256, seed=42)
+    # row hashing as BigInteger bytes: second row (null) keeps the seed
+    assert out[1] == 42
+    u = int(Decimal("12345678901234567890.12").scaleb(2))
+    nbytes = (u.bit_length() // 8) + 1
+    blob = u.to_bytes(nbytes, "big", signed=True)
+    import tests.test_spark_hash as tsh
+
+    assert out[0] == np.uint32(tsh.mmh3_scalar(blob, 42)).astype(np.int32)

@@ -1,0 +1,101 @@
+import numpy as np
+import pyarrow as pa
+
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import types as T
+from blaze_tpu.ops.basic import (
+    CoalesceBatchesExec,
+    DebugExec,
+    EmptyPartitionsExec,
+    ExpandExec,
+    FilterExec,
+    LimitExec,
+    ProjectExec,
+    RenameColumnsExec,
+    UnionExec,
+)
+from tests.util import collect_pydict, mem_scan, run_op
+
+
+def col(n):
+    return E.Column(n)
+
+
+def lit(v, t):
+    return E.Literal(v, t)
+
+
+def test_project():
+    scan = mem_scan({"a": pa.array([1, 2, 3], type=pa.int64())})
+    op = ProjectExec(scan, [E.BinaryExpr(E.BinaryOp.ADD, col("a"), lit(10, T.I64))], ["b"])
+    assert collect_pydict(op) == {"b": [11, 12, 13]}
+    assert op.schema.names == ["b"]
+
+
+def test_filter():
+    scan = mem_scan(
+        {"a": pa.array([1, None, 5, 7], type=pa.int64()), "s": pa.array(["w", "x", "y", "z"])},
+        num_batches=2,
+    )
+    op = FilterExec(scan, [E.BinaryExpr(E.BinaryOp.GT, col("a"), lit(2, T.I64))])
+    out = collect_pydict(op)
+    assert out == {"a": [5, 7], "s": ["y", "z"]}
+
+
+def test_filter_project_fusion():
+    scan = mem_scan({"a": pa.array([1, 5], type=pa.int64())})
+    op = FilterExec(
+        scan,
+        [E.BinaryExpr(E.BinaryOp.GT, col("a"), lit(2, T.I64))],
+        projection=([E.BinaryExpr(E.BinaryOp.MUL, col("a"), lit(2, T.I64))], ["a2"]),
+    )
+    assert collect_pydict(op) == {"a2": [10]}
+
+
+def test_limit():
+    scan = mem_scan({"a": list(range(10))}, num_batches=3)
+    op = LimitExec(scan, 5)
+    assert collect_pydict(op) == {"a": [0, 1, 2, 3, 4]}
+
+
+def test_coalesce_batches():
+    scan = mem_scan({"a": list(range(20))}, num_batches=10)
+    op = CoalesceBatchesExec(scan, batch_size=8)
+    from tests.util import run_op
+
+    batches = run_op(op)
+    assert [b.num_rows for b in batches] == [8, 8, 4]
+    assert sum(b.num_rows for b in batches) == 20
+
+
+def test_rename_and_debug():
+    scan = mem_scan({"a": [1], "b": ["x"]})
+    op = DebugExec(RenameColumnsExec(scan, ["c1", "c2"]), "t")
+    out = collect_pydict(op)
+    assert out == {"c1": [1], "c2": ["x"]}
+
+
+def test_union():
+    s1 = mem_scan({"a": [1, 2]})
+    s2 = mem_scan({"a": [3]})
+    op = UnionExec([s1, s2], num_partitions=2)
+    assert collect_pydict(op) == {"a": [1, 2, 3]}
+
+
+def test_empty_partitions():
+    op = EmptyPartitionsExec(T.Schema.of(("a", T.I64)), 3)
+    assert op.num_partitions() == 3
+    assert collect_pydict(op) == {"a": []}
+
+
+def test_expand():
+    scan = mem_scan({"a": pa.array([1, 2], type=pa.int64())})
+    schema = T.Schema.of(("a", T.I64), ("tag", T.I64))
+    op = ExpandExec(
+        scan,
+        [[col("a"), lit(0, T.I64)], [E.BinaryExpr(E.BinaryOp.MUL, col("a"), lit(10, T.I64)), lit(1, T.I64)]],
+        schema,
+    )
+    out = collect_pydict(op)
+    assert out["a"] == [1, 2, 10, 20]
+    assert out["tag"] == [0, 0, 1, 1]

@@ -1,0 +1,95 @@
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from blaze_tpu.config import config_override
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import types as T
+from blaze_tpu.ops.sort import SortExec
+from blaze_tpu.runtime.memmgr import MemManager
+from tests.util import collect_pydict, mem_scan
+
+
+def so(name, asc=True, nulls_first=True):
+    return E.SortOrder(E.Column(name), asc, nulls_first)
+
+
+def test_sort_ints_asc_desc():
+    data = {"a": pa.array([3, 1, None, 2], type=pa.int64()), "b": pa.array(list("wxyz"))}
+    out = collect_pydict(SortExec(mem_scan(data), [so("a")]))
+    assert out["a"] == [None, 1, 2, 3]
+    assert out["b"] == ["y", "x", "z", "w"]
+    out = collect_pydict(SortExec(mem_scan(data), [so("a", asc=False, nulls_first=False)]))
+    assert out["a"] == [3, 2, 1, None]
+
+
+def test_sort_multi_key():
+    data = {
+        "a": pa.array([1, 2, 1, 2], type=pa.int64()),
+        "b": pa.array([9.0, 1.0, 3.0, None], type=pa.float64()),
+    }
+    out = collect_pydict(SortExec(mem_scan(data, num_batches=2),
+                                  [so("a"), so("b", asc=False, nulls_first=False)]))
+    assert out["a"] == [1, 1, 2, 2]
+    assert out["b"] == [9.0, 3.0, 1.0, None]
+
+
+def test_sort_floats_nan_largest():
+    data = {"a": pa.array([1.5, float("nan"), -0.0, None, 1e308], type=pa.float64())}
+    out = collect_pydict(SortExec(mem_scan(data), [so("a", nulls_first=False)]))
+    assert out["a"][:3] == [-0.0, 1.5, 1e308]
+    assert out["a"][3] != out["a"][3]  # NaN before nulls-last
+    assert out["a"][4] is None
+
+
+def test_sort_strings_host_path():
+    data = {"s": pa.array(["pear", "apple", None, "fig"])}
+    out = collect_pydict(SortExec(mem_scan(data), [so("s")]))
+    assert out["s"] == [None, "apple", "fig", "pear"]
+
+
+def test_sort_dates_and_decimals():
+    import datetime
+    from decimal import Decimal
+
+    data = {
+        "d": pa.array([datetime.date(2020, 5, 1), datetime.date(1999, 1, 1), None],
+                      type=pa.date32()),
+        "m": pa.array([Decimal("1.10"), Decimal("-2.50"), Decimal("0.00")],
+                      type=pa.decimal128(9, 2)),
+    }
+    out = collect_pydict(SortExec(mem_scan(data), [so("d", nulls_first=False)]))
+    assert out["d"] == [datetime.date(1999, 1, 1), datetime.date(2020, 5, 1), None]
+    out = collect_pydict(SortExec(mem_scan(data), [so("m")]))
+    assert out["m"] == [Decimal("-2.50"), Decimal("0.00"), Decimal("1.10")]
+
+
+def test_topk():
+    rng = np.random.default_rng(0)
+    vals = rng.integers(0, 10_000, size=5000).tolist()
+    out = collect_pydict(SortExec(mem_scan({"a": vals}, num_batches=7),
+                                  [so("a")], fetch_limit=10))
+    assert out["a"] == sorted(vals)[:10]
+
+
+def test_external_sort_with_spill():
+    rng = np.random.default_rng(1)
+    vals = rng.integers(-(10**9), 10**9, size=20_000).tolist()
+    MemManager.reset()
+    with config_override(memory_total=2_000_000, memory_fraction=1.0):
+        out = collect_pydict(
+            SortExec(mem_scan({"a": vals}, num_batches=10), [so("a")]))
+    MemManager.reset()
+    assert out["a"] == sorted(vals)
+    assert len(out["a"]) == 20_000
+
+
+def test_external_sort_strings_with_spill():
+    rng = np.random.default_rng(2)
+    vals = ["s" + str(rng.integers(0, 10**6)) for _ in range(5000)]
+    MemManager.reset()
+    with config_override(memory_total=300_000, memory_fraction=1.0):
+        out = collect_pydict(
+            SortExec(mem_scan({"s": vals}, num_batches=8), [so("s")]))
+    MemManager.reset()
+    assert out["s"] == sorted(vals)
