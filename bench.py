@@ -1,0 +1,127 @@
+"""Benchmark: TPC-DS q01-class pipeline (scan -> filter -> two-stage hash
+aggregate over an exchange -> top-k), the reference's headline workload shape
+(BASELINE.md config 1).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` is speedup vs a CPU columnar baseline (pandas/arrow doing the
+identical query over the same parquet files) — the stand-in for Blaze-CPU
+until the reference's absolute numbers are recorded (the reference repo
+publishes none, see BASELINE.md).
+
+Env knobs: BENCH_ROWS (default 1_000_000), BENCH_PARTITIONS (default 4).
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+import blaze_tpu  # noqa: F401
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import nodes as N
+from blaze_tpu.ir import types as T
+
+ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
+PARTS = int(os.environ.get("BENCH_PARTITIONS", 4))
+
+
+def make_data(tmpdir: str):
+    import decimal
+
+    rng = np.random.default_rng(42)
+    paths = []
+    per = ROWS // PARTS
+    for p in range(PARTS):
+        unscaled = rng.integers(0, 10_000_00, per)
+        amt = pa.array([decimal.Decimal(int(v)).scaleb(-2) for v in unscaled],
+                       type=pa.decimal128(7, 2))
+        tbl = pa.table({
+            "sr_store_sk": pa.array(rng.integers(1, 400, per), type=pa.int64()),
+            "sr_customer_sk": pa.array(rng.integers(1, 100_000, per), type=pa.int64()),
+            "sr_return_amt": amt,
+        })
+        path = os.path.join(tmpdir, f"sr_{p}.parquet")
+        pq.write_table(tbl, path, row_group_size=128 * 1024)
+        paths.append(path)
+    return paths
+
+
+def build_plan(paths):
+    from blaze_tpu.ops.parquet import scan_node_for_files
+
+    scan = scan_node_for_files(paths, num_partitions=PARTS)
+    filt = N.Filter(scan, [E.BinaryExpr(
+        E.BinaryOp.GT, E.Column("sr_return_amt"),
+        E.Literal("500.00", T.DecimalType(7, 2)))])
+    partial = N.Agg(filt, E.AggExecMode.HASH_AGG,
+                    [("sr_store_sk", E.Column("sr_store_sk"))], [
+        N.AggColumn(E.AggExpr(E.AggFunction.SUM, [E.Column("sr_return_amt")],
+                              T.DecimalType(17, 2)), E.AggMode.PARTIAL, "total"),
+        N.AggColumn(E.AggExpr(E.AggFunction.COUNT, []), E.AggMode.PARTIAL, "cnt"),
+    ])
+    ex = N.ShuffleExchange(partial, N.HashPartitioning([E.Column("sr_store_sk")], PARTS))
+    final = N.Agg(ex, E.AggExecMode.HASH_AGG,
+                  [("sr_store_sk", E.Column("sr_store_sk"))], [
+        N.AggColumn(E.AggExpr(E.AggFunction.SUM, [E.Column("sr_return_amt")],
+                              T.DecimalType(17, 2)), E.AggMode.FINAL, "total"),
+        N.AggColumn(E.AggExpr(E.AggFunction.COUNT, []), E.AggMode.FINAL, "cnt"),
+    ])
+    single = N.ShuffleExchange(final, N.SinglePartitioning(1))
+    return N.Sort(single, [E.SortOrder(E.Column("total"), ascending=False)],
+                  fetch_limit=100)
+
+
+def run_engine(paths):
+    from blaze_tpu.runtime.session import Session
+
+    t0 = time.perf_counter()
+    sess = Session()
+    out = sess.execute_to_table(build_plan(paths))
+    t1 = time.perf_counter()
+    return t1 - t0, out
+
+
+def run_baseline(paths):
+    """CPU columnar baseline: pandas over the same parquet."""
+    import decimal
+
+    import pandas as pd
+
+    t0 = time.perf_counter()
+    df = pd.concat([pq.read_table(p).to_pandas() for p in paths])
+    df = df[df.sr_return_amt > decimal.Decimal("500.00")]
+    g = df.groupby("sr_store_sk").agg(total=("sr_return_amt", "sum"),
+                                      cnt=("sr_store_sk", "size"))
+    g = g.sort_values("total", ascending=False).head(100)
+    t1 = time.perf_counter()
+    return t1 - t0, g
+
+
+def main():
+    with tempfile.TemporaryDirectory(prefix="blaze_bench_") as tmpdir:
+        paths = make_data(tmpdir)
+        # warmup run compiles the device kernels
+        run_engine(paths)
+        engine_s, out = run_engine(paths)
+        baseline_s, base = run_baseline(paths)
+        # correctness cross-check before reporting numbers
+        od = out.to_pydict()
+        assert od["sr_store_sk"] == base.index.tolist(), "bench result mismatch"
+        assert od["total"] == base.total.tolist(), "bench sums mismatch"
+        print(json.dumps({
+            "metric": f"q01_like_{ROWS}rows_wallclock",
+            "value": round(engine_s, 3),
+            "unit": "s",
+            "vs_baseline": round(baseline_s / engine_s, 3),
+        }))
+
+
+if __name__ == "__main__":
+    main()

@@ -365,6 +365,40 @@ def xxhash64_bytes_np(offsets: np.ndarray, data: np.ndarray, seeds: np.ndarray) 
 # --------------------------------------------------------------------------
 
 
+def _dtype_is_fixed(dt) -> bool:
+    from blaze_tpu.ir import types as T
+
+    if isinstance(dt, T.DecimalType):
+        return dt.fits_int64
+    return dt.is_fixed_width
+
+
+def _host_fixed_words(arr, dt):
+    """pa fixed-width array -> (word array for hashing, validity)."""
+    import pyarrow as pa
+
+    from blaze_tpu.ir import types as T
+
+    validity = ~np.asarray(arr.is_null()) if arr.null_count else np.ones(len(arr), bool)
+    fill = False if pa.types.is_boolean(arr.type) else 0
+    vals = arr.fill_null(fill).to_numpy(zero_copy_only=False)
+    if np.issubdtype(vals.dtype, np.datetime64):
+        if isinstance(dt, T.DateType):
+            vals = vals.astype("datetime64[D]").view(np.int64).astype(np.int32)
+        else:
+            vals = vals.astype("datetime64[us]").view(np.int64)
+    elif isinstance(dt, T.DecimalType):
+        vals = np.array([int(d.scaleb(dt.scale)) if d is not None else 0
+                         for d in arr.to_pylist()], dtype=np.int64)
+    elif vals.dtype == np.bool_:
+        vals = vals.astype(np.int32)
+    elif vals.dtype == np.float64:
+        vals = vals.view(np.int64)
+    elif vals.dtype == np.float32:
+        vals = vals.view(np.int32)
+    return vals, validity
+
+
 def _dtype_kind(dt) -> str:
     from blaze_tpu.ir import types as T
 
@@ -451,6 +485,18 @@ def hash_batch(columns, num_rows: int, capacity: int, seed: int = 42,
                     new = xxhash64_bytes_np(offsets, data, h)
                 else:
                     new = murmur3_bytes_np(offsets, data, h)
+                h_host = np.where(validity, new, h)
+                continue
+            if _dtype_is_fixed(col.dtype):
+                # fixed-width values living on host (agg keys, f64-on-tpu)
+                vals, validity = _host_fixed_words(arr, col.dtype)
+                kind = _dtype_kind(col.dtype)
+                if is64:
+                    new = (xxhash64_int64_np(vals, h) if kind in ("i64", "f64")
+                           else xxhash64_int32_np(vals, h))
+                else:
+                    new = (murmur3_int64_np(vals, h) if kind in ("i64", "f64")
+                           else murmur3_int32_np(vals, h))
                 h_host = np.where(validity, new, h)
                 continue
             if not (pa.types.is_large_string(arr.type) or pa.types.is_large_binary(arr.type)):

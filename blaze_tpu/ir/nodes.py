@@ -473,6 +473,48 @@ class Union(PlanNode):
         return self.inputs[0].output_schema
 
 
+# --- driver-level exchange nodes ---------------------------------------------
+# In the reference these boundaries are orchestrated by Spark
+# (NativeShuffleExchangeBase / NativeBroadcastExchangeBase): the IR only
+# carries shuffle_writer / ipc_reader / ipc_writer. Our standalone driver
+# (runtime/session.py) accepts these higher-level nodes and lowers them to
+# exactly those primitives: a map stage of ShuffleWriter tasks + an IpcReader
+# over the produced file segments, or an IpcWriter collect + broadcast.
+
+
+@dataclasses.dataclass
+class ShuffleExchange(PlanNode):
+    child: PlanNode
+    partitioning: "Partitioning"
+
+    @property
+    def output_schema(self):
+        return self.child.output_schema
+
+
+@dataclasses.dataclass
+class BroadcastExchange(PlanNode):
+    child: PlanNode
+
+    @property
+    def output_schema(self):
+        return self.child.output_schema
+
+
+def map_children(node: PlanNode, fn):
+    """Rebuild a node with fn applied to each child plan node."""
+    changes = {}
+    for f in dataclasses.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, PlanNode):
+            changes[f.name] = fn(v)
+        elif isinstance(v, list) and v and all(isinstance(x, PlanNode) for x in v):
+            changes[f.name] = [fn(x) for x in v]
+    if not changes:
+        return node
+    return dataclasses.replace(node, **changes)
+
+
 # --- sinks / exchanges --------------------------------------------------------
 
 

@@ -68,7 +68,8 @@ def _sentinel_np(np_dtype, which: str):
 def _arr_np(arr: pa.Array, np_dtype) -> Tuple[np.ndarray, np.ndarray]:
     """pa.Array -> (values, validity) numpy pair."""
     valid = ~np.asarray(arr.is_null()) if arr.null_count else np.ones(len(arr), bool)
-    vals = arr.fill_null(0).to_numpy(zero_copy_only=False).astype(np_dtype, copy=False)
+    fill = False if pa.types.is_boolean(arr.type) else 0
+    vals = arr.fill_null(fill).to_numpy(zero_copy_only=False).astype(np_dtype, copy=False)
     return vals, valid
 
 

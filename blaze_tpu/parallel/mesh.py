@@ -1,0 +1,163 @@
+"""Device-mesh distributed execution: the ICI shuffle path.
+
+The reference's exchange transport is Spark's BlockManager/netty between
+executors (SURVEY.md §5.8). On a TPU slice the native transport is ICI:
+hash repartitioning becomes ``jax.lax.all_to_all`` inside a ``shard_map``
+over a device mesh, broadcast becomes mesh replication, and global
+aggregation merges with ``psum`` — XLA inserts the collectives
+(scaling-book recipe: pick a mesh, annotate shardings, let XLA place
+collectives on ICI).
+
+Two layers:
+
+- :func:`exchange_and_aggregate` — a single jittable SPMD step: local
+  partial aggregation, all-to-all row exchange routed by spark-exact
+  murmur3 pmod (so a row lands on the same reducer a file-based shuffle
+  would pick), local final aggregation. This is the building block the
+  mesh session composes and what ``__graft_entry__.dryrun_multichip``
+  compiles.
+- :func:`make_mesh` — mesh construction over the available devices.
+
+Fixed shapes: each device ships one (num_devices, capacity) tile pair per
+exchanged column — rows not routed to a peer are masked, not compacted, so
+the collective is static-shaped (SURVEY.md §7.4.1)."""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from blaze_tpu.exprs.spark_hash import murmur3_int64
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "data") -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def pmod(hashes: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Spark pmod partition routing from int32 murmur3 hashes."""
+    h = hashes.view(jnp.int32).astype(jnp.int64) if hashes.dtype == jnp.uint32 else hashes.astype(jnp.int64)
+    return ((h % n) + n) % n
+
+
+def _sorted_segment_agg(keys, vals, valid, num_segments: int):
+    """Group-by-key via device sort + segment-sum (SURVEY.md §7.4.2: prefer
+    sort-based grouping over hash tables on TPU). Returns padded
+    (unique_keys, sums, counts, seg_valid)."""
+    big = jnp.iinfo(jnp.int64).max
+    skeys = jnp.where(valid, keys, big)
+    order = jnp.argsort(skeys)
+    k = skeys[order]
+    v = jnp.where(valid, vals, 0)[order]
+    is_new = jnp.concatenate([jnp.ones(1, bool), k[1:] != k[:-1]])
+    seg_ids = jnp.cumsum(is_new) - 1
+    sums = jax.ops.segment_sum(v, seg_ids, num_segments=num_segments)
+    counts = jax.ops.segment_sum(
+        valid[order].astype(jnp.int64), seg_ids, num_segments=num_segments)
+    first_idx = jax.ops.segment_min(
+        jnp.arange(k.shape[0]), seg_ids, num_segments=num_segments)
+    uk = k[jnp.clip(first_idx, 0, k.shape[0] - 1)]
+    seg_valid = (counts > 0) & (uk != big)
+    return jnp.where(seg_valid, uk, 0), sums, counts, seg_valid
+
+
+def exchange_and_aggregate(mesh: Mesh, capacity: int, axis: str = "data"):
+    """Build the jitted SPMD step: (keys, vals, valid) sharded over the mesh
+    -> per-device (unique_keys, sums, counts, valid) after one all-to-all
+    exchange. Each device holds a (capacity,) shard."""
+    n = mesh.shape[axis]
+
+    def step(keys, vals, valid):
+        # --- local partial aggregation (combiner before the exchange)
+        pk, ps, pc, pv = _sorted_segment_agg(keys, vals, valid, capacity)
+
+        # --- route each partial group to its reducer (spark-exact murmur3)
+        h = murmur3_int64(pk, jnp.full(pk.shape, 42, jnp.uint32))
+        pid = pmod(h.view(jnp.int32), n)
+        pid = jnp.where(pv, pid, n)  # invalid rows route nowhere
+
+        # --- build (n, capacity) masked tiles and exchange over ICI
+        tile_mask = (pid[None, :] == jnp.arange(n)[:, None]) & pv[None, :]
+        tk = jnp.where(tile_mask, pk[None, :], 0)
+        ts = jnp.where(tile_mask, ps[None, :], 0)
+        tc = jnp.where(tile_mask, pc[None, :], 0)
+        tm = tile_mask
+        tk, ts, tc, tm = [
+            jax.lax.all_to_all(t, axis, split_axis=0, concat_axis=0, tiled=False)
+            for t in (tk, ts, tc, tm)
+        ]
+        # received: (n, capacity) from every peer -> flatten and re-aggregate
+        rk = tk.reshape(-1)
+        rs = ts.reshape(-1)
+        rc = tc.reshape(-1)
+        rm = tm.reshape(-1)
+        big = jnp.iinfo(jnp.int64).max
+        skeys = jnp.where(rm, rk, big)
+        order = jnp.argsort(skeys)
+        k = skeys[order]
+        is_new = jnp.concatenate([jnp.ones(1, bool), k[1:] != k[:-1]])
+        seg_ids = jnp.cumsum(is_new) - 1
+        nseg = rk.shape[0]  # a reducer may receive up to n*capacity groups
+        sums = jax.ops.segment_sum(jnp.where(rm, rs, 0)[order], seg_ids,
+                                   num_segments=nseg)
+        counts = jax.ops.segment_sum(jnp.where(rm, rc, 0)[order], seg_ids,
+                                     num_segments=nseg)
+        first_idx = jax.ops.segment_min(jnp.arange(k.shape[0]), seg_ids,
+                                        num_segments=nseg)
+        uk = k[jnp.clip(first_idx, 0, k.shape[0] - 1)]
+        out_valid = (counts > 0) & (uk != big)
+        # global row count sanity via psum (every reducer learns the total)
+        total_rows = jax.lax.psum(jnp.sum(valid.astype(jnp.int64)), axis)
+        return (jnp.where(out_valid, uk, 0), sums, counts, out_valid, total_rows)
+
+    from jax import shard_map
+
+    sharded = shard_map(
+        step, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis)),
+        out_specs=(P(axis), P(axis), P(axis), P(axis), P()),
+    )
+    return jax.jit(sharded)
+
+
+def run_distributed_sum(keys: np.ndarray, vals: np.ndarray,
+                        mesh: Optional[Mesh] = None,
+                        axis: str = "data") -> dict:
+    """Host-facing helper: global group-by-sum over all mesh devices; returns
+    {key: (sum, count)} gathered on host (used by tests and the dryrun)."""
+    mesh = mesh or make_mesh()
+    n = mesh.shape[axis]
+    total = len(keys)
+    per = -(-total // n)
+    capacity = 1
+    while capacity < per:
+        capacity *= 2
+    kbuf = np.zeros(n * capacity, dtype=np.int64)
+    vbuf = np.zeros(n * capacity, dtype=np.int64)
+    mbuf = np.zeros(n * capacity, dtype=bool)
+    for d in range(n):
+        lo, hi = d * per, min((d + 1) * per, total)
+        if hi > lo:
+            kbuf[d * capacity : d * capacity + (hi - lo)] = keys[lo:hi]
+            vbuf[d * capacity : d * capacity + (hi - lo)] = vals[lo:hi]
+            mbuf[d * capacity : d * capacity + (hi - lo)] = True
+    step = exchange_and_aggregate(mesh, capacity, axis)
+    with mesh:
+        uk, sums, counts, valid, total_rows = step(
+            jnp.asarray(kbuf), jnp.asarray(vbuf), jnp.asarray(mbuf))
+    uk, sums, counts, valid = map(np.asarray, (uk, sums, counts, valid))
+    assert int(total_rows) == int(mbuf.sum())
+    out = {}
+    for i in np.nonzero(valid)[0]:
+        k = int(uk[i])
+        s, c = out.get(k, (0, 0))
+        out[k] = (s + int(sums[i]), c + int(counts[i]))
+    return out

@@ -22,12 +22,20 @@ from blaze_tpu.config import Config, get_config
 
 
 class MemConsumer:
-    """Base for spillable operator state (reference: MemConsumer trait)."""
+    """Base for spillable operator state (reference: MemConsumer trait).
+
+    Spills are *cooperative*: only the owning task thread ever calls
+    ``spill()`` on its own consumer — either synchronously when its own
+    update crosses the budget, or on its next update after another thread
+    requested it via ``spill_requested`` (operator state is not shareable
+    mid-batch; the reference serializes this through per-consumer async
+    spill tasks, ``memmgr/mod.rs:301-421``)."""
 
     def __init__(self, name: str, spillable: bool = True):
         self.name = name
         self.spillable = spillable
         self.mem_used = 0
+        self.spill_requested = False
         self._manager: Optional["MemManager"] = None
 
     def spill(self) -> int:
@@ -101,24 +109,28 @@ class MemManager:
 
     def update(self, consumer: MemConsumer, new_used: int):
         """Record new usage; trigger spills when over budget (reference:
-        MemManager::update_consumer_mem_used decision logic)."""
+        MemManager::update_consumer_mem_used decision logic). Only the
+        calling consumer spills synchronously; other over-share consumers
+        are flagged and spill on their own thread's next update."""
+        spill_self = False
         with self._mu:
             consumer.mem_used = new_used
-            if self.used <= self.total:
-                return
-            share = self.fair_share()
-            # spill the over-share spillable consumers, largest first
-            over = sorted(
-                (c for c in self.consumers if c.spillable and c.mem_used > share),
-                key=lambda c: -c.mem_used,
-            )
-            for c in over:
-                if self.used <= self.total:
-                    break
-                freed = c.spill()
+            if consumer.spill_requested and consumer.spillable:
+                spill_self = True
+            elif self.used > self.total:
+                share = self.fair_share()
+                if consumer.spillable and consumer.mem_used > share:
+                    spill_self = True
+                for c in self.consumers:
+                    if c is not consumer and c.spillable and c.mem_used > share:
+                        c.spill_requested = True
+        if spill_self:
+            consumer.spill_requested = False
+            freed = consumer.spill()
+            with self._mu:
                 self.spill_count += 1
                 self.total_spilled_bytes += freed
-                c.mem_used = max(0, c.mem_used - freed)
+                consumer.mem_used = max(0, consumer.mem_used - freed)
 
 
 class SpillFile:

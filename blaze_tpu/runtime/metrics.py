@@ -8,6 +8,7 @@ Reference: JVM ``MetricNode`` (MetricNode.scala) mirrored by the native
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -17,20 +18,35 @@ class MetricNode:
         self.name = name
         self.children = children or []
         self.values: Dict[str, int] = {}
+        self._named: Dict[str, "MetricNode"] = {}
+        self._mu = threading.Lock()
 
     def add(self, metric: str, value: int):
-        self.values[metric] = self.values.get(metric, 0) + int(value)
+        with self._mu:
+            self.values[metric] = self.values.get(metric, 0) + int(value)
 
     def set(self, metric: str, value: int):
-        self.values[metric] = int(value)
+        with self._mu:
+            self.values[metric] = int(value)
 
     def get(self, metric: str) -> int:
         return self.values.get(metric, 0)
 
     def child(self, i: int) -> "MetricNode":
-        while len(self.children) <= i:
-            self.children.append(MetricNode(f"{self.name}.child{len(self.children)}"))
-        return self.children[i]
+        with self._mu:
+            while len(self.children) <= i:
+                self.children.append(MetricNode(f"{self.name}.child{len(self.children)}"))
+            return self.children[i]
+
+    def named_child(self, key: str) -> "MetricNode":
+        """Keyed child for driver-side groupings (stages vs result
+        partitions) so namespaces cannot collide."""
+        with self._mu:
+            node = self._named.get(key)
+            if node is None:
+                node = self._named[key] = MetricNode(f"{self.name}.{key}")
+                self.children.append(node)
+            return node
 
     def timer(self, metric: str) -> "Timer":
         return Timer(self, metric)

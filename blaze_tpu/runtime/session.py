@@ -1,0 +1,166 @@
+"""Standalone driver: stage scheduling, exchange lowering, task execution.
+
+The reference delegates this role to Spark: AQE stages end at shuffle
+exchanges, map tasks run ``ShuffleWriterExecNode`` plans, reducers re-enter
+native execution through ``IpcReaderExecNode`` over fetched blocks, and
+broadcasts collect through ``IpcWriterExecNode`` (SURVEY.md §3.3-3.4).
+
+``Session`` provides that orchestration natively so the engine runs
+standalone: it walks the plan bottom-up, runs each exchange's map stage as a
+pool of tasks (one per child partition) writing data+index files, registers
+a block provider in the resource map, and substitutes an ``IpcReader``.
+Broadcast exchanges collect the child into in-memory IPC bytes. A Spark
+frontend would bypass Session and drive ShuffleWriter/IpcReader plans
+directly, exactly like the reference."""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import os
+import tempfile
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterator, List, Optional
+
+import pyarrow as pa
+
+from blaze_tpu.config import Config, get_config
+from blaze_tpu.core.batch import ColumnarBatch
+from blaze_tpu.ir import nodes as N
+from blaze_tpu.ir import types as T
+from blaze_tpu.ops.base import ExecContext, Operator, TaskContext
+from blaze_tpu.ops.shuffle.writer import read_index_file
+from blaze_tpu.runtime.executor import build_operator
+from blaze_tpu.runtime.metrics import MetricNode
+
+
+class Session:
+    def __init__(self, conf: Optional[Config] = None, work_dir: Optional[str] = None,
+                 max_workers: Optional[int] = None):
+        self.conf = conf or get_config()
+        self.work_dir = work_dir or tempfile.mkdtemp(prefix="blaze_tpu_session_")
+        self.max_workers = max_workers or self.conf.num_io_threads
+        self.resources = {}
+        self._ids = itertools.count()
+        self._stage_ids = itertools.count()
+        self.metrics = MetricNode("session")
+
+    # -- public API -----------------------------------------------------------
+
+    def execute(self, plan: N.PlanNode) -> Iterator[ColumnarBatch]:
+        """Run a plan, yielding all result batches (final-stage partitions in
+        order)."""
+        lowered = self._lower(plan)
+        op = build_operator(lowered)
+        for p in range(op.num_partitions()):
+            ctx = self._make_ctx(p)
+            yield from op.execute(p, ctx,
+                                  self.metrics.named_child(f"result_{p}"))
+
+    def execute_to_table(self, plan: N.PlanNode) -> pa.Table:
+        batches = [b.to_arrow() for b in self.execute(plan) if b.num_rows]
+        schema = T.schema_to_arrow(plan.output_schema)
+        if not batches:
+            return schema.empty_table()
+        return pa.Table.from_batches(batches)
+
+    def execute_to_pydict(self, plan: N.PlanNode) -> dict:
+        return self.execute_to_table(plan).to_pydict()
+
+    # -- internals ------------------------------------------------------------
+
+    def _make_ctx(self, partition: int, stage: int = 0) -> ExecContext:
+        return ExecContext(
+            task=TaskContext(stage_id=stage, partition_id=partition),
+            conf=self.conf,
+            resources=self.resources,
+        )
+
+    def _lower(self, node: N.PlanNode) -> N.PlanNode:
+        node = N.map_children(node, self._lower)
+        if isinstance(node, N.ShuffleExchange):
+            return self._run_shuffle_map_stage(node)
+        if isinstance(node, N.BroadcastExchange):
+            return self._run_broadcast_collect(node)
+        return node
+
+    def _run_shuffle_map_stage(self, node: N.ShuffleExchange) -> N.PlanNode:
+        """Execute the map side (one ShuffleWriter task per child partition),
+        then expose the per-reducer file segments as an IpcReader resource."""
+        stage = next(self._stage_ids)
+        child_op = build_operator(node.child)
+        num_maps = child_op.num_partitions()
+        num_reducers = node.partitioning.num_partitions
+        shuffle_dir = os.path.join(self.work_dir, f"shuffle_{stage}")
+        os.makedirs(shuffle_dir, exist_ok=True)
+
+        def run_map(m: int):
+            from blaze_tpu.ops.shuffle.writer import ShuffleWriterExec
+
+            data = os.path.join(shuffle_dir, f"map_{m}.data")
+            index = os.path.join(shuffle_dir, f"map_{m}.index")
+            writer = ShuffleWriterExec(child_op, node.partitioning, data, index)
+            ctx = self._make_ctx(m, stage)
+            task_metrics = self.metrics.named_child(f"stage_{stage}").named_child(f"map_{m}")
+            for _ in writer.execute(m, ctx, task_metrics):
+                pass
+            return data, index
+
+        outputs = self._run_tasks(run_map, range(num_maps))
+
+        indexes = [(data, read_index_file(index)) for data, index in outputs]
+
+        def block_provider(reducer: int):
+            blocks = []
+            for data, offsets in indexes:
+                start, end = int(offsets[reducer]), int(offsets[reducer + 1])
+                if end > start:
+                    blocks.append(("file_segment", data, start, end - start))
+            return blocks
+
+        rid = f"shuffle_{stage}"
+        self.resources[rid] = block_provider
+        return N.IpcReader(schema=node.child.output_schema, resource_id=rid,
+                           num_partitions=num_reducers)
+
+    def _run_broadcast_collect(self, node: N.BroadcastExchange) -> N.PlanNode:
+        """Collect the child via IpcWriter into in-memory chunks and expose
+        them as a single-partition IpcReader readable by every task
+        (reference: NativeBroadcastExchangeBase.relationFuture + Spark
+        TorrentBroadcast of the IPC byte arrays)."""
+        stage = next(self._stage_ids)
+        child_op = build_operator(node.child)
+        num_maps = child_op.num_partitions()
+        chunks: List[bytes] = []
+        lock = threading.Lock()
+
+        class _Consumer:
+            def write(self, b: bytes):
+                with lock:
+                    chunks.append(b)
+
+        cid = f"broadcast_consumer_{stage}"
+        self.resources[cid] = _Consumer()
+
+        def run_map(m: int):
+            from blaze_tpu.ops.shuffle.reader import IpcWriterExec
+
+            writer = IpcWriterExec(child_op, cid)
+            ctx = self._make_ctx(m, stage)
+            task_metrics = self.metrics.named_child(f"stage_{stage}").named_child(f"map_{m}")
+            for _ in writer.execute(m, ctx, task_metrics):
+                pass
+
+        self._run_tasks(run_map, range(num_maps))
+        rid = f"broadcast_{stage}"
+        self.resources[rid] = lambda p: [("bytes", b) for b in chunks]
+        return N.IpcReader(schema=node.child.output_schema, resource_id=rid,
+                           num_partitions=1)
+
+    def _run_tasks(self, fn, partitions) -> list:
+        parts = list(partitions)
+        if len(parts) <= 1 or self.max_workers <= 1:
+            return [fn(p) for p in parts]
+        with ThreadPoolExecutor(max_workers=self.max_workers) as pool:
+            return list(pool.map(fn, parts))

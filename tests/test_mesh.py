@@ -1,0 +1,32 @@
+import collections
+
+import numpy as np
+
+from blaze_tpu.parallel.mesh import make_mesh, run_distributed_sum
+
+
+def test_distributed_groupby_sum_8_devices(eight_devices):
+    rng = np.random.default_rng(0)
+    n = 4000
+    keys = rng.integers(0, 300, n).astype(np.int64)
+    vals = rng.integers(0, 1000, n).astype(np.int64)
+    mesh = make_mesh(8)
+    out = run_distributed_sum(keys, vals, mesh)
+    exp_s = collections.defaultdict(int)
+    exp_c = collections.defaultdict(int)
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        exp_s[k] += v
+        exp_c[k] += 1
+    assert set(out) == set(exp_s)
+    for k, (s, c) in out.items():
+        assert s == exp_s[k]
+        assert c == exp_c[k]
+
+
+def test_distributed_sum_reducer_locality(eight_devices):
+    """Every group must land on exactly one reducer (no double counting)."""
+    keys = np.arange(100, dtype=np.int64)
+    vals = np.ones(100, dtype=np.int64)
+    out = run_distributed_sum(keys, vals, make_mesh(8))
+    assert all(v == (1, 1) for v in out.values())
+    assert len(out) == 100

@@ -1,0 +1,220 @@
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from blaze_tpu.core import ColumnarBatch
+from blaze_tpu.exprs.spark_hash import hash_batch
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import nodes as N
+from blaze_tpu.ir import types as T
+from blaze_tpu.ops.base import ExecContext
+from blaze_tpu.ops.shuffle.reader import IpcReaderExec
+from blaze_tpu.ops.shuffle.repartitioner import (
+    HashPartitioner,
+    RangePartitioner,
+    RoundRobinPartitioner,
+    create_repartitioner,
+)
+from blaze_tpu.ops.shuffle.writer import ShuffleWriterExec, read_index_file
+from blaze_tpu.runtime.session import Session
+from tests.util import mem_scan, run_op
+
+
+def col(n):
+    return E.Column(n)
+
+
+def test_hash_partitioner_pmod():
+    b = ColumnarBatch.from_pydict({"k": pa.array([1, 2, 3, None], type=pa.int64())})
+    p = HashPartitioner([col("k")], 8, b.schema)
+    pids = p.partition_ids(b)
+    h = hash_batch(b.columns, b.num_rows, b.capacity, seed=42)
+    expected = ((h.astype(np.int64) % 8) + 8) % 8
+    np.testing.assert_array_equal(pids, expected.astype(np.int32))
+
+
+def test_round_robin_deterministic():
+    b = ColumnarBatch.from_pydict({"k": list(range(10))})
+    p1 = RoundRobinPartitioner(3)
+    p2 = RoundRobinPartitioner(3)
+    np.testing.assert_array_equal(p1.partition_ids(b), p2.partition_ids(b))
+    # continues across batches
+    assert p1.partition_ids(b)[0] == (10 % 3)
+
+
+def test_range_partitioner():
+    schema = T.Schema.of(("k", T.I64))
+    b = ColumnarBatch.from_pydict({"k": pa.array([5, 15, 25, 35], type=pa.int64())}, schema)
+    part = N.RangePartitioning([E.SortOrder(col("k"))], 3, bounds=[(10,), (30,)])
+    p = create_repartitioner(part, schema)
+    np.testing.assert_array_equal(p.partition_ids(b), [0, 1, 1, 2])
+
+
+def test_bucketize_preserves_rows():
+    rng = np.random.default_rng(0)
+    b = ColumnarBatch.from_pydict(
+        {"k": rng.integers(0, 1000, 500).tolist(), "s": [f"s{i}" for i in range(500)]}
+    )
+    p = HashPartitioner([col("k")], 7, b.schema)
+    parts = p.bucketize(b)
+    total = sum(sub.num_rows for _, sub in parts)
+    assert total == 500
+    seen = set()
+    for pid, sub in parts:
+        assert pid not in seen
+        seen.add(pid)
+        pids = p.partition_ids(sub)
+        assert (pids == pid).all()
+
+
+def test_shuffle_write_read_roundtrip(tmp_path):
+    rng = np.random.default_rng(1)
+    n = 5000
+    data = {"k": rng.integers(0, 50, n).tolist(), "v": [f"v{i}" for i in range(n)]}
+    scan = mem_scan(data, num_batches=5)
+    dataf = str(tmp_path / "out.data")
+    indexf = str(tmp_path / "out.index")
+    writer = ShuffleWriterExec(scan, N.HashPartitioning([col("k")], 4), dataf, indexf)
+    out = run_op(writer)
+    assert out == []
+    offsets = read_index_file(indexf)
+    assert len(offsets) == 5
+    assert offsets[-1] == os.path.getsize(dataf)
+
+    ctx = ExecContext()
+    got_rows = 0
+    all_vs = []
+    for p in range(4):
+        start, end = int(offsets[p]), int(offsets[p + 1])
+        ctx.resources["blocks"] = [("file_segment", dataf, start, end - start)]
+        reader = IpcReaderExec(scan.schema, "blocks")
+        part_ks = []
+        for b in reader.execute(0, ctx):
+            got_rows += b.num_rows
+            d = b.to_pydict()
+            part_ks.extend(d["k"])
+            all_vs.extend(d["v"])
+        # every row in this partition hashes to p
+        if part_ks:
+            kb = ColumnarBatch.from_pydict({"k": pa.array(part_ks, type=pa.int64())})
+            hp = HashPartitioner([col("k")], 4, kb.schema)
+            assert (hp.partition_ids(kb) == p).all()
+    assert got_rows == n
+    assert sorted(all_vs) == sorted(data["v"])
+
+
+def test_shuffle_write_with_spill(tmp_path):
+    from blaze_tpu.config import config_override
+    from blaze_tpu.runtime.memmgr import MemManager
+
+    rng = np.random.default_rng(2)
+    n = 20_000
+    data = {"k": rng.integers(0, 97, n).tolist(), "v": rng.integers(0, 10**9, n).tolist()}
+    scan = mem_scan(data, num_batches=10)
+    dataf = str(tmp_path / "s.data")
+    indexf = str(tmp_path / "s.index")
+    MemManager.reset()
+    with config_override(memory_total=400_000, memory_fraction=1.0):
+        writer = ShuffleWriterExec(scan, N.HashPartitioning([col("k")], 8), dataf, indexf)
+        run_op(writer)
+    MemManager.reset()
+    offsets = read_index_file(indexf)
+    ctx = ExecContext()
+    total = 0
+    vs = []
+    for p in range(8):
+        ctx.resources["b"] = [("file_segment", dataf, int(offsets[p]),
+                               int(offsets[p + 1] - offsets[p]))]
+        for b in IpcReaderExec(scan.schema, "b").execute(0, ctx):
+            total += b.num_rows
+            vs.extend(b.to_pydict()["v"])
+    assert total == n
+    assert sorted(vs) == sorted(data["v"])
+
+
+def test_session_two_stage_agg():
+    """The q01-slice shape: partial agg -> hash exchange -> final agg."""
+    rng = np.random.default_rng(3)
+    n = 10_000
+    keys = rng.integers(0, 200, n)
+    vals = rng.integers(0, 1000, n)
+    scan_batches = ColumnarBatch.from_pydict(
+        {"k": keys.tolist(), "v": vals.tolist()})
+    # two input partitions
+    half = n // 2
+    schema = scan_batches.schema
+    parts = [[scan_batches.slice(0, half)], [scan_batches.slice(half, half)]]
+    from blaze_tpu.ops.basic import MemoryScanExec
+
+    class ScanNode(N.PlanNode):
+        @property
+        def output_schema(self):
+            return schema
+
+    # use IR all the way: FFIReader as the scan source
+    sess = Session()
+    sess.resources["src"] = lambda p: [b.to_arrow() for b in parts[p]]
+    scan = N.FFIReader(schema=schema, resource_id="src", num_partitions=2)
+    partial = N.Agg(scan, E.AggExecMode.HASH_AGG, [("k", col("k"))],
+                    [N.AggColumn(E.AggExpr(E.AggFunction.SUM, [col("v")]),
+                                 E.AggMode.PARTIAL, "s")])
+    exchange = N.ShuffleExchange(partial, N.HashPartitioning([col("k")], 4))
+    final = N.Agg(exchange, E.AggExecMode.HASH_AGG, [("k", col("k"))],
+                  [N.AggColumn(E.AggExpr(E.AggFunction.SUM, [col("v")]),
+                               E.AggMode.FINAL, "s")])
+    out = sess.execute_to_pydict(final)
+    import collections
+
+    exp = collections.defaultdict(int)
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        exp[k] += v
+    got = dict(zip(out["k"], out["s"]))
+    assert got == dict(exp)
+
+
+def test_session_single_exchange_sort_limit():
+    """global sort via single-partition exchange + sort + limit."""
+    rng = np.random.default_rng(4)
+    vals = rng.integers(0, 10**6, 3000).tolist()
+    sess = Session()
+    b = ColumnarBatch.from_pydict({"v": vals})
+    sess.resources["src"] = lambda p: [b.to_arrow()]
+    scan = N.FFIReader(schema=b.schema, resource_id="src", num_partitions=1)
+    ex = N.ShuffleExchange(scan, N.SinglePartitioning(1))
+    plan = N.Limit(N.Sort(ex, [E.SortOrder(col("v"))]), 10)
+    out = sess.execute_to_pydict(plan)
+    assert out["v"] == sorted(vals)[:10]
+
+
+def test_rss_shuffle_writer():
+    from blaze_tpu.ops.shuffle.writer import RssShuffleWriterExec
+
+    class FakeRss:
+        def __init__(self):
+            self.parts = {}
+            self.flushed = False
+
+        def write(self, pid, data):
+            self.parts.setdefault(pid, bytearray()).extend(data)
+
+        def flush(self):
+            self.flushed = True
+
+    scan = mem_scan({"k": list(range(100))}, num_batches=4)
+    rss = FakeRss()
+    ctx = ExecContext()
+    ctx.resources["rss"] = rss
+    op = RssShuffleWriterExec(scan, N.HashPartitioning([col("k")], 3), "rss")
+    assert list(op.execute(0, ctx)) == []
+    assert rss.flushed
+    # payloads decode back
+    from blaze_tpu.ops.shuffle.reader import IpcReaderExec
+
+    total = 0
+    for pid, payload in rss.parts.items():
+        ctx.resources["blocks"] = [("bytes", bytes(payload))]
+        for b in IpcReaderExec(scan.schema, "blocks").execute(0, ctx):
+            total += b.num_rows
+    assert total == 100
