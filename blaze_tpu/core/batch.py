@@ -323,6 +323,37 @@ class ColumnarBatch:
                 cols.append(c.take_host(indices))
         return ColumnarBatch(self.schema, cols, n)
 
+    def take_nullable(self, indices: np.ndarray) -> "ColumnarBatch":
+        """Row gather where index -1 yields an all-null row (outer-join null
+        extension)."""
+        indices = np.asarray(indices, dtype=np.int64)
+        n = len(indices)
+        null_mask = indices < 0
+        cap = get_config().capacity_for(n)
+        dev_idx = None
+        pa_idx = None
+        cols: List[Column] = []
+        for c in self.columns:
+            if isinstance(c, DeviceColumn):
+                if dev_idx is None:
+                    buf = np.zeros(cap, dtype=np.int64)
+                    buf[:n] = np.where(null_mask, 0, indices)
+                    dev_idx = jnp.asarray(buf)
+                    vbuf = np.zeros(cap, dtype=bool)
+                    vbuf[:n] = ~null_mask
+                    valid = jnp.asarray(vbuf)
+                cols.append(c.take_device(dev_idx, valid))
+            else:
+                if pa_idx is None:
+                    pa_idx = pa.Array.from_pandas(
+                        np.where(null_mask, 0, indices), mask=null_mask,
+                        type=pa.int64())
+                cols.append(HostColumn(c.dtype, c.array.take(pa_idx)))
+        schema = T.Schema(
+            tuple(T.StructField(f.name, f.dtype, True) for f in self.schema.fields)
+        ) if null_mask.any() else self.schema
+        return ColumnarBatch(schema, cols, n)
+
     def slice(self, offset: int, length: int) -> "ColumnarBatch":
         length = max(0, min(length, self.num_rows - offset))
         return self.take(np.arange(offset, offset + length))

@@ -390,6 +390,17 @@ def _join_output_schema(left: T.Schema, right: T.Schema, jt: JoinType,
         return right
     if jt == JoinType.EXISTENCE:
         return left + T.Schema((T.StructField(existence_col, T.BOOL, False),))
+
+    def nullable(s: T.Schema) -> T.Schema:
+        return T.Schema(tuple(T.StructField(f.name, f.dtype, True) for f in s.fields))
+
+    # outer joins null-extend a side: its fields become nullable
+    if jt == JoinType.LEFT:
+        return left + nullable(right)
+    if jt == JoinType.RIGHT:
+        return nullable(left) + right
+    if jt == JoinType.FULL:
+        return nullable(left) + nullable(right)
     return left + right
 
 

@@ -1,0 +1,266 @@
+"""Broadcast / shuffled hash joins, all join types.
+
+Reference: ``broadcast_join_exec.rs`` (677) + ``joins/bhj/*.rs`` — probes a
+prebuilt JoinHashMap, caching the built map per executor by
+``cached_build_hash_map_id`` (``broadcast_join_exec.rs:87-116``); the same
+operator serves shuffled-hash-join via PartitionMode. Join types:
+inner/left/right/full/semi/anti/existence on either side.
+
+Matching is exact (host key interning, ops/joins/keymap.py); pair expansion
+and row materialization are vectorized gathers (device for fixed-width
+columns)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from blaze_tpu.core.batch import ColumnarBatch
+from blaze_tpu.exprs.compiler import ExprEvaluator
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import types as T
+from blaze_tpu.ir.nodes import JoinSide, JoinType, _join_output_schema
+from blaze_tpu.ops.base import ExecContext, Operator
+from blaze_tpu.ops.joins.keymap import JoinHashMap, key_codes
+
+# executor-level build-map cache (reference: executor-cached by
+# cached_build_hash_map_id, built once per executor per broadcast)
+_BUILD_CACHE: Dict[str, JoinHashMap] = {}
+_BUILD_CACHE_LOCK = threading.Lock()
+
+
+def clear_build_cache():
+    with _BUILD_CACHE_LOCK:
+        _BUILD_CACHE.clear()
+
+
+class _HashJoinBase(Operator):
+    """Common probe logic; subclasses define how the build side loads."""
+
+    def __init__(self, left: Operator, right: Operator,
+                 on: List[Tuple[E.Expr, E.Expr]], join_type: JoinType,
+                 build_side: JoinSide):
+        self.on = on
+        self.join_type = join_type
+        self.build_side = build_side
+        schema = _join_output_schema(left.schema, right.schema, join_type)
+        super().__init__(schema, [left, right])
+
+    # -- orientation helpers --------------------------------------------------
+
+    @property
+    def _build_is_left(self) -> bool:
+        return self.build_side == JoinSide.LEFT
+
+    def _probe_child(self) -> int:
+        return 1 if self._build_is_left else 0
+
+    def _build_child(self) -> int:
+        return 0 if self._build_is_left else 1
+
+    def _key_exprs(self, for_build: bool) -> List[E.Expr]:
+        pairs = self.on
+        if for_build:
+            return [l if self._build_is_left else r for l, r in pairs]
+        return [r if self._build_is_left else l for l, r in pairs]
+
+    # -- build ----------------------------------------------------------------
+
+    def _load_build_map(self, partition, ctx, metrics) -> JoinHashMap:
+        raise NotImplementedError
+
+    def _build_from_child(self, partition, ctx, metrics) -> JoinHashMap:
+        child = self._build_child()
+        with metrics.timer("build_time"):
+            batches = list(self.execute_child(child, partition, ctx, metrics))
+            return JoinHashMap.build(batches, self._key_exprs(for_build=True),
+                                     self.children[child].schema)
+
+    # -- probe ----------------------------------------------------------------
+
+    def _execute(self, partition, ctx, metrics):
+        jt = self.join_type
+        bmap = self._load_build_map(partition, ctx, metrics)
+        probe_child = self._probe_child()
+        probe_schema = self.children[probe_child].schema
+        key_exprs = self._key_exprs(for_build=False)
+        probe_on_left = probe_child == 0
+
+        # which side's unmatched rows must be emitted?
+        emit_unmatched_probe = (
+            (jt == JoinType.FULL)
+            or (jt == JoinType.LEFT and probe_on_left)
+            or (jt == JoinType.RIGHT and not probe_on_left)
+        )
+        emit_unmatched_build = (
+            (jt == JoinType.FULL)
+            or (jt == JoinType.LEFT and not probe_on_left)
+            or (jt == JoinType.RIGHT and probe_on_left)
+        )
+        semi_anti_exist = jt in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI,
+                                 JoinType.RIGHT_SEMI, JoinType.RIGHT_ANTI,
+                                 JoinType.EXISTENCE)
+
+        track_build_matched = emit_unmatched_build or (
+            semi_anti_exist and not self._semi_side_is_probe())
+
+        for batch in self.execute_child(probe_child, partition, ctx, metrics):
+            with metrics.timer("probe_time"):
+                ev = ExprEvaluator(key_exprs, probe_schema)
+                cols = ev.evaluate(batch)
+                codes = key_codes(batch, cols, bmap.key_map, insert=False)
+                probe_idx, build_idx, counts = bmap.probe(codes)
+                if track_build_matched and len(build_idx):
+                    bmap.matched[build_idx] = True
+                out = self._emit_probe_batch(
+                    batch, bmap, probe_idx, build_idx, counts,
+                    emit_unmatched_probe, probe_on_left, jt)
+            if out is not None and out.num_rows:
+                yield out
+
+        # post-pass: unmatched build rows (right/left-opposite/full, or
+        # semi/anti/existence where the kept side was built)
+        with metrics.timer("finish_time"):
+            tail = self._emit_build_tail(bmap, probe_on_left, jt,
+                                         emit_unmatched_build)
+        if tail is not None and tail.num_rows:
+            yield tail
+
+    def _semi_side_is_probe(self) -> bool:
+        jt = self.join_type
+        if jt in (JoinType.LEFT_SEMI, JoinType.LEFT_ANTI, JoinType.EXISTENCE):
+            return self._probe_child() == 0
+        if jt in (JoinType.RIGHT_SEMI, JoinType.RIGHT_ANTI):
+            return self._probe_child() == 1
+        return False
+
+    def _emit_probe_batch(self, batch, bmap, probe_idx, build_idx, counts,
+                          emit_unmatched_probe, probe_on_left, jt):
+        n = batch.num_rows
+        matched_mask = counts > 0
+        if jt == JoinType.EXISTENCE:
+            if not self._semi_side_is_probe():
+                return None
+            from blaze_tpu.core.batch import DeviceColumn
+
+            exists = DeviceColumn.from_numpy(T.BOOL, matched_mask, None, batch.capacity)
+            return ColumnarBatch(self.schema, batch.columns + [exists], n)
+        if jt in (JoinType.LEFT_SEMI, JoinType.RIGHT_SEMI):
+            if not self._semi_side_is_probe():
+                return None
+            keep = np.nonzero(matched_mask)[0]
+            return batch.take(keep) if len(keep) else None
+        if jt in (JoinType.LEFT_ANTI, JoinType.RIGHT_ANTI):
+            if not self._semi_side_is_probe():
+                return None
+            keep = np.nonzero(~matched_mask)[0]
+            return batch.take(keep) if len(keep) else None
+
+        # inner / outer: expand pairs
+        if emit_unmatched_probe:
+            un = np.nonzero(~matched_mask)[0]
+            probe_idx = np.concatenate([probe_idx, un])
+            build_idx = np.concatenate([build_idx, np.full(len(un), -1, np.int64)])
+        if len(probe_idx) == 0:
+            return None
+        probe_out = batch.take(probe_idx)
+        build_out = bmap.batch.take_nullable(build_idx)
+        left, right = (build_out, probe_out) if not probe_on_left else (probe_out, build_out)
+        return ColumnarBatch(self.schema, left.columns + right.columns,
+                             len(probe_idx))
+
+    def _emit_build_tail(self, bmap, probe_on_left, jt, emit_unmatched_build):
+        build_n = bmap.batch.num_rows
+        if build_n == 0:
+            return None
+        if jt in (JoinType.LEFT_SEMI, JoinType.RIGHT_SEMI) and not self._semi_side_is_probe():
+            keep = np.nonzero(bmap.matched)[0]
+            return bmap.batch.take(keep) if len(keep) else None
+        if jt in (JoinType.LEFT_ANTI, JoinType.RIGHT_ANTI) and not self._semi_side_is_probe():
+            keep = np.nonzero(~bmap.matched)[0]
+            return bmap.batch.take(keep) if len(keep) else None
+        if jt == JoinType.EXISTENCE and not self._semi_side_is_probe():
+            from blaze_tpu.core.batch import DeviceColumn
+
+            exists = DeviceColumn.from_numpy(T.BOOL, bmap.matched, None,
+                                             bmap.batch.capacity)
+            return ColumnarBatch(self.schema, bmap.batch.columns + [exists],
+                                 build_n)
+        if not emit_unmatched_build:
+            return None
+        un = np.nonzero(~bmap.matched)[0]
+        if len(un) == 0:
+            return None
+        build_out = bmap.batch.take(un)
+        probe_schema = self.children[self._probe_child()].schema
+        probe_nulls = ColumnarBatch.empty(probe_schema).take_nullable(
+            np.full(len(un), -1, np.int64))
+        left, right = ((build_out, probe_nulls) if not probe_on_left
+                       else (probe_nulls, build_out))
+        return ColumnarBatch(self.schema, left.columns + right.columns, len(un))
+
+
+class HashJoinExec(_HashJoinBase):
+    """Shuffled hash join: build side read within this partition."""
+
+    def __init__(self, left, right, on, join_type, build_side=JoinSide.RIGHT):
+        super().__init__(left, right, on, join_type, build_side)
+
+    def num_partitions(self):
+        return self.children[self._probe_child()].num_partitions()
+
+    def _load_build_map(self, partition, ctx, metrics):
+        return self._build_from_child(partition, ctx, metrics)
+
+
+class BroadcastJoinExec(_HashJoinBase):
+    """Join against a broadcast build side; the built map is cached at
+    executor scope under ``cached_build_hash_map_id``."""
+
+    def __init__(self, left, right, on, join_type,
+                 broadcast_side=JoinSide.RIGHT, cached_build_hash_map_id=""):
+        super().__init__(left, right, on, join_type, broadcast_side)
+        self.cached_build_hash_map_id = cached_build_hash_map_id
+
+    def num_partitions(self):
+        return self.children[self._probe_child()].num_partitions()
+
+    def _load_build_map(self, partition, ctx, metrics):
+        cache_id = self.cached_build_hash_map_id
+        if not cache_id:
+            # broadcast side is single-partition regardless of the probe
+            # partition being executed
+            return self._build_from_child(0, ctx, metrics)
+        with _BUILD_CACHE_LOCK:
+            cached = _BUILD_CACHE.get(cache_id)
+        if cached is not None:
+            # per-task matched flags: outer joins over a shared map must not
+            # leak matches across tasks of different partitions
+            m = JoinHashMap(cached.batch, cached.key_map, cached.offsets,
+                            cached.schema)
+            return m
+        built = self._build_from_child(0, ctx, metrics)
+        with _BUILD_CACHE_LOCK:
+            _BUILD_CACHE.setdefault(cache_id, built)
+        return JoinHashMap(built.batch, built.key_map, built.offsets, built.schema)
+
+
+class BroadcastJoinBuildHashMapExec(Operator):
+    """Materializes a JoinHashMap from its input and emits it as a single
+    binary row (reference: broadcast_join_build_hash_map_exec.rs — the
+    executor-side build step between the broadcast read and the join)."""
+
+    SCHEMA = T.Schema.of(("hash_map", T.BINARY, False))
+
+    def __init__(self, child: Operator, keys: List[E.Expr]):
+        self.keys = keys
+        super().__init__(self.SCHEMA, [child])
+
+    def _execute(self, partition, ctx, metrics):
+        batches = list(self.execute_child(0, partition, ctx, metrics))
+        with metrics.timer("build_time"):
+            m = JoinHashMap.build(batches, self.keys, self.children[0].schema)
+            blob = m.serialize()
+        yield ColumnarBatch.from_pydict({"hash_map": [blob]}, self.SCHEMA)

@@ -1,0 +1,175 @@
+"""Join-key canonicalization and the build-side hash map.
+
+Reference: ``joins/join_hash_map.rs:44-284`` — an open-addressing table over
+packed MapValues with SIMD-ish probing, serializable for broadcast. The TPU
+re-design (SURVEY.md §7.4.2): random-access hash probing is hostile to the
+device, so keys are interned on host exactly like the aggregation path —
+vectorized per-batch dedup (``np.unique`` over the packed key matrix, C
+speed) with dict lookups only on per-batch *distinct* keys — and the build
+side becomes a CSR layout (slot -> contiguous build-row range) that turns
+probing into vectorized gather/repeat, which the device executes well.
+
+Null join keys never match (Spark equi-join semantics): rows with any null
+key get code -1 on both sides."""
+
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from blaze_tpu.core.batch import Column, ColumnarBatch, DeviceColumn
+from blaze_tpu.exprs.compiler import ExprEvaluator
+from blaze_tpu.ir import exprs as E
+
+
+def key_codes(batch: ColumnarBatch, cols: List[Column], key_map: Dict,
+              insert: bool) -> np.ndarray:
+    """Map each row's key tuple to an integer code. ``insert`` adds unseen
+    keys (build side); otherwise unseen -> -1 (probe side). Rows with any
+    null key always get -1."""
+    n = batch.num_rows
+    if n == 0:
+        return np.empty(0, dtype=np.int64)
+    all_device = all(isinstance(c, DeviceColumn) for c in cols)
+    if all_device:
+        mats = []
+        null_any = np.zeros(n, dtype=bool)
+        for c in cols:
+            data = np.asarray(c.data[:n])
+            valid = np.asarray(c.validity[:n])
+            null_any |= ~valid
+            if data.dtype == np.float64:
+                d64 = np.where(valid, data, 0.0).view(np.int64)
+            elif data.dtype == np.float32:
+                d64 = np.where(valid, data, np.float32(0)).view(np.int32).astype(np.int64)
+            else:
+                d64 = np.where(valid, data, 0).astype(np.int64)
+            mats.append(d64)
+        mat = np.column_stack(mats)
+        view = np.ascontiguousarray(mat).view(
+            np.dtype((np.void, mat.dtype.itemsize * mat.shape[1]))).ravel()
+        uniq, inverse = np.unique(view, return_inverse=True)
+        lut = np.empty(len(uniq), dtype=np.int64)
+        for i, u in enumerate(uniq):
+            kb = u.tobytes()
+            code = key_map.get(kb)
+            if code is None:
+                if insert:
+                    code = len(key_map)
+                    key_map[kb] = code
+                else:
+                    code = -1
+            lut[i] = code
+        codes = lut[inverse]
+        codes[null_any] = -1
+        return codes
+    # host path: canonical python tuples
+    pylists = [c.to_arrow(n).to_pylist() for c in cols]
+    codes = np.empty(n, dtype=np.int64)
+    for i in range(n):
+        key = tuple(pl[i] for pl in pylists)
+        if any(v is None for v in key):
+            codes[i] = -1
+            continue
+        kb = pickle.dumps(key, protocol=4)
+        code = key_map.get(kb)
+        if code is None:
+            if insert:
+                code = len(key_map)
+                key_map[kb] = code
+            else:
+                code = -1
+        codes[i] = code
+    return codes
+
+
+class JoinHashMap:
+    """Build-side map: key code -> contiguous range of build rows (CSR over
+    the concatenated, code-sorted build batch)."""
+
+    def __init__(self, batch: ColumnarBatch, key_map: Dict,
+                 offsets: np.ndarray, schema):
+        self.batch = batch          # build rows sorted by key code
+        self.key_map = key_map
+        self.offsets = offsets      # (num_codes + 1,) row ranges
+        self.schema = schema
+        self.matched = np.zeros(batch.num_rows, dtype=bool)
+
+    @property
+    def num_codes(self) -> int:
+        return len(self.offsets) - 1
+
+    @staticmethod
+    def build(batches: List[ColumnarBatch], key_exprs: List[E.Expr],
+              schema) -> "JoinHashMap":
+        key_map: Dict = {}
+        code_arrays = []
+        kept = []
+        for b in batches:
+            if b.num_rows == 0:
+                continue
+            ev = ExprEvaluator(key_exprs, b.schema)
+            cols = ev.evaluate(b)
+            code_arrays.append(key_codes(b, cols, key_map, insert=True))
+            kept.append(b)
+        if not kept:
+            empty = ColumnarBatch.empty(schema)
+            return JoinHashMap(empty, key_map, np.zeros(1, np.int64), schema)
+        big = ColumnarBatch.concat(kept, schema)
+        codes = np.concatenate(code_arrays)
+        # null-keyed build rows (-1) can never match: give them code
+        # num_codes so they sort to the tail outside every CSR range
+        ncodes = len(key_map)
+        codes = np.where(codes < 0, ncodes, codes)
+        order = np.argsort(codes, kind="stable")
+        sorted_codes = codes[order]
+        big = big.take(order)
+        counts = np.bincount(sorted_codes, minlength=ncodes + 1)[: ncodes + 1]
+        offsets = np.zeros(ncodes + 1, dtype=np.int64)
+        np.cumsum(counts[:ncodes], out=offsets[1:])
+        return JoinHashMap(big, key_map, offsets, schema)
+
+    def probe(self, codes: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """codes (n,) -> (probe_idx, build_idx, match_counts): all matching
+        row pairs, vectorized."""
+        valid = (codes >= 0) & (codes < self.num_codes)
+        safe = np.where(valid, codes, 0)
+        starts = self.offsets[safe]
+        ends = self.offsets[safe + 1]
+        counts = np.where(valid, ends - starts, 0)
+        total = int(counts.sum())
+        if total == 0:
+            return (np.empty(0, np.int64), np.empty(0, np.int64), counts)
+        probe_idx = np.repeat(np.arange(len(codes)), counts)
+        base = np.repeat(np.cumsum(counts) - counts, counts)
+        build_idx = np.repeat(starts, counts) + (np.arange(total) - base)
+        return probe_idx, build_idx, counts
+
+    # -- broadcast serialization (reference: JoinHashMap::try_into_bytes) -----
+
+    def serialize(self) -> bytes:
+        import io
+
+        from blaze_tpu.io.batch_serde import BatchWriter
+
+        buf = io.BytesIO()
+        BatchWriter(buf).write_batch(self.batch)
+        payload = {
+            "key_map": self.key_map,
+            "offsets": self.offsets,
+            "batch": buf.getvalue(),
+        }
+        return pickle.dumps(payload, protocol=4)
+
+    @staticmethod
+    def deserialize(blob: bytes, schema) -> "JoinHashMap":
+        import io
+
+        from blaze_tpu.io.batch_serde import BatchReader
+
+        payload = pickle.loads(blob)
+        batches = list(BatchReader(io.BytesIO(payload["batch"])))
+        batch = batches[0] if batches else ColumnarBatch.empty(schema)
+        return JoinHashMap(batch, payload["key_map"], payload["offsets"], schema)

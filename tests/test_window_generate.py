@@ -1,0 +1,156 @@
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import types as T
+from blaze_tpu.ir.nodes import WindowExpr
+from blaze_tpu.ops.generate import GenerateExec
+from blaze_tpu.ops.sort import SortExec
+from blaze_tpu.ops.window import WindowExec
+from tests.util import collect_pydict, mem_scan
+
+
+def col(n):
+    return E.Column(n)
+
+
+def sorted_scan(data, keys, num_batches=2):
+    return SortExec(mem_scan(data, num_batches=num_batches),
+                    [E.SortOrder(col(k)) for k in keys])
+
+
+DATA = {
+    "g": pa.array([1, 1, 1, 2, 2, 3], type=pa.int64()),
+    "o": pa.array([10, 20, 20, 5, 6, 9], type=pa.int64()),
+    "v": pa.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0], type=pa.float64()),
+}
+
+
+def test_row_number_rank_dense():
+    scan = sorted_scan(DATA, ["g", "o"])
+    op = WindowExec(scan, [
+        WindowExpr("row_number", "rn"),
+        WindowExpr("rank", "rk"),
+        WindowExpr("dense_rank", "dr"),
+    ], [col("g")], [E.SortOrder(col("o"))])
+    out = collect_pydict(op)
+    assert out["rn"] == [1, 2, 3, 1, 2, 1]
+    assert out["rk"] == [1, 2, 2, 1, 2, 1]
+    assert out["dr"] == [1, 2, 2, 1, 2, 1]
+
+
+def test_window_running_sum_with_peers():
+    scan = sorted_scan(DATA, ["g", "o"])
+    op = WindowExec(scan, [
+        WindowExpr("agg", "rsum", agg=E.AggExpr(E.AggFunction.SUM, [col("v")])),
+    ], [col("g")], [E.SortOrder(col("o"))])
+    out = collect_pydict(op)
+    # peers (o=20,20) share the frame value
+    assert out["rsum"] == [1.0, 6.0, 6.0, 4.0, 9.0, 6.0]
+
+
+def test_window_group_limit():
+    scan = sorted_scan(DATA, ["g", "o"])
+    op = WindowExec(scan, [WindowExpr("row_number", "rn")],
+                    [col("g")], [E.SortOrder(col("o"))], group_limit=2)
+    out = collect_pydict(op)
+    assert out["g"] == [1, 1, 2, 2, 3]
+    assert out["rn"] == [1, 2, 1, 2, 1]
+
+
+def test_window_partition_spans_batches():
+    data = {"g": [1] * 10 + [2] * 6, "o": list(range(10)) + list(range(6))}
+    scan = sorted_scan(data, ["g", "o"], num_batches=4)
+    op = WindowExec(scan, [WindowExpr("row_number", "rn")],
+                    [col("g")], [E.SortOrder(col("o"))])
+    out = collect_pydict(op)
+    assert out["rn"] == list(range(1, 11)) + list(range(1, 7))
+
+
+def test_explode():
+    schema = T.Schema.of(("id", T.I64), ("xs", T.ArrayType(T.I64)))
+    data = {"id": [1, 2, 3], "xs": [[10, 20], [], [30]]}
+    scan = mem_scan(data, schema)
+    op = GenerateExec(scan, "explode", [col("xs")], [0],
+                      T.Schema.of(("x", T.I64)))
+    out = collect_pydict(op)
+    assert out == {"id": [1, 1, 3], "x": [10, 20, 30]}
+    # outer keeps empty rows
+    op = GenerateExec(scan, "explode", [col("xs")], [0],
+                      T.Schema.of(("x", T.I64)), outer=True)
+    out = collect_pydict(op)
+    assert out == {"id": [1, 1, 2, 3], "x": [10, 20, None, 30]}
+
+
+def test_pos_explode():
+    schema = T.Schema.of(("id", T.I64), ("xs", T.ArrayType(T.STRING)))
+    data = {"id": [7], "xs": [["a", "b"]]}
+    scan = mem_scan(data, schema)
+    op = GenerateExec(scan, "pos_explode", [col("xs")], [0],
+                      T.Schema.of(("pos", T.I32), ("x", T.STRING)))
+    out = collect_pydict(op)
+    assert out == {"id": [7, 7], "pos": [0, 1], "x": ["a", "b"]}
+
+
+def test_json_tuple():
+    data = {"id": [1, 2], "j": ['{"a": 1, "b": "x"}', "bad"]}
+    scan = mem_scan(data)
+    op = GenerateExec(scan, "json_tuple",
+                      [col("j"), E.Literal("a", T.STRING), E.Literal("b", T.STRING)],
+                      [0], T.Schema.of(("a", T.STRING), ("b", T.STRING)))
+    out = collect_pydict(op)
+    assert out == {"id": [1, 2], "a": ["1", None], "b": ["x", None]}
+
+
+def test_udtf():
+    def split_udtf(s):
+        if s is None:
+            return
+        for part in s.split(","):
+            yield (part, len(part))
+
+    data = {"id": [1, 2], "s": ["a,bb", None]}
+    scan = mem_scan(data)
+    op = GenerateExec(scan, "udtf", [col("s")], [0],
+                      T.Schema.of(("part", T.STRING), ("len", T.I32)),
+                      outer=True, udtf=split_udtf)
+    out = collect_pydict(op)
+    assert out == {"id": [1, 1, 2], "part": ["a", "bb", None], "len": [1, 2, None]}
+
+
+def test_window_agg_peers_span_batches():
+    # regression: peer group crossing a batch boundary must share one frame
+    # value; partition spanning batches must aggregate fully
+    data = {"g": [1, 1, 1, 1], "o": [10, 20, 20, 20], "v": [1.0, 2.0, 3.0, 4.0]}
+    scan = mem_scan(data, num_batches=2)  # split inside the o=20 peer group
+    op = WindowExec(scan, [
+        WindowExpr("agg", "rsum", agg=E.AggExpr(E.AggFunction.SUM, [col("v")])),
+    ], [col("g")], [E.SortOrder(col("o"))])
+    out = collect_pydict(op)
+    assert out["rsum"] == [1.0, 10.0, 10.0, 10.0]
+
+
+def test_window_whole_partition_agg_spans_batches():
+    data = {"g": [1, 1, 1, 1, 2], "v": [1.0, 2.0, 3.0, 4.0, 9.0]}
+    scan = mem_scan(data, num_batches=2)
+    op = WindowExec(scan, [
+        WindowExpr("agg", "tot", agg=E.AggExpr(E.AggFunction.SUM, [col("v")])),
+        WindowExpr("agg", "mx", agg=E.AggExpr(E.AggFunction.MAX, [col("v")])),
+    ], [col("g")], [])
+    out = collect_pydict(op)
+    assert out["tot"] == [10.0, 10.0, 10.0, 10.0, 9.0]
+    assert out["mx"] == [4.0, 4.0, 4.0, 4.0, 9.0]
+
+
+def test_window_running_min_with_nulls():
+    data = {"g": [1, 1, 1], "o": [1, 2, 3],
+            "v": pa.array([None, 5.0, 3.0], type=pa.float64())}
+    scan = mem_scan(data)
+    op = WindowExec(scan, [
+        WindowExpr("agg", "rmin", agg=E.AggExpr(E.AggFunction.MIN, [col("v")])),
+        WindowExpr("agg", "rcnt", agg=E.AggExpr(E.AggFunction.COUNT, [col("v")])),
+    ], [col("g")], [E.SortOrder(col("o"))])
+    out = collect_pydict(op)
+    assert out["rmin"] == [None, 5.0, 3.0]
+    assert out["rcnt"] == [0, 1, 2]
