@@ -111,17 +111,7 @@ class DeviceColumn(Column):
     def to_arrow(self, num_rows: int) -> pa.Array:
         data = np.asarray(self.data[:num_rows])
         validity = np.asarray(self.validity[:num_rows])
-        dt = self.dtype
-        if isinstance(dt, T.DecimalType):
-            return _int64_to_decimal128(data, validity, dt)
-        if isinstance(dt, T.BooleanType):
-            return pa.Array.from_buffers(
-                pa.bool_(), num_rows, [pack_bitmap(validity), pack_bitmap(data)]
-            )
-        atype = T.to_arrow_type(dt)
-        return pa.Array.from_buffers(
-            atype, num_rows, [pack_bitmap(validity), pa.py_buffer(np.ascontiguousarray(data))]
-        )
+        return _devcol_to_arrow(self.dtype, data, validity, num_rows)
 
     @staticmethod
     def from_numpy(dt: T.DataType, data: np.ndarray, validity: Optional[np.ndarray], capacity: int) -> "DeviceColumn":
@@ -133,6 +123,20 @@ class DeviceColumn(Column):
         np.copyto(buf[:n], np.where(validity, data, np.zeros((), dt.np_dtype)), casting="unsafe")
         vbuf[:n] = validity
         return DeviceColumn(dt, jnp.asarray(buf), jnp.asarray(vbuf))
+
+
+def _devcol_to_arrow(dt: T.DataType, data: np.ndarray, validity: np.ndarray,
+                     num_rows: int) -> pa.Array:
+    if isinstance(dt, T.DecimalType):
+        return _int64_to_decimal128(data, validity, dt)
+    if isinstance(dt, T.BooleanType):
+        return pa.Array.from_buffers(
+            pa.bool_(), num_rows, [pack_bitmap(validity), pack_bitmap(data)]
+        )
+    atype = T.to_arrow_type(dt)
+    return pa.Array.from_buffers(
+        atype, num_rows, [pack_bitmap(validity), pa.py_buffer(np.ascontiguousarray(data))]
+    )
 
 
 @dataclasses.dataclass
@@ -374,7 +378,14 @@ class ColumnarBatch:
     # --- host boundary -------------------------------------------------------
 
     def to_arrow(self) -> pa.RecordBatch:
-        arrays = [c.to_arrow(self.num_rows) for c in self.columns]
+        from blaze_tpu.utils.device import pull_columns
+
+        pulled = pull_columns(self.columns, self.num_rows)
+        arrays = [
+            c.to_arrow(self.num_rows) if p is None
+            else _devcol_to_arrow(c.dtype, p[0], p[1], self.num_rows)
+            for c, p in zip(self.columns, pulled)
+        ]
         return pa.RecordBatch.from_arrays(arrays, schema=T.schema_to_arrow(self.schema))
 
     def to_arrow_batches(self):

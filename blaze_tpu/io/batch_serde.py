@@ -48,15 +48,18 @@ def serialize_batch(batch: ColumnarBatch, transpose: Optional[bool] = None) -> b
     cfg = get_config()
     if transpose is None:
         transpose = cfg.serde_transpose
+    from blaze_tpu.utils.device import pull_columns
+
     n = batch.num_rows
     buffers: List[bytes] = []
     cols_meta = []
     host_cols = []
     host_idx = []
+    pulled = pull_columns(batch.columns, n)  # one transfer for all columns
     for i, col in enumerate(batch.columns):
         if isinstance(col, DeviceColumn):
-            data = np.ascontiguousarray(np.asarray(col.data[:n]))
-            validity = np.asarray(col.validity[:n])
+            data = np.ascontiguousarray(pulled[i][0])
+            validity = pulled[i][1]
             raw = data.view(np.uint8).reshape(n, -1) if n else data.view(np.uint8).reshape(0, data.dtype.itemsize)
             if transpose and data.dtype.itemsize > 1:
                 raw = np.ascontiguousarray(raw.T)

@@ -88,6 +88,18 @@ class AggExec(Operator):
 
     def _execute(self, partition, ctx, metrics):
         child_schema = self.children[0].schema
+        from blaze_tpu.ops.agg_device import DevicePartialAgger, supports_device_partial
+
+        if self.exec_mode == E.AggExecMode.HASH_AGG and \
+                supports_device_partial(self, child_schema):
+            # TPU fast path: per-batch device partials, no host interning
+            agger = DevicePartialAgger(self, child_schema)
+            for batch in self.execute_child(0, partition, ctx, metrics):
+                with metrics.timer("elapsed_compute"):
+                    out = agger.process(batch)
+                if out is not None and out.num_rows:
+                    yield out
+            return
         table = AggTable(self, child_schema, ctx, metrics)
         ctx.mem.register(table)
         try:
@@ -206,10 +218,11 @@ class AggTable(MemConsumer):
             return np.zeros(n, dtype=np.int64)
         all_device = all(isinstance(c, DeviceColumn) for c in cols)
         if all_device:
+            from blaze_tpu.utils.device import pull_columns
+
+            pulled = pull_columns(cols, n)
             mats = []
-            for c in cols:
-                data = np.asarray(c.data[:n])
-                valid = np.asarray(c.validity[:n])
+            for c, (data, valid) in zip(cols, pulled):
                 if data.dtype == np.float64:
                     d64 = np.where(valid, data, 0.0).view(np.int64)
                 elif data.dtype == np.float32:

@@ -1,0 +1,250 @@
+"""Device-resident partial aggregation: the TPU fast path.
+
+The general AggTable (ops/agg.py) interns group keys on host — exact for any
+type, but it pulls every input batch's key columns across the device
+boundary. On this backend transfers cost ~25-90ms each, so for the hot
+TPC-DS shape (grouped sum/count/avg/min/max over fixed-width keys) this
+module keeps the whole partial stage on device (SURVEY.md §7.2 L2':
+sort-based grouped aggregation over ``lax.sort`` + segment ops — the same
+kernel the ICI mesh path uses, parallel/mesh.py):
+
+    sort rows by (key validity, key value)* -> segment boundaries ->
+    segment_sum/min/max per aggregate -> compact -> partial batch whose key
+    and state columns are still device arrays.
+
+One jitted call per batch; the only host sync is the group-count scalar.
+Per-batch partials are NOT consolidated across batches — they merge at the
+final stage (or in the exchange reducer), trading a slightly larger
+exchange payload for zero full-width transfers."""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from blaze_tpu.core.batch import ColumnarBatch, DeviceColumn
+from blaze_tpu.exprs.compiler import ExprEvaluator, _broadcast
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import types as T
+from blaze_tpu.utils.device import is_device_dtype
+
+_DEVICE_AGG_FNS = (E.AggFunction.SUM, E.AggFunction.COUNT, E.AggFunction.AVG,
+                   E.AggFunction.MIN, E.AggFunction.MAX)
+
+
+def supports_device_partial(op, child_schema: T.Schema) -> bool:
+    """Partial-mode hash agg over device keys and device-mode aggregates."""
+    if not op.is_partial_output or op.input_is_partial or not op.groupings:
+        return False
+    from blaze_tpu.ops import aggfns
+
+    for _, e in op.groupings:
+        if not is_device_dtype(E.infer_type(e, child_schema)):
+            return False
+    for a in op.aggs:
+        if a.agg.fn not in _DEVICE_AGG_FNS:
+            return False
+        fn = aggfns.create_agg_function(a.agg, child_schema)
+        if fn.host:
+            return False
+    return True
+
+
+class DevicePartialAgger:
+    """Streams batches through the jitted sort-segment partial kernel."""
+
+    def __init__(self, op, child_schema: T.Schema):
+        self.op = op
+        self.child_schema = child_schema
+        self.group_ev = ExprEvaluator([e for _, e in op.groupings], child_schema)
+        self.agg_evs = [
+            ExprEvaluator(list(a.agg.args), child_schema) if a.agg.args else None
+            for a in op.aggs
+        ]
+        from blaze_tpu.ops import aggfns
+
+        self.fns = [aggfns.create_agg_function(a.agg, child_schema) for a in op.aggs]
+        # static spec per agg: (kind, rescale_pow, acc_dtype) drives the
+        # kernel; acc dtype is the declared result/sum dtype so int32/f32
+        # args accumulate widened, matching the generic path
+        self.specs = []
+        for a, fn in zip(op.aggs, self.fns):
+            kind = a.agg.fn.value
+            rescale = 0
+            if isinstance(fn.arg_type, T.DecimalType) and isinstance(
+                    fn.result_type, T.DecimalType):
+                rescale = fn.result_type.scale - fn.arg_type.scale
+            if kind == "avg" and isinstance(fn.arg_type, T.DecimalType):
+                rescale = fn.sum_type.scale - fn.arg_type.scale
+            if kind == "sum":
+                acc_dt = "int64" if isinstance(fn.result_type, T.DecimalType) \
+                    else str(np.dtype(fn.result_type.np_dtype))
+            elif kind == "avg":
+                acc_dt = "int64" if isinstance(fn.sum_type, T.DecimalType) \
+                    else str(np.dtype(fn.sum_type.np_dtype))
+            else:
+                acc_dt = ""
+            self.specs.append((kind, rescale, acc_dt))
+
+    def process(self, batch: ColumnarBatch) -> Optional[ColumnarBatch]:
+        n = batch.num_rows
+        if n == 0:
+            return None
+        gcols = [self.group_ev._to_dev(self.group_ev._eval(e, batch), batch)
+                 for _, e in self.op.groupings]
+        key_data, key_valid = [], []
+        for v in gcols:
+            d, val = _broadcast(v, batch)
+            key_data.append(d)
+            key_valid.append(val & batch.row_exists_mask())
+        args = []
+        for a, ev in zip(self.op.aggs, self.agg_evs):
+            if ev is None:
+                args.append((jnp.zeros(batch.capacity, jnp.int64),
+                             batch.row_exists_mask()))
+            else:
+                dv = ev._to_dev(ev._eval(a.agg.args[0], batch), batch)
+                d, val = _broadcast(dv, batch)
+                args.append((d, val & batch.row_exists_mask()))
+        kernel = _partial_kernel(
+            tuple(str(d.dtype) for d in key_data),
+            tuple(self.specs),
+            tuple(str(a[0].dtype) for a in args),
+            batch.capacity,
+        )
+        flat = []
+        for d, v in zip(key_data, key_valid):
+            flat += [d, v]
+        for d, v in args:
+            flat += [d, v]
+        outs = kernel(batch.row_exists_mask(), *flat)
+        num_groups = int(outs[0])
+        if num_groups == 0:
+            return None
+        pos = 1
+        cols: List[DeviceColumn] = []
+        out_valid_mask = outs[pos]; pos += 1
+        schema = self.op.schema
+        ci = 0
+        for gi, (gname, e) in enumerate(self.op.groupings):
+            dt = schema[ci].dtype
+            cols.append(DeviceColumn(dt, outs[pos], outs[pos + 1] & out_valid_mask))
+            pos += 2
+            ci += 1
+        for a, fn, (kind, _, _) in zip(self.op.aggs, self.fns, self.specs):
+            if kind in ("sum",):
+                s, has = outs[pos], outs[pos + 1]; pos += 2
+                cols.append(DeviceColumn(fn.result_type, s, has & out_valid_mask))
+                cols.append(DeviceColumn(T.BOOL, has, out_valid_mask))
+                ci += 2
+            elif kind == "count":
+                c = outs[pos]; pos += 1
+                cols.append(DeviceColumn(T.I64, c, out_valid_mask))
+                ci += 1
+            elif kind == "avg":
+                s, c = outs[pos], outs[pos + 1]; pos += 2
+                cols.append(DeviceColumn(fn.sum_type, s, (c > 0) & out_valid_mask))
+                cols.append(DeviceColumn(T.I64, c, out_valid_mask))
+                ci += 2
+            elif kind in ("min", "max"):
+                v, has = outs[pos], outs[pos + 1]; pos += 2
+                cols.append(DeviceColumn(fn.result_type, v, has & out_valid_mask))
+                cols.append(DeviceColumn(T.BOOL, has, out_valid_mask))
+                ci += 2
+        return ColumnarBatch(schema, cols, num_groups)
+
+
+@functools.lru_cache(maxsize=256)
+def _partial_kernel(key_dtypes: Tuple[str, ...], specs: Tuple[Tuple[str, int], ...],
+                    arg_dtypes: Tuple[str, ...], capacity: int):
+    """Build + jit the per-batch partial kernel for one (schema, capacity)."""
+    nk = len(key_dtypes)
+
+    def kernel(exists, *flat):
+        key_data = [flat[2 * i] for i in range(nk)]
+        key_valid = [flat[2 * i + 1] for i in range(nk)]
+        args = [(flat[2 * nk + 2 * i], flat[2 * nk + 2 * i + 1])
+                for i in range(len(specs))]
+        # --- sort rows so equal keys are adjacent; padding rows last
+        operands = [(~exists).astype(jnp.uint8)]
+        for d, v in zip(key_data, key_valid):
+            operands.append(v.astype(jnp.uint8))
+            if jnp.issubdtype(d.dtype, jnp.floating):
+                # canonicalize float keys so grouping matches the host
+                # intern path: -0.0 folds into 0.0, all NaNs group together
+                d = jnp.where(jnp.isnan(d), jnp.array(float("nan"), d.dtype), d)
+                d = jnp.where(d == 0, jnp.zeros((), d.dtype), d)
+            operands.append(jnp.where(v, d, jnp.zeros((), d.dtype)))
+        iota = jnp.arange(capacity, dtype=jnp.int32)
+        sorted_ops = jax.lax.sort(tuple(operands) + (iota,), num_keys=len(operands))
+        order = sorted_ops[-1]
+        s_exists = exists[order]
+        s_keys = [(d[order], v[order]) for d, v in zip(key_data, key_valid)]
+        # --- segment boundaries: any key field differs from previous row
+        new = jnp.zeros(capacity, dtype=bool).at[0].set(True)
+        for d, v in s_keys:
+            new = new | jnp.concatenate([jnp.ones(1, bool), d[1:] != d[:-1]])
+            new = new | jnp.concatenate([jnp.ones(1, bool), v[1:] != v[:-1]])
+        new = new & s_exists
+        seg = jnp.cumsum(new) - 1
+        seg = jnp.where(s_exists, seg, capacity)  # padding rows drop
+        nseg_total = capacity
+        # --- per-aggregate segment reductions
+        outs = []
+        for (kind, rescale, acc_dt), (ad, av) in zip(specs, args):
+            sa = ad[order]
+            sv = av[order] & s_exists
+            if kind in ("sum", "avg"):
+                x = sa.astype(jnp.dtype(acc_dt))  # widen BEFORE accumulating
+                if rescale:
+                    x = x * jnp.array(10 ** rescale, x.dtype)
+                contrib = jnp.where(sv, x, jnp.zeros((), x.dtype))
+                ssum = jnp.zeros(nseg_total, contrib.dtype).at[seg].add(
+                    contrib, mode="drop")
+                scnt = jnp.zeros(nseg_total, jnp.int64).at[seg].add(
+                    sv.astype(jnp.int64), mode="drop")
+                if kind == "sum":
+                    outs.append(("sum", ssum, scnt > 0))
+                else:
+                    outs.append(("avg", ssum, scnt))
+            elif kind == "count":
+                scnt = jnp.zeros(nseg_total, jnp.int64).at[seg].add(
+                    sv.astype(jnp.int64), mode="drop")
+                outs.append(("count", scnt, None))
+            else:  # min / max
+                if jnp.issubdtype(sa.dtype, jnp.floating):
+                    sent = jnp.array(jnp.inf if kind == "min" else -jnp.inf, sa.dtype)
+                else:
+                    info = jnp.iinfo(sa.dtype)
+                    sent = jnp.array(info.max if kind == "min" else info.min, sa.dtype)
+                x = jnp.where(sv, sa, sent)
+                acc = jnp.full(nseg_total, sent, sa.dtype)
+                acc = acc.at[seg].min(x, mode="drop") if kind == "min" else \
+                    acc.at[seg].max(x, mode="drop")
+                shas = jnp.zeros(nseg_total, bool).at[seg].max(sv, mode="drop")
+                outs.append((kind, jnp.where(shas, acc, 0), shas))
+        # --- representative row (first of each segment) for key values
+        first_idx = jnp.full(nseg_total, capacity - 1, jnp.int32).at[seg].min(
+            iota, mode="drop")
+        seg_present = jnp.zeros(nseg_total, bool).at[seg].max(
+            s_exists, mode="drop")
+        num_groups = jnp.sum(seg_present)
+        # compact: present segments first, stable
+        corder = jnp.argsort(~seg_present, stable=True)
+        out_valid = seg_present[corder]
+        results = [num_groups, out_valid]
+        gather = first_idx[corder]
+        for d, v in s_keys:
+            results.append(jnp.where(out_valid, d[gather], jnp.zeros((), d.dtype)))
+            results.append(v[gather] & out_valid)
+        for kind, a, b in outs:
+            results.append(a[corder])
+            if b is not None:
+                results.append(b[corder] if b.dtype == jnp.bool_ else b[corder])
+        return tuple(results)
+
+    return jax.jit(kernel)

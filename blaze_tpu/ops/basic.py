@@ -14,7 +14,8 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from blaze_tpu.core.batch import ColumnarBatch
+from blaze_tpu.core.batch import ColumnarBatch, DeviceColumn
+import jax.numpy as jnp
 from blaze_tpu.exprs.compiler import ExprEvaluator
 from blaze_tpu.ir import exprs as E
 from blaze_tpu.ir import types as T
@@ -75,10 +76,25 @@ class FilterExec(Operator):
         for batch in self.execute_child(0, partition, ctx, metrics):
             with metrics.timer("elapsed_compute"):
                 mask = pred_ev.evaluate_predicate(batch)
-                indices = np.nonzero(np.asarray(mask))[0]
-                if len(indices) == 0:
-                    continue
-                out = batch if len(indices) == batch.num_rows else batch.take(indices)
+                all_device = all(isinstance(c, DeviceColumn) for c in batch.columns)
+                if all_device:
+                    # device-side stable compaction: one scalar pull instead
+                    # of pulling the whole mask + pushing indices
+                    count = int(mask.sum())
+                    if count == 0:
+                        continue
+                    if count == batch.num_rows:
+                        out = batch
+                    else:
+                        order = jnp.argsort(~mask, stable=True)
+                        valid = jnp.arange(batch.capacity) < count
+                        cols = [c.take_device(order, valid) for c in batch.columns]
+                        out = ColumnarBatch(batch.schema, cols, count)
+                else:
+                    indices = np.nonzero(np.asarray(mask))[0]
+                    if len(indices) == 0:
+                        continue
+                    out = batch if len(indices) == batch.num_rows else batch.take(indices)
                 if proj_ev is not None:
                     cols = proj_ev.evaluate(out)
                     out = ColumnarBatch(self.schema, cols, out.num_rows)

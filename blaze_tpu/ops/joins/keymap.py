@@ -34,11 +34,12 @@ def key_codes(batch: ColumnarBatch, cols: List[Column], key_map: Dict,
         return np.empty(0, dtype=np.int64)
     all_device = all(isinstance(c, DeviceColumn) for c in cols)
     if all_device:
+        from blaze_tpu.utils.device import pull_columns
+
+        pulled = pull_columns(cols, n)
         mats = []
         null_any = np.zeros(n, dtype=bool)
-        for c in cols:
-            data = np.asarray(c.data[:n])
-            valid = np.asarray(c.validity[:n])
+        for c, (data, valid) in zip(cols, pulled):
             null_any |= ~valid
             if data.dtype == np.float64:
                 d64 = np.where(valid, data, 0.0).view(np.int64)

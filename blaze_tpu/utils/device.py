@@ -40,3 +40,34 @@ def is_device_dtype(dt: T.DataType) -> bool:
     if isinstance(dt, T.Float64Type):
         return supports_f64()
     return dt.is_fixed_width
+
+
+def pull_columns(cols, n: int):
+    """Fetch many device columns' (data[:n], validity[:n]) in ONE
+    device_get round trip (the tunnel charges ~25-90ms per transfer
+    regardless of size — batching transfers is the single biggest lever on
+    this backend). Host columns pass through as None placeholders.
+
+    Returns a list aligned with ``cols``: (np_data, np_validity) for device
+    columns, None for host columns."""
+    from blaze_tpu.core.batch import DeviceColumn
+
+    to_pull = []
+    slots = []
+    for i, c in enumerate(cols):
+        if isinstance(c, DeviceColumn):
+            to_pull.append(c.data[:n])
+            to_pull.append(c.validity[:n])
+            slots.append(i)
+    if not to_pull:
+        return [None] * len(cols)
+    # start every transfer before blocking on any (device_get would pull
+    # leaves sequentially on this backend — async-then-collect overlaps the
+    # round trips, ~3x on the tunnel)
+    for a in to_pull:
+        a.copy_to_host_async()
+    pulled = [np.asarray(a) for a in to_pull]
+    out = [None] * len(cols)
+    for k, i in enumerate(slots):
+        out[i] = (pulled[2 * k], pulled[2 * k + 1])
+    return out

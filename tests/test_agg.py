@@ -273,3 +273,21 @@ def test_hash_wide_decimal_matches_binary():
     import tests.test_spark_hash as tsh
 
     assert out[0] == np.uint32(tsh.mmh3_scalar(blob, 42)).astype(np.int32)
+
+
+def test_device_partial_widening_sum_i32():
+    # regression: sum(int32) must accumulate in int64 on the device fast path
+    schema = T.Schema.of(("k", T.I32), ("v", T.I32))
+    n = 3000
+    data = {"k": pa.array([1] * n, type=pa.int32()),
+            "v": pa.array([2_000_000] * n, type=pa.int32())}
+    scan = mem_scan(data, schema)
+    partial = AggExec(scan, HASH, [("k", col("k"))],
+                      [agg_col(F.SUM, [col("v")], M.PARTIAL, "s"),
+                       agg_col(F.AVG, [col("v")], M.PARTIAL, "a")])
+    final = AggExec(partial, HASH, [("k", col("k"))],
+                    [agg_col(F.SUM, [col("v")], M.FINAL, "s"),
+                     agg_col(F.AVG, [col("v")], M.FINAL, "a")])
+    out = collect_pydict(final)
+    assert out["s"] == [2_000_000 * n]  # > 2^31, would wrap in int32
+    assert out["a"] == [2_000_000.0]
