@@ -148,9 +148,15 @@ def murmur3_bytes_np(offsets: np.ndarray, data: np.ndarray, seeds: np.ndarray) -
     """Spark hashUnsafeBytes over n variable-length byte strings.
 
     offsets: int64 (n+1), data: uint8 concatenated bytes, seeds: uint32 (n,).
-    Vectorized: one masked pass per 4-byte word position, then per tail byte
+    Uses the native C++ kernel when built (native/src/blaze_native.cc);
+    numpy fallback is vectorized per word position, then per tail byte
     (tail bytes are *signed*, each through a full mix round).
     """
+    from blaze_tpu.utils import native
+
+    out = native.murmur3_bytes(offsets, data, seeds)
+    if out is not None:
+        return out
     offsets = np.asarray(offsets, dtype=np.int64)
     data = np.asarray(data, dtype=np.uint8)
     starts = offsets[:-1]
@@ -261,9 +267,15 @@ def xxhash64_int32_np(values, seeds):
 def xxhash64_bytes_np(offsets: np.ndarray, data: np.ndarray, seeds: np.ndarray) -> np.ndarray:
     """Standard XXH64 over n variable-length byte strings (Spark XXH64).
 
-    Stripe loop (32-byte blocks with 4 lanes), then 8-byte chunks, 4-byte
-    chunk, single unsigned bytes, then final avalanche.
+    Native C++ kernel when built; numpy fallback runs the stripe loop
+    (32-byte blocks with 4 lanes), then 8-byte chunks, 4-byte chunk, single
+    unsigned bytes, then the final avalanche.
     """
+    from blaze_tpu.utils import native
+
+    out = native.xxh64_bytes(offsets, data, seeds)
+    if out is not None:
+        return out
     offsets = np.asarray(offsets, dtype=np.int64)
     data = np.asarray(data, dtype=np.uint8)
     starts = offsets[:-1]

@@ -31,18 +31,6 @@ from blaze_tpu.ir.serde import schema_from_json, schema_to_json
 _MAGIC = b"BTB1"
 
 
-def _compressor(codec: str, level: int):
-    if codec == "none":
-        return None
-    return zstandard.ZstdCompressor(level=level)
-
-
-def _decompressor(codec: str):
-    if codec == "none":
-        return None
-    return zstandard.ZstdDecompressor()
-
-
 def serialize_batch(batch: ColumnarBatch, transpose: Optional[bool] = None) -> bytes:
     """One batch -> uncompressed payload bytes."""
     cfg = get_config()
@@ -60,10 +48,16 @@ def serialize_batch(batch: ColumnarBatch, transpose: Optional[bool] = None) -> b
         if isinstance(col, DeviceColumn):
             data = np.ascontiguousarray(pulled[i][0])
             validity = pulled[i][1]
-            raw = data.view(np.uint8).reshape(n, -1) if n else data.view(np.uint8).reshape(0, data.dtype.itemsize)
-            if transpose and data.dtype.itemsize > 1:
-                raw = np.ascontiguousarray(raw.T)
-            buffers.append(raw.tobytes())
+            if transpose and data.dtype.itemsize > 1 and n:
+                from blaze_tpu.utils import native
+
+                t = native.transpose(data, n, data.dtype.itemsize, forward=True)
+                if t is None:
+                    t = np.ascontiguousarray(
+                        data.view(np.uint8).reshape(n, -1).T)
+                buffers.append(t.tobytes())
+            else:
+                buffers.append(data.view(np.uint8).tobytes())
             buffers.append(np.packbits(validity.astype(np.uint8), bitorder="little").tobytes())
             cols_meta.append({"kind": "dev", "transposed": bool(transpose and data.dtype.itemsize > 1)})
         else:
@@ -132,7 +126,11 @@ def deserialize_batch(payload: bytes) -> ColumnarBatch:
             itemsize = npdt.itemsize
             arr = np.frombuffer(raw, dtype=np.uint8)
             if meta["transposed"]:
-                arr = np.ascontiguousarray(arr.reshape(itemsize, n).T)
+                from blaze_tpu.utils import native
+
+                t = native.transpose(arr, n, itemsize, forward=False)
+                arr = t if t is not None else np.ascontiguousarray(
+                    arr.reshape(itemsize, n).T)
             data = arr.view(npdt).reshape(n) if n else np.zeros(0, dtype=npdt)
             validity = unpack_bitmap(vraw, n) if n else np.zeros(0, dtype=bool)
             cols.append(DeviceColumn.from_numpy(f.dtype, data, validity, cap))
@@ -141,22 +139,65 @@ def deserialize_batch(payload: bytes) -> ColumnarBatch:
     return ColumnarBatch(schema, cols, n)
 
 
+_FRAME_FMT = "<4sIQQ"  # magic, flags (1 = zstd), compressed len, raw len
+_FRAME_LEN = struct.calcsize(_FRAME_FMT)
+
+
+def _zstd_compress(payload: bytes, level: int) -> bytes:
+    from blaze_tpu.utils import native
+
+    l = native.lib()
+    if l is not None:
+        import numpy as np
+
+        src = np.frombuffer(payload, dtype=np.uint8)
+        bound = l.bt_zstd_compress_bound(len(payload))
+        if bound > 0:
+            dst = np.empty(bound, dtype=np.uint8)
+            r = l.bt_zstd_compress(src.ctypes.data, len(payload),
+                                   dst.ctypes.data, bound, level)
+            if r > 0:
+                return dst[:r].tobytes()
+    return zstandard.ZstdCompressor(level=level).compress(payload)
+
+
+def _zstd_decompress(payload: bytes, raw_len: int) -> bytes:
+    from blaze_tpu.utils import native
+
+    l = native.lib()
+    if l is not None and raw_len > 0:
+        import numpy as np
+
+        src = np.frombuffer(payload, dtype=np.uint8)
+        dst = np.empty(raw_len, dtype=np.uint8)
+        r = l.bt_zstd_decompress(src.ctypes.data, len(payload),
+                                 dst.ctypes.data, raw_len)
+        if r == raw_len:
+            return dst.tobytes()
+    return zstandard.ZstdDecompressor().decompress(payload, max_output_size=raw_len or 0)
+
+
 class BatchWriter:
     """Length-prefixed compressed frames, one per batch (reference:
-    IpcCompressionWriter over lz4/zstd framed streams)."""
+    IpcCompressionWriter over lz4/zstd framed streams). Compression runs in
+    the native library when built (native/src/blaze_native.cc), else via the
+    python zstandard binding."""
 
     def __init__(self, fileobj: BinaryIO, codec: Optional[str] = None):
         cfg = get_config()
         self.f = fileobj
         self.codec = codec or cfg.shuffle_compression_codec
-        self._comp = _compressor(self.codec, cfg.zstd_level)
+        self.level = cfg.zstd_level
         self.bytes_written = 0
 
     def write_batch(self, batch: ColumnarBatch):
         payload = serialize_batch(batch)
-        if self._comp is not None:
-            payload = self._comp.compress(payload)
-        frame = struct.pack("<4sIQ", _MAGIC, 1 if self._comp else 0, len(payload))
+        raw_len = len(payload)
+        compressed = self.codec != "none"
+        if compressed:
+            payload = _zstd_compress(payload, self.level)
+        frame = struct.pack(_FRAME_FMT, _MAGIC, 1 if compressed else 0,
+                            len(payload), raw_len)
         self.f.write(frame)
         self.f.write(payload)
         self.bytes_written += len(frame) + len(payload)
@@ -165,16 +206,15 @@ class BatchWriter:
 class BatchReader:
     def __init__(self, fileobj: BinaryIO):
         self.f = fileobj
-        self._decomp = zstandard.ZstdDecompressor()
 
     def __iter__(self) -> Iterator[ColumnarBatch]:
         while True:
-            head = self.f.read(16)
+            head = self.f.read(_FRAME_LEN)
             if not head:
                 return
-            magic, compressed, plen = struct.unpack("<4sIQ", head)
+            magic, compressed, plen, raw_len = struct.unpack(_FRAME_FMT, head)
             assert magic == _MAGIC, f"bad frame magic {magic!r}"
             payload = self.f.read(plen)
             if compressed:
-                payload = self._decomp.decompress(payload)
+                payload = _zstd_decompress(payload, raw_len)
             yield deserialize_batch(payload)

@@ -38,6 +38,9 @@ from blaze_tpu.runtime.metrics import MetricNode
 class Session:
     def __init__(self, conf: Optional[Config] = None, work_dir: Optional[str] = None,
                  max_workers: Optional[int] = None):
+        from blaze_tpu.utils.native import ensure_built_async
+
+        ensure_built_async()  # background; numpy fallbacks serve meanwhile
         self.conf = conf or get_config()
         self.work_dir = work_dir or tempfile.mkdtemp(prefix="blaze_tpu_session_")
         self.max_workers = max_workers or self.conf.num_io_threads
@@ -51,12 +54,18 @@ class Session:
     def execute(self, plan: N.PlanNode) -> Iterator[ColumnarBatch]:
         """Run a plan, yielding all result batches (final-stage partitions in
         order)."""
+        from blaze_tpu.utils.logutil import clear_task_context, set_task_context
+
         lowered = self._lower(plan)
         op = build_operator(lowered)
         for p in range(op.num_partitions()):
             ctx = self._make_ctx(p)
-            yield from op.execute(p, ctx,
-                                  self.metrics.named_child(f"result_{p}"))
+            set_task_context(0, p)
+            try:
+                yield from op.execute(p, ctx,
+                                      self.metrics.named_child(f"result_{p}"))
+            finally:
+                clear_task_context()
 
     def execute_to_table(self, plan: N.PlanNode) -> pa.Table:
         batches = [b.to_arrow() for b in self.execute(plan) if b.num_rows]
@@ -97,14 +106,19 @@ class Session:
 
         def run_map(m: int):
             from blaze_tpu.ops.shuffle.writer import ShuffleWriterExec
+            from blaze_tpu.utils.logutil import clear_task_context, set_task_context
 
             data = os.path.join(shuffle_dir, f"map_{m}.data")
             index = os.path.join(shuffle_dir, f"map_{m}.index")
             writer = ShuffleWriterExec(child_op, node.partitioning, data, index)
             ctx = self._make_ctx(m, stage)
             task_metrics = self.metrics.named_child(f"stage_{stage}").named_child(f"map_{m}")
-            for _ in writer.execute(m, ctx, task_metrics):
-                pass
+            set_task_context(stage, m)
+            try:
+                for _ in writer.execute(m, ctx, task_metrics):
+                    pass
+            finally:
+                clear_task_context()
             return data, index
 
         outputs = self._run_tasks(run_map, range(num_maps))
@@ -145,12 +159,17 @@ class Session:
 
         def run_map(m: int):
             from blaze_tpu.ops.shuffle.reader import IpcWriterExec
+            from blaze_tpu.utils.logutil import clear_task_context, set_task_context
 
             writer = IpcWriterExec(child_op, cid)
             ctx = self._make_ctx(m, stage)
             task_metrics = self.metrics.named_child(f"stage_{stage}").named_child(f"map_{m}")
-            for _ in writer.execute(m, ctx, task_metrics):
-                pass
+            set_task_context(stage, m)
+            try:
+                for _ in writer.execute(m, ctx, task_metrics):
+                    pass
+            finally:
+                clear_task_context()
 
         self._run_tasks(run_map, range(num_maps))
         rid = f"broadcast_{stage}"

@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/blaze_native.dir/src/blaze_native.cc.o"
+  "CMakeFiles/blaze_native.dir/src/blaze_native.cc.o.d"
+  "libblaze_native.pdb"
+  "libblaze_native.so"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/blaze_native.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,7 @@
+#!/bin/sh
+# Build the native host-kernel library (native/ -> native/build/libblaze_native.so)
+set -e
+cd "$(dirname "$0")/.."
+cmake -S native -B native/build -DCMAKE_BUILD_TYPE=Release
+cmake --build native/build -- -j2
+echo "built: native/build/libblaze_native.so"

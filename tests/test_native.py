@@ -1,0 +1,65 @@
+"""Native C++ kernel parity tests: the ctypes library must agree bit-for-bit
+with the numpy fallbacks (which the golden Spark vectors anchor)."""
+
+import numpy as np
+import pytest
+
+from blaze_tpu.utils import native
+
+
+requires_native = pytest.mark.skipif(native.lib() is None,
+                                     reason="native library not built")
+
+
+def _str_arrays(strings):
+    enc = [s.encode("utf-8") for s in strings]
+    offsets = np.zeros(len(enc) + 1, dtype=np.int64)
+    np.cumsum([len(b) for b in enc], out=offsets[1:])
+    data = np.frombuffer(b"".join(enc), dtype=np.uint8)
+    return offsets, data
+
+
+@requires_native
+def test_murmur3_native_matches_numpy():
+    import tests.test_spark_hash as tsh
+
+    rng = np.random.default_rng(0)
+    strings = ["".join(chr(rng.integers(32, 500)) for _ in range(rng.integers(0, 40)))
+               for _ in range(300)]
+    offsets, data = _str_arrays(strings)
+    seeds = rng.integers(0, 2**32, size=len(strings), dtype=np.uint32)
+    out = native.murmur3_bytes(offsets, data, seeds)
+    expected = np.array(
+        [tsh.mmh3_scalar(s.encode(), int(seed)) for s, seed in zip(strings, seeds)],
+        dtype=np.uint32)
+    np.testing.assert_array_equal(out, expected)
+
+
+@requires_native
+def test_xxh64_native_matches_numpy():
+    import tests.test_spark_hash as tsh
+
+    rng = np.random.default_rng(1)
+    strings = ["".join(chr(rng.integers(32, 500)) for _ in range(rng.integers(0, 100)))
+               for _ in range(300)]
+    offsets, data = _str_arrays(strings)
+    seeds = rng.integers(0, 2**63, size=len(strings), dtype=np.uint64)
+    out = native.xxh64_bytes(offsets, data, seeds)
+    expected = np.array(
+        [tsh.xxh64_scalar(s.encode(), int(seed)) for s, seed in zip(strings, seeds)],
+        dtype=np.uint64)
+    np.testing.assert_array_equal(out, expected)
+
+
+@requires_native
+def test_transpose_roundtrip():
+    rng = np.random.default_rng(2)
+    for dtype in (np.int64, np.float32, np.int16):
+        vals = rng.integers(0, 1000, 777).astype(dtype)
+        n, itemsize = len(vals), vals.dtype.itemsize
+        planes = native.transpose(vals, n, itemsize, forward=True)
+        expected = np.ascontiguousarray(
+            vals.view(np.uint8).reshape(n, itemsize).T).reshape(-1)
+        np.testing.assert_array_equal(planes, expected)
+        back = native.transpose(planes, n, itemsize, forward=False)
+        np.testing.assert_array_equal(back.view(dtype), vals)

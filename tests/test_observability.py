@@ -1,0 +1,60 @@
+import json
+import urllib.request
+
+import pyarrow as pa
+
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import nodes as N
+from blaze_tpu.runtime.http import ProfilingService
+from blaze_tpu.runtime.session import Session
+from blaze_tpu.core import ColumnarBatch
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.read().decode()
+
+
+def test_profiling_service_endpoints():
+    sess = Session()
+    b = ColumnarBatch.from_pydict({"a": [1, 2, 3]})
+    sess.resources["src"] = lambda p: [b.to_arrow()]
+    plan = N.Filter(N.FFIReader(schema=b.schema, resource_id="src", num_partitions=1),
+                    [E.BinaryExpr(E.BinaryOp.GT, E.Column("a"),
+                                  E.Literal(1, __import__("blaze_tpu.ir.types", fromlist=["I64"]).I64))])
+    list(sess.execute(plan))
+    svc = ProfilingService.start(sess)
+    try:
+        metrics = json.loads(_get(svc.port, "/debug/metrics"))
+        assert metrics["name"] == "session"
+        assert metrics["children"], "metric tree should have task nodes"
+        mem = json.loads(_get(svc.port, "/debug/memory"))
+        assert mem["process_rss_bytes"] > 0
+        cfg = json.loads(_get(svc.port, "/debug/config"))
+        assert cfg["batch_size"] >= 1024
+        prof = _get(svc.port, "/debug/pprof/profile?seconds=0.1")
+        assert "function calls" in prof
+    finally:
+        ProfilingService.stop()
+
+
+def test_metrics_tree_counts_rows():
+    sess = Session()
+    b = ColumnarBatch.from_pydict({"a": list(range(10))})
+    sess.resources["src"] = lambda p: [b.to_arrow()]
+    plan = N.FFIReader(schema=b.schema, resource_id="src", num_partitions=1)
+    list(sess.execute(plan))
+    assert sess.metrics.total("output_rows") == 10
+
+
+def test_task_context_logging(capsys):
+    from blaze_tpu.utils.logutil import init_logging, set_task_context, clear_task_context
+    import logging
+
+    log = init_logging("INFO")
+    set_task_context(3, 7)
+    logging.getLogger("blaze_tpu.test").info("hello")
+    clear_task_context()
+    # handler writes to stderr
+    err = capsys.readouterr().err
+    assert "[3.7" in err and "hello" in err

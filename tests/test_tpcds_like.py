@@ -1,0 +1,228 @@
+"""TPC-DS-shaped end-to-end queries through the Session, validated against a
+pandas oracle — the miniature analogue of the reference's TPC-DS sf=1
+correctness gate (SURVEY.md §4.3), covering the BASELINE.md query shapes:
+q01 (scan->filter->2-stage agg), q06/q07 (broadcast join + group), q17/q25
+(multi-way join), q47/q67 (window rank over sorted partitions), plus
+grouping-sets via Expand."""
+
+import collections
+from decimal import Decimal
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import nodes as N
+from blaze_tpu.ir import types as T
+from blaze_tpu.ops.parquet import scan_node_for_files
+from blaze_tpu.runtime.session import Session
+
+
+def col(n):
+    return E.Column(n)
+
+
+def lit(v, t):
+    return E.Literal(v, t)
+
+
+F = E.AggFunction
+M = E.AggMode
+HASH = E.AggExecMode.HASH_AGG
+
+
+@pytest.fixture(scope="module")
+def warehouse(tmp_path_factory):
+    """Tiny deterministic star schema on parquet."""
+    d = tmp_path_factory.mktemp("tpcds")
+    rng = np.random.default_rng(7)
+    n_sales = 20_000
+    n_items = 200
+    n_customers = 500
+
+    store_sales = pa.table({
+        "ss_item_sk": pa.array(rng.integers(1, n_items + 1, n_sales), type=pa.int64()),
+        "ss_customer_sk": pa.array(rng.integers(1, n_customers + 1, n_sales), type=pa.int64()),
+        "ss_store_sk": pa.array(rng.integers(1, 10, n_sales), type=pa.int64()),
+        "ss_sold_date_sk": pa.array(rng.integers(2450000, 2450100, n_sales), type=pa.int64()),
+        "ss_quantity": pa.array(rng.integers(1, 100, n_sales), type=pa.int32()),
+        "ss_sales_price": pa.array(
+            [Decimal(int(v)).scaleb(-2) for v in rng.integers(50, 20000, n_sales)],
+            type=pa.decimal128(7, 2)),
+    })
+    item = pa.table({
+        "i_item_sk": pa.array(np.arange(1, n_items + 1), type=pa.int64()),
+        "i_category": pa.array([f"Category{v % 8}" for v in range(n_items)]),
+        "i_brand": pa.array([f"Brand{v % 25}" for v in range(n_items)]),
+        "i_current_price": pa.array(
+            [Decimal(int(v)).scaleb(-2) for v in rng.integers(100, 9999, n_items)],
+            type=pa.decimal128(7, 2)),
+    })
+    customer = pa.table({
+        "c_customer_sk": pa.array(np.arange(1, n_customers + 1), type=pa.int64()),
+        "c_state": pa.array([f"S{v % 12}" for v in range(n_customers)]),
+    })
+    paths = {}
+    for name, tbl in [("store_sales", store_sales), ("item", item),
+                      ("customer", customer)]:
+        p = str(d / f"{name}.parquet")
+        pq.write_table(tbl, p, row_group_size=4096)
+        paths[name] = p
+    dfs = {"store_sales": store_sales.to_pandas(),
+           "item": item.to_pandas(), "customer": customer.to_pandas()}
+    return paths, dfs
+
+
+def two_stage_agg(child, groupings, aggs, n_reducers=3):
+    partial = N.Agg(child, HASH, groupings,
+                    [N.AggColumn(E.AggExpr(a.fn, a.args, rt), M.PARTIAL, name)
+                     for name, a, rt in aggs])
+    ex = N.ShuffleExchange(partial, N.HashPartitioning(
+        [e for _, e in groupings], n_reducers))
+    final = N.Agg(ex, HASH, groupings,
+                  [N.AggColumn(E.AggExpr(a.fn, a.args, rt), M.FINAL, name)
+                   for name, a, rt in aggs])
+    return final
+
+
+def test_q01_shape(warehouse):
+    """scan -> filter -> 2-stage agg -> topk (q01/BASELINE config 1)."""
+    paths, dfs = warehouse
+    scan = scan_node_for_files([paths["store_sales"]], num_partitions=2)
+    filt = N.Filter(scan, [E.BinaryExpr(E.BinaryOp.GT, col("ss_sales_price"),
+                                        lit("100.00", T.DecimalType(7, 2)))])
+    agg = two_stage_agg(filt, [("ss_store_sk", col("ss_store_sk"))], [
+        ("total", E.AggExpr(F.SUM, [col("ss_sales_price")]), T.DecimalType(17, 2)),
+        ("cnt", E.AggExpr(F.COUNT, []), None),
+    ])
+    plan = N.Sort(N.ShuffleExchange(agg, N.SinglePartitioning(1)),
+                  [E.SortOrder(col("total"), ascending=False)], fetch_limit=5)
+    out = Session().execute_to_pydict(plan)
+
+    df = dfs["store_sales"]
+    df = df[df.ss_sales_price > Decimal("100.00")]
+    exp = df.groupby("ss_store_sk").agg(
+        total=("ss_sales_price", "sum"), cnt=("ss_store_sk", "size"))
+    exp = exp.sort_values("total", ascending=False).head(5)
+    assert out["ss_store_sk"] == exp.index.tolist()
+    assert out["total"] == exp.total.tolist()
+    assert out["cnt"] == exp.cnt.tolist()
+
+
+def test_q06_q07_shape(warehouse):
+    """broadcast join + group-by (BASELINE config 2)."""
+    paths, dfs = warehouse
+    sales = scan_node_for_files([paths["store_sales"]], num_partitions=2)
+    items = scan_node_for_files([paths["item"]])
+    join = N.BroadcastJoin(sales, N.BroadcastExchange(items),
+                           [(col("ss_item_sk"), col("i_item_sk"))],
+                           N.JoinType.INNER, N.JoinSide.RIGHT, "tpcds_items")
+    agg = two_stage_agg(join, [("i_category", col("i_category"))], [
+        ("qty", E.AggExpr(F.SUM, [col("ss_quantity")]), T.I64),
+        ("avg_price", E.AggExpr(F.AVG, [col("ss_sales_price")]), T.DecimalType(11, 6)),
+    ])
+    plan = N.Sort(N.ShuffleExchange(agg, N.SinglePartitioning(1)),
+                  [E.SortOrder(col("i_category"))])
+    out = Session().execute_to_pydict(plan)
+
+    m = dfs["store_sales"].merge(dfs["item"], left_on="ss_item_sk",
+                                 right_on="i_item_sk")
+    exp = m.groupby("i_category").agg(qty=("ss_quantity", "sum"),
+                                      ap=("ss_sales_price", "mean")).sort_index()
+    assert out["i_category"] == exp.index.tolist()
+    assert out["qty"] == exp.qty.tolist()
+    for got, want in zip(out["avg_price"], exp.ap.tolist()):
+        assert abs(float(got) - float(want)) < 1e-4
+
+
+def test_q17_q25_shape_multiway(warehouse):
+    """star-schema multi-way join + exchange (BASELINE config 3)."""
+    paths, dfs = warehouse
+    sales = scan_node_for_files([paths["store_sales"]], num_partitions=2)
+    items = scan_node_for_files([paths["item"]])
+    customers = scan_node_for_files([paths["customer"]])
+    j1 = N.BroadcastJoin(sales, N.BroadcastExchange(items),
+                         [(col("ss_item_sk"), col("i_item_sk"))],
+                         N.JoinType.INNER, N.JoinSide.RIGHT, "tpcds_items2")
+    j2 = N.BroadcastJoin(j1, N.BroadcastExchange(customers),
+                         [(col("ss_customer_sk"), col("c_customer_sk"))],
+                         N.JoinType.INNER, N.JoinSide.RIGHT, "tpcds_cust")
+    agg = two_stage_agg(j2, [("c_state", col("c_state")),
+                             ("i_category", col("i_category"))], [
+        ("n", E.AggExpr(F.COUNT, []), None),
+    ])
+    plan = N.Sort(N.ShuffleExchange(agg, N.SinglePartitioning(1)),
+                  [E.SortOrder(col("c_state")), E.SortOrder(col("i_category"))])
+    out = Session().execute_to_pydict(plan)
+
+    m = dfs["store_sales"].merge(dfs["item"], left_on="ss_item_sk", right_on="i_item_sk")
+    m = m.merge(dfs["customer"], left_on="ss_customer_sk", right_on="c_customer_sk")
+    exp = m.groupby(["c_state", "i_category"]).size().sort_index()
+    assert list(zip(out["c_state"], out["i_category"])) == exp.index.tolist()
+    assert out["n"] == exp.tolist()
+
+
+def test_q47_q67_shape_window(warehouse):
+    """sort + window rank within category, keep top rows (BASELINE cfg 4)."""
+    paths, dfs = warehouse
+    sales = scan_node_for_files([paths["store_sales"]], num_partitions=2)
+    items = scan_node_for_files([paths["item"]])
+    join = N.BroadcastJoin(sales, N.BroadcastExchange(items),
+                           [(col("ss_item_sk"), col("i_item_sk"))],
+                           N.JoinType.INNER, N.JoinSide.RIGHT, "tpcds_items3")
+    agg = two_stage_agg(join, [("i_category", col("i_category")),
+                               ("i_brand", col("i_brand"))], [
+        ("qty", E.AggExpr(F.SUM, [col("ss_quantity")]), T.I64),
+    ])
+    single = N.ShuffleExchange(agg, N.SinglePartitioning(1))
+    srt = N.Sort(single, [E.SortOrder(col("i_category")),
+                          E.SortOrder(col("qty"), ascending=False)])
+    win = N.Window(srt, [N.WindowExpr("rank", "rk")],
+                   [col("i_category")],
+                   [E.SortOrder(col("qty"), ascending=False)])
+    plan = N.Filter(win, [E.BinaryExpr(E.BinaryOp.LTEQ, col("rk"), lit(2, T.I32))])
+    out = Session().execute_to_pydict(plan)
+
+    m = dfs["store_sales"].merge(dfs["item"], left_on="ss_item_sk", right_on="i_item_sk")
+    g = m.groupby(["i_category", "i_brand"]).ss_quantity.sum().reset_index()
+    g["rk"] = g.groupby("i_category").ss_quantity.rank(method="min", ascending=False)
+    exp = g[g.rk <= 2].sort_values(["i_category", "ss_quantity"],
+                                   ascending=[True, False])
+    got = sorted(zip(out["i_category"], out["i_brand"], out["qty"]))
+    want = sorted(zip(exp.i_category, exp.i_brand, exp.ss_quantity))
+    assert got == want
+
+
+def test_grouping_sets_via_expand(warehouse):
+    """rollup(category) via Expand + two-stage agg (q67-style rollup)."""
+    paths, dfs = warehouse
+    sales = scan_node_for_files([paths["store_sales"]], num_partitions=2)
+    items = scan_node_for_files([paths["item"]])
+    join = N.BroadcastJoin(sales, N.BroadcastExchange(items),
+                           [(col("ss_item_sk"), col("i_item_sk"))],
+                           N.JoinType.INNER, N.JoinSide.RIGHT, "tpcds_items4")
+    # expand into (category) and (NULL) grouping sets
+    expand_schema = T.Schema.of(("cat", T.STRING), ("gid", T.I32),
+                                ("q", T.I32))
+    expand = N.Expand(join, [
+        [col("i_category"), lit(0, T.I32), col("ss_quantity")],
+        [lit(None, T.STRING), lit(1, T.I32), col("ss_quantity")],
+    ], expand_schema)
+    agg = two_stage_agg(expand, [("cat", col("cat")), ("gid", col("gid"))], [
+        ("qty", E.AggExpr(F.SUM, [col("q")]), T.I64),
+    ])
+    plan = N.Sort(N.ShuffleExchange(agg, N.SinglePartitioning(1)),
+                  [E.SortOrder(col("gid")), E.SortOrder(col("cat"))])
+    out = Session().execute_to_pydict(plan)
+
+    m = dfs["store_sales"].merge(dfs["item"], left_on="ss_item_sk", right_on="i_item_sk")
+    per_cat = m.groupby("i_category").ss_quantity.sum().sort_index()
+    total = int(m.ss_quantity.sum())
+    n_cat = len(per_cat)
+    assert out["cat"][:n_cat] == per_cat.index.tolist()
+    assert out["qty"][:n_cat] == per_cat.tolist()
+    assert out["cat"][n_cat:] == [None]
+    assert out["qty"][n_cat:] == [total]
