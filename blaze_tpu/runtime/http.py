@@ -6,7 +6,8 @@ Reference: the feature-gated poem server started lazily on first
 bound to a free port exposes:
 
 - ``/debug/metrics``           — the session metric tree as JSON
-- ``/debug/pprof/profile?seconds=N`` — cProfile capture, pstats text
+- ``/debug/pprof/profile?seconds=N&frequency=H`` — wall-clock stack sampling
+  across ALL threads (sys._current_frames), pprof-style aggregated stacks
 - ``/debug/memory``            — process RSS + memory-manager accounting
 - ``/debug/config``            — the active engine config
 
@@ -14,11 +15,8 @@ Start with ``ProfilingService.start(session)``; idempotent per process."""
 
 from __future__ import annotations
 
-import cProfile
 import dataclasses
-import io
 import json
-import pstats
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -111,6 +109,7 @@ class ProfilingService:
         with cls._lock:
             if cls._instance is not None:
                 cls._instance.server.shutdown()
+                cls._instance.server.server_close()  # release the listen fd
                 cls._instance = None
 
 

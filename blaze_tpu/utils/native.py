@@ -40,17 +40,30 @@ def _configure(lib: ctypes.CDLL):
 
 
 def build(quiet: bool = True) -> bool:
-    """Build the native library with cmake; returns success."""
+    """Build the native library with cmake into a per-process temp build dir,
+    then atomically publish the .so — safe against concurrent builders in
+    other processes; returns success."""
+    import shutil
+
     src = os.path.join(_REPO_ROOT, "native")
-    bld = os.path.join(src, "build")
+    bld = os.path.join(src, f"build-tmp-{os.getpid()}")
     try:
         kw = dict(capture_output=quiet, cwd=_REPO_ROOT, timeout=300)
         subprocess.run(["cmake", "-S", src, "-B", bld, "-DCMAKE_BUILD_TYPE=Release"],
                        check=True, **kw)
         subprocess.run(["cmake", "--build", bld, "--", "-j2"], check=True, **kw)
-        return os.path.exists(_SO_PATH)
+        built = os.path.join(bld, "libblaze_native.so")
+        if not os.path.exists(built):
+            return False
+        os.makedirs(os.path.dirname(_SO_PATH), exist_ok=True)
+        tmp_target = _SO_PATH + f".{os.getpid()}"
+        shutil.copy2(built, tmp_target)
+        os.replace(tmp_target, _SO_PATH)  # atomic publish
+        return True
     except Exception:
         return False
+    finally:
+        shutil.rmtree(bld, ignore_errors=True)
 
 
 def lib() -> Optional[ctypes.CDLL]:
