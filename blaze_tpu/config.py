@@ -67,8 +67,9 @@ class Config:
         default_factory=lambda: os.environ.get("BLAZE_TPU_SPILL_DIR", "/tmp/blaze_tpu_spill")
     )
 
-    # Number of host worker threads for IO/decode (reference: tokio worker
-    # threads conf).
+    # Number of host worker threads for IO/decode and task overlap
+    # (reference: tokio worker threads conf). On the tunneled-TPU backend
+    # threads mostly overlap device round trips, not CPU.
     num_io_threads: int = 4
 
     # Per-operator enable flags (reference: spark.auron.enable.<op>,

@@ -78,10 +78,23 @@ class ExprEvaluator:
         self.exprs = exprs
         self.input_schema = input_schema
         self.row_num_offset = 0
+        # common-subexpression cache, valid for ONE batch only (reference:
+        # CachedExprsEvaluator's cached_exprs — shared subtrees evaluate once)
+        self._cse: dict = {}
+        self._cse_ref = None  # weakref to the batch the cache belongs to
+        self._cse_keys: dict = {}
+
+    def _reset_cse(self, batch: ColumnarBatch):
+        import weakref
+
+        if self._cse_ref is None or self._cse_ref() is not batch:
+            self._cse.clear()
+            self._cse_ref = weakref.ref(batch)
 
     # -- public API -----------------------------------------------------------
 
     def evaluate(self, batch: ColumnarBatch) -> List[Column]:
+        self._reset_cse(batch)
         out = []
         for expr in self.exprs:
             val = self._eval(expr, batch)
@@ -91,6 +104,7 @@ class ExprEvaluator:
 
     def evaluate_predicate(self, batch: ColumnarBatch) -> jax.Array:
         """Conjunction of all exprs as a device keep-mask (null -> drop)."""
+        self._reset_cse(batch)
         mask = None
         for expr in self.exprs:
             val = self._eval(expr, batch)
@@ -138,10 +152,35 @@ class ExprEvaluator:
     # -- core recursion -------------------------------------------------------
 
     def _eval(self, expr: E.Expr, batch: ColumnarBatch) -> Val:
+        key = self._expr_key(expr)
+        if key is not None:
+            cached = self._cse.get(key)
+            if cached is not None:
+                return cached
         method = getattr(self, "_eval_" + type(expr).__name__, None)
         if method is None:
             raise ExprError(f"unsupported expression {type(expr).__name__}")
-        return method(expr, batch)
+        out = method(expr, batch)
+        if key is not None:
+            self._cse[key] = out
+        return out
+
+    def _expr_key(self, expr: E.Expr):
+        """Structural identity for CSE; stateful/unserializable exprs opt
+        out. Cached per expr object (id) since IR trees are immutable."""
+        if isinstance(expr, (E.Column, E.BoundReference, E.Literal, E.RowNum,
+                             E.PyUDF)):
+            return None  # trivial or stateful — not worth caching / unsafe
+        key = self._cse_keys.get(id(expr))
+        if key is None:
+            try:
+                from blaze_tpu.ir.serde import expr_to_json
+
+                key = expr_to_json(expr)
+            except Exception:
+                key = False
+            self._cse_keys[id(expr)] = key
+        return key or None
 
     def _eval_Column(self, expr: E.Column, batch: ColumnarBatch) -> Val:
         idx = batch.schema.index_of(expr.name)

@@ -411,6 +411,9 @@ class SortMergeJoin(PlanNode):
     on: List[Tuple[E.Expr, E.Expr]]
     join_type: JoinType
     sort_options: List[Tuple[bool, bool]] = None  # (ascending, nulls_first) per key
+    # extra non-equi join condition evaluated over left+right columns
+    # (reference: SMJ inequality-join option / join filters)
+    condition: Optional[E.Expr] = None
 
     @property
     def output_schema(self):
@@ -429,6 +432,7 @@ class HashJoin(PlanNode):
     on: List[Tuple[E.Expr, E.Expr]]
     join_type: JoinType
     build_side: JoinSide = JoinSide.RIGHT
+    condition: Optional[E.Expr] = None
 
     @property
     def output_schema(self):
@@ -457,6 +461,7 @@ class BroadcastJoin(PlanNode):
     # executor-level cache key for the built hash map (reference:
     # cached_build_hash_map_id, broadcast_join_exec.rs:87-116)
     cached_build_hash_map_id: str = ""
+    condition: Optional[E.Expr] = None
 
     @property
     def output_schema(self):

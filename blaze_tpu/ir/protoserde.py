@@ -553,6 +553,8 @@ def plan_to_proto(node: N.PlanNode) -> pb.PlanNode:
             sm = m.sort_merge_join.sort_options.add()
             sm.ascending = asc
             sm.nulls_first = nf
+        if node.condition is not None:
+            m.sort_merge_join.condition.CopyFrom(expr_to_proto(node.condition))
     elif isinstance(node, N.HashJoin):
         m.hash_join.left.CopyFrom(plan_to_proto(node.left))
         m.hash_join.right.CopyFrom(plan_to_proto(node.right))
@@ -562,6 +564,8 @@ def plan_to_proto(node: N.PlanNode) -> pb.PlanNode:
             om.right.CopyFrom(expr_to_proto(r))
         m.hash_join.join_type = node.join_type.value
         m.hash_join.build_side = node.build_side.value
+        if node.condition is not None:
+            m.hash_join.condition.CopyFrom(expr_to_proto(node.condition))
     elif isinstance(node, N.BroadcastJoin):
         m.broadcast_join.left.CopyFrom(plan_to_proto(node.left))
         m.broadcast_join.right.CopyFrom(plan_to_proto(node.right))
@@ -572,6 +576,8 @@ def plan_to_proto(node: N.PlanNode) -> pb.PlanNode:
         m.broadcast_join.join_type = node.join_type.value
         m.broadcast_join.broadcast_side = node.broadcast_side.value
         m.broadcast_join.cached_build_hash_map_id = node.cached_build_hash_map_id
+        if node.condition is not None:
+            m.broadcast_join.condition.CopyFrom(expr_to_proto(node.condition))
     elif isinstance(node, N.BroadcastJoinBuildHashMap):
         m.broadcast_join_build_hash_map.child.CopyFrom(plan_to_proto(node.child))
         for e in node.keys:
@@ -695,20 +701,23 @@ def plan_from_proto(m: pb.PlanNode) -> N.PlanNode:
             plan_from_proto(j.left), plan_from_proto(j.right),
             [(expr_from_proto(o.left), expr_from_proto(o.right)) for o in j.on],
             N.JoinType(j.join_type),
-            [(s.ascending, s.nulls_first) for s in j.sort_options] or None)
+            [(s.ascending, s.nulls_first) for s in j.sort_options] or None,
+            expr_from_proto(j.condition) if j.HasField("condition") else None)
     if which == "hash_join":
         j = m.hash_join
         return N.HashJoin(
             plan_from_proto(j.left), plan_from_proto(j.right),
             [(expr_from_proto(o.left), expr_from_proto(o.right)) for o in j.on],
-            N.JoinType(j.join_type), N.JoinSide(j.build_side))
+            N.JoinType(j.join_type), N.JoinSide(j.build_side),
+            expr_from_proto(j.condition) if j.HasField("condition") else None)
     if which == "broadcast_join":
         j = m.broadcast_join
         return N.BroadcastJoin(
             plan_from_proto(j.left), plan_from_proto(j.right),
             [(expr_from_proto(o.left), expr_from_proto(o.right)) for o in j.on],
             N.JoinType(j.join_type), N.JoinSide(j.broadcast_side),
-            j.cached_build_hash_map_id)
+            j.cached_build_hash_map_id,
+            expr_from_proto(j.condition) if j.HasField("condition") else None)
     if which == "broadcast_join_build_hash_map":
         return N.BroadcastJoinBuildHashMap(
             plan_from_proto(m.broadcast_join_build_hash_map.child),

@@ -41,12 +41,38 @@ class _HashJoinBase(Operator):
 
     def __init__(self, left: Operator, right: Operator,
                  on: List[Tuple[E.Expr, E.Expr]], join_type: JoinType,
-                 build_side: JoinSide):
+                 build_side: JoinSide, condition: Optional[E.Expr] = None):
         self.on = on
         self.join_type = join_type
         self.build_side = build_side
+        # extra non-equi condition over left+right columns; matched pairs
+        # failing it count as unmatched (reference: join filters)
+        self.condition = condition
+        self._pair_schema = left.schema + right.schema
         schema = _join_output_schema(left.schema, right.schema, join_type)
         super().__init__(schema, [left, right])
+
+    def _apply_condition(self, batch, bmap, probe_idx, build_idx, probe_on_left):
+        """Filter matching pairs by the extra condition; returns the
+        surviving (probe_idx, build_idx, counts-per-probe-row)."""
+        n = batch.num_rows
+        if self.condition is None or len(probe_idx) == 0:
+            counts = np.bincount(probe_idx, minlength=n) if len(probe_idx) else \
+                np.zeros(n, dtype=np.int64)
+            return probe_idx, build_idx, counts
+        probe_out = batch.take(probe_idx)
+        build_out = bmap.batch.take(build_idx)
+        left, right = ((probe_out, build_out) if probe_on_left
+                       else (build_out, probe_out))
+        pair = ColumnarBatch(self._pair_schema, left.columns + right.columns,
+                             len(probe_idx))
+        ev = ExprEvaluator([self.condition], self._pair_schema)
+        keep = np.asarray(ev.evaluate_predicate(pair))[: len(probe_idx)]
+        probe_idx = probe_idx[keep]
+        build_idx = build_idx[keep]
+        counts = np.bincount(probe_idx, minlength=n) if len(probe_idx) else \
+            np.zeros(n, dtype=np.int64)
+        return probe_idx, build_idx, counts
 
     # -- orientation helpers --------------------------------------------------
 
@@ -111,7 +137,9 @@ class _HashJoinBase(Operator):
                 ev = ExprEvaluator(key_exprs, probe_schema)
                 cols = ev.evaluate(batch)
                 codes = key_codes(batch, cols, bmap.key_map, insert=False)
-                probe_idx, build_idx, counts = bmap.probe(codes)
+                probe_idx, build_idx, _ = bmap.probe(codes)
+                probe_idx, build_idx, counts = self._apply_condition(
+                    batch, bmap, probe_idx, build_idx, probe_on_left)
                 if track_build_matched and len(build_idx):
                     bmap.matched[build_idx] = True
                 out = self._emit_probe_batch(
@@ -205,8 +233,9 @@ class _HashJoinBase(Operator):
 class HashJoinExec(_HashJoinBase):
     """Shuffled hash join: build side read within this partition."""
 
-    def __init__(self, left, right, on, join_type, build_side=JoinSide.RIGHT):
-        super().__init__(left, right, on, join_type, build_side)
+    def __init__(self, left, right, on, join_type, build_side=JoinSide.RIGHT,
+                 condition=None):
+        super().__init__(left, right, on, join_type, build_side, condition)
 
     def num_partitions(self):
         return self.children[self._probe_child()].num_partitions()
@@ -220,8 +249,9 @@ class BroadcastJoinExec(_HashJoinBase):
     executor scope under ``cached_build_hash_map_id``."""
 
     def __init__(self, left, right, on, join_type,
-                 broadcast_side=JoinSide.RIGHT, cached_build_hash_map_id=""):
-        super().__init__(left, right, on, join_type, broadcast_side)
+                 broadcast_side=JoinSide.RIGHT, cached_build_hash_map_id="",
+                 condition=None):
+        super().__init__(left, right, on, join_type, broadcast_side, condition)
         self.cached_build_hash_map_id = cached_build_hash_map_id
 
     def num_partitions(self):

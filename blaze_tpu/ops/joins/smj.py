@@ -90,10 +90,15 @@ def _materialize(segments: List[Tuple[ColumnarBatch, int, int]], schema) -> Colu
 class SortMergeJoinExec(Operator):
     def __init__(self, left: Operator, right: Operator,
                  on: List[Tuple[E.Expr, E.Expr]], join_type: JoinType,
-                 sort_options: Optional[List[Tuple[bool, bool]]] = None):
+                 sort_options: Optional[List[Tuple[bool, bool]]] = None,
+                 condition: Optional[E.Expr] = None):
         self.on = on
         self.join_type = join_type
         self.sort_options = sort_options or [(True, True)] * len(on)
+        # extra non-equi condition over left+right columns (reference: SMJ
+        # inequality-join option); key-matched pairs failing it are unmatched
+        self.condition = condition
+        self._pair_schema = left.schema + right.schema
         schema = _join_output_schema(left.schema, right.schema, join_type)
         super().__init__(schema, [left, right])
 
@@ -188,26 +193,62 @@ class _Emitter:
 
     def matched(self, lrun: ColumnarBatch, rrun: ColumnarBatch):
         jt = self.op.join_type
-        if jt == JoinType.LEFT_SEMI:
-            yield from self._push(lrun)
-            return
-        if jt == JoinType.RIGHT_SEMI:
-            yield from self._push(rrun)
-            return
-        if jt in (JoinType.LEFT_ANTI,):
-            return
-        if jt == JoinType.RIGHT_ANTI:
-            return
-        if jt == JoinType.EXISTENCE:
-            yield from self._push(self._with_exists(lrun, True))
-            return
         nl, nr = lrun.num_rows, rrun.num_rows
         li = np.repeat(np.arange(nl), nr)
         ri = np.tile(np.arange(nr), nl)
-        lout = lrun.take(li)
-        rout = rrun.take(ri)
-        yield from self._push(
-            ColumnarBatch(self.op.schema, lout.columns + rout.columns, nl * nr))
+        cond = self.op.condition
+        if cond is not None:
+            from blaze_tpu.exprs.compiler import ExprEvaluator
+
+            lout = lrun.take(li)
+            rout = rrun.take(ri)
+            pair = ColumnarBatch(self.op._pair_schema,
+                                 lout.columns + rout.columns, nl * nr)
+            ev = ExprEvaluator([cond], self.op._pair_schema)
+            keep = np.asarray(ev.evaluate_predicate(pair))[: nl * nr]
+            li, ri = li[keep], ri[keep]
+        l_matched = np.zeros(nl, dtype=bool)
+        l_matched[li] = True
+        r_matched = np.zeros(nr, dtype=bool)
+        r_matched[ri] = True
+
+        if jt == JoinType.LEFT_SEMI:
+            idx = np.nonzero(l_matched)[0]
+            if len(idx):
+                yield from self._push(lrun.take(idx))
+            return
+        if jt == JoinType.RIGHT_SEMI:
+            idx = np.nonzero(r_matched)[0]
+            if len(idx):
+                yield from self._push(rrun.take(idx))
+            return
+        if jt == JoinType.LEFT_ANTI:
+            idx = np.nonzero(~l_matched)[0]  # condition-failed rows
+            if len(idx):
+                yield from self._push(lrun.take(idx))
+            return
+        if jt == JoinType.RIGHT_ANTI:
+            idx = np.nonzero(~r_matched)[0]
+            if len(idx):
+                yield from self._push(rrun.take(idx))
+            return
+        if jt == JoinType.EXISTENCE:
+            yield from self._push(self._with_exists(lrun, l_matched))
+            return
+        if len(li):
+            lout = lrun.take(li)
+            rout = rrun.take(ri)
+            yield from self._push(
+                ColumnarBatch(self.op.schema, lout.columns + rout.columns, len(li)))
+        # key-matched rows whose every pair failed the condition are
+        # unmatched for outer purposes
+        if cond is not None:
+            lun = np.nonzero(~l_matched)[0]
+            if len(lun):
+                yield from self.left_unmatched(lrun.take(lun))
+            run_ = np.nonzero(~r_matched)[0]
+            if len(run_):
+                yield from self.right_unmatched(rrun.take(run_))
 
     def left_unmatched(self, lrun: ColumnarBatch):
         jt = self.op.join_type
@@ -215,7 +256,8 @@ class _Emitter:
             yield from self._push(lrun)
             return
         if jt == JoinType.EXISTENCE:
-            yield from self._push(self._with_exists(lrun, False))
+            yield from self._push(
+                self._with_exists(lrun, np.zeros(lrun.num_rows, dtype=bool)))
             return
         if jt in (JoinType.LEFT, JoinType.FULL):
             rnulls = ColumnarBatch.empty(self.op.children[1].schema).take_nullable(
@@ -236,7 +278,7 @@ class _Emitter:
                 ColumnarBatch(self.op.schema, lnulls.columns + rrun.columns,
                               rrun.num_rows))
 
-    def _with_exists(self, lrun: ColumnarBatch, flag: bool) -> ColumnarBatch:
-        exists = DeviceColumn.from_numpy(
-            T.BOOL, np.full(lrun.num_rows, flag), None, lrun.capacity)
+    def _with_exists(self, lrun: ColumnarBatch, flags: np.ndarray) -> ColumnarBatch:
+        exists = DeviceColumn.from_numpy(T.BOOL, np.asarray(flags, dtype=bool),
+                                         None, lrun.capacity)
         return ColumnarBatch(self.op.schema, lrun.columns + [exists], lrun.num_rows)

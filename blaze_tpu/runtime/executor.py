@@ -74,18 +74,20 @@ def build_operator(node: N.PlanNode) -> Operator:
         from blaze_tpu.ops.joins.smj import SortMergeJoinExec
 
         return SortMergeJoinExec(build_operator(node.left), build_operator(node.right),
-                                 node.on, node.join_type, node.sort_options)
+                                 node.on, node.join_type, node.sort_options,
+                                 node.condition)
     if isinstance(node, N.HashJoin):
         from blaze_tpu.ops.joins.bhj import HashJoinExec
 
         return HashJoinExec(build_operator(node.left), build_operator(node.right),
-                            node.on, node.join_type, node.build_side)
+                            node.on, node.join_type, node.build_side,
+                            node.condition)
     if isinstance(node, N.BroadcastJoin):
         from blaze_tpu.ops.joins.bhj import BroadcastJoinExec
 
         return BroadcastJoinExec(build_operator(node.left), build_operator(node.right),
                                  node.on, node.join_type, node.broadcast_side,
-                                 node.cached_build_hash_map_id)
+                                 node.cached_build_hash_map_id, node.condition)
     if isinstance(node, N.BroadcastJoinBuildHashMap):
         from blaze_tpu.ops.joins.bhj import BroadcastJoinBuildHashMapExec
 

@@ -53,19 +53,32 @@ class Session:
 
     def execute(self, plan: N.PlanNode) -> Iterator[ColumnarBatch]:
         """Run a plan, yielding all result batches (final-stage partitions in
-        order)."""
+        order). Partitions execute concurrently on the task pool — device
+        round-trip latency overlaps — while batches are yielded in partition
+        order."""
         from blaze_tpu.utils.logutil import clear_task_context, set_task_context
 
         lowered = self._lower(plan)
         op = build_operator(lowered)
-        for p in range(op.num_partitions()):
+        nparts = op.num_partitions()
+
+        def run_partition(p: int):
             ctx = self._make_ctx(p)
             set_task_context(0, p)
             try:
-                yield from op.execute(p, ctx,
-                                      self.metrics.named_child(f"result_{p}"))
+                return list(op.execute(p, ctx,
+                                       self.metrics.named_child(f"result_{p}")))
             finally:
                 clear_task_context()
+
+        if nparts <= 1 or self.max_workers <= 1:
+            for p in range(nparts):
+                yield from run_partition(p)
+            return
+        with ThreadPoolExecutor(max_workers=min(self.max_workers, nparts)) as pool:
+            futures = [pool.submit(run_partition, p) for p in range(nparts)]
+            for f in futures:
+                yield from f.result()
 
     def execute_to_table(self, plan: N.PlanNode) -> pa.Table:
         batches = [b.to_arrow() for b in self.execute(plan) if b.num_rows]

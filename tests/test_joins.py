@@ -56,12 +56,14 @@ def normalize(rows):
     return sorted(rows, key=keyf)
 
 
-def run_join(make_op, join_type, num_batches=2, **kw):
+def run_join(make_op, join_type, num_batches=2, condition=None, **kw):
     left = mem_scan(LEFT, num_batches=num_batches)
     right = mem_scan(RIGHT, num_batches=num_batches)
     if make_op is SortMergeJoinExec:
         left = SortExec(left, [E.SortOrder(col("lk"))])
         right = SortExec(right, [E.SortOrder(col("rk"))])
+    if condition is not None:
+        kw["condition"] = condition
     op = make_op(left, right, [(col("lk"), col("rk"))], join_type, **kw)
     tbl = collect(op)
     return normalize(list(zip(*[tbl[c].to_pylist() for c in tbl.column_names])))
@@ -178,3 +180,52 @@ def test_empty_sides():
     assert all(v is None for v in out["lv"])
     op = HashJoinExec(empty_l, right, [(col("lk"), col("rk"))], JoinType.INNER)
     assert collect(op).num_rows == 0
+
+
+@pytest.mark.parametrize("make_op", [HashJoinExec, SortMergeJoinExec],
+                         ids=["hash", "smj"])
+def test_join_condition_filters_pairs(make_op):
+    # inner with condition rv > 15: only the (2, 20.5) pair of the 2-key run
+    cond = E.BinaryExpr(E.BinaryOp.GT, col("rv"),
+                        E.Literal(15.0, T.F64))
+    got = run_join(make_op, JoinType.INNER, condition=cond)
+    assert got == normalize([(2, "b", 2, 20.5), (2, "c", 2, 20.5),
+                             (3, "d", 3, 30.5)])
+    # left outer: key-matched rows whose pairs all fail become null-extended
+    cond2 = E.BinaryExpr(E.BinaryOp.GT, col("rv"), E.Literal(25.0, T.F64))
+    got = run_join(make_op, JoinType.LEFT, condition=cond2)
+    assert got == normalize([
+        (1, "a", None, None), (2, "b", None, None), (2, "c", None, None),
+        (3, "d", 3, 30.5), (None, "e", None, None), (5, "f", None, None),
+    ])
+    # semi/anti respect the condition
+    got = run_join(make_op, JoinType.LEFT_SEMI, condition=cond2)
+    assert got == normalize([(3, "d")])
+    got = run_join(make_op, JoinType.LEFT_ANTI, condition=cond2)
+    assert got == normalize([(1, "a"), (2, "b"), (2, "c"), (None, "e"), (5, "f")])
+    # existence flag reflects the condition
+    got = run_join(make_op, JoinType.EXISTENCE, condition=cond2)
+    assert got == normalize([
+        (1, "a", False), (2, "b", False), (2, "c", False), (3, "d", True),
+        (None, "e", False), (5, "f", False),
+    ])
+
+
+def test_join_condition_proto_roundtrip():
+    from blaze_tpu.ir import nodes as NN
+    from blaze_tpu.ir import protoserde as P
+    from blaze_tpu.ir import types as TT
+
+    schema = TT.Schema.of(("lk", TT.I64), ("lv", TT.STRING))
+    rschema = TT.Schema.of(("rk", TT.I64), ("rv", TT.F64))
+    l = NN.FFIReader(schema=schema, resource_id="l", num_partitions=1)
+    r = NN.FFIReader(schema=rschema, resource_id="r", num_partitions=1)
+    cond = E.BinaryExpr(E.BinaryOp.GT, col("rv"), E.Literal(1.0, T.F64))
+    for node in (NN.HashJoin(l, r, [(col("lk"), col("rk"))], JoinType.LEFT,
+                             condition=cond),
+                 NN.SortMergeJoin(l, r, [(col("lk"), col("rk"))], JoinType.INNER,
+                                  condition=cond)):
+        blob = P.plan_to_bytes(node)
+        back = P.plan_from_bytes(blob)
+        assert P.plan_to_bytes(back) == blob
+        assert back.condition is not None
