@@ -166,19 +166,23 @@ class ExprEvaluator:
         return out
 
     def _expr_key(self, expr: E.Expr):
-        """Structural identity for CSE; stateful/unserializable exprs opt
-        out. Cached per expr object (id) since IR trees are immutable."""
-        if isinstance(expr, (E.Column, E.BoundReference, E.Literal, E.RowNum,
-                             E.PyUDF)):
-            return None  # trivial or stateful — not worth caching / unsafe
+        """Structural identity for CSE; trees containing stateful or
+        callable-bearing nodes opt out entirely (two distinct lambdas share a
+        qualname, and RowNum advances state per evaluation). Cached per expr
+        object (id) since IR trees are immutable."""
+        if isinstance(expr, (E.Column, E.BoundReference, E.Literal)):
+            return None  # trivial — not worth caching
         key = self._cse_keys.get(id(expr))
         if key is None:
-            try:
-                from blaze_tpu.ir.serde import expr_to_json
-
-                key = expr_to_json(expr)
-            except Exception:
+            if _contains_stateful(expr):
                 key = False
+            else:
+                try:
+                    from blaze_tpu.ir.serde import expr_to_json
+
+                    key = expr_to_json(expr)
+                except Exception:
+                    key = False
             self._cse_keys[id(expr)] = key
         return key or None
 
@@ -629,6 +633,12 @@ class ExprEvaluator:
 
     def _eval_SortOrder(self, expr: E.SortOrder, batch) -> Val:
         return self._eval(expr.child, batch)
+
+
+def _contains_stateful(expr: E.Expr) -> bool:
+    if isinstance(expr, (E.RowNum, E.PyUDF)):
+        return True
+    return any(_contains_stateful(c) for c in expr.children())
 
 
 def _broadcast(v: DevVal, batch: ColumnarBatch):

@@ -52,11 +52,12 @@ class _HashJoinBase(Operator):
         schema = _join_output_schema(left.schema, right.schema, join_type)
         super().__init__(schema, [left, right])
 
-    def _apply_condition(self, batch, bmap, probe_idx, build_idx, probe_on_left):
+    def _apply_condition(self, batch, bmap, probe_idx, build_idx, probe_on_left,
+                         cond_ev):
         """Filter matching pairs by the extra condition; returns the
         surviving (probe_idx, build_idx, counts-per-probe-row)."""
         n = batch.num_rows
-        if self.condition is None or len(probe_idx) == 0:
+        if cond_ev is None or len(probe_idx) == 0:
             counts = np.bincount(probe_idx, minlength=n) if len(probe_idx) else \
                 np.zeros(n, dtype=np.int64)
             return probe_idx, build_idx, counts
@@ -66,8 +67,7 @@ class _HashJoinBase(Operator):
                        else (build_out, probe_out))
         pair = ColumnarBatch(self._pair_schema, left.columns + right.columns,
                              len(probe_idx))
-        ev = ExprEvaluator([self.condition], self._pair_schema)
-        keep = np.asarray(ev.evaluate_predicate(pair))[: len(probe_idx)]
+        keep = np.asarray(cond_ev.evaluate_predicate(pair))[: len(probe_idx)]
         probe_idx = probe_idx[keep]
         build_idx = build_idx[keep]
         counts = np.bincount(probe_idx, minlength=n) if len(probe_idx) else \
@@ -132,14 +132,16 @@ class _HashJoinBase(Operator):
         track_build_matched = emit_unmatched_build or (
             semi_anti_exist and not self._semi_side_is_probe())
 
+        key_ev = ExprEvaluator(key_exprs, probe_schema)
+        cond_ev = ExprEvaluator([self.condition], self._pair_schema) \
+            if self.condition is not None else None
         for batch in self.execute_child(probe_child, partition, ctx, metrics):
             with metrics.timer("probe_time"):
-                ev = ExprEvaluator(key_exprs, probe_schema)
-                cols = ev.evaluate(batch)
+                cols = key_ev.evaluate(batch)
                 codes = key_codes(batch, cols, bmap.key_map, insert=False)
                 probe_idx, build_idx, _ = bmap.probe(codes)
                 probe_idx, build_idx, counts = self._apply_condition(
-                    batch, bmap, probe_idx, build_idx, probe_on_left)
+                    batch, bmap, probe_idx, build_idx, probe_on_left, cond_ev)
                 if track_build_matched and len(build_idx):
                     bmap.matched[build_idx] = True
                 out = self._emit_probe_batch(

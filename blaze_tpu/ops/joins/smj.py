@@ -170,6 +170,11 @@ class _Emitter:
         self.batch_size = batch_size
         self.buf: List[ColumnarBatch] = []
         self.rows = 0
+        if op.condition is not None:
+            from blaze_tpu.exprs.compiler import ExprEvaluator
+
+            # one evaluator for all runs: keeps the CSE/jit caches warm
+            self.cond_ev = ExprEvaluator([op.condition], op._pair_schema)
 
     def _push(self, batch: Optional[ColumnarBatch]):
         if batch is None or batch.num_rows == 0:
@@ -194,18 +199,30 @@ class _Emitter:
     def matched(self, lrun: ColumnarBatch, rrun: ColumnarBatch):
         jt = self.op.join_type
         nl, nr = lrun.num_rows, rrun.num_rows
+        cond = self.op.condition
+        if cond is None:
+            # no pair expansion for the non-pair join types (a skewed run
+            # would otherwise allocate O(nl*nr) just to learn "all matched")
+            if jt == JoinType.LEFT_SEMI:
+                yield from self._push(lrun)
+                return
+            if jt == JoinType.RIGHT_SEMI:
+                yield from self._push(rrun)
+                return
+            if jt in (JoinType.LEFT_ANTI, JoinType.RIGHT_ANTI):
+                return
+            if jt == JoinType.EXISTENCE:
+                yield from self._push(
+                    self._with_exists(lrun, np.ones(nl, dtype=bool)))
+                return
         li = np.repeat(np.arange(nl), nr)
         ri = np.tile(np.arange(nr), nl)
-        cond = self.op.condition
         if cond is not None:
-            from blaze_tpu.exprs.compiler import ExprEvaluator
-
             lout = lrun.take(li)
             rout = rrun.take(ri)
             pair = ColumnarBatch(self.op._pair_schema,
                                  lout.columns + rout.columns, nl * nr)
-            ev = ExprEvaluator([cond], self.op._pair_schema)
-            keep = np.asarray(ev.evaluate_predicate(pair))[: nl * nr]
+            keep = np.asarray(self.cond_ev.evaluate_predicate(pair))[: nl * nr]
             li, ri = li[keep], ri[keep]
         l_matched = np.zeros(nl, dtype=bool)
         l_matched[li] = True

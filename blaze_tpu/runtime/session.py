@@ -62,23 +62,68 @@ class Session:
         op = build_operator(lowered)
         nparts = op.num_partitions()
 
-        def run_partition(p: int):
+        def run_partition_stream(p: int):
             ctx = self._make_ctx(p)
             set_task_context(0, p)
             try:
-                return list(op.execute(p, ctx,
-                                       self.metrics.named_child(f"result_{p}")))
+                yield from op.execute(p, ctx,
+                                      self.metrics.named_child(f"result_{p}"))
             finally:
                 clear_task_context()
 
         if nparts <= 1 or self.max_workers <= 1:
             for p in range(nparts):
-                yield from run_partition(p)
+                yield from run_partition_stream(p)
             return
+
+        # concurrent partitions with bounded per-partition queues: device
+        # round trips overlap while memory stays O(queue depth), and batches
+        # still stream out in partition order
+        import queue as _queue
+
+        DONE = object()
+        queues = [_queue.Queue(maxsize=4) for _ in range(nparts)]
+        stop = threading.Event()
+
+        def _put(q, item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def produce(p: int):
+            try:
+                for b in run_partition_stream(p):
+                    if not _put(queues[p], b):
+                        return  # consumer stopped early
+                _put(queues[p], DONE)
+            except BaseException as exc:
+                _put(queues[p], exc)
+
         with ThreadPoolExecutor(max_workers=min(self.max_workers, nparts)) as pool:
-            futures = [pool.submit(run_partition, p) for p in range(nparts)]
-            for f in futures:
-                yield from f.result()
+            try:
+                for p in range(nparts):
+                    pool.submit(produce, p)
+                for p in range(nparts):
+                    while True:
+                        item = queues[p].get()
+                        if item is DONE:
+                            break
+                        if isinstance(item, BaseException):
+                            raise item
+                        yield item
+            finally:
+                # unblock producers on early close so pool shutdown completes
+                stop.set()
+                for q in queues:
+                    while True:
+                        try:
+                            q.get_nowait()
+                        except _queue.Empty:
+                            break
 
     def execute_to_table(self, plan: N.PlanNode) -> pa.Table:
         batches = [b.to_arrow() for b in self.execute(plan) if b.num_rows]

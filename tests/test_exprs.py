@@ -328,3 +328,31 @@ def test_review_fixes():
     days = days_from_civil(y, m, d)
     yy, mm, dd = civil_from_days(days)
     assert (int(yy[0]), int(mm[0]), int(dd[0])) == (-2, 3, 1)
+
+
+def test_cse_distinct_udfs_not_merged():
+    # two structurally-identical trees around different lambdas must not be
+    # deduped by the CSE cache
+    f = E.PyUDF(lambda a: pa.array([v + 1 for v in a.to_pylist()], type=pa.int64()),
+                [col("a")], T.I64, "f")
+    g = E.PyUDF(lambda a: pa.array([v * 100 for v in a.to_pylist()], type=pa.int64()),
+                [col("a")], T.I64, "g")
+    add0 = lambda u: E.BinaryExpr(E.BinaryOp.ADD, u, lit(0, T.I64))
+    out = run([add0(f), add0(g)], {"a": pa.array([1, 2], type=pa.int64())})
+    assert out["c0"] == [2, 3]
+    assert out["c1"] == [100, 200]
+
+
+def test_cse_shared_subtree_single_eval():
+    calls = []
+
+    def counting(a):
+        calls.append(1)
+        return pa.array([v + 1 for v in a.to_pylist()], type=pa.int64())
+
+    # pure shared subtree evaluates once per batch; the PyUDF itself opts out
+    shared = E.BinaryExpr(E.BinaryOp.MUL, col("a"), lit(3, T.I64))
+    e1 = E.BinaryExpr(E.BinaryOp.ADD, shared, lit(1, T.I64))
+    e2 = E.BinaryExpr(E.BinaryOp.ADD, shared, lit(2, T.I64))
+    out = run([e1, e2], {"a": pa.array([1], type=pa.int64())})
+    assert out == {"c0": [4], "c1": [5]}
