@@ -76,6 +76,11 @@ class Config:
     # AuronConverters.scala:99-140). Checked by the plan converter/session.
     enabled_ops: dict = dataclasses.field(default_factory=dict)
 
+    # Trace upstream FilterExec predicates into the device partial-agg
+    # kernel (experimental: compiles pathologically slowly on the axon
+    # remote-compile backend; default off until diagnosed).
+    fused_filter_agg: bool = False
+
     # Capacity bucketing: device buffers are padded up to the next bucket to
     # bound XLA recompilation. Buckets are powers of two >= min_capacity.
     min_capacity: int = 256

@@ -92,9 +92,26 @@ class AggExec(Operator):
 
         if self.exec_mode == E.AggExecMode.HASH_AGG and \
                 supports_device_partial(self, child_schema):
-            # TPU fast path: per-batch device partials, no host interning
-            agger = DevicePartialAgger(self, child_schema)
-            for batch in self.execute_child(0, partition, ctx, metrics):
+            # TPU fast path: per-batch device partials, no host interning.
+            # When the child is a fusable FilterExec, its predicate traces
+            # into the same jitted kernel (one device call per batch).
+            from blaze_tpu.ops.agg_device import supports_fused_filter
+            from blaze_tpu.ops.basic import FilterExec
+
+            child_op = self.children[0]
+            source = child_op
+            fused_preds = None
+            if ctx.conf.fused_filter_agg and isinstance(child_op, FilterExec) \
+                    and supports_fused_filter(
+                    child_op, child_op.children[0].schema):
+                source = child_op.children[0]
+                fused_preds = child_op.predicates
+            agger = DevicePartialAgger(self, child_schema,
+                                       fused_predicates=fused_preds)
+            src_iter = (source.execute(partition, ctx, metrics.child(0))
+                        if source is not child_op else
+                        self.execute_child(0, partition, ctx, metrics))
+            for batch in src_iter:
                 with metrics.timer("elapsed_compute"):
                     out = agger.process(batch)
                 if out is not None and out.num_rows:

@@ -35,6 +35,9 @@ from blaze_tpu.utils.device import is_device_dtype
 _DEVICE_AGG_FNS = (E.AggFunction.SUM, E.AggFunction.COUNT, E.AggFunction.AVG,
                    E.AggFunction.MIN, E.AggFunction.MAX)
 
+# jitted fused (filter+partial-agg) kernels, shared across agger instances
+_FUSED_KERNELS = {}
+
 
 def supports_device_partial(op, child_schema: T.Schema) -> bool:
     """Partial-mode hash agg over device keys and device-mode aggregates."""
@@ -54,12 +57,33 @@ def supports_device_partial(op, child_schema: T.Schema) -> bool:
     return True
 
 
-class DevicePartialAgger:
-    """Streams batches through the jitted sort-segment partial kernel."""
+def supports_fused_filter(filter_op, grandchild_schema: T.Schema) -> bool:
+    """Can the filter's predicate run inside the agg's jitted kernel? All
+    columns must be device-resident (the tracer batch is rebuilt from jit
+    inputs) and the predicate must be stateless jax-traceable."""
+    from blaze_tpu.exprs.compiler import _contains_stateful
 
-    def __init__(self, op, child_schema: T.Schema):
+    if getattr(filter_op, "projection", None) is not None:
+        return False
+    if not all(is_device_dtype(f.dtype) for f in grandchild_schema.fields):
+        return False
+    return not any(_contains_stateful(p) for p in filter_op.predicates)
+
+
+class DevicePartialAgger:
+    """Streams batches through the jitted sort-segment partial kernel.
+
+    With ``fused_predicates`` set, the upstream FilterExec's predicate is
+    traced INTO the kernel (reference: filter-project fusion): the filter
+    mask becomes the kernel's row-exists mask, so a filter+partial-agg
+    pipeline stage costs one jit call and one scalar sync per batch instead
+    of a compaction round trip plus the kernel."""
+
+    def __init__(self, op, child_schema: T.Schema, fused_predicates=None):
         self.op = op
         self.child_schema = child_schema
+        self.fused_predicates = fused_predicates
+        self._fused_cache = {}
         self.group_ev = ExprEvaluator([e for _, e in op.groupings], child_schema)
         self.agg_evs = [
             ExprEvaluator(list(a.agg.args), child_schema) if a.agg.args else None
@@ -90,26 +114,24 @@ class DevicePartialAgger:
                 acc_dt = ""
             self.specs.append((kind, rescale, acc_dt))
 
-    def process(self, batch: ColumnarBatch) -> Optional[ColumnarBatch]:
-        n = batch.num_rows
-        if n == 0:
-            return None
+    def _flow(self, batch: ColumnarBatch, exists):
+        """Traceable per-batch flow: evaluate keys/args, run the segment
+        kernel body. Works on real arrays (eager) and tracers (fused jit)."""
         gcols = [self.group_ev._to_dev(self.group_ev._eval(e, batch), batch)
                  for _, e in self.op.groupings]
         key_data, key_valid = [], []
         for v in gcols:
             d, val = _broadcast(v, batch)
             key_data.append(d)
-            key_valid.append(val & batch.row_exists_mask())
+            key_valid.append(val & exists)
         args = []
         for a, ev in zip(self.op.aggs, self.agg_evs):
             if ev is None:
-                args.append((jnp.zeros(batch.capacity, jnp.int64),
-                             batch.row_exists_mask()))
+                args.append((jnp.zeros(batch.capacity, jnp.int64), exists))
             else:
                 dv = ev._to_dev(ev._eval(a.agg.args[0], batch), batch)
                 d, val = _broadcast(dv, batch)
-                args.append((d, val & batch.row_exists_mask()))
+                args.append((d, val & exists))
         kernel = _partial_kernel(
             tuple(str(d.dtype) for d in key_data),
             tuple(self.specs),
@@ -121,7 +143,62 @@ class DevicePartialAgger:
             flat += [d, v]
         for d, v in args:
             flat += [d, v]
-        outs = kernel(batch.row_exists_mask(), *flat)
+        return kernel(exists, *flat)
+
+    def _fused_fn(self, batch: ColumnarBatch):
+        """Jitted (predicate + flow), cached at MODULE level by structural
+        key — jax.jit caches by function identity, so a per-instance closure
+        would recompile for every partition/run."""
+        cap_key = (batch.capacity, tuple(str(f.dtype) for f in batch.schema.fields))
+        fn = self._fused_cache.get(cap_key)
+        if fn is not None:
+            return fn
+        key = (self._structural_key(), cap_key)
+        fn = _FUSED_KERNELS.get(key)
+        if fn is None:
+            schema = batch.schema
+            preds = self.fused_predicates
+            agger = self
+
+            def fused(num_rows, *flat):
+                cols = [
+                    DeviceColumn(f.dtype, flat[2 * i], flat[2 * i + 1])
+                    for i, f in enumerate(schema.fields)
+                ]
+                tb = ColumnarBatch(schema, cols, num_rows)
+                # fresh evaluator per trace: its CSE cache must hold tracers
+                # of THIS trace only
+                pred_ev = ExprEvaluator(list(preds), schema)
+                mask = pred_ev.evaluate_predicate(tb)
+                return agger._flow(tb, mask)
+
+            fn = jax.jit(fused)
+            _FUSED_KERNELS[key] = fn
+        self._fused_cache[cap_key] = fn
+        return fn
+
+    def _structural_key(self) -> str:
+        if getattr(self, "_skey", None) is None:
+            from blaze_tpu.ir.serde import expr_to_json
+
+            parts = [expr_to_json(p) for p in self.fused_predicates]
+            parts += [f"{n}:{expr_to_json(e)}" for n, e in self.op.groupings]
+            parts += [f"{a.name}:{a.mode.value}:{expr_to_json(a.agg)}"
+                      for a in self.op.aggs]
+            self._skey = "|".join(parts)
+        return self._skey
+
+    def process(self, batch: ColumnarBatch) -> Optional[ColumnarBatch]:
+        n = batch.num_rows
+        if n == 0:
+            return None
+        if self.fused_predicates is not None:
+            flat = []
+            for c in batch.columns:
+                flat += [c.data, c.validity]
+            outs = self._fused_fn(batch)(jnp.int64(n), *flat)
+        else:
+            outs = self._flow(batch, batch.row_exists_mask())
         num_groups = int(outs[0])
         if num_groups == 0:
             return None
