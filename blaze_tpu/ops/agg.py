@@ -108,7 +108,7 @@ class AggExec(Operator):
                 fused_preds = child_op.predicates
             agger = DevicePartialAgger(self, child_schema,
                                        fused_predicates=fused_preds)
-            src_iter = (source.execute(partition, ctx, metrics.child(0))
+            src_iter = (source.execute(partition, ctx, metrics.child(0).child(0))
                         if source is not child_op else
                         self.execute_child(0, partition, ctx, metrics))
             for batch in src_iter:

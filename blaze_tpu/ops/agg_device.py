@@ -117,6 +117,12 @@ class DevicePartialAgger:
     def _flow(self, batch: ColumnarBatch, exists):
         """Traceable per-batch flow: evaluate keys/args, run the segment
         kernel body. Works on real arrays (eager) and tracers (fused jit)."""
+        # direct _eval use bypasses evaluate()'s per-batch CSE reset — reset
+        # explicitly or batch N would reuse batch N-1's cached arrays
+        self.group_ev._reset_cse(batch)
+        for ev in self.agg_evs:
+            if ev is not None:
+                ev._reset_cse(batch)
         gcols = [self.group_ev._to_dev(self.group_ev._eval(e, batch), batch)
                  for _, e in self.op.groupings]
         key_data, key_valid = [], []
@@ -149,7 +155,8 @@ class DevicePartialAgger:
         """Jitted (predicate + flow), cached at MODULE level by structural
         key — jax.jit caches by function identity, so a per-instance closure
         would recompile for every partition/run."""
-        cap_key = (batch.capacity, tuple(str(f.dtype) for f in batch.schema.fields))
+        cap_key = (batch.capacity,
+                   tuple((f.name, str(f.dtype)) for f in batch.schema.fields))
         fn = self._fused_cache.get(cap_key)
         if fn is not None:
             return fn

@@ -145,12 +145,73 @@ class Session:
         )
 
     def _lower(self, node: N.PlanNode) -> N.PlanNode:
+        self._check_op_enabled(node)
         node = N.map_children(node, self._lower)
         if isinstance(node, N.ShuffleExchange):
+            if isinstance(node.partitioning, N.RangePartitioning) and \
+                    not node.partitioning.bounds and \
+                    node.partitioning.num_partitions > 1:
+                # driver-side bound sampling (reference: reservoir sampling in
+                # NativeShuffleExchangeBase.scala:211-246 shipping bounds as
+                # literals): sample the child once, derive per-reducer bounds
+                node = dataclasses.replace(
+                    node, partitioning=self._sample_range_bounds(node))
             return self._run_shuffle_map_stage(node)
         if isinstance(node, N.BroadcastExchange):
             return self._run_broadcast_collect(node)
         return node
+
+    def _check_op_enabled(self, node: N.PlanNode):
+        """Per-operator gating (reference: spark.auron.enable.<op> flags in
+        AuronConvertStrategy — there the fallback is vanilla Spark; a
+        standalone engine has nowhere to fall back, so a disabled operator
+        is a planning error surfaced before execution)."""
+        import re
+
+        # acronym-aware camel -> snake (FFIReader -> ffi_reader)
+        name = re.sub(r"(?<=[a-z0-9])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])", "_",
+                      type(node).__name__).lower()
+        if not self.conf.is_op_enabled(name):
+            raise ValueError(
+                f"operator {name!r} is disabled by configuration "
+                f"(enabled_ops[{name!r}] = False)")
+
+    def _sample_range_bounds(self, node: N.ShuffleExchange) -> N.RangePartitioning:
+        """Sample up to ~100 rows/partition of the child's sort keys and cut
+        num_partitions-1 quantile bounds."""
+        part = node.partitioning
+        child_op = build_operator(node.child)
+        ev_exprs = [so.child for so in part.sort_orders]
+        samples = []
+        for p in range(child_op.num_partitions()):
+            ctx = self._make_ctx(p)
+            taken = 0
+            for batch in child_op.execute(p, ctx):
+                from blaze_tpu.exprs.compiler import ExprEvaluator
+
+                ev = ExprEvaluator(ev_exprs, batch.schema)
+                cols = ev.evaluate(batch)
+                arrays = [c.to_arrow(batch.num_rows).to_pylist() for c in cols]
+                step = max(1, batch.num_rows // 50)
+                for i in range(0, batch.num_rows, step):
+                    samples.append(tuple(a[i] for a in arrays))
+                taken += batch.num_rows
+                if taken >= 5000:
+                    break
+        if not samples:
+            return dataclasses.replace(part, bounds=[])
+        from blaze_tpu.ops.sort_keys import _host_key_part
+
+        def keyf(row):
+            return tuple(_host_key_part(v, so)
+                         for v, so in zip(row, part.sort_orders))
+
+        samples.sort(key=keyf)
+        n = part.num_partitions
+        bounds = []
+        for i in range(1, n):
+            bounds.append(samples[min(len(samples) - 1, i * len(samples) // n)])
+        return dataclasses.replace(part, bounds=bounds)
 
     def _run_shuffle_map_stage(self, node: N.ShuffleExchange) -> N.PlanNode:
         """Execute the map side (one ShuffleWriter task per child partition),

@@ -291,3 +291,19 @@ def test_device_partial_widening_sum_i32():
     out = collect_pydict(final)
     assert out["s"] == [2_000_000 * n]  # > 2^31, would wrap in int32
     assert out["a"] == [2_000_000.0]
+
+
+def test_device_partial_expr_keys_multi_batch():
+    # regression: device partial agg with a NON-trivial grouping expression
+    # across multiple batches (CSE must reset per batch in the direct-_eval
+    # flow)
+    data = {"k": [1, 1, 2, 5, 5, 6], "v": [1, 1, 1, 1, 1, 1]}
+    scan = mem_scan(data, num_batches=2)  # [1,1,2] then [5,5,6]
+    gexpr = E.BinaryExpr(E.BinaryOp.ADD, col("k"), E.Literal(0, T.I64))
+    partial = AggExec(scan, HASH, [("g", gexpr)],
+                      [agg_col(F.COUNT, [], M.PARTIAL, "c")])
+    final = AggExec(partial, HASH, [("g", col("g"))],
+                    [agg_col(F.COUNT, [], M.FINAL, "c")])
+    out = _sorted_out(final, "g")
+    assert out["g"] == [1, 2, 5, 6]
+    assert out["c"] == [2, 1, 2, 1]

@@ -218,3 +218,34 @@ def test_rss_shuffle_writer():
         for b in IpcReaderExec(scan.schema, "blocks").execute(0, ctx):
             total += b.num_rows
     assert total == 100
+
+
+def test_session_distributed_global_sort_range_sampling():
+    """Range exchange with driver-sampled bounds + per-partition sort = the
+    reference's global-sort path; bounds left empty are sampled by Session."""
+    rng = np.random.default_rng(9)
+    vals = rng.integers(-(10**9), 10**9, 30_000).tolist()
+    sess = Session()
+    b = ColumnarBatch.from_pydict({"v": vals})
+    third = 10_000
+    sess.resources["src"] = lambda p: [b.slice(p * third, third).to_arrow()]
+    scan = N.FFIReader(schema=b.schema, resource_id="src", num_partitions=3)
+    ex = N.ShuffleExchange(scan, N.RangePartitioning(
+        [E.SortOrder(col("v"))], 4, bounds=[]))
+    plan = N.Sort(ex, [E.SortOrder(col("v"))])
+    out = sess.execute_to_pydict(plan)
+    assert out["v"] == sorted(vals)
+
+
+def test_session_disabled_operator_rejected():
+    from blaze_tpu.config import config_override
+
+    sess_b = ColumnarBatch.from_pydict({"v": [1]})
+    with config_override(enabled_ops={"filter": False}):
+        sess = Session()
+        sess.resources["src"] = lambda p: [sess_b.to_arrow()]
+        plan = N.Filter(
+            N.FFIReader(schema=sess_b.schema, resource_id="src", num_partitions=1),
+            [E.BinaryExpr(E.BinaryOp.GT, col("v"), E.Literal(0, T.I64))])
+        with pytest.raises(ValueError, match="disabled"):
+            list(sess.execute(plan))
