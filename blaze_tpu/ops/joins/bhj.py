@@ -107,8 +107,11 @@ class _HashJoinBase(Operator):
     # -- probe ----------------------------------------------------------------
 
     def _execute(self, partition, ctx, metrics):
-        jt = self.join_type
         bmap = self._load_build_map(partition, ctx, metrics)
+        yield from self._probe_with_map(bmap, partition, ctx, metrics)
+
+    def _probe_with_map(self, bmap: JoinHashMap, partition, ctx, metrics):
+        jt = self.join_type
         probe_child = self._probe_child()
         probe_schema = self.children[probe_child].schema
         key_exprs = self._key_exprs(for_build=False)
@@ -233,7 +236,11 @@ class _HashJoinBase(Operator):
 
 
 class HashJoinExec(_HashJoinBase):
-    """Shuffled hash join: build side read within this partition."""
+    """Shuffled hash join: build side read within this partition. When the
+    build side turns out too large for an in-memory map, execution falls
+    back to a sort-merge join over the same children (reference:
+    SMJ_FALLBACK_* conf, AuronConverters.scala:522-557 — there the planner
+    decides; here the runtime measures the actual build)."""
 
     def __init__(self, left, right, on, join_type, build_side=JoinSide.RIGHT,
                  condition=None):
@@ -244,6 +251,64 @@ class HashJoinExec(_HashJoinBase):
 
     def _load_build_map(self, partition, ctx, metrics):
         return self._build_from_child(partition, ctx, metrics)
+
+    def _execute(self, partition, ctx, metrics):
+        if ctx.conf.smj_fallback_enable:
+            build_child = self.children[self._build_child()]
+            batches = []
+            rows = 0
+            nbytes = 0
+            too_big = False
+            it = build_child.execute(partition, ctx,
+                                     metrics.child(self._build_child()))
+            for b in it:
+                batches.append(b)
+                rows += b.num_rows
+                nbytes += b.nbytes()
+                if rows > ctx.conf.smj_fallback_rows_threshold or \
+                        nbytes > ctx.conf.smj_fallback_mem_size_threshold:
+                    too_big = True
+                    break
+            if too_big:
+                metrics.add("smj_fallback", 1)
+                yield from self._fallback_smj(partition, ctx, metrics,
+                                              batches, it)
+                return
+            bmap = JoinHashMap.build(batches, self._key_exprs(for_build=True),
+                                     build_child.schema)
+            yield from self._probe_with_map(bmap, partition, ctx, metrics)
+            return
+        yield from super()._execute(partition, ctx, metrics)
+
+    def _fallback_smj(self, partition, ctx, metrics, staged, build_rest):
+        """Re-plan this partition as sort + SMJ; the already-read build
+        batches replay ahead of the remaining stream."""
+        from blaze_tpu.ops.basic import MemoryScanExec
+        from blaze_tpu.ops.joins.smj import SortMergeJoinExec
+        from blaze_tpu.ops.sort import SortExec
+
+        build_i = self._build_child()
+        probe_i = self._probe_child()
+
+        class _Replay(MemoryScanExec):
+            def __init__(self, schema):
+                super().__init__(schema, [[]])
+
+            def _execute(self, p, c, m):
+                yield from staged
+                yield from build_rest
+
+        build_src = _Replay(self.children[build_i].schema)
+        sides = [None, None]
+        sides[build_i] = SortExec(build_src,
+                                  [E.SortOrder(e) for e in self._key_exprs(True)])
+        sides[probe_i] = SortExec(self.children[probe_i],
+                                  [E.SortOrder(e) for e in self._key_exprs(False)])
+        smj = SortMergeJoinExec(sides[0], sides[1], self.on, self.join_type,
+                                condition=self.condition)
+        # the probe child must execute at `partition`; the replayed build is
+        # partition-agnostic
+        yield from smj._execute(partition, ctx, metrics)
 
 
 class BroadcastJoinExec(_HashJoinBase):
