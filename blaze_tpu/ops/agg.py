@@ -117,6 +117,13 @@ class AggExec(Operator):
                 if out is not None and out.num_rows:
                     yield out
             return
+        if self.exec_mode == E.AggExecMode.SORT_AGG and self.groupings:
+            # input sorted by grouping keys (converter-guaranteed, as for the
+            # reference's SortAgg): stream with bounded memory — per-batch
+            # mini partials, re-aggregated chunk-wise with chunks cut at key
+            # boundaries so no group spans two chunks
+            yield from _execute_sorted_impl(self, partition, ctx, metrics)
+            return
         table = AggTable(self, child_schema, ctx, metrics)
         ctx.mem.register(table)
         try:
@@ -145,6 +152,62 @@ class AggExec(Operator):
         finally:
             ctx.mem.unregister(table)
             table.release()
+
+
+def _execute_sorted_impl(op: "AggExec", partition, ctx, metrics):
+    child_schema = op.children[0].schema
+
+    def partial_batches():
+        for batch in op.execute_child(0, partition, ctx, metrics):
+            if batch.num_rows == 0:
+                continue
+            t = AggTable(op, child_schema, ctx, metrics)
+            t.spillable = False
+            t.process_batch(batch)
+            yield from t._emit(partial=True, sort_by_key=False, include_key=True)
+
+    yield from _sorted_chunker(op, child_schema, ctx, metrics, partial_batches())
+
+
+def _sorted_chunker(op: "AggExec", child_schema, ctx, metrics, partial_batches):
+    """Re-aggregate a key-sorted stream of partial batches (each carrying the
+    #aggkey column) chunk-wise; chunks only cut at key boundaries."""
+    bs = ctx.conf.batch_size
+    chunk_parts = []
+    chunk_rows = 0
+    partial_out = op.is_partial_output
+    driver_table = AggTable(op, child_schema, ctx, metrics)
+    driver_table.spillable = False
+
+    def flush():
+        nonlocal chunk_parts, chunk_rows
+        if not chunk_parts:
+            return
+        merged = ColumnarBatch.concat(chunk_parts, chunk_parts[0].schema)
+        chunk_parts, chunk_rows = [], 0
+        base, _ = _split_key_col(merged)
+        sub = driver_table._make_merge_table()
+        sub.process_batch(base)
+        yield from sub._emit(partial=partial_out)
+
+    last_key = None
+    for pb in partial_batches:
+        _, keys = _split_key_col(pb, keys_only=True)
+        base = pb
+        # cut before the first row of a new key once the chunk is full
+        start = 0
+        for i, k in enumerate(keys):
+            if last_key is not None and k != last_key and chunk_rows + (i - start) >= bs:
+                if i > start:
+                    chunk_parts.append(base.slice(start, i - start))
+                    chunk_rows += i - start
+                yield from flush()
+                start = i
+            last_key = k
+        if len(keys) > start:
+            chunk_parts.append(base.slice(start, len(keys) - start))
+            chunk_rows += len(keys) - start
+    yield from flush()
 
 
 def _partial_arg_schema(a: E.AggExpr, child_schema: T.Schema, pos: int):
@@ -463,8 +526,20 @@ class AggTable(MemConsumer):
                 c = DeviceColumn(c.dtype, c.data[: max(self.capacity, ns)],
                                  c.validity[: max(self.capacity, ns)])
             final_cols.append(c)
-        schema = self.op.schema if not include_key else T.Schema(
-            self.op.schema.fields + (T.StructField(_KEY_COL, T.BINARY, False),)
+        # partial emission carries state columns regardless of the op's own
+        # output mode (spill / sorted-streaming paths emit partials even for
+        # COMPLETE/FINAL ops)
+        if partial:
+            base_schema = T.Schema(
+                tuple(
+                    T.StructField(n, self.op.schema[i].dtype)
+                    for i, (n, _) in enumerate(self.op.groupings)
+                ) + tuple(_partial_schema_fields(self.op, self.fns))
+            )
+        else:
+            base_schema = self.op.schema
+        schema = base_schema if not include_key else T.Schema(
+            base_schema.fields + (T.StructField(_KEY_COL, T.BINARY, False),)
         )
         if include_key:
             keys = self.slot_keys if order is None else [self.slot_keys[i] for i in order]
