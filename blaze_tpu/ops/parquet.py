@@ -123,6 +123,28 @@ class ParquetScanExec(Operator):
         def produce():
             try:
                 for pfile in group.files:
+                    if pfile.range is not None:
+                        # byte-range split: read the row groups whose start
+                        # offset midpoint falls inside [start, end) — the
+                        # same ownership rule Spark/parquet splits use, so
+                        # every row group is read by exactly one split
+                        pf = pq.ParquetFile(pfile.path)
+                        rgs = []
+                        for i in range(pf.metadata.num_row_groups):
+                            rg = pf.metadata.row_group(i)
+                            c = rg.column(0)
+                            off = c.dictionary_page_offset or c.data_page_offset
+                            if pfile.range.start <= off < pfile.range.end:
+                                rgs.append(i)
+                        if not rgs:
+                            continue
+                        for rb in pf.iter_batches(batch_size=batch_size,
+                                                  row_groups=rgs,
+                                                  columns=proj_names):
+                            metrics.add("bytes_scanned", rb.nbytes)
+                            if not _put((pfile, rb)):
+                                return
+                        continue
                     ds = pads.dataset(pfile.path, format="parquet")
                     scanner = ds.scanner(columns=proj_names, filter=filt,
                                          batch_size=batch_size)

@@ -297,8 +297,24 @@ class Session:
                            num_partitions=1)
 
     def _run_tasks(self, fn, partitions) -> list:
+        """Run map tasks with one retry per task (the reference delegates
+        retry/speculation to Spark, SURVEY.md §5.3; a standalone driver owns
+        it — shuffle writes are atomic via tmp-file rename, and round-robin
+        routing is deterministic, so retries are safe)."""
+        import logging
+
+        log = logging.getLogger("blaze_tpu.session")
+
+        def run_with_retry(p):
+            try:
+                return fn(p)
+            except Exception as exc:
+                log.warning("task %s failed (%s: %s); retrying once",
+                            p, type(exc).__name__, exc)
+                return fn(p)
+
         parts = list(partitions)
         if len(parts) <= 1 or self.max_workers <= 1:
-            return [fn(p) for p in parts]
+            return [run_with_retry(p) for p in parts]
         with ThreadPoolExecutor(max_workers=self.max_workers) as pool:
-            return list(pool.map(fn, parts))
+            return list(pool.map(run_with_retry, parts))

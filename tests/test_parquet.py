@@ -120,3 +120,56 @@ def test_q01_style_end_to_end(pq_file):
     exp = df.groupby("name").amt.sum().sort_values(ascending=False).head(3)
     assert out["name"] == exp.index.tolist()
     assert out["total"] == exp.tolist()
+
+
+def test_scan_byte_range_splits(tmp_path):
+    """One file split into two byte-range partitions: every row group is
+    owned by exactly one split, union covers all rows."""
+    tbl = pa.table({"x": pa.array(range(10_000), type=pa.int64())})
+    path = str(tmp_path / "split.parquet")
+    pq.write_table(tbl, path, row_group_size=1000)
+    size = os.path.getsize(path)
+    mid = size // 2
+    schema = T.schema_from_arrow(pq.read_schema(path))
+    conf = N.FileScanConf(
+        file_groups=[
+            N.FileGroup(files=[N.PartitionedFile(path, size, N.FileRange(0, mid))]),
+            N.FileGroup(files=[N.PartitionedFile(path, size, N.FileRange(mid, size))]),
+        ],
+        file_schema=schema,
+        projection=[0],
+    )
+    op = build_operator(N.ParquetScan(conf))
+    per_part = []
+    from blaze_tpu.ops.base import ExecContext
+
+    for p in range(2):
+        rows = []
+        for b in op.execute(p, ExecContext()):
+            rows.extend(b.to_pydict()["x"])
+        per_part.append(rows)
+    assert len(per_part[0]) > 0 and len(per_part[1]) > 0
+    assert sorted(per_part[0] + per_part[1]) == list(range(10_000))
+
+
+def test_session_task_retry(tmp_path):
+    """A flaky map task succeeds on the automatic retry."""
+    from blaze_tpu.core import ColumnarBatch
+    from blaze_tpu.runtime.session import Session
+
+    attempts = {"n": 0}
+    b = ColumnarBatch.from_pydict({"v": [1, 2, 3]})
+
+    def flaky_src(p):
+        attempts["n"] += 1
+        if attempts["n"] == 1:
+            raise RuntimeError("transient failure")
+        return [b.to_arrow()]
+
+    sess = Session()
+    sess.resources["src"] = flaky_src
+    scan = N.FFIReader(schema=b.schema, resource_id="src", num_partitions=1)
+    plan = N.ShuffleExchange(scan, N.SinglePartitioning(1))
+    out = sess.execute_to_pydict(plan)
+    assert out["v"] == [1, 2, 3]
+    assert attempts["n"] == 2
