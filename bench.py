@@ -32,6 +32,23 @@ ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
 PARTS = int(os.environ.get("BENCH_PARTITIONS", 4))
 
 
+def probe_device(timeout_s: float = 150.0) -> bool:
+    """The axon TPU sits behind a tunnel that can hang indefinitely; probe
+    it in a SUBPROCESS with a deadline. On failure the caller pins the cpu
+    platform (must happen before this process touches a jax backend) so the
+    bench always reports a number instead of hanging the driver."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp; float(jnp.arange(8).sum())"],
+            timeout=timeout_s, capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def make_data(tmpdir: str):
     import decimal
 
@@ -105,6 +122,14 @@ def run_baseline(paths):
 
 
 def main():
+    device = "device"
+    if not probe_device():
+        # accelerator unreachable: pin cpu BEFORE any backend init so the
+        # run completes; the reported metric is flagged
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        device = "cpu_fallback"
     with tempfile.TemporaryDirectory(prefix="blaze_bench_") as tmpdir:
         paths = make_data(tmpdir)
         # warmup run compiles the device kernels
@@ -115,12 +140,15 @@ def main():
         od = out.to_pydict()
         assert od["sr_store_sk"] == base.index.tolist(), "bench result mismatch"
         assert od["total"] == base.total.tolist(), "bench sums mismatch"
-        print(json.dumps({
+        record = {
             "metric": f"q01_like_{ROWS}rows_wallclock",
             "value": round(engine_s, 3),
             "unit": "s",
             "vs_baseline": round(baseline_s / engine_s, 3),
-        }))
+        }
+        if device != "device":
+            record["note"] = "accelerator unreachable; ran on cpu fallback"
+        print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -135,6 +135,22 @@ class Session:
     def execute_to_pydict(self, plan: N.PlanNode) -> dict:
         return self.execute_to_table(plan).to_pydict()
 
+    def close(self):
+        """Remove shuffle files and release resources (a failed stage is
+        recomputed from the last shuffle, reference SURVEY.md §5.4 — once a
+        session closes its durable intermediates go too)."""
+        import shutil
+
+        self.resources.clear()
+        shutil.rmtree(self.work_dir, ignore_errors=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
     # -- internals ------------------------------------------------------------
 
     def _make_ctx(self, partition: int, stage: int = 0) -> ExecContext:

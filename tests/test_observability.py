@@ -58,3 +58,43 @@ def test_task_context_logging(capsys):
     # handler writes to stderr
     err = capsys.readouterr().err
     assert "[3.7" in err and "hello" in err
+
+
+def test_cooperative_cancellation():
+    from blaze_tpu.core import ColumnarBatch
+    from blaze_tpu.ir import types as T
+    from blaze_tpu.ops.base import ExecContext, TaskCancelled
+    from blaze_tpu.ops.basic import FilterExec, MemoryScanExec
+    from blaze_tpu.ir import exprs as EE
+    import pytest
+
+    b = ColumnarBatch.from_pydict({"a": list(range(100))})
+    scan = MemoryScanExec(b.schema, [[b.slice(i * 10, 10) for i in range(10)]])
+    op = FilterExec(scan, [EE.BinaryExpr(EE.BinaryOp.GTEQ, EE.Column("a"),
+                                         EE.Literal(0, T.I64))])
+    ctx = ExecContext()
+    it = op.execute(0, ctx)
+    next(it)  # first batch flows
+    ctx.cancel()
+    with pytest.raises(TaskCancelled):
+        for _ in it:
+            pass
+
+
+def test_session_close_removes_workdir():
+    import os
+
+    from blaze_tpu.core import ColumnarBatch
+    from blaze_tpu.runtime.session import Session
+
+    b = ColumnarBatch.from_pydict({"v": [1, 2]})
+    with Session() as sess:
+        sess.resources["src"] = lambda p: [b.to_arrow()]
+        plan = N.ShuffleExchange(
+            N.FFIReader(schema=b.schema, resource_id="src", num_partitions=1),
+            N.SinglePartitioning(1))
+        out = sess.execute_to_pydict(plan)
+        assert out["v"] == [1, 2]
+        wd = sess.work_dir
+        assert os.path.exists(wd)
+    assert not os.path.exists(wd)
