@@ -30,3 +30,16 @@ def test_distributed_sum_reducer_locality(eight_devices):
     out = run_distributed_sum(keys, vals, make_mesh(8))
     assert all(v == (1, 1) for v in out.values())
     assert len(out) == 100
+
+
+def test_distributed_broadcast_join(eight_devices):
+    from blaze_tpu.parallel.mesh import run_broadcast_join
+
+    rng = np.random.default_rng(2)
+    probe = rng.integers(0, 200, 1000).astype(np.int64)
+    build_keys = np.arange(0, 200, 2, dtype=np.int64)  # even keys only
+    build_vals = build_keys * 10
+    out, total = run_broadcast_join(probe, build_keys, build_vals, make_mesh(8))
+    exp = [int(k) * 10 if k % 2 == 0 else None for k in probe]
+    assert out == exp
+    assert total == sum(1 for k in probe if k % 2 == 0)
