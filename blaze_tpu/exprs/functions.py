@@ -63,6 +63,7 @@ register_type_rule("date_add", T.DATE)
 register_type_rule("date_sub", T.DATE)
 register_type_rule("split", T.ArrayType(T.STRING))
 register_type_rule("make_array", lambda ts: T.ArrayType(ts[0] if ts else T.NULL))
+register_type_rule("array_union", lambda ts: ts[0])
 register_type_rule("unscaled_value", T.I64)
 register_type_rule("make_decimal", lambda ts: T.DecimalType(38, 18))
 register_type_rule("check_overflow", lambda ts: ts[0])
@@ -603,6 +604,33 @@ def _parse_json_path(path):
     return steps
 
 
+def _fn_array_union(args, ev, batch):
+    """brickhouse array_union: element-wise union of array columns with
+    dedup, preserving first-seen order (reference: brickhouse array_union in
+    datafusion-ext-functions)."""
+    from blaze_tpu.exprs.compiler import HostVal
+
+    arrs = [ev._to_host(a, batch).arr for a in args]
+    et = args[0].dtype.element_type if isinstance(args[0].dtype, T.ArrayType) else T.NULL
+    pylists = [a.to_pylist() for a in arrs]
+    n = len(pylists[0]) if pylists else 0
+    out = []
+    for i in range(n):
+        seen = []
+        any_val = False
+        for pl in pylists:
+            items = pl[i]
+            if items is None:
+                continue
+            any_val = True
+            for v in items:
+                if v not in seen:
+                    seen.append(v)
+        out.append(seen if any_val else None)
+    return HostVal(T.ArrayType(et),
+                   pa.array(out, type=pa.large_list(T.to_arrow_type(et))))
+
+
 def _fn_make_array(args, ev, batch):
     from blaze_tpu.exprs.compiler import HostVal
 
@@ -684,4 +712,5 @@ _FUNCTIONS = {
     "md5": _fn_md5,
     "get_json_object": _fn_get_json_object,
     "make_array": _fn_make_array,
+    "array_union": _fn_array_union,
 }

@@ -284,9 +284,16 @@ def scan_node_for_files(paths: List[str], num_partitions: int = 1,
     for i, p in enumerate(paths):
         size = os.path.getsize(p)
         groups[i % num_partitions].append(N.PartitionedFile(p, size))
-    proj = list(range(len(schema))) if projection is None else [
-        schema.index_of(n) for n in projection
-    ]
+    if projection is None:
+        proj = list(range(len(schema)))
+    else:
+        # case-insensitive column resolution (reference: schema adaption in
+        # scan/mod.rs:34-92 matches file columns case-insensitively)
+        lower = {f.name.lower(): i for i, f in enumerate(schema.fields)}
+        proj = [
+            schema.index_of(n) if n in schema.names else lower[n.lower()]
+            for n in projection
+        ]
     conf = N.FileScanConf(
         file_groups=[N.FileGroup(files=g) for g in groups],
         file_schema=schema,

@@ -356,3 +356,13 @@ def test_cse_shared_subtree_single_eval():
     e2 = E.BinaryExpr(E.BinaryOp.ADD, shared, lit(2, T.I64))
     out = run([e1, e2], {"a": pa.array([1], type=pa.int64())})
     assert out == {"c0": [4], "c1": [5]}
+
+
+def test_array_union():
+    schema = T.Schema.of(("a", T.ArrayType(T.I64)), ("b", T.ArrayType(T.I64)))
+    out = run(
+        [E.ScalarFunction("array_union", [col("a"), col("b")])],
+        {"a": [[1, 2, 2], None], "b": [[2, 3], [4]]},
+        schema,
+    )
+    assert out["c0"] == [[1, 2, 3], [4]]

@@ -173,3 +173,12 @@ def test_session_task_retry(tmp_path):
     out = sess.execute_to_pydict(plan)
     assert out["v"] == [1, 2, 3]
     assert attempts["n"] == 2
+
+
+def test_scan_projection_case_insensitive(pq_file):
+    path, tbl = pq_file
+    node = scan_node_for_files([path], projection=["ID", "Name"])
+    op = build_operator(node)
+    out = collect_pydict(op)
+    assert out["id"] == tbl["id"].to_pylist()
+    assert out["name"] == tbl["name"].to_pylist()

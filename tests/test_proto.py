@@ -135,3 +135,12 @@ def test_task_definition_roundtrip():
     task, back = P.task_definition_from_bytes(blob)
     assert (task.stage_id, task.partition_id, task.task_id) == (3, 7, 123)
     assert back.output_schema.names == ["a"]
+
+
+def test_json_serde_roundtrip_executes():
+    from blaze_tpu.ir import serde as S
+
+    plan = build_rich_plan()
+    back = S.plan_from_json(S.plan_to_json(plan))
+    assert S.plan_to_json(back) == S.plan_to_json(plan)
+    assert back.output_schema.names == plan.output_schema.names
