@@ -63,7 +63,14 @@ register_type_rule("date_add", T.DATE)
 register_type_rule("date_sub", T.DATE)
 register_type_rule("split", T.ArrayType(T.STRING))
 register_type_rule("make_array", lambda ts: T.ArrayType(ts[0] if ts else T.NULL))
-register_type_rule("array_union", lambda ts: ts[0])
+def _array_union_type_rule(ts):
+    for t in ts:
+        if isinstance(t, T.ArrayType) and not isinstance(t.element_type, T.NullType):
+            return t
+    return T.ArrayType(T.NULL)
+
+
+register_type_rule("array_union", _array_union_type_rule)
 register_type_rule("unscaled_value", T.I64)
 register_type_rule("make_decimal", lambda ts: T.DecimalType(38, 18))
 register_type_rule("check_overflow", lambda ts: ts[0])
@@ -606,29 +613,42 @@ def _parse_json_path(path):
 
 def _fn_array_union(args, ev, batch):
     """brickhouse array_union: element-wise union of array columns with
-    dedup, preserving first-seen order (reference: brickhouse array_union in
-    datafusion-ext-functions)."""
+    dedup, first-seen order. Result is never null — ``null U null = {}``
+    (reference: brickhouse/array_union.rs semantics)."""
     from blaze_tpu.exprs.compiler import HostVal
 
     arrs = [ev._to_host(a, batch).arr for a in args]
-    et = args[0].dtype.element_type if isinstance(args[0].dtype, T.ArrayType) else T.NULL
+    et = _array_union_element_type([a.dtype for a in args])
     pylists = [a.to_pylist() for a in arrs]
     n = len(pylists[0]) if pylists else 0
     out = []
     for i in range(n):
         seen = []
-        any_val = False
+        seen_set = set()
         for pl in pylists:
             items = pl[i]
             if items is None:
                 continue
-            any_val = True
             for v in items:
-                if v not in seen:
+                try:
+                    new = v not in seen_set
+                    if new:
+                        seen_set.add(v)
+                except TypeError:  # unhashable nested value
+                    new = v not in seen
+                if new:
                     seen.append(v)
-        out.append(seen if any_val else None)
+        out.append(seen)
     return HostVal(T.ArrayType(et),
                    pa.array(out, type=pa.large_list(T.to_arrow_type(et))))
+
+
+def _array_union_element_type(arg_types) -> T.DataType:
+    """First non-null List element type (reference skips DataType::Null)."""
+    for t in arg_types:
+        if isinstance(t, T.ArrayType) and not isinstance(t.element_type, T.NullType):
+            return t.element_type
+    return T.NULL
 
 
 def _fn_make_array(args, ev, batch):

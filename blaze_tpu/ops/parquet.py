@@ -290,10 +290,14 @@ def scan_node_for_files(paths: List[str], num_partitions: int = 1,
         # case-insensitive column resolution (reference: schema adaption in
         # scan/mod.rs:34-92 matches file columns case-insensitively)
         lower = {f.name.lower(): i for i, f in enumerate(schema.fields)}
-        proj = [
-            schema.index_of(n) if n in schema.names else lower[n.lower()]
-            for n in projection
-        ]
+        proj = []
+        for n in projection:
+            if n in schema.names:
+                proj.append(schema.index_of(n))
+            elif n.lower() in lower:
+                proj.append(lower[n.lower()])
+            else:
+                schema.index_of(n)  # raises the descriptive KeyError
     conf = N.FileScanConf(
         file_groups=[N.FileGroup(files=g) for g in groups],
         file_schema=schema,

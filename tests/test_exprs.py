@@ -366,3 +366,13 @@ def test_array_union():
         schema,
     )
     assert out["c0"] == [[1, 2, 3], [4]]
+
+
+def test_array_union_null_semantics():
+    schema = T.Schema.of(("a", T.ArrayType(T.I64)), ("b", T.ArrayType(T.I64)))
+    out = run(
+        [E.ScalarFunction("array_union", [col("a"), col("b")])],
+        {"a": [None, [1]], "b": [None, None]},
+        schema,
+    )
+    assert out["c0"] == [[], [1]]  # null U null = {} (never null)
