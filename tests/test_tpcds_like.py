@@ -226,3 +226,48 @@ def test_grouping_sets_via_expand(warehouse):
     assert out["qty"][:n_cat] == per_cat.tolist()
     assert out["cat"][n_cat:] == [None]
     assert out["qty"][n_cat:] == [total]
+
+
+def test_not_exists_shape_anti_join(warehouse):
+    """customers with no store sales (NOT EXISTS -> left anti join)."""
+    paths, dfs = warehouse
+    customers = scan_node_for_files([paths["customer"]])
+    sales = scan_node_for_files([paths["store_sales"]], num_partitions=2)
+    # shuffle both sides by key, anti join per partition
+    cust_ex = N.ShuffleExchange(customers, N.HashPartitioning(
+        [col("c_customer_sk")], 3))
+    sales_ex = N.ShuffleExchange(sales, N.HashPartitioning(
+        [col("ss_customer_sk")], 3))
+    anti = N.HashJoin(cust_ex, sales_ex,
+                      [(col("c_customer_sk"), col("ss_customer_sk"))],
+                      N.JoinType.LEFT_ANTI, N.JoinSide.RIGHT)
+    plan = N.Sort(N.ShuffleExchange(anti, N.SinglePartitioning(1)),
+                  [E.SortOrder(col("c_customer_sk"))])
+    out = Session().execute_to_pydict(plan)
+    buyers = set(dfs["store_sales"].ss_customer_sk.unique().tolist())
+    exp = sorted(sk for sk in dfs["customer"].c_customer_sk.tolist()
+                 if sk not in buyers)
+    assert out["c_customer_sk"] == exp
+
+
+def test_union_all_shape(warehouse):
+    """UNION ALL of two filtered scans, aggregated (q-style set op)."""
+    paths, dfs = warehouse
+    low = N.Filter(scan_node_for_files([paths["store_sales"]]),
+                   [E.BinaryExpr(E.BinaryOp.LT, col("ss_quantity"),
+                                 lit(10, T.I32))])
+    high = N.Filter(scan_node_for_files([paths["store_sales"]]),
+                    [E.BinaryExpr(E.BinaryOp.GTEQ, col("ss_quantity"),
+                                  lit(90, T.I32))])
+    union = N.Union([low, high], num_partitions=2)
+    agg = two_stage_agg(union, [("ss_store_sk", col("ss_store_sk"))], [
+        ("n", E.AggExpr(F.COUNT, []), None),
+    ])
+    plan = N.Sort(N.ShuffleExchange(agg, N.SinglePartitioning(1)),
+                  [E.SortOrder(col("ss_store_sk"))])
+    out = Session().execute_to_pydict(plan)
+    df = dfs["store_sales"]
+    sub = df[(df.ss_quantity < 10) | (df.ss_quantity >= 90)]
+    exp = sub.groupby("ss_store_sk").size().sort_index()
+    assert out["ss_store_sk"] == exp.index.tolist()
+    assert out["n"] == exp.tolist()
