@@ -154,3 +154,13 @@ def test_window_running_min_with_nulls():
     out = collect_pydict(op)
     assert out["rmin"] == [None, 5.0, 3.0]
     assert out["rcnt"] == [0, 1, 2]
+
+
+def test_explode_map():
+    schema = T.Schema.of(("id", T.I64), ("m", T.MapType(T.STRING, T.I64)))
+    data = {"id": [1, 2], "m": [[("a", 10), ("b", 20)], None]}
+    scan = mem_scan(data, schema)
+    op = GenerateExec(scan, "explode", [col("m")], [0],
+                      T.Schema.of(("k", T.STRING), ("v", T.I64)), outer=True)
+    out = collect_pydict(op)
+    assert out == {"id": [1, 1, 2], "k": ["a", "b", None], "v": [10, 20, None]}
