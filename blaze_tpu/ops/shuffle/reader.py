@@ -22,6 +22,10 @@ from blaze_tpu.ops.base import Operator
 
 
 class IpcReaderExec(Operator):
+    """Decodes shuffle blocks with a prefetch thread so decompress/deser
+    overlaps downstream compute (reference: the reducer-side async read in
+    ipc_reader_exec.rs)."""
+
     def __init__(self, schema: T.Schema, resource_id: str, num_partitions: int = 1):
         self.resource_id = resource_id
         self._num_partitions = num_partitions
@@ -31,15 +35,57 @@ class IpcReaderExec(Operator):
         return self._num_partitions
 
     def _execute(self, partition, ctx, metrics):
+        import queue
+        import threading
+
         provider = ctx.resources[self.resource_id]
         blocks: Iterable = provider(partition) if callable(provider) else provider
-        for block in blocks:
-            with metrics.timer("ipc_read_time"):
-                stream = _open_block(block)
-            for batch in BatchReader(stream):
+        q: "queue.Queue" = queue.Queue(maxsize=4)
+        stop = threading.Event()
+        SENTINEL = object()
+
+        def _put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for block in blocks:
+                    stream = _open_block(block)
+                    for batch in BatchReader(stream):
+                        if not _put(batch):
+                            return
+                _put(SENTINEL)
+            except BaseException as exc:
+                _put(exc)
+
+        t = threading.Thread(target=produce, daemon=True, name="ipc-prefetch")
+        t.start()
+        try:
+            while True:
+                with metrics.timer("ipc_read_time"):
+                    item = q.get()
+                if item is SENTINEL:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                batch = item
                 if batch.schema.names != self.schema.names:
                     batch = batch.rename(self.schema.names)
                 yield batch
+        finally:
+            stop.set()
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout=5)
 
 
 def _open_block(block):

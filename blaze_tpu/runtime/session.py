@@ -270,8 +270,13 @@ class Session:
 
         rid = f"shuffle_{stage}"
         self.resources[rid] = block_provider
-        return N.IpcReader(schema=node.child.output_schema, resource_id=rid,
-                           num_partitions=num_reducers)
+        # coalesce reducer input: maps emit many small (e.g. per-batch
+        # partial-agg) batches; merging them cuts downstream per-batch
+        # overheads (reference: ExecutionContext.coalesce on every stream)
+        return N.CoalesceBatches(
+            N.IpcReader(schema=node.child.output_schema, resource_id=rid,
+                        num_partitions=num_reducers),
+            batch_size=0)
 
     def _run_broadcast_collect(self, node: N.BroadcastExchange) -> N.PlanNode:
         """Collect the child via IpcWriter into in-memory chunks and expose
