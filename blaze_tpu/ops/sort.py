@@ -122,6 +122,12 @@ class _SortState(MemConsumer):
             return 0
         freed = self.staged_bytes
         run = self._sorted_run()
+        if self.device:
+            # squeeze normalized keys into the spilled run so the merge
+            # phase never re-evaluates sort keys (reference: squeezed key
+            # blocks in sort_exec.rs); u64 keys store order-preserving as
+            # i64 via a sign-bit flip (host-side numpy — no device bitcasts)
+            run = _append_key_columns(run, SK.merge_keys_matrix(run, self.op.sort_orders))
         spill = SpillFile("sort")
         with self.metrics.timer("spill_io_time"):
             spill.writer.write_batch(run)
@@ -199,6 +205,40 @@ class _SortState(MemConsumer):
         self.staged = []
 
 
+_KEY_PREFIX = "#sortkey"
+
+
+def _append_key_columns(run: ColumnarBatch, keys_u64: np.ndarray) -> ColumnarBatch:
+    """Attach the (n, 2k) uint64 merge-key matrix as i64 columns."""
+    from blaze_tpu.core.batch import DeviceColumn
+
+    n = run.num_rows
+    fields = list(run.schema.fields)
+    cols = list(run.columns)
+    flipped = (keys_u64 ^ np.uint64(1 << 63)).view(np.int64)
+    for i in range(keys_u64.shape[1]):
+        fields.append(T.StructField(f"{_KEY_PREFIX}{i}", T.I64, False))
+        cols.append(DeviceColumn.from_numpy(T.I64, flipped[:, i], None, run.capacity))
+    return ColumnarBatch(T.Schema(tuple(fields)), cols, n)
+
+
+def _strip_key_columns(batch: ColumnarBatch):
+    """Split a spilled run into (data batch, key matrix as flipped i64) —
+    key tuples compare identically to the unflipped u64 ordering."""
+    base = [i for i, f in enumerate(batch.schema.fields)
+            if not f.name.startswith(_KEY_PREFIX)]
+    keyi = [i for i, f in enumerate(batch.schema.fields)
+            if f.name.startswith(_KEY_PREFIX)]
+    if not keyi:
+        return batch, None
+    n = batch.num_rows
+    from blaze_tpu.utils.device import pull_columns
+
+    pulled = pull_columns([batch.columns[i] for i in keyi], n)
+    keys = np.stack([p[0] for p in pulled], axis=1)
+    return batch.select(base), keys
+
+
 class _RunCursor:
     __slots__ = ("rid", "it", "device", "orders", "batch", "keys", "pos")
 
@@ -215,10 +255,14 @@ class _RunCursor:
         for b in self.it:
             if b.num_rows == 0:
                 continue
-            self.batch = b
             if self.device:
-                self.keys = [tuple(r) for r in SK.merge_keys_matrix(b, self.orders)]
+                self.batch, keys = _strip_key_columns(b)
+                if keys is None:  # legacy run without squeezed keys
+                    keys = (SK.merge_keys_matrix(self.batch, self.orders)
+                            ^ np.uint64(1 << 63)).view(np.int64)
+                self.keys = [tuple(r) for r in keys]
             else:
+                self.batch = b
                 self.keys = SK.host_keys_matrix(b, self.orders)
             self.pos = 0
             return True
