@@ -249,3 +249,32 @@ def test_session_disabled_operator_rejected():
             [E.BinaryExpr(E.BinaryOp.GT, col("v"), E.Literal(0, T.I64))])
         with pytest.raises(ValueError, match="disabled"):
             list(sess.execute(plan))
+
+
+def test_collect_agg_state_through_exchange():
+    """collect_list/set host states (ArrayType columns) must survive the
+    shuffle serde between partial and final stages."""
+    sess = Session()
+    b = ColumnarBatch.from_pydict({
+        "k": pa.array([1, 1, 2, 2], type=pa.int64()),
+        "s": pa.array(["a", "b", "c", "c"]),
+    })
+    sess.resources["src"] = lambda p: [b.slice(p * 2, 2).to_arrow()]
+    scan = N.FFIReader(schema=b.schema, resource_id="src", num_partitions=2)
+    partial = N.Agg(scan, E.AggExecMode.HASH_AGG, [("k", col("k"))], [
+        N.AggColumn(E.AggExpr(E.AggFunction.COLLECT_LIST, [col("s")]),
+                    E.AggMode.PARTIAL, "cl"),
+        N.AggColumn(E.AggExpr(E.AggFunction.COLLECT_SET, [col("s")]),
+                    E.AggMode.PARTIAL, "cs"),
+    ])
+    ex = N.ShuffleExchange(partial, N.HashPartitioning([col("k")], 2))
+    final = N.Agg(ex, E.AggExecMode.HASH_AGG, [("k", col("k"))], [
+        N.AggColumn(E.AggExpr(E.AggFunction.COLLECT_LIST, [col("s")]),
+                    E.AggMode.FINAL, "cl"),
+        N.AggColumn(E.AggExpr(E.AggFunction.COLLECT_SET, [col("s")]),
+                    E.AggMode.FINAL, "cs"),
+    ])
+    out = sess.execute_to_pydict(final)
+    got = {k: (sorted(cl), sorted(cs))
+           for k, cl, cs in zip(out["k"], out["cl"], out["cs"])}
+    assert got == {1: (["a", "b"], ["a", "b"]), 2: (["c", "c"], ["c"])}
