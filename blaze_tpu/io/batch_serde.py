@@ -67,8 +67,11 @@ def serialize_batch(batch: ColumnarBatch, transpose: Optional[bool] = None) -> b
     if host_cols:
         sink = io.BytesIO()
         arrays = [c.to_arrow(n) for c in host_cols]
+        # positional synthetic names: output schemas (e.g. join left++right)
+        # may repeat a field name, and a name-keyed restore would alias the
+        # duplicates to one IPC column after a shuffle/spill round trip
         hschema = pa.schema(
-            [pa.field(batch.schema[i].name, arrays[k].type) for k, i in enumerate(host_idx)]
+            [pa.field(f"h{k}", arrays[k].type) for k in range(len(host_idx))]
         )
         rb = pa.RecordBatch.from_arrays(arrays, schema=hschema)
         with pa.ipc.new_stream(sink, hschema) as w:
@@ -100,12 +103,11 @@ def deserialize_batch(payload: bytes) -> ColumnarBatch:
     n = header["num_rows"]
     cap = cfg.capacity_for(n)
     ipc_len = header["ipc_len"]
-    host_arrays = {}
+    host_arrays: List[pa.Array] = []
     if ipc_len:
         reader = pa.ipc.open_stream(pa.py_buffer(bytes(buf[pos : pos + ipc_len])))
         rb = reader.read_next_batch()
-        for name, col in zip(rb.schema.names, rb.columns):
-            host_arrays[name] = col
+        host_arrays = list(rb.columns)  # positional, matches "host" meta order
     pos += ipc_len
 
     def read_buf():
@@ -117,6 +119,7 @@ def deserialize_batch(payload: bytes) -> ColumnarBatch:
         return b
 
     cols = []
+    next_host = 0
     for i, meta in enumerate(header["cols"]):
         f = schema[i]
         if meta["kind"] == "dev":
@@ -135,7 +138,8 @@ def deserialize_batch(payload: bytes) -> ColumnarBatch:
             validity = unpack_bitmap(vraw, n) if n else np.zeros(0, dtype=bool)
             cols.append(DeviceColumn.from_numpy(f.dtype, data, validity, cap))
         else:
-            cols.append(HostColumn(f.dtype, host_arrays[f.name]))
+            cols.append(HostColumn(f.dtype, host_arrays[next_host]))
+            next_host += 1
     return ColumnarBatch(schema, cols, n)
 
 

@@ -42,9 +42,19 @@ def key_codes(batch: ColumnarBatch, cols: List[Column], key_map: Dict,
         for c, (data, valid) in zip(cols, pulled):
             null_any |= ~valid
             if data.dtype == np.float64:
-                d64 = np.where(valid, data, 0.0).view(np.int64)
+                d = np.where(valid, data, 0.0)
+                # canonicalize before viewing bits: -0.0 -> +0.0 and every
+                # NaN payload -> the quiet NaN, so float keys match by Spark
+                # equality (not bit pattern) even without a frontend
+                # normalize_nan_and_zero projection
+                d = np.where(d == 0.0, 0.0, d)
+                d = np.where(np.isnan(d), np.float64(np.nan), d)
+                d64 = d.view(np.int64)
             elif data.dtype == np.float32:
-                d64 = np.where(valid, data, np.float32(0)).view(np.int32).astype(np.int64)
+                d = np.where(valid, data, np.float32(0))
+                d = np.where(d == np.float32(0), np.float32(0), d)
+                d = np.where(np.isnan(d), np.float32(np.nan), d)
+                d64 = d.view(np.int32).astype(np.int64)
             else:
                 d64 = np.where(valid, data, 0).astype(np.int64)
             mats.append(d64)
@@ -67,10 +77,18 @@ def key_codes(batch: ColumnarBatch, cols: List[Column], key_map: Dict,
         codes[null_any] = -1
         return codes
     # host path: canonical python tuples
+    def _canon(v):
+        if isinstance(v, float):
+            if v != v:
+                return float("nan")  # one canonical NaN payload
+            if v == 0.0:
+                return 0.0  # fold -0.0
+        return v
+
     pylists = [c.to_arrow(n).to_pylist() for c in cols]
     codes = np.empty(n, dtype=np.int64)
     for i in range(n):
-        key = tuple(pl[i] for pl in pylists)
+        key = tuple(_canon(pl[i]) for pl in pylists)
         if any(v is None for v in key):
             codes[i] = -1
             continue

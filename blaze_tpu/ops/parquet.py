@@ -36,7 +36,7 @@ _QUEUE_DEPTH = 4
 _SENTINEL = object()
 
 
-def predicate_to_arrow(expr: Optional[E.Expr]):
+def predicate_to_arrow(expr: Optional[E.Expr], schema: Optional[T.Schema] = None):
     """Best-effort conversion of an IR predicate into a pyarrow.dataset
     expression for row-group/page pruning; None when not convertible (the
     engine's FilterExec still applies the full predicate — pushdown is an
@@ -46,38 +46,81 @@ def predicate_to_arrow(expr: Optional[E.Expr]):
     if expr is None:
         return None
     try:
-        return _convert_pred(expr, pc)
+        return _convert_pred(expr, pc, schema)
     except NotImplementedError:
         return None
 
 
-def _convert_pred(e: E.Expr, pc):
+def _convert_pred(e: E.Expr, pc, schema=None):
     B = E.BinaryOp
     if isinstance(e, E.BinaryExpr):
         if e.op in (B.AND, B.OR):
-            l = _convert_pred(e.left, pc)
-            r = _convert_pred(e.right, pc)
+            l = _convert_pred(e.left, pc, schema)
+            r = _convert_pred(e.right, pc, schema)
             return l & r if e.op == B.AND else l | r
         fns = {B.EQ: "__eq__", B.NEQ: "__ne__", B.LT: "__lt__", B.LTEQ: "__le__",
                B.GT: "__gt__", B.GTEQ: "__ge__"}
         if e.op in fns:
-            l = _convert_operand(e.left, pc)
-            r = _convert_operand(e.right, pc)
+            l = _convert_operand(e.left, pc, schema)
+            r = _convert_operand(e.right, pc, schema)
             return getattr(l, fns[e.op])(r)
     if isinstance(e, E.Not):
-        return ~_convert_pred(e.child, pc)
+        return ~_convert_pred(e.child, pc, schema)
     if isinstance(e, E.IsNotNull):
-        return _convert_operand(e.child, pc).is_valid()
+        return _convert_operand(e.child, pc, schema).is_valid()
     if isinstance(e, E.IsNull):
-        return _convert_operand(e.child, pc).is_null()
+        return _convert_operand(e.child, pc, schema).is_null()
     if isinstance(e, E.InList) and not e.negated:
         vals = [v.value for v in e.values if isinstance(v, E.Literal)]
         if len(vals) == len(e.values):
-            return _convert_operand(e.child, pc).isin(vals)
+            return _convert_operand(e.child, pc, schema).isin(vals)
     raise NotImplementedError
 
 
-def _convert_operand(e: E.Expr, pc):
+_INT_RANK = {T.Int8Type: 8, T.Int16Type: 16, T.Int32Type: 32, T.Int64Type: 64}
+
+
+def _operand_dtype(e: E.Expr, schema) -> Optional[T.DataType]:
+    if isinstance(e, E.Literal):
+        return e.dtype
+    if isinstance(e, E.Column) and schema is not None and e.name in schema.names:
+        return schema[schema.index_of(e.name)].dtype
+    if isinstance(e, E.Cast):
+        return e.dtype
+    return None
+
+
+def _cast_is_lossless_widening(src: Optional[T.DataType], dst: T.DataType) -> bool:
+    """True only for casts where every source value maps 1:1 to a distinct
+    target value, so ``cast(col) OP lit`` filters the same rows as the
+    original predicate. Anything else (narrowing, truncation, int64->float64,
+    numeric->string, timestamp->date...) must NOT be pushed down: the scanner
+    filter is exact, and FilterExec cannot restore rows already dropped."""
+    if src is None:
+        return False
+    if type(src) is type(dst):
+        if isinstance(src, T.DecimalType):
+            return dst.precision >= src.precision and dst.scale == src.scale
+        return True
+    if type(src) in _INT_RANK:
+        if type(dst) in _INT_RANK:
+            return _INT_RANK[type(dst)] >= _INT_RANK[type(src)]
+        # f32 holds ints up to 2^24 exactly, f64 up to 2^53
+        if isinstance(dst, T.Float32Type):
+            return _INT_RANK[type(src)] <= 16
+        if isinstance(dst, T.Float64Type):
+            return _INT_RANK[type(src)] <= 32
+        if isinstance(dst, T.DecimalType):
+            digits = {8: 3, 16: 5, 32: 10, 64: 19}[_INT_RANK[type(src)]]
+            return dst.precision - dst.scale >= digits
+    if isinstance(src, T.Float32Type) and isinstance(dst, T.Float64Type):
+        return True
+    if isinstance(src, T.DateType) and isinstance(dst, T.TimestampType):
+        return True
+    return False
+
+
+def _convert_operand(e: E.Expr, pc, schema=None):
     if isinstance(e, E.Column):
         return pc.field(e.name)
     if isinstance(e, E.Literal):
@@ -90,7 +133,9 @@ def _convert_operand(e: E.Expr, pc):
             v = Decimal(str(v))
         return pc.scalar(v)
     if isinstance(e, E.Cast):
-        return _convert_operand(e.child, pc)
+        if not _cast_is_lossless_widening(_operand_dtype(e.child, schema), e.dtype):
+            raise NotImplementedError
+        return _convert_operand(e.child, pc, schema)
     raise NotImplementedError
 
 
@@ -106,7 +151,7 @@ class ParquetScanExec(Operator):
     def _execute(self, partition, ctx, metrics):
         group = self.conf.file_groups[partition]
         proj_names = [self.conf.file_schema[i].name for i in self.conf.projection]
-        filt = predicate_to_arrow(self.predicate)
+        filt = predicate_to_arrow(self.predicate, self.conf.file_schema)
         batch_size = ctx.conf.batch_size
         q: "queue.Queue" = queue.Queue(maxsize=_QUEUE_DEPTH)
         stop = threading.Event()

@@ -176,7 +176,17 @@ class WindowExec(Operator):
         out = ColumnarBatch(T.Schema(tuple(fields)), out_cols, n) \
             if self.output_window_cols else part
         if self.group_limit is not None:
-            keep = np.nonzero(rn <= self.group_limit)[0]
+            # Filter on the produced window function's values (reference:
+            # window_exec.rs:227-236), not the raw row number: rank() <= K and
+            # dense_rank() <= K keep ALL boundary-tied rows.
+            kinds = {w.kind for w in self.window_exprs}
+            if kinds == {"rank"}:
+                limit_vals = rank
+            elif kinds == {"dense_rank"}:
+                limit_vals = dense
+            else:
+                limit_vals = rn
+            keep = np.nonzero(limit_vals <= self.group_limit)[0]
             if len(keep) < n:
                 out = out.take(keep)
         return out

@@ -1,0 +1,195 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md) and the
+OrcScanExec predicate gap (VERDICT.md weak #5)."""
+
+import math
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.orc as orc
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import nodes as N
+from blaze_tpu.ir import types as T
+from blaze_tpu.ops.base import ExecContext
+from blaze_tpu.ops.orc import OrcScanExec
+from blaze_tpu.ops.parquet import ParquetScanExec, predicate_to_arrow, scan_node_for_files
+from blaze_tpu.runtime.executor import build_operator
+from tests.util import collect_pydict, mem_scan
+
+
+# -- parquet: casts in pushed predicates (ADVICE high) ------------------------
+
+def _dbl_file(tmp_path):
+    tbl = pa.table({"d": pa.array([1.5, 5.0, 5.7, 9.9], type=pa.float64())})
+    path = str(tmp_path / "d.parquet")
+    pq.write_table(tbl, path)
+    return path
+
+
+def test_narrowing_cast_predicate_not_pushed(tmp_path):
+    """cast(double as int) == 5 must NOT become an exact scanner filter
+    (it would drop the 5.7 row that Spark's truncating cast matches)."""
+    path = _dbl_file(tmp_path)
+    pred = E.BinaryExpr(E.BinaryOp.EQ,
+                        E.Cast(E.Column("d"), T.I32), E.Literal(5, T.I32))
+    schema = T.schema_from_arrow(pq.read_schema(path))
+    assert predicate_to_arrow(pred, schema) is None
+    node = scan_node_for_files([path], predicate=pred)
+    out = collect_pydict(build_operator(node))
+    assert out["d"] == [1.5, 5.0, 5.7, 9.9]  # scan yields every row
+
+
+def test_lossless_widening_cast_predicate_pushed(tmp_path):
+    tbl = pa.table({"i": pa.array([1, 5, 9], type=pa.int32())})
+    path = str(tmp_path / "i.parquet")
+    pq.write_table(tbl, path)
+    pred = E.BinaryExpr(E.BinaryOp.EQ,
+                        E.Cast(E.Column("i"), T.I64), E.Literal(5, T.I64))
+    schema = T.schema_from_arrow(pq.read_schema(path))
+    assert predicate_to_arrow(pred, schema) is not None
+    node = scan_node_for_files([path], predicate=pred)
+    out = collect_pydict(build_operator(node))
+    assert out["i"] == [5]
+
+
+# -- window: group limit keeps rank ties (ADVICE medium) ----------------------
+
+def test_rank_group_limit_keeps_ties():
+    from blaze_tpu.ir.nodes import WindowExpr
+    from blaze_tpu.ops.sort import SortExec
+    from blaze_tpu.ops.window import WindowExec
+
+    data = {
+        "g": pa.array([1, 1, 1, 1], type=pa.int64()),
+        "o": pa.array([10, 20, 20, 30], type=pa.int64()),
+    }
+    scan = SortExec(mem_scan(data), [E.SortOrder(E.Column("g")),
+                                     E.SortOrder(E.Column("o"))])
+    op = WindowExec(scan, [WindowExpr("rank", "rk")],
+                    [E.Column("g")], [E.SortOrder(E.Column("o"))],
+                    group_limit=2)
+    out = collect_pydict(op)
+    # rank() <= 2 keeps BOTH o=20 rows (ranks 1,2,2), drops o=30 (rank 4)
+    assert out["o"] == [10, 20, 20]
+    assert out["rk"] == [1, 2, 2]
+
+
+def test_row_number_group_limit_unchanged():
+    from blaze_tpu.ir.nodes import WindowExpr
+    from blaze_tpu.ops.sort import SortExec
+    from blaze_tpu.ops.window import WindowExec
+
+    data = {
+        "g": pa.array([1, 1, 1, 1], type=pa.int64()),
+        "o": pa.array([10, 20, 20, 30], type=pa.int64()),
+    }
+    scan = SortExec(mem_scan(data), [E.SortOrder(E.Column("g")),
+                                     E.SortOrder(E.Column("o"))])
+    op = WindowExec(scan, [WindowExpr("row_number", "rn")],
+                    [E.Column("g")], [E.SortOrder(E.Column("o"))],
+                    group_limit=2)
+    out = collect_pydict(op)
+    assert out["o"] == [10, 20]
+
+
+# -- batch serde: duplicate host-column names (ADVICE medium) -----------------
+
+def test_serde_duplicate_host_column_names():
+    from blaze_tpu.core.batch import ColumnarBatch
+    from blaze_tpu.io.batch_serde import deserialize_batch, serialize_batch
+
+    schema = T.Schema((
+        T.StructField("name", T.STRING),
+        T.StructField("name", T.STRING),
+    ))
+    rb = pa.record_batch(
+        [pa.array(["l0", "l1"]), pa.array(["r0", "r1"])],
+        schema=pa.schema([pa.field("name", pa.string()),
+                          pa.field("name", pa.string())]))
+    batch = ColumnarBatch.from_arrow(rb, schema)
+    out = deserialize_batch(serialize_batch(batch))
+    assert out.columns[0].to_arrow(2).to_pylist() == ["l0", "l1"]
+    assert out.columns[1].to_arrow(2).to_pylist() == ["r0", "r1"]
+
+
+# -- join keys: float canonicalization (ADVICE low) ---------------------------
+
+def test_float_join_keys_negzero_and_nan_match():
+    from blaze_tpu.ops.joins.bhj import BroadcastJoinExec, JoinSide, JoinType
+
+    nan1 = np.float64(np.nan)
+    nan2 = np.frombuffer(np.int64(0x7FF8000000000001).tobytes(), np.float64)[0]
+    assert math.isnan(nan2)
+    left = {"k": pa.array([0.0, nan1, 1.5], type=pa.float64()),
+            "lv": pa.array([1, 2, 3], type=pa.int64())}
+    right = {"k2": pa.array([-0.0, nan2, 1.5], type=pa.float64()),
+             "rv": pa.array([10, 20, 30], type=pa.int64())}
+    op = BroadcastJoinExec(
+        mem_scan(left), mem_scan(right),
+        [(E.Column("k"), E.Column("k2"))], JoinType.INNER, JoinSide.RIGHT)
+    out = collect_pydict(op)
+    # Spark float equality: -0.0 == 0.0 and NaN == NaN regardless of payload
+    assert sorted(out["lv"]) == [1, 2, 3]
+
+
+# -- orc: predicate pruning + row filtering (VERDICT weak #5) -----------------
+
+@pytest.fixture
+def orc_file(tmp_path):
+    n = 100_000
+    tbl = pa.table({
+        "id": pa.array(range(n), type=pa.int64()),
+        "v": pa.array([i % 997 for i in range(n)], type=pa.int64()),
+    })
+    path = str(tmp_path / "t.orc")
+    orc.write_table(tbl, path, stripe_size=128 * 1024)
+    return path, tbl
+
+
+def _orc_scan(path, predicate=None):
+    schema = T.schema_from_arrow(orc.ORCFile(path).schema)
+    conf = N.FileScanConf(
+        file_groups=[N.FileGroup(files=[N.PartitionedFile(path, os.path.getsize(path))])],
+        file_schema=schema,
+        projection=list(range(len(schema))),
+    )
+    return OrcScanExec(conf, predicate)
+
+
+def test_orc_stripe_pruning_and_row_filter(orc_file):
+    path, tbl = orc_file
+    f = orc.ORCFile(path)
+    assert f.nstripes > 1, "fixture must produce multiple stripes"
+    pred = E.BinaryExpr(E.BinaryOp.GTEQ, E.Column("id"),
+                        E.Literal(99_000, T.I64))
+    op = _orc_scan(path, pred)
+    ctx = ExecContext()
+    rows = []
+    for b in op.execute(0, ctx):
+        rows.extend(b.columns[0].to_arrow(b.num_rows).to_pylist())
+    assert rows == list(range(99_000, 100_000))  # exact rows, filtered in-scan
+    pruned = ctx.metrics.get("stripes_pruned")
+    assert pruned > 0 and pruned < f.nstripes  # selective predicate skips stripes
+    # unfiltered scan still yields everything
+    op2 = _orc_scan(path)
+    out2 = collect_pydict(op2)
+    assert len(out2["id"]) == 100_000
+
+
+def test_orc_pruning_correct_under_or_and_nulls(tmp_path):
+    n = 50_000
+    vals = [None if i % 1000 == 0 else i for i in range(n)]
+    tbl = pa.table({"x": pa.array(vals, type=pa.int64())})
+    path = str(tmp_path / "n.orc")
+    orc.write_table(tbl, path, stripe_size=64 * 1024)
+    pred = E.BinaryExpr(
+        E.BinaryOp.OR,
+        E.BinaryExpr(E.BinaryOp.LT, E.Column("x"), E.Literal(10, T.I64)),
+        E.BinaryExpr(E.BinaryOp.GTEQ, E.Column("x"), E.Literal(n - 10, T.I64)))
+    op = _orc_scan(path, pred)
+    out = collect_pydict(op)
+    expect = [v for v in vals if v is not None and (v < 10 or v >= n - 10)]
+    assert sorted(out["x"]) == sorted(expect)
