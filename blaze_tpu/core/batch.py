@@ -308,63 +308,93 @@ class ColumnarBatch:
         ]
         return ColumnarBatch(self.schema, cols, self.num_rows)
 
+    def _device_slots(self):
+        return [i for i, c in enumerate(self.columns) if isinstance(c, DeviceColumn)]
+
     def take(self, indices: np.ndarray) -> "ColumnarBatch":
-        """Host-driven row gather (indices must be < num_rows)."""
+        """Host-driven row gather (indices must be < num_rows). All device
+        columns move in ONE jitted dispatch (core/kernels.py)."""
+        from blaze_tpu.core import kernels
+
         indices = np.asarray(indices, dtype=np.int64)
         n = len(indices)
         cap = get_config().capacity_for(n)
-        dev_idx = None
-        cols: List[Column] = []
-        for c in self.columns:
-            if isinstance(c, DeviceColumn):
-                if dev_idx is None:
-                    buf = np.zeros(cap, dtype=np.int64)
-                    buf[:n] = indices
-                    dev_idx = jnp.asarray(buf)
-                    valid = jnp.arange(cap) < n
-                cols.append(c.take_device(dev_idx, valid))
-            else:
-                cols.append(c.take_host(indices))
+        slots = self._device_slots()
+        cols: List[Column] = list(self.columns)
+        if slots:
+            datas, valids = kernels.gather_planes(
+                [self.columns[i].data for i in slots],
+                [self.columns[i].validity for i in slots],
+                indices, cap, n)
+            for k, i in enumerate(slots):
+                cols[i] = DeviceColumn(self.columns[i].dtype, datas[k], valids[k])
+        for i, c in enumerate(self.columns):
+            if not isinstance(c, DeviceColumn):
+                cols[i] = c.take_host(indices)
         return ColumnarBatch(self.schema, cols, n)
 
     def take_nullable(self, indices: np.ndarray) -> "ColumnarBatch":
         """Row gather where index -1 yields an all-null row (outer-join null
         extension)."""
+        from blaze_tpu.core import kernels
+
         indices = np.asarray(indices, dtype=np.int64)
         n = len(indices)
         null_mask = indices < 0
         cap = get_config().capacity_for(n)
-        dev_idx = None
+        slots = self._device_slots()
+        cols: List[Column] = list(self.columns)
+        if slots:
+            datas, valids = kernels.gather_planes(
+                [self.columns[i].data for i in slots],
+                [self.columns[i].validity for i in slots],
+                np.where(null_mask, 0, indices), cap, n, null_mask=null_mask)
+            for k, i in enumerate(slots):
+                cols[i] = DeviceColumn(self.columns[i].dtype, datas[k], valids[k])
         pa_idx = None
-        cols: List[Column] = []
-        for c in self.columns:
-            if isinstance(c, DeviceColumn):
-                if dev_idx is None:
-                    buf = np.zeros(cap, dtype=np.int64)
-                    buf[:n] = np.where(null_mask, 0, indices)
-                    dev_idx = jnp.asarray(buf)
-                    vbuf = np.zeros(cap, dtype=bool)
-                    vbuf[:n] = ~null_mask
-                    valid = jnp.asarray(vbuf)
-                cols.append(c.take_device(dev_idx, valid))
-            else:
+        for i, c in enumerate(self.columns):
+            if not isinstance(c, DeviceColumn):
                 if pa_idx is None:
                     pa_idx = pa.Array.from_pandas(
                         np.where(null_mask, 0, indices), mask=null_mask,
                         type=pa.int64())
-                cols.append(HostColumn(c.dtype, c.array.take(pa_idx)))
+                cols[i] = HostColumn(c.dtype, c.array.take(pa_idx))
         schema = T.Schema(
             tuple(T.StructField(f.name, f.dtype, True) for f in self.schema.fields)
         ) if null_mask.any() else self.schema
         return ColumnarBatch(schema, cols, n)
 
     def slice(self, offset: int, length: int) -> "ColumnarBatch":
+        """Contiguous row window: one jitted dynamic-slice dispatch for all
+        device columns, zero-copy arrow slices for host columns."""
+        from blaze_tpu.core import kernels
+
         length = max(0, min(length, self.num_rows - offset))
-        return self.take(np.arange(offset, offset + length))
+        cap = get_config().capacity_for(length)
+        slots = self._device_slots()
+        cols: List[Column] = list(self.columns)
+        if slots:
+            if cap > self.capacity:
+                return self.take(np.arange(offset, offset + length))
+            datas, valids = kernels.slice_planes(
+                [self.columns[i].data for i in slots],
+                [self.columns[i].validity for i in slots],
+                offset, length, cap)
+            for k, i in enumerate(slots):
+                cols[i] = DeviceColumn(self.columns[i].dtype, datas[k], valids[k])
+        for i, c in enumerate(self.columns):
+            if not isinstance(c, DeviceColumn):
+                cols[i] = HostColumn(c.dtype, c.array.slice(offset, length))
+        return ColumnarBatch(self.schema, cols, length)
 
     @staticmethod
     def concat(batches: List["ColumnarBatch"], schema: Optional[T.Schema] = None) -> "ColumnarBatch":
-        """Coalesce small batches (reference: coalesce_batches_unchecked)."""
+        """Coalesce small batches (reference: coalesce_batches_unchecked).
+        Device planes concatenate+compact in one jitted dispatch; host arrays
+        via arrow concat — no arrow round trip for device data (the round-1
+        profiler's top fixed cost)."""
+        from blaze_tpu.core import kernels
+
         if not batches:
             if schema is None:
                 raise ValueError("concat of zero batches requires a schema")
@@ -372,8 +402,33 @@ class ColumnarBatch:
         batches = [b for b in batches if b.num_rows > 0] or batches[:1]
         if len(batches) == 1:
             return batches[0]
-        tbl = pa.concat_tables([pa.Table.from_batches(b.to_arrow_batches()) for b in batches])
-        return ColumnarBatch.from_arrow(tbl, batches[0].schema)
+        schema = schema or batches[0].schema
+        total = sum(b.num_rows for b in batches)
+        cap = get_config().capacity_for(total)
+        slots = batches[0]._device_slots()
+        ncols = len(batches[0].columns)
+        cols: List[Column] = [None] * ncols
+        if slots:
+            # concat_planes assumes each batch's device columns share one
+            # capacity (one index space per batch) — normalize stragglers
+            batches = [
+                b if len({b.columns[i].capacity for i in slots}) == 1
+                else b.with_capacity(max(b.columns[i].capacity for i in slots))
+                for b in batches
+            ]
+            datas, valids = kernels.concat_planes(
+                [tuple(b.columns[i].data for b in batches) for i in slots],
+                [tuple(b.columns[i].validity for b in batches) for i in slots],
+                [b.num_rows for b in batches], cap)
+            for k, i in enumerate(slots):
+                cols[i] = DeviceColumn(batches[0].columns[i].dtype, datas[k], valids[k])
+        for i in range(ncols):
+            if cols[i] is None:
+                c0 = batches[0].columns[i]
+                arr = pa.concat_arrays([
+                    b.columns[i].to_arrow(b.num_rows) for b in batches])
+                cols[i] = HostColumn(c0.dtype, arr)
+        return ColumnarBatch(schema, cols, total)
 
     # --- host boundary -------------------------------------------------------
 
@@ -399,3 +454,58 @@ class ColumnarBatch:
 
     def __repr__(self):
         return f"ColumnarBatch({self.num_rows} rows, schema={self.schema.names})"
+
+
+@dataclasses.dataclass
+class HostBatch:
+    """Host-side mirror of a ColumnarBatch: numpy planes for device columns,
+    arrow arrays for host columns. The staging form for shuffle
+    split/serialize — ONE device pull, then numpy-speed row routing with no
+    further device dispatches (reference: BufferedData stages rows host-side
+    before the partition-id radix sort, buffered_data.rs:48-541)."""
+
+    schema: T.Schema
+    items: list  # per column: (np_data, np_valid) tuple, or pa.Array
+    num_rows: int
+
+    @staticmethod
+    def from_batch(batch: ColumnarBatch) -> "HostBatch":
+        from blaze_tpu.utils.device import pull_columns
+
+        n = batch.num_rows
+        pulled = pull_columns(batch.columns, n)
+        items = [
+            (p[0], p[1]) if p is not None else c.to_arrow(n)
+            for c, p in zip(batch.columns, pulled)
+        ]
+        return HostBatch(batch.schema, items, n)
+
+    def take(self, indices: np.ndarray) -> "HostBatch":
+        pa_idx = None
+        items = []
+        for it in self.items:
+            if isinstance(it, tuple):
+                items.append((it[0][indices], it[1][indices]))
+            else:
+                if pa_idx is None:
+                    pa_idx = pa.array(np.asarray(indices, dtype=np.int64),
+                                      type=pa.int64())
+                items.append(it.take(pa_idx))
+        return HostBatch(self.schema, items, len(indices))
+
+    def slice(self, offset: int, length: int) -> "HostBatch":
+        items = [
+            (it[0][offset:offset + length], it[1][offset:offset + length])
+            if isinstance(it, tuple) else it.slice(offset, length)
+            for it in self.items
+        ]
+        return HostBatch(self.schema, items, length)
+
+    def to_columnar(self, capacity: Optional[int] = None) -> ColumnarBatch:
+        cap = capacity or get_config().capacity_for(self.num_rows)
+        cols: List[Column] = [
+            DeviceColumn.from_numpy(f.dtype, it[0], it[1], cap)
+            if isinstance(it, tuple) else HostColumn(f.dtype, it)
+            for f, it in zip(self.schema.fields, self.items)
+        ]
+        return ColumnarBatch(self.schema, cols, self.num_rows)

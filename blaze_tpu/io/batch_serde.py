@@ -31,21 +31,33 @@ from blaze_tpu.ir.serde import schema_from_json, schema_to_json
 _MAGIC = b"BTB1"
 
 
-def serialize_batch(batch: ColumnarBatch, transpose: Optional[bool] = None) -> bytes:
-    """One batch -> uncompressed payload bytes."""
+def serialize_batch(batch, transpose: Optional[bool] = None) -> bytes:
+    """One batch (ColumnarBatch or HostBatch) -> uncompressed payload bytes.
+    A HostBatch serializes with zero device traffic (the shuffle writer pulls
+    once per input batch, then routes rows host-side)."""
+    from blaze_tpu.core.batch import HostBatch
+
     cfg = get_config()
     if transpose is None:
         transpose = cfg.serde_transpose
-    from blaze_tpu.utils.device import pull_columns
 
     n = batch.num_rows
+    if isinstance(batch, HostBatch):
+        pulled = [it if isinstance(it, tuple) else None for it in batch.items]
+        host_arrays = {i: it for i, it in enumerate(batch.items)
+                       if not isinstance(it, tuple)}
+    else:
+        from blaze_tpu.utils.device import pull_columns
+
+        pulled = pull_columns(batch.columns, n)  # one transfer for all columns
+        host_arrays = {i: c.to_arrow(n) for i, c in enumerate(batch.columns)
+                       if pulled[i] is None}
     buffers: List[bytes] = []
     cols_meta = []
     host_cols = []
     host_idx = []
-    pulled = pull_columns(batch.columns, n)  # one transfer for all columns
-    for i, col in enumerate(batch.columns):
-        if isinstance(col, DeviceColumn):
+    for i in range(len(batch.schema)):
+        if pulled[i] is not None:
             data = np.ascontiguousarray(pulled[i][0])
             validity = pulled[i][1]
             if transpose and data.dtype.itemsize > 1 and n:
@@ -62,11 +74,12 @@ def serialize_batch(batch: ColumnarBatch, transpose: Optional[bool] = None) -> b
             cols_meta.append({"kind": "dev", "transposed": bool(transpose and data.dtype.itemsize > 1)})
         else:
             host_idx.append(i)
-            host_cols.append(col)
+            host_cols.append(host_arrays[i])
             cols_meta.append({"kind": "host"})
     if host_cols:
         sink = io.BytesIO()
-        arrays = [c.to_arrow(n) for c in host_cols]
+        arrays = [a.combine_chunks() if isinstance(a, pa.ChunkedArray) else a
+                  for a in host_cols]
         # positional synthetic names: output schemas (e.g. join left++right)
         # may repeat a field name, and a name-keyed restore would alias the
         # duplicates to one IPC column after a shuffle/spill round trip

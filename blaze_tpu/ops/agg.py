@@ -714,22 +714,21 @@ def _assemble(schema: T.Schema, cols: List[Column], num_rows: int,
     device columns (host key columns are already ordered)."""
     from blaze_tpu.config import get_config
 
+    from blaze_tpu.core import kernels
+
     cap = get_config().capacity_for(num_rows)
-    out_cols: List[Column] = []
-    for c in cols:
-        if isinstance(c, DeviceColumn):
-            if order is not None:
-                idx = _pad_to(order, cap, fill=0)
-                valid = jnp.arange(cap) < num_rows
-                c = c.with_capacity(max(c.capacity, cap)).take_device(jnp.asarray(idx), valid)
-            else:
-                c = DeviceColumn(c.dtype, c.data[:cap], c.validity[:cap]) if c.capacity >= cap else c.with_capacity(cap)
-                c = DeviceColumn(c.dtype, c.data,
-                                 c.validity & (jnp.arange(cap) < num_rows))
-        else:
-            if len(c.array) > num_rows:
-                c = HostColumn(c.dtype, c.array.slice(0, num_rows))
-        out_cols.append(c)
+    out_cols: List[Column] = list(cols)
+    dev = [(i, c) for i, c in enumerate(cols) if isinstance(c, DeviceColumn)]
+    if dev:
+        idx = order if order is not None else np.arange(num_rows)
+        datas, valids = kernels.gather_planes(
+            [c.data for _, c in dev], [c.validity for _, c in dev],
+            np.asarray(idx, dtype=np.int64), cap, num_rows)
+        for k, (i, c) in enumerate(dev):
+            out_cols[i] = DeviceColumn(c.dtype, datas[k], valids[k])
+    for i, c in enumerate(cols):
+        if not isinstance(c, DeviceColumn) and len(c.array) > num_rows:
+            out_cols[i] = HostColumn(c.dtype, c.array.slice(0, num_rows))
     return ColumnarBatch(schema, out_cols, num_rows)
 
 

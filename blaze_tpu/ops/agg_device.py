@@ -253,29 +253,66 @@ def _partial_kernel(key_dtypes: Tuple[str, ...], specs: Tuple[Tuple[str, int], .
         key_valid = [flat[2 * i + 1] for i in range(nk)]
         args = [(flat[2 * nk + 2 * i], flat[2 * nk + 2 * i + 1])
                 for i in range(len(specs))]
-        # --- sort rows so equal keys are adjacent; padding rows last
-        operands = [(~exists).astype(jnp.uint8)]
+        iota = jnp.arange(capacity, dtype=jnp.int32)
+        canon = []
         for d, v in zip(key_data, key_valid):
-            operands.append(v.astype(jnp.uint8))
             if jnp.issubdtype(d.dtype, jnp.floating):
                 # canonicalize float keys so grouping matches the host
                 # intern path: -0.0 folds into 0.0, all NaNs group together
                 d = jnp.where(jnp.isnan(d), jnp.array(float("nan"), d.dtype), d)
                 d = jnp.where(d == 0, jnp.zeros((), d.dtype), d)
-            operands.append(jnp.where(v, d, jnp.zeros((), d.dtype)))
-        iota = jnp.arange(capacity, dtype=jnp.int32)
-        sorted_ops = jax.lax.sort(tuple(operands) + (iota,), num_keys=len(operands))
-        order = sorted_ops[-1]
+            canon.append(jnp.where(v, d, jnp.zeros((), d.dtype)))
+
+        def sort_path(_):
+            # sort rows so equal keys are adjacent; padding rows last
+            operands = [(~exists).astype(jnp.uint8)]
+            for d, v in zip(canon, key_valid):
+                operands.append(v.astype(jnp.uint8))
+                operands.append(d)
+            sorted_ops = jax.lax.sort(tuple(operands) + (iota,),
+                                      num_keys=len(operands))
+            order = sorted_ops[-1]
+            s_exists = exists[order]
+            # segment boundaries: any key field differs from previous row
+            new = jnp.zeros(capacity, dtype=bool).at[0].set(True)
+            for d, v in zip(canon, key_valid):
+                sd, sv = d[order], v[order]
+                new = new | jnp.concatenate([jnp.ones(1, bool), sd[1:] != sd[:-1]])
+                new = new | jnp.concatenate([jnp.ones(1, bool), sv[1:] != sv[:-1]])
+            new = new & s_exists
+            seg = (jnp.cumsum(new) - 1).astype(jnp.int32)
+            seg = jnp.where(s_exists, seg, capacity)
+            return seg, order
+
+        single_int_key = nk == 1 and jnp.issubdtype(
+            jnp.dtype(key_dtypes[0]), jnp.integer)
+        if single_int_key:
+            # direct segmentation: when every valid key lies in
+            # [0, capacity-1) the key IS the segment id — no sort at all
+            # (the common TPC-DS dimension-key group-by). Decided on device
+            # by lax.cond: no host sync, both branches compiled once.
+            v0 = key_valid[0]
+            # range-check and build seg in int64/int32, NOT the key dtype:
+            # int8/16 would wrap the capacity sentinels (32768 -> -32768, and
+            # negative scatter indices wrap instead of drop), and comparing
+            # in a narrowed dtype could false-positive the fits test
+            d064 = canon[0].astype(jnp.int64)
+            fits = jnp.all(jnp.where(exists & v0,
+                                     (d064 >= 0) & (d064 < capacity - 1), True))
+
+            def direct_path(_):
+                seg = jnp.where(
+                    exists,
+                    jnp.where(v0, d064.astype(jnp.int32), jnp.int32(capacity - 1)),
+                    jnp.int32(capacity))
+                return seg, iota
+
+            seg, order = jax.lax.cond(fits, direct_path, sort_path, None)
+        else:
+            seg, order = sort_path(None)
+
         s_exists = exists[order]
         s_keys = [(d[order], v[order]) for d, v in zip(key_data, key_valid)]
-        # --- segment boundaries: any key field differs from previous row
-        new = jnp.zeros(capacity, dtype=bool).at[0].set(True)
-        for d, v in s_keys:
-            new = new | jnp.concatenate([jnp.ones(1, bool), d[1:] != d[:-1]])
-            new = new | jnp.concatenate([jnp.ones(1, bool), v[1:] != v[:-1]])
-        new = new & s_exists
-        seg = jnp.cumsum(new) - 1
-        seg = jnp.where(s_exists, seg, capacity)  # padding rows drop
         nseg_total = capacity
         # --- per-aggregate segment reductions
         outs = []
@@ -317,18 +354,24 @@ def _partial_kernel(key_dtypes: Tuple[str, ...], specs: Tuple[Tuple[str, int], .
         seg_present = jnp.zeros(nseg_total, bool).at[seg].max(
             s_exists, mode="drop")
         num_groups = jnp.sum(seg_present)
-        # compact: present segments first, stable
-        corder = jnp.argsort(~seg_present, stable=True)
-        out_valid = seg_present[corder]
+        # compact present segments to the front by cumsum+scatter (O(n); an
+        # argsort here would cost a second full lax.sort)
+        pos = jnp.cumsum(seg_present) - 1
+        scat = jnp.where(seg_present, pos, nseg_total).astype(jnp.int32)
+
+        def compact(x):
+            return jnp.zeros((nseg_total,), x.dtype).at[scat].set(x, mode="drop")
+
+        out_valid = iota < num_groups
         results = [num_groups, out_valid]
-        gather = first_idx[corder]
         for d, v in s_keys:
-            results.append(jnp.where(out_valid, d[gather], jnp.zeros((), d.dtype)))
-            results.append(v[gather] & out_valid)
+            results.append(jnp.where(out_valid, compact(d[first_idx]),
+                                     jnp.zeros((), d.dtype)))
+            results.append(compact(v[first_idx]) & out_valid)
         for kind, a, b in outs:
-            results.append(a[corder])
+            results.append(compact(a))
             if b is not None:
-                results.append(b[corder] if b.dtype == jnp.bool_ else b[corder])
+                results.append(compact(b))
         return tuple(results)
 
     return jax.jit(kernel)

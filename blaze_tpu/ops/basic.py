@@ -78,17 +78,22 @@ class FilterExec(Operator):
                 mask = pred_ev.evaluate_predicate(batch)
                 all_device = all(isinstance(c, DeviceColumn) for c in batch.columns)
                 if all_device:
-                    # device-side stable compaction: one scalar pull instead
-                    # of pulling the whole mask + pushing indices
-                    count = int(mask.sum())
+                    # device-side stable compaction: one jitted dispatch and
+                    # one scalar pull (core/kernels.py)
+                    from blaze_tpu.core import kernels
+
+                    count, datas, valids = kernels.compact_planes(
+                        [c.data for c in batch.columns],
+                        [c.validity for c in batch.columns], mask)
                     if count == 0:
                         continue
                     if count == batch.num_rows:
                         out = batch
                     else:
-                        order = jnp.argsort(~mask, stable=True)
-                        valid = jnp.arange(batch.capacity) < count
-                        cols = [c.take_device(order, valid) for c in batch.columns]
+                        cols = [
+                            DeviceColumn(c.dtype, d, v) for c, d, v in
+                            zip(batch.columns, datas, valids)
+                        ]
                         out = ColumnarBatch(batch.schema, cols, count)
                 else:
                     indices = np.nonzero(np.asarray(mask))[0]

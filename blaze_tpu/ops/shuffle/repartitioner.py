@@ -13,7 +13,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from blaze_tpu.core.batch import ColumnarBatch
+from blaze_tpu.core.batch import ColumnarBatch, HostBatch
 from blaze_tpu.exprs.compiler import ExprEvaluator
 from blaze_tpu.exprs.spark_hash import hash_batch
 from blaze_tpu.ir import exprs as E
@@ -29,26 +29,46 @@ class Repartitioner:
         """(num_rows,) int32 partition id per row."""
         raise NotImplementedError
 
-    def bucketize(self, batch: ColumnarBatch) -> List[Tuple[int, ColumnarBatch]]:
-        """Split a batch into per-partition sub-batches: one stable gather by
-        partition id, then contiguous slices (reference: radix sort by pid in
-        buffered_data.rs)."""
-        n = batch.num_rows
-        if n == 0:
-            return []
-        if self.num_partitions == 1:
-            return [(0, batch)]
-        pids = self.partition_ids(batch)
+    def _split_ranges(self, pids: np.ndarray):
+        """Stable pid-sort split: (order, [(pid, start, end), ...])."""
+        n = len(pids)
         order = np.argsort(pids, kind="stable")
         sorted_pids = pids[order]
         boundaries = np.nonzero(np.diff(sorted_pids))[0] + 1
         starts = np.concatenate([[0], boundaries])
         ends = np.concatenate([boundaries, [n]])
+        return order, [(int(sorted_pids[s]), int(s), int(e))
+                       for s, e in zip(starts, ends)]
+
+    def bucketize(self, batch: ColumnarBatch) -> List[Tuple[int, ColumnarBatch]]:
+        """Split a batch into per-partition device sub-batches: one stable
+        gather by partition id, then contiguous slices (reference: radix sort
+        by pid in buffered_data.rs). Used when the sub-batches feed further
+        device compute; the serialize path uses bucketize_host."""
+        n = batch.num_rows
+        if n == 0:
+            return []
+        if self.num_partitions == 1:
+            return [(0, batch)]
+        order, ranges = self._split_ranges(self.partition_ids(batch))
         gathered = batch.take(order)
-        out = []
-        for s, e in zip(starts, ends):
-            out.append((int(sorted_pids[s]), gathered.slice(int(s), int(e - s))))
-        return out
+        return [(pid, gathered.slice(s, e - s)) for pid, s, e in ranges]
+
+    def bucketize_host(self, batch: ColumnarBatch) -> List[Tuple[int, HostBatch]]:
+        """Shuffle-write fast path: ONE device pull, then numpy-speed routing.
+        The device never sees the per-partition sub-batches (they go straight
+        to the serializer), so this replaces num_partitions device gathers +
+        num_partitions pulls with a single transfer (reference: staged
+        host-side radix sort by partition id, buffered_data.rs:88+)."""
+        n = batch.num_rows
+        if n == 0:
+            return []
+        host = HostBatch.from_batch(batch)
+        if self.num_partitions == 1:
+            return [(0, host)]
+        order, ranges = self._split_ranges(self.partition_ids(batch))
+        gathered = host.take(order)
+        return [(pid, gathered.slice(s, e - s)) for pid, s, e in ranges]
 
 
 class SinglePartitioner(Repartitioner):

@@ -95,7 +95,7 @@ class _WriterState(MemConsumer):
         self.spills = []
 
     def insert(self, batch: ColumnarBatch):
-        for pid, sub in self.repart.bucketize(batch):
+        for pid, sub in self.repart.bucketize_host(batch):
             self.streams.write(pid, sub)
         self.update_mem_used(self.streams.nbytes)
 
@@ -171,7 +171,7 @@ class RssShuffleWriterExec(Operator):
         codec = ctx.conf.shuffle_compression_codec
         for batch in self.execute_child(0, partition, ctx, metrics):
             with metrics.timer("elapsed_compute"):
-                for pid, sub in repart.bucketize(batch):
+                for pid, sub in repart.bucketize_host(batch):
                     buf = io.BytesIO()
                     BatchWriter(buf, codec=codec).write_batch(sub)
                     writer.write(pid, buf.getvalue())

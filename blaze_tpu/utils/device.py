@@ -56,8 +56,12 @@ def pull_columns(cols, n: int):
     slots = []
     for i, c in enumerate(cols):
         if isinstance(c, DeviceColumn):
-            to_pull.append(c.data[:n])
-            to_pull.append(c.validity[:n])
+            # pull the FULL capacity array and slice host-side: an eager
+            # device-side [:n] costs a dispatch + copy per column, while the
+            # padded tail is at most 2x bytes (power-of-two buckets) on a
+            # link whose cost is per-transfer, not per-byte
+            to_pull.append(c.data)
+            to_pull.append(c.validity)
             slots.append(i)
     if not to_pull:
         return [None] * len(cols)
@@ -66,7 +70,7 @@ def pull_columns(cols, n: int):
     # round trips, ~3x on the tunnel)
     for a in to_pull:
         a.copy_to_host_async()
-    pulled = [np.asarray(a) for a in to_pull]
+    pulled = [np.asarray(a)[:n] for a in to_pull]
     out = [None] * len(cols)
     for k, i in enumerate(slots):
         out[i] = (pulled[2 * k], pulled[2 * k + 1])
