@@ -174,34 +174,44 @@ class HostColumn(Column):
         return col, arr.dictionary
 
 
-def _arrow_to_column(arr: pa.Array, dt: T.DataType, capacity: int) -> Column:
-    from blaze_tpu.utils.device import is_device_dtype
-
+def arrow_fixed_planes(arr: pa.Array, dt: T.DataType):
+    """Arrow fixed-width array -> (np_data, np_validity) planes in the device
+    layout (decimal<=18 as unscaled int64, dates as day int64, bool unpacked)."""
     if isinstance(arr, pa.ChunkedArray):
         arr = arr.combine_chunks()
     n = len(arr)
     if pa.types.is_dictionary(arr.type):
         arr = arr.cast(arr.type.value_type)
-    if isinstance(dt, T.DecimalType) and dt.fits_int64:
+    if isinstance(dt, T.DecimalType):
+        assert dt.fits_int64, f"decimal({dt.precision},{dt.scale}) exceeds int64 planes"
         validity = unpack_bitmap(arr.buffers()[0], n, arr.offset)
-        values = _decimal128_lo64(arr)
-        return DeviceColumn.from_numpy(dt, values, validity, capacity)
-    if is_device_dtype(dt) and not isinstance(dt, T.DecimalType):
-        validity = ~np.asarray(arr.is_null()) if arr.null_count else np.ones(n, dtype=bool)
-        if isinstance(dt, T.BooleanType):
-            values = unpack_bitmap(arr.buffers()[1], n, arr.offset)
+        return _decimal128_lo64(arr), validity
+    validity = ~np.asarray(arr.is_null()) if arr.null_count else np.ones(n, dtype=bool)
+    if isinstance(dt, T.BooleanType):
+        return unpack_bitmap(arr.buffers()[1], n, arr.offset), validity
+    values = arr.fill_null(0).to_numpy(zero_copy_only=False)
+    if np.issubdtype(values.dtype, np.datetime64):
+        if isinstance(dt, T.DateType):
+            values = values.astype("datetime64[D]").view(np.int64)
         else:
-            values = arr.fill_null(0).to_numpy(zero_copy_only=False)
-            if np.issubdtype(values.dtype, np.datetime64):
-                if isinstance(dt, T.DateType):
-                    values = values.astype("datetime64[D]").view(np.int64)
-                else:
-                    values = values.astype("datetime64[us]").view(np.int64)
-            elif values.dtype == np.uint64:
-                # the one lossy unsigned mapping — fail loudly on overflow
-                if n and values[validity].max(initial=0) > np.iinfo(np.int64).max:
-                    raise OverflowError("uint64 column exceeds int64 range")
-                values = values.astype(np.int64)
+            values = values.astype("datetime64[us]").view(np.int64)
+    elif values.dtype == np.uint64:
+        # the one lossy unsigned mapping — fail loudly on overflow
+        if n and values[validity].max(initial=0) > np.iinfo(np.int64).max:
+            raise OverflowError("uint64 column exceeds int64 range")
+        values = values.astype(np.int64)
+    return values, validity
+
+
+def _arrow_to_column(arr: pa.Array, dt: T.DataType, capacity: int) -> Column:
+    from blaze_tpu.utils.device import is_device_dtype
+
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    if pa.types.is_dictionary(arr.type):
+        arr = arr.cast(arr.type.value_type)
+    if is_device_dtype(dt):
+        values, validity = arrow_fixed_planes(arr, dt)
         return DeviceColumn.from_numpy(dt, values, validity, capacity)
     # host-resident: normalize strings/binary to large_ variants
     if isinstance(dt, T.StringType) and not pa.types.is_large_string(arr.type):

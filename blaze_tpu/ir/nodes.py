@@ -165,6 +165,23 @@ class IpcReader(PlanNode):
 
 
 @dataclasses.dataclass
+class BatchSource(PlanNode):
+    """Serves pre-materialized ColumnarBatches from the resource map (the
+    session-internal landing node for the ICI mesh exchange — the reducer
+    side's analogue of IpcReader when rows arrived over a collective instead
+    of shuffle files). The resource is ``partition -> list[ColumnarBatch]``
+    or an indexable of per-partition batch lists."""
+
+    schema: T.Schema
+    resource_id: str
+    num_partitions: int = 1
+
+    @property
+    def output_schema(self):
+        return self.schema
+
+
+@dataclasses.dataclass
 class FFIReader(PlanNode):
     """Imports host-produced Arrow batches (reference: FFIReaderExecNode, the
     ConvertToNative path). The resource is a callable partition -> iterator of

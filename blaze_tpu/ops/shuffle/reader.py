@@ -163,3 +163,24 @@ class FFIReaderExec(Operator):
         for rb in rbs:
             batch = ColumnarBatch.from_arrow(rb, self.schema)
             yield batch
+
+
+class BatchSourceExec(Operator):
+    """Serves pre-materialized ColumnarBatches from the resource map (the
+    reducer-side landing of the ICI mesh exchange, parallel/mesh.py — rows
+    arrived over a collective, so there is nothing to decode)."""
+
+    def __init__(self, schema: T.Schema, resource_id: str, num_partitions: int = 1):
+        self.resource_id = resource_id
+        self._num_partitions = num_partitions
+        super().__init__(schema, [])
+
+    def num_partitions(self):
+        return self._num_partitions
+
+    def _execute(self, partition, ctx, metrics):
+        provider = ctx.resources[self.resource_id]
+        batches = provider(partition) if callable(provider) else provider[partition]
+        for b in batches:
+            metrics.add("output_rows", b.num_rows)
+            yield b

@@ -202,6 +202,198 @@ def run_broadcast_join(probe_keys: np.ndarray, build_keys: np.ndarray,
     return out, int(tot)
 
 
+# ---------------------------------------------------------------------------
+# General ColumnarBatch exchange (the engine's exchange, not a demo kernel)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis", "nplanes"))
+def _exchange_step(mesh, axis, nplanes, pids, live, *planes):
+    """SPMD all-to-all of masked row tiles, built once per (mesh, plane
+    structure). Each device holds (capacity,) shards; device d sends row i to
+    peer pids[i]; received rows land flattened in (n*capacity,) with a live
+    mask. Static shapes throughout (SURVEY.md §7.4.1): rows are masked, not
+    compacted, so XLA lays the collective on ICI with no host round trip."""
+    from jax import shard_map
+
+    n = mesh.shape[axis]
+
+    def step(pids, live, *planes):
+        tile_mask = (pids[None, :] == jnp.arange(n)[:, None]) & live[None, :]
+        outs = []
+        for p in planes:
+            t = jnp.where(tile_mask, p[None, :], jnp.zeros((), p.dtype))
+            t = jax.lax.all_to_all(t, axis, split_axis=0, concat_axis=0)
+            outs.append(t.reshape(-1))
+        m = jax.lax.all_to_all(tile_mask, axis, split_axis=0, concat_axis=0)
+        return (m.reshape(-1),) + tuple(outs)
+
+    sharded = shard_map(
+        step, mesh=mesh,
+        in_specs=(P(axis),) * (2 + nplanes),
+        out_specs=(P(axis),) * (1 + nplanes),
+    )
+    return sharded(pids, live, *planes)
+
+
+class MeshBatchExchange:
+    """Exchange real ColumnarBatches over the ICI mesh — the TPU-native
+    replacement for the reference's file/netty shuffle transport
+    (``shuffle/buffered_data.rs:48-541`` + ``ipc_reader_exec.rs:132-325``,
+    SURVEY.md §5.8 "TPU-native equivalent").
+
+    Columns of any engine type move: device columns (ints, floats, dates,
+    timestamps, decimal<=18 as unscaled int64, agg partial states) ship as
+    raw planes + validity; host columns (strings, wide decimals) ship as
+    dictionary codes against a driver-built global dictionary and are
+    rematerialized on the reducer. Partition ids come from the SAME
+    Repartitioner as the file path (spark-exact murmur3 pmod), so a row
+    lands on the same reducer either way."""
+
+    def __init__(self, mesh: Mesh, axis: Optional[str] = None):
+        assert len(mesh.axis_names) == 1, (
+            f"MeshBatchExchange needs a 1-D mesh, got axes {mesh.axis_names}")
+        self.mesh = mesh
+        self.axis = axis or mesh.axis_names[0]
+        self.n = mesh.shape[self.axis]
+
+    def run(self, schema, shard_batches: List[Optional["object"]],
+            shard_pids: List[Optional[np.ndarray]],
+            num_reducers: int) -> List["object"]:
+        """shard_batches[s]: ColumnarBatch (or None) held by mesh slot s;
+        shard_pids[s]: per-row reducer ids. Returns one host-resident
+        HostBatch per reducer (num_reducers <= mesh size)."""
+        from blaze_tpu.config import get_config
+        from blaze_tpu.core.batch import HostBatch, HostColumn
+        from blaze_tpu.ir import types as T
+        from blaze_tpu.utils.device import pull_columns
+
+        import pyarrow as pa
+
+        n = self.n
+        assert num_reducers <= n, (num_reducers, n)
+        assert len(shard_batches) == n
+
+        cap = get_config().capacity_for(
+            max([b.num_rows for b in shard_batches if b is not None] or [1]))
+
+        # --- host staging: one pull per shard, global dict for host columns
+        from blaze_tpu.utils.device import is_device_dtype
+
+        ncols = len(schema)
+        host_slots = [i for i, f in enumerate(schema.fields)
+                      if not is_device_dtype(f.dtype)]
+        dictionaries: dict = {}
+        shard_items = []  # per shard: list of (np_data, np_valid) per column
+        from blaze_tpu.core.batch import arrow_fixed_planes
+
+        for s, b in enumerate(shard_batches):
+            if b is None or b.num_rows == 0:
+                shard_items.append(None)
+                continue
+            pulled = pull_columns(b.columns, b.num_rows)
+            items = []
+            for i, c in enumerate(b.columns):
+                if i in host_slots:
+                    items.append(c.array if isinstance(c, HostColumn)
+                                 else c.to_arrow(b.num_rows))
+                elif pulled[i] is not None:
+                    items.append(pulled[i])
+                else:
+                    # fixed-width value materialized host-side (e.g. generic
+                    # agg output): extract planes without a device round trip
+                    items.append(arrow_fixed_planes(c.array, schema[i].dtype))
+            shard_items.append(items)
+        for i in host_slots:
+            arrays = [it[i] for it in shard_items if it is not None]
+            if not arrays:
+                dictionaries[i] = pa.array(
+                    [], type=T.to_arrow_type(schema[i].dtype))
+                continue
+            combined = pa.concat_arrays(
+                [a.combine_chunks() if isinstance(a, pa.ChunkedArray) else a
+                 for a in arrays])
+            denc = combined.dictionary_encode()
+            dictionaries[i] = denc.dictionary
+            codes = denc.indices
+            off = 0
+            for it in shard_items:
+                if it is None:
+                    continue
+                k = len(it[i])
+                sl = codes.slice(off, k)
+                valid = ~np.asarray(sl.is_null()) if sl.null_count \
+                    else np.ones(k, bool)
+                it[i] = (sl.fill_null(0).to_numpy(zero_copy_only=False)
+                         .astype(np.int32), valid)
+                off += k
+
+        # --- build global sharded planes: (n*cap,) per column data/validity
+        from jax.sharding import NamedSharding
+
+        sharding = NamedSharding(self.mesh, P(self.axis))
+        gpids = np.full(n * cap, n, dtype=np.int32)  # n == route nowhere
+        glive = np.zeros(n * cap, dtype=bool)
+        gdatas, gvalids = [], []
+        for i in range(ncols):
+            dt = np.int32 if i in host_slots else \
+                shard_items_dtype(shard_items, i)
+            gdatas.append(np.zeros(n * cap, dtype=dt))
+            gvalids.append(np.zeros(n * cap, dtype=bool))
+        for s, it in enumerate(shard_items):
+            if it is None:
+                continue
+            k = len(shard_pids[s])
+            base = s * cap
+            gpids[base:base + k] = shard_pids[s]
+            glive[base:base + k] = True
+            for i in range(ncols):
+                gdatas[i][base:base + k] = it[i][0]
+                gvalids[i][base:base + k] = it[i][1]
+
+        planes = []
+        for i in range(ncols):
+            planes.append(jax.device_put(gdatas[i], sharding))
+            planes.append(jax.device_put(gvalids[i], sharding))
+        with self.mesh:
+            outs = _exchange_step(
+                self.mesh, self.axis, len(planes),
+                jax.device_put(gpids, sharding),
+                jax.device_put(glive, sharding), *planes)
+        out_live = np.asarray(outs[0])
+        out_planes = [np.asarray(o) for o in outs[1:]]
+
+        # --- rebuild one HOST batch per reducer (numpy compaction of live
+        # rows). Host-resident on purpose: the session may hold the result in
+        # its resource map across stages, and pinning every intermediate
+        # exchange in HBM would accumulate device memory the way shuffle
+        # files never do — the reducer re-materializes on first read.
+        out_cap = n * cap
+        results = []
+        for r in range(num_reducers):
+            seg = slice(r * out_cap, (r + 1) * out_cap)
+            rows = np.nonzero(out_live[seg])[0]
+            items = []
+            for i, f in enumerate(schema.fields):
+                d = out_planes[2 * i][seg][rows]
+                v = out_planes[2 * i + 1][seg][rows]
+                if i in host_slots:
+                    codes = pa.array(d, type=pa.int32()) if v.all() else \
+                        pa.array(np.where(v, d, 0), type=pa.int32(), mask=~v)
+                    items.append(dictionaries[i].take(codes))
+                else:
+                    items.append((d, v))
+            results.append(HostBatch(schema, items, len(rows)))
+        return results
+
+
+def shard_items_dtype(shard_items, i):
+    for it in shard_items:
+        if it is not None:
+            return it[i][0].dtype
+    return np.int64
+
+
 def run_distributed_sum(keys: np.ndarray, vals: np.ndarray,
                         mesh: Optional[Mesh] = None,
                         axis: str = "data") -> dict:

@@ -127,6 +127,10 @@ def build_operator(node: N.PlanNode) -> Operator:
         from blaze_tpu.ops.shuffle.reader import FFIReaderExec
 
         return FFIReaderExec(node.schema, node.resource_id, node.num_partitions)
+    if isinstance(node, N.BatchSource):
+        from blaze_tpu.ops.shuffle.reader import BatchSourceExec
+
+        return BatchSourceExec(node.schema, node.resource_id, node.num_partitions)
     if isinstance(node, (N.ShuffleExchange, N.BroadcastExchange)):
         raise ValueError(
             f"{type(node).__name__} is a driver-level node; execute the plan "

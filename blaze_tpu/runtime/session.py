@@ -37,13 +37,23 @@ from blaze_tpu.runtime.metrics import MetricNode
 
 class Session:
     def __init__(self, conf: Optional[Config] = None, work_dir: Optional[str] = None,
-                 max_workers: Optional[int] = None):
+                 max_workers: Optional[int] = None, mesh=None):
+        """``mesh``: a jax.sharding.Mesh. When given, ShuffleExchanges whose
+        reducer count fits the mesh lower to the ICI all-to-all transport
+        (parallel/mesh.py MeshBatchExchange) instead of shuffle files — the
+        reference's netty block fetch becomes an XLA collective
+        (SURVEY.md §5.8). Exchanges that don't fit fall back to files."""
         from blaze_tpu.utils.native import ensure_built_async
 
         ensure_built_async()  # background; numpy fallbacks serve meanwhile
         self.conf = conf or get_config()
         self.work_dir = work_dir or tempfile.mkdtemp(prefix="blaze_tpu_session_")
         self.max_workers = max_workers or self.conf.num_io_threads
+        if mesh is not None:
+            assert len(mesh.axis_names) == 1, (
+                f"Session needs a 1-D mesh (one exchange axis), got "
+                f"axes {mesh.axis_names}")
+        self.mesh = mesh
         self.resources = {}
         self._ids = itertools.count()
         self._stage_ids = itertools.count()
@@ -172,6 +182,9 @@ class Session:
                 # literals): sample the child once, derive per-reducer bounds
                 node = dataclasses.replace(
                     node, partitioning=self._sample_range_bounds(node))
+            if self.mesh is not None and \
+                    node.partitioning.num_partitions <= self.mesh.devices.size:
+                return self._run_mesh_exchange(node)
             return self._run_shuffle_map_stage(node)
         if isinstance(node, N.BroadcastExchange):
             return self._run_broadcast_collect(node)
@@ -276,6 +289,76 @@ class Session:
         return N.CoalesceBatches(
             N.IpcReader(schema=node.child.output_schema, resource_id=rid,
                         num_partitions=num_reducers),
+            batch_size=0)
+
+    def _run_mesh_exchange(self, node: N.ShuffleExchange) -> N.PlanNode:
+        """Lower a ShuffleExchange onto the device mesh: run map partitions,
+        route rows with the SAME Repartitioner as the file path (spark-exact
+        pids), then move them with one ICI all-to-all instead of writing
+        data+index files (parallel/mesh.py). Result batches land in the
+        resource map behind a BatchSource."""
+        import numpy as np
+
+        from blaze_tpu.core.batch import ColumnarBatch
+        from blaze_tpu.ops.shuffle.repartitioner import create_repartitioner
+        from blaze_tpu.parallel.mesh import MeshBatchExchange
+
+        stage = next(self._stage_ids)
+        child_op = build_operator(node.child)
+        num_maps = child_op.num_partitions()
+        num_reducers = node.partitioning.num_partitions
+        schema = node.child.output_schema
+        n = self.mesh.devices.size
+
+        def run_map(m: int):
+            """Collect one map partition and compute its rows' reducer ids
+            (per-task repartitioner, matching the file path's determinism)."""
+            from blaze_tpu.utils.logutil import clear_task_context, set_task_context
+
+            ctx = self._make_ctx(m, stage)
+            task_metrics = self.metrics.named_child(f"stage_{stage}").named_child(f"map_{m}")
+            set_task_context(stage, m)
+            try:
+                repart = create_repartitioner(node.partitioning, schema)
+                batches, pids = [], []
+                for b in child_op.execute(m, ctx, task_metrics):
+                    if b.num_rows == 0:
+                        continue
+                    batches.append(b)
+                    pids.append(repart.partition_ids(b))
+                if not batches:
+                    return None, None
+                return (ColumnarBatch.concat(batches, schema),
+                        np.concatenate(pids).astype(np.int32))
+            finally:
+                clear_task_context()
+
+        outputs = self._run_tasks(run_map, range(num_maps))
+
+        # fold map partitions onto the n mesh slots (round-robin)
+        shard_batches: List[Optional[ColumnarBatch]] = [None] * n
+        shard_pids: List[Optional[np.ndarray]] = [None] * n
+        for m, (b, p) in enumerate(outputs):
+            if b is None:
+                continue
+            s = m % n
+            if shard_batches[s] is None:
+                shard_batches[s], shard_pids[s] = b, p
+            else:
+                shard_batches[s] = ColumnarBatch.concat([shard_batches[s], b], schema)
+                shard_pids[s] = np.concatenate([shard_pids[s], p])
+
+        exchange = MeshBatchExchange(self.mesh)
+        reducer_batches = exchange.run(schema, shard_batches, shard_pids,
+                                       num_reducers)
+        rid = f"mesh_shuffle_{stage}"
+        # HostBatches in the resource map (host RAM, like shuffle files);
+        # the reducer task re-materializes device columns on read
+        self.resources[rid] = lambda r: [reducer_batches[r].to_columnar()] \
+            if reducer_batches[r].num_rows else []
+        return N.CoalesceBatches(
+            N.BatchSource(schema=schema, resource_id=rid,
+                          num_partitions=num_reducers),
             batch_size=0)
 
     def _run_broadcast_collect(self, node: N.BroadcastExchange) -> N.PlanNode:
