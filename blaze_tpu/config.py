@@ -34,6 +34,11 @@ class Config:
     memory_fraction: float = 0.6
     # Total memory budget in bytes; None = derive from system.
     memory_total: Optional[int] = None
+    # How long an under-share producer blocks for peers to spill before
+    # spilling itself (reference waits on a condvar with a 10s timeout,
+    # memmgr/mod.rs:301-421; shorter default keeps single-threaded stalls
+    # bounded).
+    mem_wait_timeout_s: float = 2.0
 
     # Device HBM budget for resident batch data (bytes). None = ask the device.
     hbm_budget: Optional[int] = None

@@ -115,6 +115,8 @@ class DeviceColumn(Column):
 
     @staticmethod
     def from_numpy(dt: T.DataType, data: np.ndarray, validity: Optional[np.ndarray], capacity: int) -> "DeviceColumn":
+        from blaze_tpu.utils.device import DEVICE_STATS
+
         n = len(data)
         if validity is None:
             validity = np.ones(n, dtype=bool)
@@ -122,6 +124,7 @@ class DeviceColumn(Column):
         vbuf = np.zeros(capacity, dtype=bool)
         np.copyto(buf[:n], np.where(validity, data, np.zeros((), dt.np_dtype)), casting="unsafe")
         vbuf[:n] = validity
+        DEVICE_STATS.add_to_device(buf.nbytes + vbuf.nbytes)
         return DeviceColumn(dt, jnp.asarray(buf), jnp.asarray(vbuf))
 
 

@@ -13,12 +13,25 @@ capacity-bucket discipline (config.capacity_for) makes those recur.
 from __future__ import annotations
 
 import functools
+import time
 from typing import List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
+
+def _dispatch(fn, *args, **kw):
+    """Run one jitted kernel dispatch under the device-residency clock
+    (utils/device.DEVICE_STATS; on an async backend this times dispatch, on
+    the CPU backend it approximates execution)."""
+    from blaze_tpu.utils.device import DEVICE_STATS
+
+    t0 = time.perf_counter()
+    out = fn(*args, **kw)
+    DEVICE_STATS.add_kernel(time.perf_counter() - t0)
+    return out
 
 
 @jax.jit
@@ -48,7 +61,7 @@ def gather_planes(datas: Sequence[jax.Array], valids: Sequence[jax.Array],
         lbuf[:n_out] = True
     else:
         lbuf[:n_out] = ~null_mask
-    return _gather(tuple(datas), tuple(valids), jnp.asarray(buf), jnp.asarray(lbuf))
+    return _dispatch(_gather, tuple(datas), tuple(valids), jnp.asarray(buf), jnp.asarray(lbuf))
 
 
 @jax.jit
@@ -68,7 +81,7 @@ def compact_planes(datas: Sequence[jax.Array], valids: Sequence[jax.Array],
                    mask: jax.Array):
     """Stable device-side compaction of rows where ``mask`` holds (FilterExec
     hot path): one dispatch + one scalar sync for the surviving-row count."""
-    count, out_d, out_v = _compact(tuple(datas), tuple(valids), mask)
+    count, out_d, out_v = _dispatch(_compact, tuple(datas), tuple(valids), mask)
     return int(count), out_d, out_v
 
 
@@ -91,8 +104,8 @@ def slice_planes(datas: Sequence[jax.Array], valids: Sequence[jax.Array],
                  offset: int, length: int, out_cap: int):
     """Contiguous row window in ONE jitted dispatch; offset/length are traced
     so every slice of the same shapes reuses one compiled program."""
-    return _dyn_slice(tuple(datas), tuple(valids),
-                      jnp.int64(offset), jnp.int64(length), out_cap)
+    return _dispatch(_dyn_slice, tuple(datas), tuple(valids),
+                     jnp.int64(offset), jnp.int64(length), out_cap=out_cap)
 
 
 @jax.jit
@@ -122,7 +135,8 @@ def concat_planes(per_field_datas: List[Tuple[jax.Array, ...]],
         base += cap_j
     live = np.zeros(out_cap, dtype=bool)
     live[:total] = True
-    return _concat_gather(
+    return _dispatch(
+        _concat_gather,
         tuple(tuple(p) for p in per_field_datas),
         tuple(tuple(p) for p in per_field_valids),
         jnp.asarray(idx), jnp.asarray(live))

@@ -196,9 +196,14 @@ class DevicePartialAgger:
         return self._skey
 
     def process(self, batch: ColumnarBatch) -> Optional[ColumnarBatch]:
+        import time as _time
+
+        from blaze_tpu.utils.device import DEVICE_STATS
+
         n = batch.num_rows
         if n == 0:
             return None
+        t0 = _time.perf_counter()
         if self.fused_predicates is not None:
             flat = []
             for c in batch.columns:
@@ -206,7 +211,8 @@ class DevicePartialAgger:
             outs = self._fused_fn(batch)(jnp.int64(n), *flat)
         else:
             outs = self._flow(batch, batch.row_exists_mask())
-        num_groups = int(outs[0])
+        num_groups = int(outs[0])  # the sync point: kernel completes here
+        DEVICE_STATS.add_kernel(_time.perf_counter() - t0)
         if num_groups == 0:
             return None
         pos = 1

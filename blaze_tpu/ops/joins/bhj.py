@@ -141,7 +141,9 @@ class _HashJoinBase(Operator):
         for batch in self.execute_child(probe_child, partition, ctx, metrics):
             with metrics.timer("probe_time"):
                 cols = key_ev.evaluate(batch)
-                codes = key_codes(batch, cols, bmap.key_map, insert=False)
+                codes, on_device = bmap.probe_codes(batch, cols)
+                if on_device:
+                    metrics.add("device_probe_batches", 1)
                 probe_idx, build_idx, _ = bmap.probe(codes)
                 probe_idx, build_idx, counts = self._apply_condition(
                     batch, bmap, probe_idx, build_idx, probe_on_left, cond_ev)
@@ -336,12 +338,16 @@ class BroadcastJoinExec(_HashJoinBase):
             # per-task matched flags: outer joins over a shared map must not
             # leak matches across tasks of different partitions
             m = JoinHashMap(cached.batch, cached.key_map, cached.offsets,
-                            cached.schema)
+                            cached.schema, cached.sorted_keys)
+            m._dev_cell = cached._dev_cell  # share the device-side upload
             return m
         built = self._build_from_child(0, ctx, metrics)
         with _BUILD_CACHE_LOCK:
             _BUILD_CACHE.setdefault(cache_id, built)
-        return JoinHashMap(built.batch, built.key_map, built.offsets, built.schema)
+        m = JoinHashMap(built.batch, built.key_map, built.offsets,
+                        built.schema, built.sorted_keys)
+        m._dev_cell = built._dev_cell
+        return m
 
 
 class BroadcastJoinBuildHashMapExec(Operator):

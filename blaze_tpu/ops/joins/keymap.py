@@ -14,6 +14,7 @@ key get code -1 on both sides."""
 
 from __future__ import annotations
 
+import functools
 import pickle
 from typing import Dict, List, Optional, Tuple
 
@@ -104,16 +105,78 @@ def key_codes(batch: ColumnarBatch, cols: List[Column], key_map: Dict,
     return codes
 
 
+def _canon_words(data: np.ndarray) -> np.ndarray:
+    """Numpy values -> canonical int64 key words (floats: -0.0 folded,
+    NaN payloads unified — Spark float equality, see key_codes)."""
+    if data.dtype == np.float64:
+        d = np.where(data == 0.0, 0.0, data)
+        d = np.where(np.isnan(d), np.float64(np.nan), d)
+        return d.view(np.int64)
+    if data.dtype == np.float32:
+        d = np.where(data == np.float32(0), np.float32(0), data)
+        d = np.where(np.isnan(d), np.float32(np.nan), d)
+        return d.view(np.int32).astype(np.int64)
+    return data.astype(np.int64)
+
+
+@functools.lru_cache(maxsize=None)
+def _probe_fn(dtype_str: str, nk: int):
+    """Module-level cache: one jitted probe per (dtype, key count) — a
+    per-call closure would recompile for every probe batch."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def probe(uniq, d, v):
+        if jnp.issubdtype(d.dtype, jnp.floating):
+            d = jnp.where(d == 0, jnp.zeros((), d.dtype), d)
+            d = jnp.where(jnp.isnan(d), jnp.array(float("nan"), d.dtype), d)
+            if d.dtype == jnp.float32:
+                w = d.view(jnp.int32).astype(jnp.int64)
+            else:
+                w = d.view(jnp.int64)
+        else:
+            w = d.astype(jnp.int64)
+        idx = jnp.searchsorted(uniq, w)
+        cidx = jnp.clip(idx, 0, max(nk - 1, 0))
+        hit = v & (idx < nk) & (uniq[cidx] == w)
+        return jnp.where(hit, idx, -1)
+
+    return probe
+
+
+def _searchsorted_probe(sorted_keys, data, validity, n_keys: int):
+    """Jitted device probe: canonical word -> rank in sorted_keys or -1."""
+    return _probe_fn(str(data.dtype), n_keys)(sorted_keys, data, validity)
+
+
 class JoinHashMap:
     """Build-side map: key code -> contiguous range of build rows (CSR over
-    the concatenated, code-sorted build batch)."""
+    the concatenated, code-sorted build batch).
 
-    def __init__(self, batch: ColumnarBatch, key_map: Dict,
-                 offsets: np.ndarray, schema):
+    Two code assignments share the CSR layout:
+
+    - **device probe** (single fixed-width key): codes are ranks in the
+      SORTED unique-key array; the probe looks keys up with a jitted
+      ``searchsorted`` on device — no per-row host work (reference analogue:
+      the prefetched group-of-8 probe of ``joins/join_hash_map.rs:44-284``,
+      re-designed as binary search per SURVEY.md §7.2 L2').
+    - **host interning** (multi-column / var-width keys): vectorized
+      ``np.unique`` dedup + dict lookups on per-batch distincts.
+    """
+
+    def __init__(self, batch: ColumnarBatch, key_map: Optional[Dict],
+                 offsets: np.ndarray, schema,
+                 sorted_keys: Optional[np.ndarray] = None):
         self.batch = batch          # build rows sorted by key code
         self.key_map = key_map
         self.offsets = offsets      # (num_codes + 1,) row ranges
         self.schema = schema
+        self.sorted_keys = sorted_keys  # device-probe path: sorted unique keys
+        # one-element cell so per-task copies of a cached map SHARE the
+        # device-resident sorted-key upload (one transfer per executor, not
+        # one per probe task)
+        self._dev_cell = [None]
         self.matched = np.zeros(batch.num_rows, dtype=bool)
 
     @property
@@ -123,24 +186,55 @@ class JoinHashMap:
     @staticmethod
     def build(batches: List[ColumnarBatch], key_exprs: List[E.Expr],
               schema) -> "JoinHashMap":
-        key_map: Dict = {}
-        code_arrays = []
+        key_cols = []
         kept = []
         for b in batches:
             if b.num_rows == 0:
                 continue
             ev = ExprEvaluator(key_exprs, b.schema)
-            cols = ev.evaluate(b)
-            code_arrays.append(key_codes(b, cols, key_map, insert=True))
+            key_cols.append(ev.evaluate(b))
             kept.append(b)
         if not kept:
             empty = ColumnarBatch.empty(schema)
-            return JoinHashMap(empty, key_map, np.zeros(1, np.int64), schema)
+            return JoinHashMap(empty, {}, np.zeros(1, np.int64), schema)
+        if len(key_exprs) == 1 and all(
+                isinstance(cols[0], DeviceColumn) for cols in key_cols):
+            return JoinHashMap._build_sorted(kept, key_cols, schema)
+        key_map: Dict = {}
+        code_arrays = [key_codes(b, cols, key_map, insert=True)
+                       for b, cols in zip(kept, key_cols)]
         big = ColumnarBatch.concat(kept, schema)
         codes = np.concatenate(code_arrays)
+        ncodes = len(key_map)
+        return JoinHashMap._from_codes(big, codes, ncodes, key_map, None, schema)
+
+    @staticmethod
+    def _build_sorted(kept, key_cols, schema) -> "JoinHashMap":
+        """Single fixed-width key: codes are ranks in the sorted unique-key
+        array (canonical int64 words), enabling the device searchsorted
+        probe."""
+        from blaze_tpu.utils.device import pull_columns
+
+        words = []
+        valids = []
+        for b, cols in zip(kept, key_cols):
+            (data, valid), = pull_columns(cols, b.num_rows)
+            words.append(_canon_words(data))
+            valids.append(valid)
+        big = ColumnarBatch.concat(kept, schema)
+        w = np.concatenate(words)
+        v = np.concatenate(valids)
+        uniq = np.unique(w[v])
+        codes = np.searchsorted(uniq, w)
+        codes = np.where(v & (codes < len(uniq)) &
+                         (uniq[np.clip(codes, 0, max(len(uniq) - 1, 0))] == w),
+                         codes, -1) if len(uniq) else np.full(len(w), -1)
+        return JoinHashMap._from_codes(big, codes, len(uniq), None, uniq, schema)
+
+    @staticmethod
+    def _from_codes(big, codes, ncodes, key_map, sorted_keys, schema):
         # null-keyed build rows (-1) can never match: give them code
         # num_codes so they sort to the tail outside every CSR range
-        ncodes = len(key_map)
         codes = np.where(codes < 0, ncodes, codes)
         order = np.argsort(codes, kind="stable")
         sorted_codes = codes[order]
@@ -148,7 +242,43 @@ class JoinHashMap:
         counts = np.bincount(sorted_codes, minlength=ncodes + 1)[: ncodes + 1]
         offsets = np.zeros(ncodes + 1, dtype=np.int64)
         np.cumsum(counts[:ncodes], out=offsets[1:])
-        return JoinHashMap(big, key_map, offsets, schema)
+        return JoinHashMap(big, key_map, offsets, schema, sorted_keys)
+
+    def probe_codes(self, batch: ColumnarBatch, cols: List[Column]) -> Tuple[np.ndarray, bool]:
+        """Row key -> code for this map; returns (codes, used_device_probe)."""
+        if self.sorted_keys is not None and len(cols) == 1 and \
+                isinstance(cols[0], DeviceColumn):
+            return self._device_probe(batch, cols[0]), True
+        if self.key_map is None:
+            # sorted-key map probed host-side (single fixed-width key whose
+            # probe column happens to live on host): same canonical words,
+            # numpy searchsorted
+            from blaze_tpu.core.batch import arrow_fixed_planes
+
+            assert len(cols) == 1
+            data, valid = arrow_fixed_planes(
+                cols[0].to_arrow(batch.num_rows), cols[0].dtype)
+            w = _canon_words(data)
+            uniq = self.sorted_keys
+            if len(uniq) == 0:
+                return np.full(batch.num_rows, -1, np.int64), False
+            codes = np.searchsorted(uniq, w)
+            hit = valid & (codes < len(uniq)) & \
+                (uniq[np.clip(codes, 0, len(uniq) - 1)] == w)
+            return np.where(hit, codes, -1), False
+        return key_codes(batch, cols, self.key_map, insert=False), False
+
+    def _device_probe(self, batch: ColumnarBatch, col: DeviceColumn) -> np.ndarray:
+        import jax.numpy as jnp
+
+        if self._dev_cell[0] is None:
+            self._dev_cell[0] = jnp.asarray(
+                self.sorted_keys if len(self.sorted_keys)
+                else np.zeros(1, np.int64))
+        codes = _searchsorted_probe(
+            self._dev_cell[0], col.data, col.validity,
+            len(self.sorted_keys))
+        return np.asarray(codes)[: batch.num_rows]
 
     def probe(self, codes: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """codes (n,) -> (probe_idx, build_idx, match_counts): all matching
@@ -178,6 +308,7 @@ class JoinHashMap:
         payload = {
             "key_map": self.key_map,
             "offsets": self.offsets,
+            "sorted_keys": self.sorted_keys,
             "batch": buf.getvalue(),
         }
         return pickle.dumps(payload, protocol=4)
@@ -191,4 +322,5 @@ class JoinHashMap:
         payload = pickle.loads(blob)
         batches = list(BatchReader(io.BytesIO(payload["batch"])))
         batch = batches[0] if batches else ColumnarBatch.empty(schema)
-        return JoinHashMap(batch, payload["key_map"], payload["offsets"], schema)
+        return JoinHashMap(batch, payload["key_map"], payload["offsets"], schema,
+                           payload.get("sorted_keys"))

@@ -10,6 +10,8 @@ bound to a free port exposes:
   across ALL threads (sys._current_frames), pprof-style aggregated stacks
 - ``/debug/memory``            — process RSS + memory-manager accounting
 - ``/debug/config``            — the active engine config
+- ``/debug/device``            — device residency: transfer bytes/calls +
+  jitted-kernel dispatch counts/time (utils/device.DEVICE_STATS)
 
 Start with ``ProfilingService.start(session)``; idempotent per process."""
 
@@ -91,6 +93,10 @@ class ProfilingService:
 
                         self._send(json.dumps(dataclasses.asdict(get_config()),
                                               indent=2, default=str))
+                    elif url.path == "/debug/device":
+                        from blaze_tpu.utils.device import DEVICE_STATS
+
+                        self._send(json.dumps(DEVICE_STATS.snapshot(), indent=2))
                     else:
                         self.send_response(404)
                         self.end_headers()

@@ -36,6 +36,7 @@ class MemConsumer:
         self.spillable = spillable
         self.mem_used = 0
         self.spill_requested = False
+        self.owner_thread: Optional[int] = None  # set at register time
         self._manager: Optional["MemManager"] = None
 
     def spill(self) -> int:
@@ -53,12 +54,16 @@ class MemManager:
     _instance: Optional["MemManager"] = None
     _lock = threading.Lock()
 
-    def __init__(self, total: int):
+    def __init__(self, total: int, wait_timeout_s: Optional[float] = None):
         self.total = total
         self.consumers: List[MemConsumer] = []
         self._mu = threading.RLock()
+        self._cv = threading.Condition(self._mu)
         self.total_spilled_bytes = 0
         self.spill_count = 0
+        self.wait_count = 0
+        self.wait_timeout_s = wait_timeout_s if wait_timeout_s is not None \
+            else get_config().mem_wait_timeout_s
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -86,6 +91,7 @@ class MemManager:
     def register(self, consumer: MemConsumer):
         with self._mu:
             consumer._manager = self
+            consumer.owner_thread = threading.get_ident()
             self.consumers.append(consumer)
 
     def unregister(self, consumer: MemConsumer):
@@ -94,6 +100,7 @@ class MemManager:
             consumer.mem_used = 0
             if consumer in self.consumers:
                 self.consumers.remove(consumer)
+            self._cv.notify_all()  # freed memory may unblock waiters
 
     # -- accounting -----------------------------------------------------------
 
@@ -108,29 +115,75 @@ class MemManager:
             return self.total // n
 
     def update(self, consumer: MemConsumer, new_used: int):
-        """Record new usage; trigger spills when over budget (reference:
-        MemManager::update_consumer_mem_used decision logic). Only the
-        calling consumer spills synchronously; other over-share consumers
-        are flagged and spill on their own thread's next update."""
-        spill_self = False
-        with self._mu:
-            consumer.mem_used = new_used
-            if consumer.spill_requested and consumer.spillable:
-                spill_self = True
-            elif self.used > self.total:
-                share = self.fair_share()
-                if consumer.spillable and consumer.mem_used > share:
-                    spill_self = True
-                for c in self.consumers:
-                    if c is not consumer and c.spillable and c.mem_used > share:
-                        c.spill_requested = True
-        if spill_self:
-            consumer.spill_requested = False
-            freed = consumer.spill()
-            with self._mu:
-                self.spill_count += 1
-                self.total_spilled_bytes += freed
-                consumer.mem_used = max(0, consumer.mem_used - freed)
+        """Record new usage; decide Spill / Wait / Nothing (reference:
+        MemManager::update_consumer_mem_used, memmgr/mod.rs:301-457).
+
+        - over its fair share while the pool is over budget -> the caller
+          spills synchronously (only the owning thread touches its state);
+        - under its share while the pool is over budget -> over-share peers
+          are flagged, and the caller BLOCKS on a condvar until memory frees
+          or the timeout lapses — a producer can no longer overshoot the
+          budget unboundedly between peer updates;
+        - on timeout with the pool still over budget, the caller spills
+          itself if it can (progress guarantee: a stalled peer that never
+          reaches its next update must not wedge the query)."""
+        import time
+
+        me = threading.get_ident()
+        deadline = None
+        growing = new_used > consumer.mem_used
+        while True:
+            action = "none"
+            with self._cv:
+                consumer.mem_used = new_used
+                if consumer.spill_requested and consumer.spillable:
+                    action = "spill"
+                elif self.used > self.total and growing:
+                    # a shrinking update must NEVER block — freeing memory
+                    # while waiting for someone else to free memory inverts
+                    # the backpressure
+                    share = self.fair_share()
+                    if consumer.spillable and consumer.mem_used > share:
+                        action = "spill"
+                    else:
+                        foreign_peer = False
+                        for c in self.consumers:
+                            if c is not consumer and c.spillable and \
+                                    c.mem_used > share:
+                                c.spill_requested = True
+                                # a peer on the CALLING thread can only spill
+                                # on its own next update — which this wait
+                                # would block; wait only for peers that
+                                # another thread can actually advance
+                                if c.owner_thread != me:
+                                    foreign_peer = True
+                        if foreign_peer:
+                            action = "wait"
+                        elif consumer.spillable and consumer.mem_used > 0:
+                            action = "spill"  # make progress single-threaded
+                if action == "wait":
+                    now = time.monotonic()
+                    if deadline is None:
+                        deadline = now + self.wait_timeout_s
+                        self.wait_count += 1
+                    if now >= deadline:
+                        action = "timeout"
+                    else:
+                        self._cv.wait(min(deadline - now, 0.05))
+            if action == "spill" or (
+                    action == "timeout" and consumer.spillable and
+                    consumer.mem_used > 0):
+                consumer.spill_requested = False
+                freed = consumer.spill()
+                with self._cv:
+                    self.spill_count += 1
+                    self.total_spilled_bytes += freed
+                    consumer.mem_used = max(0, consumer.mem_used - freed)
+                    self._cv.notify_all()
+                return
+            if action == "wait":
+                continue
+            return
 
 
 class SpillFile:

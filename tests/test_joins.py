@@ -300,3 +300,48 @@ def test_udaf_aggregation():
     got = dict(zip(out["k"], out["g"]))
     assert abs(got[1] - 4.0) < 1e-9  # sqrt(2*8)
     assert abs(got[2] - 5.0) < 1e-9
+
+
+def test_device_probe_engaged_single_fixed_key():
+    """Single fixed-width key joins must take the device searchsorted probe
+    (VERDICT round-1 item 3): no host interning on the probe hot path."""
+    import time
+
+    from blaze_tpu.ops.base import ExecContext
+
+    rng = np.random.default_rng(21)
+    n = 50_000
+    left = mem_scan({"lk": pa.array(rng.integers(0, 2000, n), type=pa.int64()),
+                     "lv": pa.array(rng.integers(0, 100, n), type=pa.int64())},
+                    num_batches=4)
+    right = mem_scan({"rk": pa.array(np.arange(2000), type=pa.int64()),
+                      "rv": pa.array(np.arange(2000) * 3, type=pa.int64())})
+    op = BroadcastJoinExec(left, right, [(col("lk"), col("rk"))],
+                           JoinType.INNER, JoinSide.RIGHT)
+    ctx = ExecContext()
+    t0 = time.perf_counter()
+    rows = 0
+    for b in op.execute(0, ctx):
+        rows += b.num_rows
+    dt = time.perf_counter() - t0
+    assert rows == n  # every probe key hits exactly one build row
+    m = ctx.metrics
+    # metric lives on the operator's child node tree; search it
+    assert m.total("device_probe_batches") >= 4, "device probe not engaged"
+    # micro-bench guard: 50k probes through the device path should be far
+    # from per-row-python speeds (~10s); generous bound for CI variance
+    assert dt < 5.0, f"probe too slow: {dt:.2f}s"
+
+
+def test_sorted_map_build_equivalence_floats():
+    """Sorted device map groups -0.0/+0.0 and NaN payloads like the host
+    intern path."""
+    nan = float("nan")
+    left = mem_scan({"lk": pa.array([0.0, -0.0, nan, 1.5], type=pa.float64()),
+                     "lv": pa.array([1, 2, 3, 4], type=pa.int64())})
+    right = mem_scan({"rk": pa.array([-0.0, nan, 1.5], type=pa.float64()),
+                      "rv": pa.array([10, 20, 30], type=pa.int64())})
+    op = BroadcastJoinExec(left, right, [(col("lk"), col("rk"))],
+                           JoinType.INNER, JoinSide.RIGHT)
+    out = collect(op).to_pydict()
+    assert sorted(out["lv"]) == [1, 2, 3, 4]
