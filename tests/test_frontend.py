@@ -1,0 +1,344 @@
+"""Input-side converter: Spark physical-plan JSON -> proto IR -> execution
+(VERDICT round-1 item 4). Fixtures follow Spark's ``TreeNode.toJSON`` wire
+form: pre-order node arrays with ``class``/``num-children``, expression
+trees nested as such arrays inside plan fields."""
+
+import decimal
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu.frontend import SparkPlanConverter, convert_spark_plan
+from blaze_tpu.ir.protoserde import plan_from_bytes
+from blaze_tpu.runtime.session import Session
+
+SPARK = "org.apache.spark.sql"
+X = f"{SPARK}.catalyst.expressions"
+P = f"{SPARK}.execution"
+
+
+def attr(name, dtype, eid):
+    return {"class": f"{X}.AttributeReference", "num-children": 0,
+            "name": name, "dataType": dtype, "nullable": True, "metadata": {},
+            "exprId": {"product-class": f"{X}.ExprId", "id": eid,
+                       "jvmId": "00000000-0000-0000-0000-000000000000"},
+            "qualifier": []}
+
+
+def lit(value, dtype):
+    return {"class": f"{X}.Literal", "num-children": 0,
+            "value": value, "dataType": dtype}
+
+
+def binop(cls, l, r):
+    return [{"class": f"{X}.{cls}", "num-children": 2, "left": 0, "right": 1}] \
+        + l + r
+
+
+def agg_expr(fn_cls, mode, rid, children):
+    fn = [{"class": f"{X}.aggregate.{fn_cls}",
+           "num-children": len(children)}] + [c for ch in children for c in ch]
+    return [{"class": f"{X}.aggregate.AggregateExpression", "num-children": 1,
+             "aggregateFunction": 0,
+             "mode": {"object": f"{X}.aggregate.{mode}$"},
+             "isDistinct": False,
+             "resultId": {"product-class": f"{X}.ExprId", "id": rid,
+                          "jvmId": "00000000-0000-0000-0000-000000000000"}}] + fn
+
+
+def sort_order(child, asc=True):
+    d = "Ascending$" if asc else "Descending$"
+    n = "NullsFirst$" if asc else "NullsLast$"
+    return [{"class": f"{X}.SortOrder", "num-children": 1, "child": 0,
+             "direction": {"object": f"{X}.{d}"},
+             "nullOrdering": {"object": f"{X}.{n}"},
+             "sameOrderExpressions": []}] + child
+
+
+@pytest.fixture
+def store_returns(tmp_path):
+    rng = np.random.default_rng(17)
+    n = 20_000
+    paths = []
+    for p in range(2):
+        amt = pa.array([decimal.Decimal(int(v)).scaleb(-2)
+                        for v in rng.integers(0, 100000, n // 2)],
+                       type=pa.decimal128(7, 2))
+        tbl = pa.table({
+            "sr_store_sk": pa.array(rng.integers(1, 50, n // 2), type=pa.int64()),
+            "sr_return_amt": amt,
+        })
+        path = str(tmp_path / f"sr_{p}.parquet")
+        pq.write_table(tbl, path)
+        paths.append(path)
+    return paths
+
+
+def _bench_pipeline_json():
+    """scan -> filter(amt > 500.00) -> partial agg -> exchange -> final agg:
+    the q01 shape, as Spark serializes it."""
+    scan = {"class": f"{P}.FileSourceScanExec", "num-children": 0,
+            "output": [[attr("sr_store_sk", "long", 1)],
+                       [attr("sr_return_amt", "decimal(7,2)", 2)]],
+            "requiredSchema": {"type": "struct", "fields": []},
+            "partitionFilters": [], "dataFilters": [],
+            "tableIdentifier": "store_returns"}
+    filt = {"class": f"{P}.FilterExec", "num-children": 1, "condition":
+            binop("GreaterThan", [attr("sr_return_amt", "decimal(7,2)", 2)],
+                  [lit("500.00", "decimal(7,2)")]),
+            "child": 0}
+    partial = {"class": f"{P}.aggregate.HashAggregateExec", "num-children": 1,
+               "requiredChildDistributionExpressions": None,
+               "groupingExpressions": [[attr("sr_store_sk", "long", 1)]],
+               "aggregateExpressions": [
+                   agg_expr("Sum", "Partial", 10,
+                            [[attr("sr_return_amt", "decimal(7,2)", 2)]])],
+               "aggregateAttributes": [],
+               "initialInputBufferOffset": 0,
+               "resultExpressions": [], "child": 0}
+    exchange = {"class": f"{P}.exchange.ShuffleExchangeExec", "num-children": 1,
+                "outputPartitioning": [
+                    {"class": f"{SPARK}.catalyst.plans.physical.HashPartitioning",
+                     "num-children": 1, "expressions": [0],
+                     "numPartitions": 4},
+                    attr("sr_store_sk", "long", 1)],
+                "shuffleOrigin": {"object": f"{P}.exchange.ENSURE_REQUIREMENTS$"},
+                "child": 0}
+    final = {"class": f"{P}.aggregate.HashAggregateExec", "num-children": 1,
+             "requiredChildDistributionExpressions": [],
+             "groupingExpressions": [[attr("sr_store_sk", "long", 1)]],
+             "aggregateExpressions": [
+                 agg_expr("Sum", "Final", 10,
+                          [[attr("sr_return_amt", "decimal(7,2)", 2)]])],
+             "aggregateAttributes": [],
+             "initialInputBufferOffset": 0,
+             "resultExpressions": [], "child": 0}
+    return [final, exchange, partial, filt, scan]
+
+
+def test_bench_pipeline_via_serialized_ir(store_returns):
+    conv = SparkPlanConverter(tables={"store_returns": store_returns})
+    blob = conv.convert_to_proto(json.dumps(_bench_pipeline_json()))
+    assert isinstance(blob, bytes) and len(blob) > 50
+    plan = plan_from_bytes(blob)  # arrives from "outside" as proto bytes
+    with Session() as s:
+        out = s.execute_to_table(plan).to_pydict()
+    # oracle
+    tbl = pa.concat_tables([pq.read_table(p) for p in store_returns]).to_pandas()
+    tbl = tbl[tbl.sr_return_amt > decimal.Decimal("500.00")]
+    g = tbl.groupby("sr_store_sk").sr_return_amt.sum()
+    got = dict(zip(out["sr_store_sk#1"], out["sum#10"]))
+    assert got == g.to_dict()
+
+
+def test_join_query_via_converter(store_returns, tmp_path):
+    stores = pa.table({
+        "s_store_sk": pa.array(list(range(1, 50)), type=pa.int64()),
+        "s_city": pa.array([f"city{i % 5}" for i in range(1, 50)]),
+    })
+    spath = str(tmp_path / "store.parquet")
+    pq.write_table(stores, spath)
+
+    scan_sr = {"class": f"{P}.FileSourceScanExec", "num-children": 0,
+               "output": [[attr("sr_store_sk", "long", 1)],
+                          [attr("sr_return_amt", "decimal(7,2)", 2)]],
+               "partitionFilters": [], "dataFilters": [],
+               "tableIdentifier": "store_returns"}
+    scan_st = {"class": f"{P}.FileSourceScanExec", "num-children": 0,
+               "output": [[attr("s_store_sk", "long", 3)],
+                          [attr("s_city", "string", 4)]],
+               "partitionFilters": [], "dataFilters": [],
+               "tableIdentifier": "store"}
+    bcast = {"class": f"{P}.exchange.BroadcastExchangeExec", "num-children": 1,
+             "mode": {}, "child": 0}
+    join = {"class": f"{P}.joins.BroadcastHashJoinExec", "num-children": 2,
+            "leftKeys": [[attr("sr_store_sk", "long", 1)]],
+            "rightKeys": [[attr("s_store_sk", "long", 3)]],
+            "joinType": {"object": f"{SPARK}.catalyst.plans.Inner$"},
+            "buildSide": {"object": f"{P}.joins.BuildRight$"},
+            "condition": None, "left": 0, "right": 1}
+    plan_json = [join, scan_sr, bcast, scan_st]
+
+    conv = SparkPlanConverter(tables={"store_returns": store_returns,
+                                      "store": [spath]})
+    res = conv.convert(json.dumps(plan_json))
+    assert res.fully_native, res.tags
+    with Session() as s:
+        out = s.execute_to_table(res.plan).to_pydict()
+    n_sr = sum(pq.read_table(p).num_rows for p in store_returns)
+    assert len(out["s_city#4"]) == n_sr  # every sr row matches one store
+
+
+def test_window_query_via_converter(store_returns):
+    scan = {"class": f"{P}.FileSourceScanExec", "num-children": 0,
+            "output": [[attr("sr_store_sk", "long", 1)],
+                       [attr("sr_return_amt", "decimal(7,2)", 2)]],
+            "partitionFilters": [], "dataFilters": [],
+            "tableIdentifier": "store_returns"}
+    exchange = {"class": f"{P}.exchange.ShuffleExchangeExec", "num-children": 1,
+                "outputPartitioning": [
+                    {"class": f"{SPARK}.catalyst.plans.physical.HashPartitioning",
+                     "num-children": 1, "expressions": [0],
+                     "numPartitions": 3},
+                    attr("sr_store_sk", "long", 1)],
+                "shuffleOrigin": {"object": f"{P}.exchange.ENSURE_REQUIREMENTS$"},
+                "child": 0}
+    sort = {"class": f"{P}.SortExec", "num-children": 1,
+            "sortOrder": [sort_order([attr("sr_store_sk", "long", 1)]),
+                          sort_order([attr("sr_return_amt", "decimal(7,2)", 2)])],
+            "global": False, "child": 0}
+    wexpr = [{"class": f"{X}.Alias", "num-children": 1, "child": 0,
+              "name": "rn",
+              "exprId": {"product-class": f"{X}.ExprId", "id": 20,
+                         "jvmId": "00000000-0000-0000-0000-000000000000"},
+              "qualifier": []},
+             {"class": f"{X}.WindowExpression", "num-children": 2,
+              "windowFunction": 0, "windowSpec": 1},
+             {"class": f"{X}.RowNumber", "num-children": 0},
+             {"class": f"{X}.WindowSpecDefinition", "num-children": 0,
+              "partitionSpec": [], "orderSpec": [], "frameSpecification": {}}]
+    window = {"class": f"{P}.window.WindowExec", "num-children": 1,
+              "windowExpression": [wexpr],
+              "partitionSpec": [[attr("sr_store_sk", "long", 1)]],
+              "orderSpec": [sort_order([attr("sr_return_amt", "decimal(7,2)", 2)])],
+              "child": 0}
+    res = convert_spark_plan(json.dumps([window, sort, exchange, scan]),
+                             tables={"store_returns": store_returns})
+    assert res.fully_native, res.tags
+    with Session() as s:
+        out = s.execute_to_table(res.plan).to_pydict()
+    # row_number restarts at 1 per store and is dense
+    import collections
+
+    seen = collections.defaultdict(int)
+    by_store_rows = collections.defaultdict(list)
+    for sk, rn in zip(out["sr_store_sk#1"], out["rn#20"]):
+        by_store_rows[sk].append(rn)
+    for sk, rns in by_store_rows.items():
+        assert sorted(rns) == list(range(1, len(rns) + 1))
+
+
+def test_unsupported_node_falls_back_with_tag(store_returns):
+    scan = {"class": f"{P}.FileSourceScanExec", "num-children": 0,
+            "output": [[attr("sr_store_sk", "long", 1)]],
+            "partitionFilters": [], "dataFilters": [],
+            "tableIdentifier": "store_returns"}
+    exotic = {"class": f"{P}.python.ArrowEvalPythonExec", "num-children": 1,
+              "udfs": [], "child": 0}
+    res = convert_spark_plan(json.dumps([exotic, scan]),
+                             tables={"store_returns": store_returns})
+    assert not res.fully_native
+    assert res.plan is None
+    kinds = dict(res.tags)
+    assert kinds["FileSourceScanExec"] == "converted"  # child still converts
+    assert "no converter" in kinds["ArrowEvalPythonExec"]
+
+
+def test_disabled_operator_falls_back(store_returns):
+    from blaze_tpu.config import config_override
+
+    scan = {"class": f"{P}.FileSourceScanExec", "num-children": 0,
+            "output": [[attr("sr_store_sk", "long", 1)]],
+            "partitionFilters": [], "dataFilters": [],
+            "tableIdentifier": "store_returns"}
+    filt = {"class": f"{P}.FilterExec", "num-children": 1,
+            "condition": binop("GreaterThan", [attr("sr_store_sk", "long", 1)],
+                               [lit(10, "long")]),
+            "child": 0}
+    with config_override(enabled_ops={"filter": False}):
+        res = convert_spark_plan(json.dumps([filt, scan]),
+                                 tables={"store_returns": store_returns})
+    assert not res.fully_native
+    assert any("disabled" in t for _, t in res.tags)
+
+
+def test_scan_data_filters_prune(store_returns):
+    scan = {"class": f"{P}.FileSourceScanExec", "num-children": 0,
+            "output": [[attr("sr_store_sk", "long", 1)],
+                       [attr("sr_return_amt", "decimal(7,2)", 2)]],
+            "partitionFilters": [],
+            "dataFilters": [binop("LessThan", [attr("sr_store_sk", "long", 1)],
+                                  [lit(5, "long")])],
+            "tableIdentifier": "store_returns"}
+    res = convert_spark_plan(json.dumps([scan]),
+                             tables={"store_returns": store_returns})
+    assert res.fully_native, res.tags
+    with Session() as s:
+        out = s.execute_to_table(res.plan).to_pydict()
+    assert out["sr_store_sk#1"] and max(out["sr_store_sk#1"]) < 5
+
+
+def test_final_agg_result_expressions_projection(store_returns):
+    """Final-stage resultExpressions rename/reorder the agg output."""
+    plan_json = _bench_pipeline_json()
+    final = plan_json[0]
+    final["resultExpressions"] = [
+        [{"class": f"{X}.Alias", "num-children": 1, "child": 0, "name": "total",
+          "exprId": {"product-class": f"{X}.ExprId", "id": 30,
+                     "jvmId": "00000000-0000-0000-0000-000000000000"},
+          "qualifier": []},
+         {"class": f"{X}.AttributeReference", "num-children": 0,
+          "name": "sum", "dataType": "decimal(17,2)", "nullable": True,
+          "metadata": {},
+          "exprId": {"product-class": f"{X}.ExprId", "id": 10,
+                     "jvmId": "00000000-0000-0000-0000-000000000000"},
+          "qualifier": []}],
+        [attr("sr_store_sk", "long", 1)],
+    ]
+    res = convert_spark_plan(json.dumps(plan_json),
+                             tables={"store_returns": store_returns})
+    assert res.fully_native, res.tags
+    with Session() as s:
+        out = s.execute_to_table(res.plan).to_pydict()
+    assert list(out.keys()) == ["total#30", "sr_store_sk#1"]  # renamed+reordered
+    tbl = pa.concat_tables([pq.read_table(p) for p in store_returns]).to_pandas()
+    tbl = tbl[tbl.sr_return_amt > decimal.Decimal("500.00")]
+    g = tbl.groupby("sr_store_sk").sr_return_amt.sum()
+    assert dict(zip(out["sr_store_sk#1"], out["total#30"])) == g.to_dict()
+
+
+def test_non_default_window_frame_falls_back(store_returns):
+    scan = {"class": f"{P}.FileSourceScanExec", "num-children": 0,
+            "output": [[attr("sr_store_sk", "long", 1)]],
+            "partitionFilters": [], "dataFilters": [],
+            "tableIdentifier": "store_returns"}
+    wexpr = [{"class": f"{X}.Alias", "num-children": 1, "child": 0, "name": "s",
+              "exprId": {"product-class": f"{X}.ExprId", "id": 21,
+                         "jvmId": "00000000-0000-0000-0000-000000000000"},
+              "qualifier": []},
+             {"class": f"{X}.WindowExpression", "num-children": 2,
+              "windowFunction": 0, "windowSpec": 1},
+             {"class": f"{X}.RowNumber", "num-children": 0},
+             {"class": f"{X}.WindowSpecDefinition", "num-children": 0,
+              "partitionSpec": [], "orderSpec": [],
+              "frameSpecification": {
+                  "class": f"{X}.SpecifiedWindowFrame",
+                  "frameType": {"object": f"{X}.RowFrame$"},
+                  "lower": {"class": f"{X}.Literal", "value": "-2",
+                            "dataType": "integer"},
+                  "upper": {"object": f"{X}.CurrentRow$"}}}]
+    window = {"class": f"{P}.window.WindowExec", "num-children": 1,
+              "windowExpression": [wexpr],
+              "partitionSpec": [[attr("sr_store_sk", "long", 1)]],
+              "orderSpec": [], "child": 0}
+    res = convert_spark_plan(json.dumps([window, scan]),
+                             tables={"store_returns": store_returns})
+    assert not res.fully_native
+    assert any("frame" in t for _, t in res.tags)
+
+
+def test_partition_filters_fall_back(store_returns):
+    scan = {"class": f"{P}.FileSourceScanExec", "num-children": 0,
+            "output": [[attr("sr_store_sk", "long", 1)]],
+            "partitionFilters": [binop("EqualTo",
+                                       [attr("dt", "string", 9)],
+                                       [lit("2020-01-01", "string")])],
+            "dataFilters": [], "tableIdentifier": "store_returns"}
+    res = convert_spark_plan(json.dumps([scan]),
+                             tables={"store_returns": store_returns})
+    assert not res.fully_native
+    assert any("partitionFilters" in t for _, t in res.tags)
