@@ -215,7 +215,14 @@ def expr_to_proto(e: E.Expr) -> pb.ExprNode:
     elif isinstance(e, E.AggExpr):
         m.agg.CopyFrom(agg_to_proto(e))
     elif isinstance(e, E.PyUDF):
-        m.py_udf.import_path = f"{e.fn.__module__}:{e.fn.__qualname__}"
+        if _resolvable_function(e.fn):
+            m.py_udf.import_path = f"{e.fn.__module__}:{e.fn.__qualname__}"
+        else:
+            # stateful callable / closure: ship pickled (reference ships
+            # serialized Spark closures the same way)
+            import pickle as _pickle
+
+            m.py_udf.pickled = _pickle.dumps(e.fn, protocol=4)
         for a in e.args:
             m.py_udf.args.append(expr_to_proto(a))
         m.py_udf.return_type.CopyFrom(type_to_proto(e.return_type))
@@ -223,6 +230,27 @@ def expr_to_proto(e: E.Expr) -> pb.ExprNode:
     else:
         raise NotImplementedError(f"proto expr {type(e).__name__}")
     return m
+
+
+def _resolvable_function(fn) -> bool:
+    """True only for plain module-level functions whose import path resolves
+    back to the SAME object — lambdas ('<lambda>'), closures ('<locals>'),
+    bound methods (state-dropping), and callable instances all ship pickled
+    instead."""
+    import types as _types
+
+    if not isinstance(fn, _types.FunctionType):
+        return False
+    qual = getattr(fn, "__qualname__", "")
+    if not qual or "<" in qual:
+        return False
+    try:
+        obj = importlib.import_module(fn.__module__)
+        for part in qual.split("."):
+            obj = getattr(obj, part)
+        return obj is fn
+    except (ImportError, AttributeError):
+        return False
 
 
 def sort_order_to_proto(so: E.SortOrder) -> pb.SortOrderExpr:
@@ -328,10 +356,15 @@ def expr_from_proto(m: pb.ExprNode) -> E.Expr:
     if which == "agg":
         return agg_from_proto(m.agg)
     if which == "py_udf":
-        mod, qual = m.py_udf.import_path.split(":")
-        fn = importlib.import_module(mod)
-        for part in qual.split("."):
-            fn = getattr(fn, part)
+        if m.py_udf.pickled:
+            import pickle as _pickle
+
+            fn = _pickle.loads(m.py_udf.pickled)
+        else:
+            mod, qual = m.py_udf.import_path.split(":")
+            fn = importlib.import_module(mod)
+            for part in qual.split("."):
+                fn = getattr(fn, part)
         return E.PyUDF(fn, [expr_from_proto(a) for a in m.py_udf.args],
                        type_from_proto(m.py_udf.return_type), m.py_udf.name)
     raise NotImplementedError(f"proto expr {which}")

@@ -178,3 +178,32 @@ class RssShuffleWriterExec(Operator):
         writer.flush()
         return
         yield  # pragma: no cover
+
+class FileSegmentBlockProvider:
+    """Picklable reducer->blocks mapping over map-output data+index files —
+    the resource an IpcReader pulls (reference: fetched BlockObjects served
+    as file segments, ipc_reader_exec.rs:185-325). Plain data, so it crosses
+    the driver->worker process boundary intact."""
+
+    def __init__(self, indexes):
+        # [(data_path, offsets int64[num_reducers+1]), ...]
+        self.indexes = [(path, np.asarray(offsets)) for path, offsets in indexes]
+
+    def __call__(self, reducer: int):
+        blocks = []
+        for data, offsets in self.indexes:
+            start, end = int(offsets[reducer]), int(offsets[reducer + 1])
+            if end > start:
+                blocks.append(("file_segment", data, start, end - start))
+        return blocks
+
+
+class BytesBlockProvider:
+    """Picklable provider serving in-memory IPC chunks to every partition
+    (broadcast collect, reference: TorrentBroadcast of IPC byte arrays)."""
+
+    def __init__(self, chunks):
+        self.chunks = list(chunks)
+
+    def __call__(self, partition: int):
+        return [("bytes", b) for b in self.chunks]

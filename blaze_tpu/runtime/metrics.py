@@ -61,6 +61,18 @@ class MetricNode:
     def total(self, metric: str) -> int:
         return self.get(metric) + sum(c.total(metric) for c in self.children)
 
+    def merge_dict(self, d: dict):
+        """Fold a serialized metric tree (to_dict of a remote task) into
+        this node — how worker-process task metrics reach the driver's tree
+        (reference: update_spark_metric_node pushing native metrics into the
+        JVM MetricNode mirror at task end). Children merge POSITIONALLY:
+        remote node names embed the remote root's prefix, and name-keyed
+        merging would give pool and in-driver runs different tree shapes."""
+        for k, v in (d.get("values") or {}).items():
+            self.add(k, v)
+        for i, c in enumerate(d.get("children") or []):
+            self.child(i).merge_dict(c)
+
 
 class Timer:
     """Accumulates nanoseconds into a metric. The reference subtracts

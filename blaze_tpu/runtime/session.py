@@ -30,19 +30,27 @@ from blaze_tpu.core.batch import ColumnarBatch
 from blaze_tpu.ir import nodes as N
 from blaze_tpu.ir import types as T
 from blaze_tpu.ops.base import ExecContext, Operator, TaskContext
-from blaze_tpu.ops.shuffle.writer import read_index_file
+from blaze_tpu.ops.shuffle.writer import (BytesBlockProvider,
+                                           FileSegmentBlockProvider,
+                                           read_index_file)
 from blaze_tpu.runtime.executor import build_operator
 from blaze_tpu.runtime.metrics import MetricNode
 
 
 class Session:
     def __init__(self, conf: Optional[Config] = None, work_dir: Optional[str] = None,
-                 max_workers: Optional[int] = None, mesh=None):
+                 max_workers: Optional[int] = None, mesh=None,
+                 num_worker_processes: int = 0):
         """``mesh``: a jax.sharding.Mesh. When given, ShuffleExchanges whose
         reducer count fits the mesh lower to the ICI all-to-all transport
         (parallel/mesh.py MeshBatchExchange) instead of shuffle files — the
         reference's netty block fetch becomes an XLA collective
-        (SURVEY.md §5.8). Exchanges that don't fit fall back to files."""
+        (SURVEY.md §5.8). Exchanges that don't fit fall back to files.
+
+        ``num_worker_processes``: when > 0, shuffle MAP tasks ship as proto
+        TaskDefinitions to a pool of OS worker processes (runtime/cluster.py)
+        — real process isolation with task retry on worker loss, the
+        standalone analogue of Spark executors running the native engine."""
         from blaze_tpu.utils.native import ensure_built_async
 
         ensure_built_async()  # background; numpy fallbacks serve meanwhile
@@ -54,6 +62,12 @@ class Session:
                 f"Session needs a 1-D mesh (one exchange axis), got "
                 f"axes {mesh.axis_names}")
         self.mesh = mesh
+        self.num_worker_processes = num_worker_processes
+        self.pool = None
+        if num_worker_processes > 0:
+            from blaze_tpu.runtime.cluster import WorkerPool
+
+            self.pool = WorkerPool(num_worker_processes)
         self.resources = {}
         self._ids = itertools.count()
         self._stage_ids = itertools.count()
@@ -151,6 +165,9 @@ class Session:
         session closes its durable intermediates go too)."""
         import shutil
 
+        if self.pool is not None:
+            self.pool.close()
+            self.pool = None
         self.resources.clear()
         shutil.rmtree(self.work_dir, ignore_errors=True)
 
@@ -243,8 +260,9 @@ class Session:
         return dataclasses.replace(part, bounds=bounds)
 
     def _run_shuffle_map_stage(self, node: N.ShuffleExchange) -> N.PlanNode:
-        """Execute the map side (one ShuffleWriter task per child partition),
-        then expose the per-reducer file segments as an IpcReader resource."""
+        """Execute the map side (one ShuffleWriter task per child partition)
+        — on the process pool when configured, else on driver threads — then
+        expose the per-reducer file segments as an IpcReader resource."""
         stage = next(self._stage_ids)
         child_op = build_operator(node.child)
         num_maps = child_op.num_partitions()
@@ -252,37 +270,35 @@ class Session:
         shuffle_dir = os.path.join(self.work_dir, f"shuffle_{stage}")
         os.makedirs(shuffle_dir, exist_ok=True)
 
-        def run_map(m: int):
-            from blaze_tpu.ops.shuffle.writer import ShuffleWriterExec
-            from blaze_tpu.utils.logutil import clear_task_context, set_task_context
+        def paths_for(m: int):
+            return (os.path.join(shuffle_dir, f"map_{m}.data"),
+                    os.path.join(shuffle_dir, f"map_{m}.index"))
 
-            data = os.path.join(shuffle_dir, f"map_{m}.data")
-            index = os.path.join(shuffle_dir, f"map_{m}.index")
-            writer = ShuffleWriterExec(child_op, node.partitioning, data, index)
-            ctx = self._make_ctx(m, stage)
-            task_metrics = self.metrics.named_child(f"stage_{stage}").named_child(f"map_{m}")
-            set_task_context(stage, m)
-            try:
-                for _ in writer.execute(m, ctx, task_metrics):
-                    pass
-            finally:
-                clear_task_context()
-            return data, index
+        outputs = None
+        if self.pool is not None:
+            outputs = self._run_map_stage_on_pool(node, stage, num_maps, paths_for)
+        if outputs is None:
+            def run_map(m: int):
+                from blaze_tpu.ops.shuffle.writer import ShuffleWriterExec
+                from blaze_tpu.utils.logutil import clear_task_context, set_task_context
 
-        outputs = self._run_tasks(run_map, range(num_maps))
+                data, index = paths_for(m)
+                writer = ShuffleWriterExec(child_op, node.partitioning, data, index)
+                ctx = self._make_ctx(m, stage)
+                task_metrics = self.metrics.named_child(f"stage_{stage}").named_child(f"map_{m}")
+                set_task_context(stage, m)
+                try:
+                    for _ in writer.execute(m, ctx, task_metrics):
+                        pass
+                finally:
+                    clear_task_context()
+                return data, index
+
+            outputs = self._run_tasks(run_map, range(num_maps))
 
         indexes = [(data, read_index_file(index)) for data, index in outputs]
-
-        def block_provider(reducer: int):
-            blocks = []
-            for data, offsets in indexes:
-                start, end = int(offsets[reducer]), int(offsets[reducer + 1])
-                if end > start:
-                    blocks.append(("file_segment", data, start, end - start))
-            return blocks
-
         rid = f"shuffle_{stage}"
-        self.resources[rid] = block_provider
+        self.resources[rid] = FileSegmentBlockProvider(indexes)
         # coalesce reducer input: maps emit many small (e.g. per-batch
         # partial-agg) batches; merging them cuts downstream per-batch
         # overheads (reference: ExecutionContext.coalesce on every stream)
@@ -361,6 +377,45 @@ class Session:
                           num_partitions=num_reducers),
             batch_size=0)
 
+    def _run_map_stage_on_pool(self, node: N.ShuffleExchange, stage: int,
+                               num_maps: int, paths_for):
+        """Ship map tasks to worker processes as proto TaskDefinitions.
+        Returns None (-> in-driver fallback) when the plan or its resources
+        cannot cross the process boundary (e.g. mesh BatchSource handles,
+        python UDF closures)."""
+        import dataclasses as _dc
+        import pickle
+
+        from blaze_tpu.ir.protoserde import task_definition_to_bytes
+
+        conf_dict = _dc.asdict(self.conf)
+        try:
+            resources = {k: v for k, v in self.resources.items()}
+            pickle.dumps(resources, protocol=4)
+            msgs = []
+            for m in range(num_maps):
+                data, index = paths_for(m)
+                writer_node = N.ShuffleWriter(node.child, node.partitioning,
+                                              data, index)
+                task_bytes = task_definition_to_bytes(stage, m, m, writer_node)
+                msgs.append({"task_bytes": task_bytes, "conf": conf_dict})
+        except (NotImplementedError, TypeError, AttributeError,
+                pickle.PicklingError) as exc:
+            import logging
+
+            logging.getLogger("blaze_tpu.session").info(
+                "map stage %d not shippable to worker pool (%s); running "
+                "in-driver", stage, exc)
+            return None
+        # stage resources (shuffle block indexes, broadcast chunks) go to
+        # each worker ONCE, not inside every task message
+        replies = self.pool.run_tasks(msgs, shared=resources)
+        stage_metrics = self.metrics.named_child(f"stage_{stage}")
+        for m, r in enumerate(replies):
+            stage_metrics.named_child(f"map_{m}").merge_dict(
+                r.get("metrics") or {})
+        return [paths_for(m) for m in range(num_maps)]
+
     def _run_broadcast_collect(self, node: N.BroadcastExchange) -> N.PlanNode:
         """Collect the child via IpcWriter into in-memory chunks and expose
         them as a single-partition IpcReader readable by every task
@@ -396,7 +451,7 @@ class Session:
 
         self._run_tasks(run_map, range(num_maps))
         rid = f"broadcast_{stage}"
-        self.resources[rid] = lambda p: [("bytes", b) for b in chunks]
+        self.resources[rid] = BytesBlockProvider(chunks)
         return N.IpcReader(schema=node.child.output_schema, resource_id=rid,
                            num_partitions=1)
 

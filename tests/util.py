@@ -45,3 +45,21 @@ def collect(op: Operator, ctx=None):
 
 def collect_pydict(op: Operator, ctx=None):
     return collect(op, ctx).to_pydict()
+
+
+class CrashOnce:
+    """Worker-crash fixture UDF: hard-kills the hosting process on the first
+    call (marker file absent), passes through afterwards. Module-level class
+    so it pickles by reference across the driver->worker boundary."""
+
+    def __init__(self, marker_path):
+        self.marker_path = marker_path
+
+    def __call__(self, x):
+        import os
+
+        if not os.path.exists(self.marker_path):
+            with open(self.marker_path, "w") as f:
+                f.write("attempt")
+            os._exit(9)
+        return x
