@@ -121,8 +121,11 @@ class OrcScanExec(Operator):
 
         import pyarrow.compute as pc
 
+        from blaze_tpu.io import fs as FS
+
         try:
-            key = (path, os.path.getmtime(path))
+            key = (path, os.path.getmtime(path)) if not FS.has_scheme(path) \
+                else (path, float(FS.getsize(path)))
         except OSError:
             key = (path, 0.0)
         hit = _STATS_CACHE.get(key)
@@ -162,8 +165,10 @@ class OrcScanExec(Operator):
         prune = prune and pred_cols and all(c in file_names for c in pred_cols)
         row_filter = predicate_to_arrow(self.predicate, self.conf.file_schema) \
             if self.predicate is not None else None
+        from blaze_tpu.io import fs as FS
+
         for pfile in self.conf.file_groups[partition].files:
-            f = orc.ORCFile(pfile.path)
+            f = orc.ORCFile(FS.open_input(pfile.path))
             stats = self._stripe_stats(f, pfile.path, pred_cols) \
                 if prune and f.nstripes > 1 else None
             for stripe_i in range(f.nstripes):

@@ -173,7 +173,9 @@ class ParquetScanExec(Operator):
                         # offset midpoint falls inside [start, end) — the
                         # same ownership rule Spark/parquet splits use, so
                         # every row group is read by exactly one split
-                        pf = pq.ParquetFile(pfile.path)
+                        from blaze_tpu.io import fs as FS
+
+                        pf = pq.ParquetFile(FS.open_input(pfile.path))
                         rgs = []
                         for i in range(pf.metadata.num_row_groups):
                             rg = pf.metadata.row_group(i)
@@ -190,7 +192,10 @@ class ParquetScanExec(Operator):
                             if not _put((pfile, rb)):
                                 return
                         continue
-                    ds = pads.dataset(pfile.path, format="parquet")
+                    from blaze_tpu.io import fs as FS
+
+                    afs, apath = FS.arrow_filesystem(pfile.path)
+                    ds = pads.dataset(apath, format="parquet", filesystem=afs)
                     scanner = ds.scanner(columns=proj_names, filter=filt,
                                          batch_size=batch_size)
                     for rb in scanner.to_batches():
@@ -260,7 +265,9 @@ class ParquetSinkExec(Operator):
         super().__init__(child.schema, [child])
 
     def _execute(self, partition, ctx, metrics):
-        os.makedirs(self.fs_path, exist_ok=True)
+        from blaze_tpu.io import fs as FS
+
+        FS.makedirs(self.fs_path)
         writers = {}
         compression = self.props.get("compression", "zstd")
         ndp = self.num_dyn_parts
@@ -301,12 +308,16 @@ class ParquetSinkExec(Operator):
         yield  # pragma: no cover
 
     def _write(self, writers, subdir, rb, partition, compression):
+        from blaze_tpu.io import fs as FS
+
         key = subdir
         if key not in writers:
-            d = os.path.join(self.fs_path, subdir) if subdir else self.fs_path
-            os.makedirs(d, exist_ok=True)
-            path = os.path.join(d, f"part-{partition:05d}.parquet")
-            writers[key] = pq.ParquetWriter(path, rb.schema, compression=compression)
+            base = self.fs_path.rstrip("/")
+            d = f"{base}/{subdir}" if subdir else base
+            FS.makedirs(d)
+            path = f"{d}/part-{partition:05d}.parquet"
+            writers[key] = pq.ParquetWriter(FS.open_output(path), rb.schema,
+                                            compression=compression)
         writers[key].write_batch(rb)
 
 
@@ -324,10 +335,13 @@ def scan_node_for_files(paths: List[str], num_partitions: int = 1,
                         predicate: Optional[E.Expr] = None) -> N.ParquetScan:
     """Convenience: build a ParquetScan node over local files, splitting files
     round-robin into partitions (driver-side planning helper)."""
-    schema = T.schema_from_arrow(pq.read_schema(paths[0]))
+    from blaze_tpu.io import fs as FS
+
+    with FS.open_input(paths[0]) as f0:
+        schema = T.schema_from_arrow(pq.read_schema(f0))
     groups = [[] for _ in range(num_partitions)]
     for i, p in enumerate(paths):
-        size = os.path.getsize(p)
+        size = FS.getsize(p)
         groups[i % num_partitions].append(N.PartitionedFile(p, size))
     if projection is None:
         proj = list(range(len(schema)))

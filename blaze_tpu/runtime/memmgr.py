@@ -191,10 +191,21 @@ class SpillFile:
     Spill trait + try_new_spill; we always use the disk backend)."""
 
     def __init__(self, prefix: str = "spill"):
+        import uuid
+
+        from blaze_tpu.io import fs as FS
+
         cfg = get_config()
-        os.makedirs(cfg.spill_dir, exist_ok=True)
-        fd, self.path = tempfile.mkstemp(prefix=prefix + "-", dir=cfg.spill_dir)
-        self._file: Optional[BinaryIO] = os.fdopen(fd, "w+b")
+        if FS.has_scheme(cfg.spill_dir):
+            # remote spill dir (reference: spills routed through the JVM
+            # Hadoop FS when configured, spill.rs backends)
+            FS.makedirs(cfg.spill_dir)
+            self.path = f"{cfg.spill_dir.rstrip('/')}/{prefix}-{uuid.uuid4().hex}"
+            self._file: Optional[BinaryIO] = _RemoteSpillHandle(self.path)
+        else:
+            os.makedirs(cfg.spill_dir, exist_ok=True)
+            fd, self.path = tempfile.mkstemp(prefix=prefix + "-", dir=cfg.spill_dir)
+            self._file = os.fdopen(fd, "w+b")
         from blaze_tpu.io.batch_serde import BatchWriter
 
         self.writer = BatchWriter(self._file, codec=cfg.spill_compression_codec)
@@ -213,10 +224,16 @@ class SpillFile:
         return self.writer.bytes_written
 
     def release(self):
+        from blaze_tpu.io import fs as FS
+
         if self._file is not None:
             self._file.close()
             self._file = None
-        if os.path.exists(self.path):
+        if FS.has_scheme(self.path):
+            fs, p = FS.get_fs(self.path)
+            if fs.exists(p):
+                fs.rm(p)
+        elif os.path.exists(self.path):
             os.unlink(self.path)
 
     def __del__(self):
@@ -224,3 +241,52 @@ class SpillFile:
             self.release()
         except Exception:
             pass
+
+
+class _RemoteSpillHandle:
+    """Read/write file handle over a remote (fsspec) spill object: buffered
+    writes upload on flush; reads open the uploaded object. Supports the
+    SpillFile access pattern (append-writes, then seek(0)+sequential or
+    ranged reads)."""
+
+    def __init__(self, path: str):
+        import io as _io
+
+        self.path = path
+        self._buf = _io.BytesIO()
+        self._uploaded = False
+        self._reader = None
+
+    # write side ------------------------------------------------------------
+    def write(self, b):
+        return self._buf.write(b)
+
+    def tell(self):
+        return self._reader.tell() if self._reader is not None else self._buf.tell()
+
+    def flush(self):
+        from blaze_tpu.io import fs as FS
+
+        with FS.open_output(self.path) as out:
+            out.write(self._buf.getvalue())
+        self._uploaded = True
+
+    # read side -------------------------------------------------------------
+    def seek(self, pos, whence=0):
+        if not self._uploaded:
+            self.flush()
+        if self._reader is None:
+            from blaze_tpu.io import fs as FS
+
+            self._reader = FS.open_input(self.path)
+        return self._reader.seek(pos, whence)
+
+    def read(self, n=-1):
+        if self._reader is None:
+            self.seek(0)
+        return self._reader.read(n)
+
+    def close(self):
+        if self._reader is not None:
+            self._reader.close()
+            self._reader = None

@@ -1,0 +1,137 @@
+"""Remote filesystem provider (VERDICT round-1 item 6): scan, sink, and
+spill run against a non-posix filesystem (fsspec ``memory://``) — the
+standalone analogue of hadoop_fs.rs routing all IO through Hadoop
+FileSystem."""
+
+import decimal
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.orc
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu.config import config_override
+from blaze_tpu.io import fs as FS
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import nodes as N
+from blaze_tpu.ir import types as T
+from blaze_tpu.runtime.executor import build_operator
+from blaze_tpu.runtime.session import Session
+from tests.util import collect_pydict, mem_scan
+
+
+@pytest.fixture
+def memfs():
+    import fsspec
+
+    fs = fsspec.filesystem("memory")
+    # each test starts with a clean store
+    for p in list(fs.store):
+        try:
+            fs.rm(p)
+        except Exception:
+            pass
+    return fs
+
+
+def _write_remote_parquet(fs, path, tbl):
+    with fs.open(path, "wb") as f:
+        pq.write_table(tbl, f)
+
+
+def test_parquet_scan_from_memory_fs(memfs):
+    tbl = pa.table({
+        "id": pa.array(range(5000), type=pa.int64()),
+        "name": pa.array([f"n{i % 11}" for i in range(5000)]),
+    })
+    _write_remote_parquet(memfs, "/data/t.parquet", tbl)
+    from blaze_tpu.ops.parquet import scan_node_for_files
+
+    node = scan_node_for_files(["memory:///data/t.parquet"])
+    out = collect_pydict(build_operator(node))
+    assert out["id"] == list(range(5000))
+    assert out["name"][:3] == ["n0", "n1", "n2"]
+
+
+def test_parquet_sink_to_memory_fs(memfs):
+    data = {
+        "k": pa.array([1, 2, 1, 3], type=pa.int64()),
+        "v": pa.array(["a", "b", "c", "d"]),
+    }
+    scan = mem_scan(data)
+    from blaze_tpu.ops.parquet import ParquetSinkExec
+
+    sink = ParquetSinkExec(scan, "memory:///out", num_dyn_parts=0)
+    from blaze_tpu.ops.base import ExecContext
+
+    list(sink.execute(0, ExecContext()))
+    files = [p for p in memfs.ls("/out", detail=False)]
+    assert files, "sink must write into the remote fs"
+    with memfs.open(files[0], "rb") as f:
+        back = pq.read_table(f)
+    assert back.to_pydict() == {"k": [1, 2, 1, 3], "v": ["a", "b", "c", "d"]}
+
+
+def test_orc_scan_from_memory_fs(memfs):
+    tbl = pa.table({"x": pa.array(range(2000), type=pa.int64())})
+    with memfs.open("/data/t.orc", "wb") as f:
+        pyarrow.orc.write_table(tbl, f)
+    from blaze_tpu.ops.orc import OrcScanExec
+
+    schema = T.Schema.of(("x", T.I64))
+    conf = N.FileScanConf(
+        file_groups=[N.FileGroup(files=[
+            N.PartitionedFile("memory:///data/t.orc", FS.getsize("memory:///data/t.orc"))])],
+        file_schema=schema,
+        projection=[0],
+    )
+    out = collect_pydict(OrcScanExec(conf))
+    assert out["x"] == list(range(2000))
+
+
+def test_spill_to_memory_fs(memfs):
+    from blaze_tpu.runtime.memmgr import SpillFile
+    from blaze_tpu.core.batch import ColumnarBatch
+
+    with config_override(spill_dir="memory:///spills"):
+        sp = SpillFile("t")
+        b = ColumnarBatch.from_pydict({"a": pa.array([1, 2, 3], type=pa.int64())})
+        sp.writer.write_batch(b)
+        sp.finish_write()
+        assert memfs.ls("/spills", detail=False), "spill object must exist remotely"
+        got = [bb.to_pydict() for bb in sp.read_batches()]
+        assert got == [{"a": [1, 2, 3]}]
+        sp.release()
+        assert not memfs.ls("/spills", detail=False)
+
+
+def test_end_to_end_query_over_memory_fs(memfs):
+    rng = np.random.default_rng(31)
+    n = 10_000
+    tbl = pa.table({
+        "k": pa.array(rng.integers(0, 30, n), type=pa.int64()),
+        "amt": pa.array([decimal.Decimal(int(v)).scaleb(-2)
+                         for v in rng.integers(0, 10000, n)],
+                        type=pa.decimal128(9, 2)),
+    })
+    _write_remote_parquet(memfs, "/warehouse/t1.parquet", tbl.slice(0, n // 2))
+    _write_remote_parquet(memfs, "/warehouse/t2.parquet", tbl.slice(n // 2))
+    from blaze_tpu.ops.parquet import scan_node_for_files
+
+    scan = scan_node_for_files(["memory:///warehouse/t1.parquet",
+                                "memory:///warehouse/t2.parquet"],
+                               num_partitions=2)
+    partial = N.Agg(scan, E.AggExecMode.HASH_AGG, [("k", E.Column("k"))], [
+        N.AggColumn(E.AggExpr(E.AggFunction.SUM, [E.Column("amt")],
+                              T.DecimalType(19, 2)), E.AggMode.PARTIAL, "s")])
+    ex = N.ShuffleExchange(partial, N.SinglePartitioning(1))
+    final = N.Agg(ex, E.AggExecMode.HASH_AGG, [("k", E.Column("k"))], [
+        N.AggColumn(E.AggExpr(E.AggFunction.SUM, [E.Column("amt")],
+                              T.DecimalType(19, 2)), E.AggMode.FINAL, "s")])
+    plan = N.Sort(final, [E.SortOrder(E.Column("k"))])
+    with Session() as s:
+        out = s.execute_to_table(plan).to_pydict()
+    df = tbl.to_pandas().groupby("k").amt.sum()
+    assert out["k"] == sorted(df.index.tolist())
+    assert out["s"] == [df[k] for k in out["k"]]
