@@ -40,6 +40,14 @@ class Config:
     # bounded).
     mem_wait_timeout_s: float = 2.0
 
+    # AQE skew-join splitting (reference: isSkewJoin + partial shuffle reads
+    # flowing through the IR, AuronConverters.scala:420-489): a reducer
+    # whose stream-side bytes exceed factor x median (and the floor) splits
+    # into map-subset sub-partitions joined against the full other side.
+    skew_join_enable: bool = True
+    skew_join_factor: float = 3.0
+    skew_join_min_bytes: int = 64 << 20
+
     # Device HBM budget for resident batch data (bytes). None = ask the device.
     hbm_budget: Optional[int] = None
 

@@ -37,10 +37,38 @@ from blaze_tpu.runtime.executor import build_operator
 from blaze_tpu.runtime.metrics import MetricNode
 
 
+class _SubsetBlockProvider:
+    """Sub-partition -> file-segment blocks for the skew-join split: each
+    sub-partition p maps to (reducer, optional map subset); when
+    ``subset_applies`` (the split side) only the subset's map files serve,
+    otherwise the FULL reducer partition is duplicated into every split
+    (reference: partial shuffle reads, isShuffleReadFull=false)."""
+
+    def __init__(self, indexes, parts, subset_applies: bool):
+        import numpy as np
+
+        self.indexes = [(path, np.asarray(offsets)) for path, offsets in indexes]
+        self.parts = parts
+        self.subset_applies = subset_applies
+
+    def __call__(self, p: int):
+        reducer, subset = self.parts[p]
+        maps = subset if (self.subset_applies and subset is not None) \
+            else range(len(self.indexes))
+        blocks = []
+        for m in maps:
+            data, offsets = self.indexes[m]
+            start, end = int(offsets[reducer]), int(offsets[reducer + 1])
+            if end > start:
+                blocks.append(("file_segment", data, start, end - start))
+        return blocks
+
+
 class Session:
     def __init__(self, conf: Optional[Config] = None, work_dir: Optional[str] = None,
                  max_workers: Optional[int] = None, mesh=None,
-                 num_worker_processes: int = 0):
+                 num_worker_processes: int = 0,
+                 rss_sock_path: Optional[str] = None):
         """``mesh``: a jax.sharding.Mesh. When given, ShuffleExchanges whose
         reducer count fits the mesh lower to the ICI all-to-all transport
         (parallel/mesh.py MeshBatchExchange) instead of shuffle files — the
@@ -62,6 +90,9 @@ class Session:
                 f"Session needs a 1-D mesh (one exchange axis), got "
                 f"axes {mesh.axis_names}")
         self.mesh = mesh
+        # push-shuffle through a remote shuffle service (runtime/rss.py) —
+        # the Celeborn/Uniffle role, SURVEY.md §2.6
+        self.rss_sock_path = rss_sock_path
         self.num_worker_processes = num_worker_processes
         self.pool = None
         if num_worker_processes > 0:
@@ -189,7 +220,18 @@ class Session:
 
     def _lower(self, node: N.PlanNode) -> N.PlanNode:
         self._check_op_enabled(node)
-        node = N.map_children(node, self._lower)
+        if isinstance(node, N.SortMergeJoin) and self.conf.skew_join_enable \
+                and self.mesh is None and self.rss_sock_path is None \
+                and getattr(self, "_dist_ok", True):
+            out = self._try_skew_join(node)
+            if out is not None:
+                return out
+        prev_dist_ok = getattr(self, "_dist_ok", True)
+        self._dist_ok = self._child_dist_ok(node, prev_dist_ok)
+        try:
+            node = N.map_children(node, self._lower)
+        finally:
+            self._dist_ok = prev_dist_ok
         if isinstance(node, N.ShuffleExchange):
             if isinstance(node.partitioning, N.RangePartitioning) and \
                     not node.partitioning.bounds and \
@@ -202,10 +244,29 @@ class Session:
             if self.mesh is not None and \
                     node.partitioning.num_partitions <= self.mesh.devices.size:
                 return self._run_mesh_exchange(node)
+            if self.rss_sock_path is not None:
+                return self._run_rss_map_stage(node)
             return self._run_shuffle_map_stage(node)
         if isinstance(node, N.BroadcastExchange):
             return self._run_broadcast_collect(node)
         return node
+
+    @staticmethod
+    def _child_dist_ok(node: N.PlanNode, own_dist_ok: bool) -> bool:
+        """May a child's output partitioning (count/assignment) change under
+        this node? Exchanges re-partition (always yes); row-local operators
+        pass their own freedom through; partition-zipping or
+        distribution-assuming operators (joins, aggs, windows, unions) pin
+        their children — Spark's OptimizeSkewedJoin applies the same 'no
+        parent requires the distribution' rule."""
+        if isinstance(node, (N.ShuffleExchange, N.BroadcastExchange)):
+            return True
+        if isinstance(node, (N.Projection, N.Filter, N.Limit,
+                             N.CoalesceBatches, N.Debug, N.RenameColumns,
+                             N.Sort, N.Generate, N.Expand, N.ParquetSink,
+                             N.BroadcastJoin)):
+            return own_dist_ok
+        return False
 
     def _check_op_enabled(self, node: N.PlanNode):
         """Per-operator gating (reference: spark.auron.enable.<op> flags in
@@ -259,14 +320,12 @@ class Session:
             bounds.append(samples[min(len(samples) - 1, i * len(samples) // n)])
         return dataclasses.replace(part, bounds=bounds)
 
-    def _run_shuffle_map_stage(self, node: N.ShuffleExchange) -> N.PlanNode:
-        """Execute the map side (one ShuffleWriter task per child partition)
-        — on the process pool when configured, else on driver threads — then
-        expose the per-reducer file segments as an IpcReader resource."""
+    def _exec_map_stage(self, node: N.ShuffleExchange):
+        """Run one exchange's map side to files; returns (stage,
+        [(data_path, offsets)] per map)."""
         stage = next(self._stage_ids)
         child_op = build_operator(node.child)
         num_maps = child_op.num_partitions()
-        num_reducers = node.partitioning.num_partitions
         shuffle_dir = os.path.join(self.work_dir, f"shuffle_{stage}")
         os.makedirs(shuffle_dir, exist_ok=True)
 
@@ -296,7 +355,14 @@ class Session:
 
             outputs = self._run_tasks(run_map, range(num_maps))
 
-        indexes = [(data, read_index_file(index)) for data, index in outputs]
+        return stage, [(data, read_index_file(index)) for data, index in outputs]
+
+    def _run_shuffle_map_stage(self, node: N.ShuffleExchange) -> N.PlanNode:
+        """Execute the map side (one ShuffleWriter task per child partition)
+        — on the process pool when configured, else on driver threads — then
+        expose the per-reducer file segments as an IpcReader resource."""
+        num_reducers = node.partitioning.num_partitions
+        stage, indexes = self._exec_map_stage(node)
         rid = f"shuffle_{stage}"
         self.resources[rid] = FileSegmentBlockProvider(indexes)
         # coalesce reducer input: maps emit many small (e.g. per-batch
@@ -306,6 +372,172 @@ class Session:
             N.IpcReader(schema=node.child.output_schema, resource_id=rid,
                         num_partitions=num_reducers),
             batch_size=0)
+
+    # -- AQE skew-join splitting ----------------------------------------------
+
+    def _try_skew_join(self, node: N.SortMergeJoin) -> Optional[N.PlanNode]:
+        """AQE skew handling (reference: skew splits arriving in the IR via
+        ``isSkewJoin``/partial shuffle reads, AuronConverters.scala:420-489 +
+        NativeRDD.scala:58-59; here the standalone driver IS the AQE layer):
+
+        after both map stages finish, a reducer partition whose stream-side
+        bytes exceed ``skew_join_factor`` x median (and a floor) is split
+        into map-subset sub-partitions, each joined against the OTHER side's
+        FULL partition — sound exactly when the split side's rows are
+        emitted at most once per row (inner/left* when splitting left,
+        inner/right when splitting right)."""
+        def unwrap(c):
+            if isinstance(c, N.Sort) and isinstance(c.child, N.ShuffleExchange):
+                return c, c.child
+            if isinstance(c, N.ShuffleExchange):
+                return None, c
+            return None, None
+
+        lsort, lex = unwrap(node.left)
+        rsort, rex = unwrap(node.right)
+        if lex is None or rex is None:
+            return None
+        for consumed in (lsort, lex, rsort, rex):
+            if consumed is not None:
+                self._check_op_enabled(consumed)
+        if not isinstance(lex.partitioning, N.HashPartitioning) or \
+                not isinstance(rex.partitioning, N.HashPartitioning):
+            return None
+        R = lex.partitioning.num_partitions
+        if rex.partitioning.num_partitions != R:
+            return None
+        jt = node.join_type
+        can_split_left = jt in (N.JoinType.INNER, N.JoinType.LEFT,
+                                N.JoinType.LEFT_SEMI, N.JoinType.LEFT_ANTI)
+        can_split_right = jt in (N.JoinType.INNER, N.JoinType.RIGHT)
+        if not (can_split_left or can_split_right):
+            return None
+
+        # lower the subtrees BELOW the exchanges, then run both map stages
+        lex = dataclasses.replace(lex, child=self._lower(lex.child))
+        rex = dataclasses.replace(rex, child=self._lower(rex.child))
+        lstage, lindexes = self._exec_map_stage(lex)
+        rstage, rindexes = self._exec_map_stage(rex)
+
+        def reducer_sizes(indexes):
+            import numpy as np
+
+            sizes = np.zeros(R, dtype=np.int64)
+            for _, offsets in indexes:
+                sizes += offsets[1:R + 1] - offsets[:R]
+            return sizes
+
+        import numpy as np
+
+        lsizes = reducer_sizes(lindexes)
+        rsizes = reducer_sizes(rindexes)
+        factor = self.conf.skew_join_factor
+        floor = self.conf.skew_join_min_bytes
+
+        def skewed(sizes):
+            med = float(np.median(sizes)) or 1.0
+            return sizes > np.maximum(med * factor, floor)
+
+        lskew, rskew = skewed(lsizes), skewed(rsizes)
+        split_left = can_split_left and bool(lskew.any())
+        split_right = (not split_left) and can_split_right and bool(rskew.any())
+        # (split side chosen greedily: left first — splitting both at once
+        # would need an m x n cartesian of sub-partitions)
+        # build sub-partition spec: list of (reducer, side_map_subset|None)
+        parts = []
+        skew_mask = lskew if split_left else (rskew if split_right else
+                                              np.zeros(R, bool))
+        side_indexes = lindexes if split_left else rindexes
+        side_sizes = lsizes if split_left else rsizes
+        for r in range(R):
+            if not skew_mask[r]:
+                parts.append((r, None))
+                continue
+            target = max(float(np.median(side_sizes)), floor / 4.0, 1.0)
+            chunks, cur, cur_bytes = [], [], 0
+            for m, (_, offsets) in enumerate(side_indexes):
+                sz = int(offsets[r + 1] - offsets[r])
+                cur.append(m)
+                cur_bytes += sz
+                if cur_bytes >= target:
+                    chunks.append(cur)
+                    cur, cur_bytes = [], 0
+            if cur:
+                chunks.append(cur)
+            for chunk in chunks:
+                parts.append((r, chunk))
+            self.metrics.add("skew_partitions_split", 1)
+
+        lrid, rrid = f"shuffle_{lstage}", f"shuffle_{rstage}"
+        self.resources[lrid] = _SubsetBlockProvider(
+            lindexes, parts, subset_applies=split_left)
+        self.resources[rrid] = _SubsetBlockProvider(
+            rindexes, parts, subset_applies=split_right)
+        nparts = len(parts)
+        left: N.PlanNode = N.CoalesceBatches(
+            N.IpcReader(schema=lex.child.output_schema, resource_id=lrid,
+                        num_partitions=nparts), batch_size=0)
+        right: N.PlanNode = N.CoalesceBatches(
+            N.IpcReader(schema=rex.child.output_schema, resource_id=rrid,
+                        num_partitions=nparts), batch_size=0)
+        if lsort is not None:
+            left = dataclasses.replace(lsort, child=left)
+        if rsort is not None:
+            right = dataclasses.replace(rsort, child=right)
+        return dataclasses.replace(node, left=left, right=right)
+
+    def _run_rss_map_stage(self, node: N.ShuffleExchange) -> N.PlanNode:
+        """Push-shuffle: map tasks push partition frames to the RSS server
+        (RssShuffleWriterExec -> RssClient.write), reducers fetch their
+        partition's blocks from it — no local shuffle files (reference:
+        Celeborn/Uniffle write/read paths, CelebornPartitionWriter.scala +
+        AuronRssShuffleWriterBase)."""
+        from blaze_tpu.ops.shuffle.writer import RssShuffleWriterExec
+        from blaze_tpu.runtime.rss import RssClient
+
+        stage = next(self._stage_ids)
+        child_op = build_operator(node.child)
+        num_maps = child_op.num_partitions()
+        num_reducers = node.partitioning.num_partitions
+        from blaze_tpu.runtime.rss import RssWriterFactory
+
+        client = RssClient(self.rss_sock_path, app=self.work_dir,
+                           shuffle_id=stage)
+        wid = f"rss_writer_{stage}"
+        self.resources[wid] = RssWriterFactory(client)
+
+        shipped = None
+        if self.pool is not None:
+            shipped = self._run_rss_stage_on_pool(node, stage, num_maps, wid)
+        if shipped is None:
+            def run_map(m: int):
+                from blaze_tpu.utils.logutil import clear_task_context, set_task_context
+
+                writer = RssShuffleWriterExec(child_op, node.partitioning, wid)
+                ctx = self._make_ctx(m, stage)
+                task_metrics = self.metrics.named_child(
+                    f"stage_{stage}").named_child(f"map_{m}")
+                set_task_context(stage, m)
+                try:
+                    for _ in writer.execute(m, ctx, task_metrics):
+                        pass
+                finally:
+                    clear_task_context()
+
+            self._run_tasks(run_map, range(num_maps))
+
+        rid = f"rss_shuffle_{stage}"
+        self.resources[rid] = client  # provider form: client(pid) -> blocks
+        return N.CoalesceBatches(
+            N.IpcReader(schema=node.child.output_schema, resource_id=rid,
+                        num_partitions=num_reducers),
+            batch_size=0)
+
+    def _run_rss_stage_on_pool(self, node, stage, num_maps, wid):
+        ok = self._ship_stage_to_pool(
+            stage, num_maps,
+            lambda m: N.RssShuffleWriter(node.child, node.partitioning, wid))
+        return True if ok else None
 
     def _run_mesh_exchange(self, node: N.ShuffleExchange) -> N.PlanNode:
         """Lower a ShuffleExchange onto the device mesh: run map partitions,
@@ -377,10 +609,9 @@ class Session:
                           num_partitions=num_reducers),
             batch_size=0)
 
-    def _run_map_stage_on_pool(self, node: N.ShuffleExchange, stage: int,
-                               num_maps: int, paths_for):
+    def _ship_stage_to_pool(self, stage: int, num_maps: int, writer_node_for):
         """Ship map tasks to worker processes as proto TaskDefinitions.
-        Returns None (-> in-driver fallback) when the plan or its resources
+        Returns False (-> in-driver fallback) when the plan or its resources
         cannot cross the process boundary (e.g. mesh BatchSource handles,
         python UDF closures)."""
         import dataclasses as _dc
@@ -392,13 +623,11 @@ class Session:
         try:
             resources = {k: v for k, v in self.resources.items()}
             pickle.dumps(resources, protocol=4)
-            msgs = []
-            for m in range(num_maps):
-                data, index = paths_for(m)
-                writer_node = N.ShuffleWriter(node.child, node.partitioning,
-                                              data, index)
-                task_bytes = task_definition_to_bytes(stage, m, m, writer_node)
-                msgs.append({"task_bytes": task_bytes, "conf": conf_dict})
+            msgs = [
+                {"task_bytes": task_definition_to_bytes(
+                    stage, m, m, writer_node_for(m)), "conf": conf_dict}
+                for m in range(num_maps)
+            ]
         except (NotImplementedError, TypeError, AttributeError,
                 pickle.PicklingError) as exc:
             import logging
@@ -406,7 +635,7 @@ class Session:
             logging.getLogger("blaze_tpu.session").info(
                 "map stage %d not shippable to worker pool (%s); running "
                 "in-driver", stage, exc)
-            return None
+            return False
         # stage resources (shuffle block indexes, broadcast chunks) go to
         # each worker ONCE, not inside every task message
         replies = self.pool.run_tasks(msgs, shared=resources)
@@ -414,7 +643,15 @@ class Session:
         for m, r in enumerate(replies):
             stage_metrics.named_child(f"map_{m}").merge_dict(
                 r.get("metrics") or {})
-        return [paths_for(m) for m in range(num_maps)]
+        return True
+
+    def _run_map_stage_on_pool(self, node: N.ShuffleExchange, stage: int,
+                               num_maps: int, paths_for):
+        ok = self._ship_stage_to_pool(
+            stage, num_maps,
+            lambda m: N.ShuffleWriter(node.child, node.partitioning,
+                                      *paths_for(m)))
+        return [paths_for(m) for m in range(num_maps)] if ok else None
 
     def _run_broadcast_collect(self, node: N.BroadcastExchange) -> N.PlanNode:
         """Collect the child via IpcWriter into in-memory chunks and expose

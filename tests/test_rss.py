@@ -1,0 +1,87 @@
+"""Remote shuffle service stand-in (round-1 missing item 5): push-based
+shuffle through a socket server, single- and multi-process, with
+retry-safe attempt commits (reference: Celeborn/Uniffle integration,
+SURVEY.md §2.6)."""
+
+import decimal
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import nodes as N
+from blaze_tpu.ir import types as T
+from blaze_tpu.runtime.rss import RssClient, RssServer
+from blaze_tpu.runtime.session import Session
+from tests.test_cluster import _q01
+
+
+@pytest.fixture(scope="module")
+def rss_server():
+    srv = RssServer()
+    yield srv
+    srv.close()
+
+
+@pytest.fixture(scope="module")
+def q01_files(tmp_path_factory):
+    td = tmp_path_factory.mktemp("rssdata")
+    rng = np.random.default_rng(29)
+    paths = []
+    for p in range(2):
+        n = 6000
+        tbl = pa.table({
+            "store": pa.array(rng.integers(1, 40, n), type=pa.int64()),
+            "amt": pa.array([decimal.Decimal(int(v)).scaleb(-2)
+                             for v in rng.integers(0, 100000, n)],
+                            type=pa.decimal128(9, 2)),
+        })
+        path = str(td / f"f{p}.parquet")
+        pq.write_table(tbl, path)
+        paths.append(path)
+    return paths
+
+
+def test_rss_shuffle_equals_file_shuffle(rss_server, q01_files):
+    plan = _q01(q01_files)
+    with Session() as s_file:
+        expect = s_file.execute_to_table(plan).to_pydict()
+    with Session(rss_sock_path=rss_server.sock_path) as s_rss:
+        got = s_rss.execute_to_table(plan).to_pydict()
+    assert got == expect
+    assert len(got["store"]) > 0
+
+
+def test_duplicate_attempt_blocks_deduped(rss_server):
+    """A retried map task's pushes are invisible: only the first committed
+    attempt's blocks serve fetches."""
+    c = RssClient(rss_server.sock_path, app="dedup-test", shuffle_id=1)
+    w1 = c.writer_for_map(0)
+    w1.write(0, b"attempt1-block")
+    w1.flush()
+    # retry of the same map pushes again with a new attempt id
+    w2 = c.writer_for_map(0)
+    w2.write(0, b"attempt2-block")
+    w2.flush()
+    assert c.fetch(0) == [b"attempt1-block"]
+
+
+def test_uncommitted_attempt_invisible(rss_server):
+    c = RssClient(rss_server.sock_path, app="uncommitted-test", shuffle_id=2)
+    w = c.writer_for_map(3)
+    w.write(1, b"half-written")
+    # no flush: a map task that died mid-push leaves nothing visible
+    assert c.fetch(1) == []
+
+
+@pytest.mark.slow
+def test_rss_shuffle_through_worker_processes(rss_server, q01_files):
+    plan = _q01(q01_files)
+    with Session() as s_file:
+        expect = s_file.execute_to_table(plan).to_pydict()
+    with Session(rss_sock_path=rss_server.sock_path,
+                 num_worker_processes=2) as s:
+        got = s.execute_to_table(plan).to_pydict()
+    assert got == expect
