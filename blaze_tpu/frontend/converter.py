@@ -66,9 +66,12 @@ class SparkPlanConverter:
     """One-shot converter for a serialized Spark physical plan."""
 
     def __init__(self, tables: Optional[Dict[str, List[str]]] = None,
-                 conf: Optional[Config] = None):
-        # tableIdentifier (or bare table name) -> parquet/orc file paths
+                 conf: Optional[Config] = None, catalog=None):
+        # tableIdentifier (or bare table name) -> parquet/orc file paths;
+        # a blaze_tpu.catalog.Catalog additionally resolves hive-partitioned
+        # tables and prunes them by partitionFilters
         self.tables = tables or {}
+        self.catalog = catalog
         self.conf = conf or get_config()
         self.tags: List[Tuple[str, str]] = []
 
@@ -156,7 +159,11 @@ class SparkPlanConverter:
         ident = node.field("tableIdentifier")
         if isinstance(ident, dict):
             ident = ".".join(str(v) for v in ident.values() if v)
-        paths = self.tables.get(str(ident)) if ident else None
+        ident = str(ident) if ident else None
+        if self.catalog is not None and ident in getattr(
+                self.catalog, "tables", {}):
+            return self._catalog_scan(node, ident)
+        paths = self.tables.get(ident) if ident else None
         if paths is None:
             # also accept an explicit location list (test harnesses)
             paths = node.field("locations")
@@ -166,11 +173,11 @@ class SparkPlanConverter:
                 "converter's tables mapping")
         pfilters = node.field("partitionFilters")
         if pfilters:
-            # a partition-pruned Spark scan resolves its pruning against the
-            # catalog's partition directory values; silently reading every
-            # file would return extra rows — fall back until hive-partition
-            # listings flow through the tables mapping
-            raise UnsupportedNode("scan with partitionFilters")
+            # a partition-pruned Spark scan resolves its pruning against
+            # the partition directory values; without a Catalog, silently
+            # reading every file would return extra rows
+            raise UnsupportedNode(
+                "scan with partitionFilters needs a Catalog table")
         out_attrs = self._scope_from_output(node) or []
         names = [FE.attr_name(a) for a in out_attrs]
         bare = [a.field("name") for a in out_attrs]
@@ -191,6 +198,38 @@ class SparkPlanConverter:
         if pred is not None:
             plan = N.Filter(plan, [pred])
         if names:
+            plan = N.RenameColumns(plan, names)
+        return plan, self._attr_scope(out_attrs)
+
+    def _catalog_scan(self, node, ident: str):
+        """FileSourceScanExec through the Catalog: hive partition values
+        resolve and partitionFilters PRUNE files before IO (reference:
+        NativeHiveTableScanBase + Catalyst partition pruning)."""
+        out_attrs = self._scope_from_output(node) or []
+        names = [FE.attr_name(a) for a in out_attrs]
+        bare = [a.field("name") for a in out_attrs]
+        scope: AttrScope = {}
+        ppred = None
+        for t in decode_field_trees(node.field("partitionFilters")):
+            e = convert_expr(t, scope)
+            ppred = e if ppred is None else E.BinaryExpr(E.BinaryOp.AND, ppred, e)
+        dpred = None
+        for t in decode_field_trees(node.field("dataFilters")):
+            e = convert_expr(t, scope)
+            dpred = e if dpred is None else E.BinaryExpr(E.BinaryOp.AND, dpred, e)
+        t = self.catalog.tables[ident]
+        nparts = max(1, min(len(t.files), 4))
+        plan = self.catalog.scan_node(
+            ident, num_partitions=nparts, projection=bare or None,
+            predicate=dpred, partition_predicate=ppred)
+        if dpred is not None and not isinstance(plan, N.EmptyPartitions):
+            plan = N.Filter(plan, [dpred])
+        if names and not isinstance(plan, N.EmptyPartitions):
+            # the scan emits data columns + ALL partition columns; narrow to
+            # the attributes Spark's scan declares, in its order
+            scan_names = plan.output_schema.names
+            if bare != scan_names:
+                plan = N.Projection(plan, [E.Column(b) for b in bare], bare)
             plan = N.RenameColumns(plan, names)
         return plan, self._attr_scope(out_attrs)
 
