@@ -8,8 +8,9 @@ non-IPC format with optional **byte-plane transpose** of fixed-width columns
 - fixed-width (device) columns serialize as raw little-endian planes
   (optionally byte-transposed) + packed validity bitmaps;
 - var-width/nested (host) columns serialize as Arrow IPC;
-- each batch is one length-prefixed frame, zstd-compressed (codec from
-  config; lz4 python binding is absent in this environment).
+- each batch is one length-prefixed frame, zstd- or lz4-compressed (codec
+  from config; lz4 rides the native lib's dlopen of liblz4.so.1 — the
+  python binding is absent in this environment).
 """
 
 from __future__ import annotations
@@ -156,8 +157,48 @@ def deserialize_batch(payload: bytes) -> ColumnarBatch:
     return ColumnarBatch(schema, cols, n)
 
 
-_FRAME_FMT = "<4sIQQ"  # magic, flags (1 = zstd), compressed len, raw len
+_FRAME_FMT = "<4sIQQ"  # magic, flags (0=raw, 1=zstd, 2=lz4), compressed len, raw len
 _FRAME_LEN = struct.calcsize(_FRAME_FMT)
+
+
+def _lz4_compress(payload: bytes):
+    """lz4 block compression via the native lib's dlopen'd liblz4 (the
+    reference supports lz4 + zstd codecs, ipc_compression.rs:34-260);
+    returns None when unavailable so the caller falls back to zstd."""
+    from blaze_tpu.utils import native
+
+    l = native.lib()
+    if l is None or not l.bt_lz4_available():
+        return None
+    import numpy as np
+
+    src = np.frombuffer(payload, dtype=np.uint8)
+    bound = l.bt_lz4_compress_bound(len(payload))
+    if bound <= 0:
+        return None
+    dst = np.empty(bound, dtype=np.uint8)
+    r = l.bt_lz4_compress(src.ctypes.data if len(payload) else None,
+                          len(payload), dst.ctypes.data, bound)
+    if r <= 0:
+        return None
+    return dst[:r].tobytes()
+
+
+def _lz4_decompress(payload: bytes, raw_len: int) -> bytes:
+    from blaze_tpu.utils import native
+
+    l = native.lib()
+    if l is None or not l.bt_lz4_available():
+        raise RuntimeError("lz4 frame but liblz4 unavailable")
+    import numpy as np
+
+    src = np.frombuffer(payload, dtype=np.uint8)
+    dst = np.empty(max(raw_len, 1), dtype=np.uint8)
+    r = l.bt_lz4_decompress(src.ctypes.data, len(payload),
+                            dst.ctypes.data, raw_len)
+    if r != raw_len:
+        raise RuntimeError(f"lz4 decompress failed ({r} != {raw_len})")
+    return dst[:raw_len].tobytes()
 
 
 def _zstd_compress(payload: bytes, level: int) -> bytes:
@@ -210,11 +251,16 @@ class BatchWriter:
     def write_batch(self, batch: ColumnarBatch):
         payload = serialize_batch(batch)
         raw_len = len(payload)
-        compressed = self.codec != "none"
-        if compressed:
-            payload = _zstd_compress(payload, self.level)
-        frame = struct.pack(_FRAME_FMT, _MAGIC, 1 if compressed else 0,
-                            len(payload), raw_len)
+        flags = 0
+        if self.codec == "lz4":
+            out = _lz4_compress(payload)
+            if out is not None:
+                payload, flags = out, 2
+            else:  # liblz4 missing: degrade to zstd, stay readable
+                payload, flags = _zstd_compress(payload, self.level), 1
+        elif self.codec != "none":
+            payload, flags = _zstd_compress(payload, self.level), 1
+        frame = struct.pack(_FRAME_FMT, _MAGIC, flags, len(payload), raw_len)
         self.f.write(frame)
         self.f.write(payload)
         self.bytes_written += len(frame) + len(payload)
@@ -229,9 +275,11 @@ class BatchReader:
             head = self.f.read(_FRAME_LEN)
             if not head:
                 return
-            magic, compressed, plen, raw_len = struct.unpack(_FRAME_FMT, head)
+            magic, flags, plen, raw_len = struct.unpack(_FRAME_FMT, head)
             assert magic == _MAGIC, f"bad frame magic {magic!r}"
             payload = self.f.read(plen)
-            if compressed:
+            if flags == 2:
+                payload = _lz4_decompress(payload, raw_len)
+            elif flags == 1:
                 payload = _zstd_decompress(payload, raw_len)
             yield deserialize_batch(payload)

@@ -37,6 +37,15 @@ def _configure(lib: ctypes.CDLL):
     lib.bt_zstd_decompress.restype = ctypes.c_int64
     lib.bt_zstd_decompress.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                        ctypes.c_void_p, ctypes.c_int64]
+    lib.bt_lz4_available.restype = ctypes.c_int
+    lib.bt_lz4_compress_bound.restype = ctypes.c_int64
+    lib.bt_lz4_compress_bound.argtypes = [ctypes.c_int64]
+    lib.bt_lz4_compress.restype = ctypes.c_int64
+    lib.bt_lz4_compress.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                    ctypes.c_void_p, ctypes.c_int64]
+    lib.bt_lz4_decompress.restype = ctypes.c_int64
+    lib.bt_lz4_decompress.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                      ctypes.c_void_p, ctypes.c_int64]
 
 
 def build(quiet: bool = True) -> bool:
@@ -81,7 +90,7 @@ def lib() -> Optional[ctypes.CDLL]:
         try:
             l = ctypes.CDLL(_SO_PATH)
             _configure(l)
-            assert l.bt_version() == 1
+            assert l.bt_version() >= 1
             _lib = l
         except Exception:
             _tried = True

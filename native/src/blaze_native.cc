@@ -205,4 +205,72 @@ EXPORT int64_t bt_zstd_decompress(const uint8_t* src, int64_t src_len,
 #endif
 }
 
-EXPORT int bt_version() { return 1; }
+// ---------------------------------------------------------------------------
+// lz4 block codec (reference supports lz4 + zstd shuffle/spill codecs,
+// common/ipc_compression.rs:34-260). The image ships liblz4.so.1 without
+// headers, so the three stable-ABI entry points are declared here and
+// resolved with dlopen at first use.
+// ---------------------------------------------------------------------------
+
+#include <dlfcn.h>
+
+namespace {
+typedef int (*lz4_bound_fn)(int);
+typedef int (*lz4_compress_fn)(const char*, char*, int, int);
+typedef int (*lz4_decompress_fn)(const char*, char*, int, int);
+
+struct Lz4Api {
+  lz4_bound_fn bound = nullptr;
+  lz4_compress_fn compress = nullptr;
+  lz4_decompress_fn decompress = nullptr;
+  bool ok = false;
+};
+
+const Lz4Api& lz4_api() {
+  static Lz4Api api = [] {
+    Lz4Api a;
+    void* h = dlopen("liblz4.so.1", RTLD_NOW | RTLD_GLOBAL);
+    if (!h) h = dlopen("liblz4.so", RTLD_NOW | RTLD_GLOBAL);
+    if (h) {
+      a.bound = reinterpret_cast<lz4_bound_fn>(dlsym(h, "LZ4_compressBound"));
+      a.compress = reinterpret_cast<lz4_compress_fn>(
+          dlsym(h, "LZ4_compress_default"));
+      a.decompress = reinterpret_cast<lz4_decompress_fn>(
+          dlsym(h, "LZ4_decompress_safe"));
+      a.ok = a.bound && a.compress && a.decompress;
+    }
+    return a;
+  }();
+  return api;
+}
+}  // namespace
+
+EXPORT int bt_lz4_available() { return lz4_api().ok ? 1 : 0; }
+
+EXPORT int64_t bt_lz4_compress_bound(int64_t src_len) {
+  const Lz4Api& a = lz4_api();
+  if (!a.ok || src_len > INT32_MAX) return -1;
+  return a.bound(static_cast<int>(src_len));
+}
+
+EXPORT int64_t bt_lz4_compress(const uint8_t* src, int64_t src_len,
+                               uint8_t* dst, int64_t dst_cap) {
+  const Lz4Api& a = lz4_api();
+  if (!a.ok || src_len > INT32_MAX || dst_cap > INT32_MAX) return -1;
+  int r = a.compress(reinterpret_cast<const char*>(src),
+                     reinterpret_cast<char*>(dst),
+                     static_cast<int>(src_len), static_cast<int>(dst_cap));
+  return r > 0 ? r : -1;
+}
+
+EXPORT int64_t bt_lz4_decompress(const uint8_t* src, int64_t src_len,
+                                 uint8_t* dst, int64_t dst_cap) {
+  const Lz4Api& a = lz4_api();
+  if (!a.ok || src_len > INT32_MAX || dst_cap > INT32_MAX) return -1;
+  int r = a.decompress(reinterpret_cast<const char*>(src),
+                       reinterpret_cast<char*>(dst),
+                       static_cast<int>(src_len), static_cast<int>(dst_cap));
+  return r >= 0 ? r : -1;
+}
+
+EXPORT int bt_version() { return 2; }
