@@ -462,10 +462,11 @@ class SparkPlanConverter:
         for t in wtrees:
             alias = t if t.name == "Alias" else None
             inner = t.children[0] if alias is not None else t
+            frame = None
             if inner.name == "WindowExpression":
                 fn_node = inner.children[0]
                 if len(inner.children) > 1:
-                    _require_default_frame(inner.children[1])
+                    frame = _parse_frame(inner.children[1])
             else:
                 fn_node = inner
             nm = FE.attr_name(alias) if alias is not None else \
@@ -479,9 +480,12 @@ class SparkPlanConverter:
                 wexprs.append(N.WindowExpr("dense_rank", nm))
             elif fname == "AggregateExpression":
                 agg, _mode, _r = FE.convert_agg_expr(fn_node, scope)
-                wexprs.append(N.WindowExpr("agg", nm, agg=agg))
+                wexprs.append(N.WindowExpr("agg", nm, agg=agg, frame=frame))
             else:
                 raise UnsupportedNode(f"window function {fname}")
+            if frame is not None and fname != "AggregateExpression":
+                raise UnsupportedNode(
+                    f"explicit frame on window function {fname}")
             if alias is not None:
                 eid = (alias.field("exprId") or {}).get("id")
                 if eid is not None:
@@ -521,24 +525,44 @@ class SparkPlanConverter:
             self._attr_scope(out_attrs)
 
 
-def _require_default_frame(spec: TreeNode):
-    """ops/window.py implements only Spark's DEFAULT frames (whole
-    partition without ORDER BY; RANGE unbounded-preceding..current-row with
-    it) — any explicit non-default SpecifiedWindowFrame must fall back, not
-    silently run with default semantics."""
+def _parse_frame(spec: TreeNode):
+    """frameSpecification -> None (Spark default semantics) or an explicit
+    ("rows", lower, upper) frame for aggregates-over-window (ops/window.py
+    computes ROWS frames with prefix sums / sliding windows). RANGE frames
+    with value offsets stay unsupported -> fall back."""
     frame = spec.field("frameSpecification")
     if frame in (None, {}, []):
-        return
+        return None
     if isinstance(frame, dict) and not frame.get("class") and \
             not frame.get("product-class"):
-        return  # UnspecifiedFrame serializations
+        return None  # UnspecifiedFrame serializations
     text = json.dumps(frame)
     if "UnspecifiedFrame" in text:
-        return
-    if "SpecifiedWindowFrame" in text and "UnboundedPreceding" in text \
-            and "CurrentRow" in text and "RowFrame" not in text:
-        return  # RANGE UNBOUNDED PRECEDING .. CURRENT ROW == the default
-    raise UnsupportedNode(f"non-default window frame: {text[:120]}")
+        return None
+    if "SpecifiedWindowFrame" in text and "RowFrame" not in text:
+        if "UnboundedPreceding" in text and "CurrentRow" in text:
+            return None  # RANGE UNBOUNDED .. CURRENT ROW == the default
+        raise UnsupportedNode(f"RANGE frame with offsets: {text[:120]}")
+    if "RowFrame" in text and isinstance(frame, dict):
+        lo = _frame_bound(frame.get("lower"))
+        hi = _frame_bound(frame.get("upper"))
+        return ("rows", lo, hi)
+    raise UnsupportedNode(f"unrecognized window frame: {text[:120]}")
+
+
+def _frame_bound(b):
+    """UnboundedPreceding/Following -> None; CurrentRow -> 0; Literal ->
+    signed row offset (Spark serializes PRECEDING as negative literals)."""
+    if b is None:
+        return None
+    text = json.dumps(b) if not isinstance(b, str) else b
+    if "UnboundedPreceding" in text or "UnboundedFollowing" in text:
+        return None
+    if "CurrentRow" in text:
+        return 0
+    if isinstance(b, dict) and "value" in b:
+        return int(b["value"])
+    raise UnsupportedNode(f"window frame bound {text[:80]}")
 
 
 def _snake(name: str) -> str:

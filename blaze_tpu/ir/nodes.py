@@ -352,6 +352,10 @@ class WindowExpr:
     name: str
     agg: Optional[E.AggExpr] = None
     return_type: Optional[T.DataType] = None
+    # explicit frame ("rows", lower, upper): offsets relative to the current
+    # row, None = unbounded (reference: SpecifiedWindowFrame). None frame =
+    # Spark's default (whole partition / RANGE unbounded..current).
+    frame: Optional[tuple] = None
 
 
 @dataclasses.dataclass

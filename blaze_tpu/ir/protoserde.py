@@ -555,6 +555,16 @@ def plan_to_proto(node: N.PlanNode) -> pb.PlanNode:
                 wm.agg.CopyFrom(agg_to_proto(w.agg))
             if w.return_type is not None:
                 wm.return_type.CopyFrom(type_to_proto(w.return_type))
+            if w.frame is not None:
+                ftype, lo, hi = w.frame
+                wm.has_frame = True
+                wm.frame_type = ftype
+                if lo is not None:
+                    wm.has_lower = True
+                    wm.lower = int(lo)
+                if hi is not None:
+                    wm.has_upper = True
+                    wm.upper = int(hi)
         for e in node.partition_spec:
             m.window.partition_spec.append(expr_to_proto(e))
         for so in node.order_spec:
@@ -710,7 +720,12 @@ def plan_from_proto(m: pb.PlanNode) -> N.PlanNode:
         for wm in m.window.window_exprs:
             agg = agg_from_proto(wm.agg) if wm.HasField("agg") else None
             rt = type_from_proto(wm.return_type) if wm.HasField("return_type") else None
-            wes.append(N.WindowExpr(wm.kind, wm.name, agg, rt))
+            frame = None
+            if wm.has_frame:
+                frame = (wm.frame_type,
+                         wm.lower if wm.has_lower else None,
+                         wm.upper if wm.has_upper else None)
+            wes.append(N.WindowExpr(wm.kind, wm.name, agg, rt, frame))
         gl = m.window.group_limit if m.window.has_group_limit else None
         return N.Window(plan_from_proto(m.window.child), wes,
                         [expr_from_proto(e) for e in m.window.partition_spec],

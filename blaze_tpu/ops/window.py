@@ -293,7 +293,26 @@ class WindowExec(Operator):
         F = E.AggFunction
         has_order = bool(self.order_spec)
         masked = np.where(valid, nv, 0) if nv.dtype != object else nv
-        if has_order:
+        frame = tuple(w.frame) if w.frame is not None else None
+        if frame is not None and frame[0] == "rows":
+            # explicit ROWS frame (reference: SpecifiedWindowFrame RowFrame):
+            # per-row [start, end) windows via padded prefix sums
+            lo, hi = frame[1], frame[2]
+            idx = np.arange(n)
+            start = np.zeros(n, np.int64) if lo is None else \
+                np.clip(idx + int(lo), 0, n)
+            end_excl = np.full(n, n, np.int64) if hi is None else \
+                np.clip(idx + int(hi) + 1, 0, n)
+            end_excl = np.maximum(end_excl, start)
+            zero = masked[0] * 0 if n else 0  # object-safe (Decimal) zero
+            cs0 = np.concatenate([[zero], np.cumsum(masked)])
+            cc0 = np.concatenate([[0], np.cumsum(valid.astype(np.int64))])
+            fsum = cs0[end_excl] - cs0[start]
+            fcnt = cc0[end_excl] - cc0[start]
+            if agg.fn in (F.MIN, F.MAX):
+                fval = _frame_minmax(nv, valid, lo, hi, start, end_excl,
+                                     agg.fn == F.MIN)
+        elif has_order:
             csum = np.cumsum(masked)
             ccnt = np.cumsum(valid.astype(np.int64))
             # frame value at each row = value at its peer-group END
@@ -333,6 +352,60 @@ class WindowExec(Operator):
         elif result_t == T.F64:
             out = [None if v is None else float(v) for v in out]
         return HostColumn(result_t, pa.array(out, type=T.to_arrow_type(result_t))), result_t
+
+
+def _frame_minmax(vals, valid, lo, hi, start, end_excl, is_min: bool) -> np.ndarray:
+    """Per-row min/max over ROWS-frame windows [start, end). Numeric values
+    vectorize: finite (lo, hi) via sentinel-padded sliding windows,
+    half-unbounded via running accumulates; object (decimal) values fall
+    back to per-row slice scans."""
+    n = len(vals)
+    out = np.empty(n, dtype=object)
+    if n == 0:
+        return out
+    cc0 = np.concatenate([[0], np.cumsum(valid.astype(np.int64))])
+    has = (cc0[end_excl] - cc0[start]) > 0
+    numeric = vals.dtype != object
+    if numeric:
+        if np.issubdtype(vals.dtype, np.floating):
+            sent = np.array(np.inf if is_min else -np.inf, vals.dtype)
+        else:
+            info = np.iinfo(vals.dtype)
+            sent = np.array(info.max if is_min else info.min, vals.dtype)
+        x = np.where(valid, vals, sent)
+        red = np.minimum if is_min else np.maximum
+        if lo is not None and hi is not None:
+            w = int(hi) - int(lo) + 1
+            if w <= 0:
+                out[:] = None
+                return out
+            pad_lo = max(0, -int(lo))
+            pad_hi = max(0, int(hi))
+            xp = np.concatenate([np.full(pad_lo, sent, vals.dtype), x,
+                                 np.full(pad_hi, sent, vals.dtype)])
+            sw = np.lib.stride_tricks.sliding_window_view(xp, w)
+            got = (sw.min(axis=1) if is_min else sw.max(axis=1))[
+                np.arange(n) + int(lo) + pad_lo]
+        elif lo is None:
+            run = red.accumulate(x)  # unbounded preceding .. i+hi
+            got = run[np.clip(end_excl - 1, 0, n - 1)]
+        else:
+            run = red.accumulate(x[::-1])[::-1]  # i+lo .. unbounded following
+            got = run[np.clip(start, 0, n - 1)]
+        for i in range(n):
+            out[i] = got[i].item() if has[i] else None
+        return out
+    better = (lambda a, b: a < b) if is_min else (lambda a, b: a > b)
+    for i in range(n):
+        s, e = int(start[i]), int(end_excl[i])
+        best = None
+        for j in range(s, e):
+            if valid[j]:
+                v = vals[j]
+                if best is None or better(v, best):
+                    best = v
+        out[i] = best
+    return out
 
 
 def _masked_running(vals, valid, accfn, is_min: bool):

@@ -164,3 +164,116 @@ def test_explode_map():
                       T.Schema.of(("k", T.STRING), ("v", T.I64)), outer=True)
     out = collect_pydict(op)
     assert out == {"id": [1, 1, 2], "k": ["a", "b", None], "v": [10, 20, None]}
+
+
+# -- explicit ROWS frames (round 2: reference SpecifiedWindowFrame) -----------
+
+
+def test_rows_frame_sliding_sum():
+    """SUM OVER (ROWS BETWEEN 2 PRECEDING AND CURRENT ROW)."""
+    data = {"g": pa.array([1] * 6, type=pa.int64()),
+            "o": pa.array(range(6), type=pa.int64()),
+            "v": pa.array([1, 2, 3, 4, 5, 6], type=pa.int64())}
+    scan = sorted_scan(data, ["g", "o"])
+    from blaze_tpu.ir.nodes import WindowExpr
+    from blaze_tpu.ops.window import WindowExec
+
+    op = WindowExec(scan, [
+        WindowExpr("agg", "s", agg=E.AggExpr(E.AggFunction.SUM, [col("v")]),
+                   frame=("rows", -2, 0)),
+    ], [col("g")], [E.SortOrder(col("o"))])
+    out = collect_pydict(op)
+    assert out["s"] == [1, 3, 6, 9, 12, 15]
+
+
+def test_rows_frame_min_max_and_following():
+    data = {"g": pa.array([1] * 5 + [2] * 3, type=pa.int64()),
+            "o": pa.array(list(range(5)) + list(range(3)), type=pa.int64()),
+            "v": pa.array([5, 1, 4, 2, 3, 9, 7, 8], type=pa.int64())}
+    scan = sorted_scan(data, ["g", "o"])
+    from blaze_tpu.ir.nodes import WindowExpr
+    from blaze_tpu.ops.window import WindowExec
+
+    op = WindowExec(scan, [
+        WindowExpr("agg", "mn", agg=E.AggExpr(E.AggFunction.MIN, [col("v")]),
+                   frame=("rows", -1, 1)),
+        WindowExpr("agg", "mx", agg=E.AggExpr(E.AggFunction.MAX, [col("v")]),
+                   frame=("rows", 0, None)),  # current .. unbounded following
+    ], [col("g")], [E.SortOrder(col("o"))])
+    out = collect_pydict(op)
+    assert out["mn"] == [1, 1, 1, 2, 2, 7, 7, 7]
+    assert out["mx"] == [5, 4, 4, 3, 3, 9, 8, 8]
+
+
+def test_rows_frame_proto_round_trip():
+    from blaze_tpu.ir.nodes import WindowExpr
+    from blaze_tpu.ir.protoserde import plan_from_bytes, plan_to_bytes
+    from blaze_tpu.ir import nodes as N
+    from blaze_tpu.ir import types as T
+
+    w = N.Window(
+        N.EmptyPartitions(T.Schema.of(("g", T.I64), ("v", T.I64)), 1),
+        [WindowExpr("agg", "s", agg=E.AggExpr(E.AggFunction.SUM, [col("v")]),
+                    frame=("rows", -3, None))],
+        [col("g")], [])
+    back = plan_from_bytes(plan_to_bytes(w))
+    assert back.window_exprs[0].frame == ("rows", -3, None)
+
+
+def test_frontend_rows_frame_converts():
+    """The converter now accepts RowFrame specs (was a fallback)."""
+    import json
+
+    import numpy as np
+    import pyarrow.parquet as pq
+    import tempfile, os
+
+    from tests.test_frontend import P, X, attr, sort_order
+    from blaze_tpu.frontend import convert_spark_plan
+    from blaze_tpu.runtime.session import Session
+
+    td = tempfile.mkdtemp()
+    path = os.path.join(td, "t.parquet")
+    pq.write_table(pa.table({"k": pa.array([1, 1, 1, 1], type=pa.int64()),
+                             "v": pa.array([10, 20, 30, 40], type=pa.int64())}),
+                   path)
+    scan = {"class": f"{P}.FileSourceScanExec", "num-children": 0,
+            "output": [[attr("k", "long", 1)], [attr("v", "long", 2)]],
+            "partitionFilters": [], "dataFilters": [],
+            "tableIdentifier": "t"}
+    srt = {"class": f"{P}.SortExec", "num-children": 1,
+           "sortOrder": [sort_order([attr("v", "long", 2)])],
+           "global": False, "child": 0}
+    wexpr = [{"class": f"{X}.Alias", "num-children": 1, "child": 0, "name": "s",
+              "exprId": {"product-class": f"{X}.ExprId", "id": 20,
+                         "jvmId": "00000000-0000-0000-0000-000000000000"},
+              "qualifier": []},
+             {"class": f"{X}.WindowExpression", "num-children": 2,
+              "windowFunction": 0, "windowSpec": 1},
+             {"class": f"{X}.aggregate.AggregateExpression", "num-children": 1,
+              "aggregateFunction": 0,
+              "mode": {"object": f"{X}.aggregate.Complete$"},
+              "isDistinct": False,
+              "resultId": {"product-class": f"{X}.ExprId", "id": 21,
+                           "jvmId": "00000000-0000-0000-0000-000000000000"}},
+             {"class": f"{X}.aggregate.Sum", "num-children": 1, "child": 0},
+             attr("v", "long", 2),
+             {"class": f"{X}.WindowSpecDefinition", "num-children": 0,
+              "partitionSpec": [], "orderSpec": [],
+              "frameSpecification": {
+                  "class": f"{X}.SpecifiedWindowFrame",
+                  "frameType": {"object": f"{X}.RowFrame$"},
+                  "lower": {"class": f"{X}.Literal", "value": "-1",
+                            "dataType": "integer"},
+                  "upper": {"object": f"{X}.CurrentRow$"}}}]
+    window = {"class": f"{P}.window.WindowExec", "num-children": 1,
+              "windowExpression": [wexpr],
+              "partitionSpec": [[attr("k", "long", 1)]],
+              "orderSpec": [sort_order([attr("v", "long", 2)])],
+              "child": 0}
+    res = convert_spark_plan(json.dumps([window, srt, scan]),
+                             tables={"t": [path]})
+    assert res.fully_native, res.tags
+    with Session() as s:
+        out = s.execute_to_table(res.plan).to_pydict()
+    assert out["s#20"] == [10, 30, 50, 70]  # sliding 2-row sums
