@@ -168,7 +168,7 @@ def _lz4_compress(payload: bytes):
     from blaze_tpu.utils import native
 
     l = native.lib()
-    if l is None or not l.bt_lz4_available():
+    if l is None or not hasattr(l, "bt_lz4_available") or not l.bt_lz4_available():
         return None
     import numpy as np
 
@@ -188,7 +188,7 @@ def _lz4_decompress(payload: bytes, raw_len: int) -> bytes:
     from blaze_tpu.utils import native
 
     l = native.lib()
-    if l is None or not l.bt_lz4_available():
+    if l is None or not hasattr(l, "bt_lz4_available") or not l.bt_lz4_available():
         raise RuntimeError("lz4 frame but liblz4 unavailable")
     import numpy as np
 

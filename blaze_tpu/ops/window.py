@@ -311,7 +311,7 @@ class WindowExec(Operator):
             fcnt = cc0[end_excl] - cc0[start]
             if agg.fn in (F.MIN, F.MAX):
                 fval = _frame_minmax(nv, valid, lo, hi, start, end_excl,
-                                     agg.fn == F.MIN)
+                                     agg.fn == F.MIN, fcnt > 0)
         elif has_order:
             csum = np.cumsum(masked)
             ccnt = np.cumsum(valid.astype(np.int64))
@@ -354,17 +354,21 @@ class WindowExec(Operator):
         return HostColumn(result_t, pa.array(out, type=T.to_arrow_type(result_t))), result_t
 
 
-def _frame_minmax(vals, valid, lo, hi, start, end_excl, is_min: bool) -> np.ndarray:
-    """Per-row min/max over ROWS-frame windows [start, end). Numeric values
-    vectorize: finite (lo, hi) via sentinel-padded sliding windows,
-    half-unbounded via running accumulates; object (decimal) values fall
-    back to per-row slice scans."""
+def _frame_minmax(vals, valid, lo, hi, start, end_excl, is_min: bool,
+                  has: np.ndarray) -> np.ndarray:
+    """Per-row min/max over ROWS-frame windows [start, end); ``has`` marks
+    rows whose frame holds at least one valid value (the caller's fcnt>0).
+    Numeric values vectorize: finite (lo, hi) via sentinel-padded sliding
+    windows, half-unbounded via running accumulates; object (decimal)
+    values fall back to per-row slice scans."""
     n = len(vals)
     out = np.empty(n, dtype=object)
     if n == 0:
         return out
-    cc0 = np.concatenate([[0], np.cumsum(valid.astype(np.int64))])
-    has = (cc0[end_excl] - cc0[start]) > 0
+    if lo is not None:
+        lo = max(int(lo), -n)  # clamp: a billion-row PRECEDING offset must
+    if hi is not None:
+        hi = min(int(hi), n)   # not allocate billion-entry sentinel padding
     numeric = vals.dtype != object
     if numeric:
         if np.issubdtype(vals.dtype, np.floating):
@@ -392,8 +396,8 @@ def _frame_minmax(vals, valid, lo, hi, start, end_excl, is_min: bool) -> np.ndar
         else:
             run = red.accumulate(x[::-1])[::-1]  # i+lo .. unbounded following
             got = run[np.clip(start, 0, n - 1)]
-        for i in range(n):
-            out[i] = got[i].item() if has[i] else None
+        out[has] = got[has]
+        out[~has] = None
         return out
     better = (lambda a, b: a < b) if is_min else (lambda a, b: a > b)
     for i in range(n):

@@ -37,15 +37,16 @@ def _configure(lib: ctypes.CDLL):
     lib.bt_zstd_decompress.restype = ctypes.c_int64
     lib.bt_zstd_decompress.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                        ctypes.c_void_p, ctypes.c_int64]
-    lib.bt_lz4_available.restype = ctypes.c_int
-    lib.bt_lz4_compress_bound.restype = ctypes.c_int64
-    lib.bt_lz4_compress_bound.argtypes = [ctypes.c_int64]
-    lib.bt_lz4_compress.restype = ctypes.c_int64
-    lib.bt_lz4_compress.argtypes = [ctypes.c_void_p, ctypes.c_int64,
-                                    ctypes.c_void_p, ctypes.c_int64]
-    lib.bt_lz4_decompress.restype = ctypes.c_int64
-    lib.bt_lz4_decompress.argtypes = [ctypes.c_void_p, ctypes.c_int64,
-                                      ctypes.c_void_p, ctypes.c_int64]
+    if hasattr(lib, "bt_lz4_available"):  # absent in v1 prebuilt libraries
+        lib.bt_lz4_available.restype = ctypes.c_int
+        lib.bt_lz4_compress_bound.restype = ctypes.c_int64
+        lib.bt_lz4_compress_bound.argtypes = [ctypes.c_int64]
+        lib.bt_lz4_compress.restype = ctypes.c_int64
+        lib.bt_lz4_compress.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                        ctypes.c_void_p, ctypes.c_int64]
+        lib.bt_lz4_decompress.restype = ctypes.c_int64
+        lib.bt_lz4_decompress.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                          ctypes.c_void_p, ctypes.c_int64]
 
 
 def build(quiet: bool = True) -> bool:
@@ -101,12 +102,22 @@ def lib() -> Optional[ctypes.CDLL]:
 _build_thread: Optional[threading.Thread] = None
 
 
+CURRENT_VERSION = 2
+
+
 def ensure_built_async():
-    """Kick off a background build when the library is missing; callers keep
-    using numpy fallbacks until it loads (Session starts this)."""
+    """Kick off a background build when the library is missing OR a stale
+    version is on disk; callers keep using numpy fallbacks (and the current
+    features they have) until the fresh build loads (Session starts this)."""
     global _build_thread
-    if os.path.exists(_SO_PATH) or os.environ.get("BLAZE_TPU_NO_NATIVE_BUILD"):
+    if os.environ.get("BLAZE_TPU_NO_NATIVE_BUILD"):
         return
+    if os.path.exists(_SO_PATH):
+        l = lib()
+        if l is not None and l.bt_version() >= CURRENT_VERSION:
+            return
+        # stale prebuilt: rebuild in the background; the loaded copy keeps
+        # serving its own feature set meanwhile
     with _lock:
         if _build_thread is not None:
             return
