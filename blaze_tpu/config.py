@@ -48,6 +48,11 @@ class Config:
     skew_join_factor: float = 3.0
     skew_join_min_bytes: int = 64 << 20
 
+    # Device FINAL/PARTIAL_MERGE aggregation buffers all partial-state
+    # batches before one merge kernel call; beyond this size it falls back
+    # to the spill-capable host table.
+    device_merge_max_bytes: int = 256 << 20
+
     # Device HBM budget for resident batch data (bytes). None = ask the device.
     hbm_budget: Optional[int] = None
 
