@@ -53,6 +53,12 @@ class Config:
     # to the spill-capable host table.
     device_merge_max_bytes: int = 256 << 20
 
+    # AQE small-partition coalescing (Spark's coalescePartitions): adjacent
+    # reducer partitions below the advisory size merge into one read task
+    # when no ancestor relies on the exchange's partition count.
+    coalesce_partitions_enable: bool = True
+    advisory_partition_bytes: int = 8 << 20
+
     # Device HBM budget for resident batch data (bytes). None = ask the device.
     hbm_budget: Optional[int] = None
 

@@ -64,6 +64,27 @@ class _SubsetBlockProvider:
         return blocks
 
 
+class _CoalescedBlockProvider:
+    """Read-side partition p serves the file segments of a GROUP of
+    adjacent reducers (AQE coalescing; reference receives coalesced
+    partition specs from Spark AQE the same way)."""
+
+    def __init__(self, indexes, groups):
+        import numpy as np
+
+        self.indexes = [(path, np.asarray(offsets)) for path, offsets in indexes]
+        self.groups = groups
+
+    def __call__(self, p: int):
+        blocks = []
+        for r in self.groups[p]:
+            for data, offsets in self.indexes:
+                start, end = int(offsets[r]), int(offsets[r + 1])
+                if end > start:
+                    blocks.append(("file_segment", data, start, end - start))
+        return blocks
+
+
 class Session:
     def __init__(self, conf: Optional[Config] = None, work_dir: Optional[str] = None,
                  max_workers: Optional[int] = None, mesh=None,
@@ -227,11 +248,14 @@ class Session:
             if out is not None:
                 return out
         prev_dist_ok = getattr(self, "_dist_ok", True)
+        prev_zip_ok = getattr(self, "_zip_ok", True)
         self._dist_ok = self._child_dist_ok(node, prev_dist_ok)
+        self._zip_ok = self._child_zip_ok(node, prev_zip_ok)
         try:
             node = N.map_children(node, self._lower)
         finally:
             self._dist_ok = prev_dist_ok
+            self._zip_ok = prev_zip_ok
         if isinstance(node, N.ShuffleExchange):
             if isinstance(node.partitioning, N.RangePartitioning) and \
                     not node.partitioning.bounds and \
@@ -250,6 +274,19 @@ class Session:
         if isinstance(node, N.BroadcastExchange):
             return self._run_broadcast_collect(node)
         return node
+
+    @staticmethod
+    def _child_zip_ok(node: N.PlanNode, own_zip_ok: bool) -> bool:
+        """May a child's partition COUNT change (whole partitions merged)?
+        Only partition-ZIPPING parents forbid it: joins pair partition i of
+        both children, unions map partitions positionally. Group-confining
+        operators (agg/window) are fine with merged whole partitions —
+        exactly Spark coalescePartitions' soundness rule."""
+        if isinstance(node, (N.ShuffleExchange, N.BroadcastExchange)):
+            return True
+        if isinstance(node, (N.SortMergeJoin, N.HashJoin, N.Union)):
+            return False
+        return own_zip_ok
 
     @staticmethod
     def _child_dist_ok(node: N.PlanNode, own_dist_ok: bool) -> bool:
@@ -364,7 +401,18 @@ class Session:
         num_reducers = node.partitioning.num_partitions
         stage, indexes = self._exec_map_stage(node)
         rid = f"shuffle_{stage}"
-        self.resources[rid] = FileSegmentBlockProvider(indexes)
+        groups = self._coalesce_reducers(indexes, num_reducers)
+        if groups is not None:
+            # AQE partition coalescing (Spark coalescePartitions): adjacent
+            # small reducers merge into one read task; sound because merging
+            # WHOLE reducer partitions keeps every group/range confined to
+            # one partition, and the _dist_ok guard blocks it under
+            # partition-zipping ancestors
+            self.metrics.add("coalesced_partitions", num_reducers - len(groups))
+            self.resources[rid] = _CoalescedBlockProvider(indexes, groups)
+            num_reducers = len(groups)
+        else:
+            self.resources[rid] = FileSegmentBlockProvider(indexes)
         # coalesce reducer input: maps emit many small (e.g. per-batch
         # partial-agg) batches; merging them cuts downstream per-batch
         # overheads (reference: ExecutionContext.coalesce on every stream)
@@ -485,6 +533,30 @@ class Session:
         if rsort is not None:
             right = dataclasses.replace(rsort, child=right)
         return dataclasses.replace(node, left=left, right=right)
+
+    def _coalesce_reducers(self, indexes, num_reducers: int):
+        """Greedy adjacent merge of under-sized reducer partitions; returns
+        the list of reducer groups, or None when coalescing is off, unsound
+        (a partition-zipping ancestor), or a no-op."""
+        import numpy as np
+
+        if not self.conf.coalesce_partitions_enable or num_reducers <= 1 \
+                or not getattr(self, "_zip_ok", True):
+            return None
+        sizes = np.zeros(num_reducers, dtype=np.int64)
+        for _, offsets in indexes:
+            sizes += offsets[1:num_reducers + 1] - offsets[:num_reducers]
+        target = self.conf.advisory_partition_bytes
+        groups, cur, cur_bytes = [], [], 0
+        for r in range(num_reducers):
+            cur.append(r)
+            cur_bytes += int(sizes[r])
+            if cur_bytes >= target:
+                groups.append(cur)
+                cur, cur_bytes = [], 0
+        if cur:
+            groups.append(cur)
+        return groups if len(groups) < num_reducers else None
 
     def _run_rss_map_stage(self, node: N.ShuffleExchange) -> N.PlanNode:
         """Push-shuffle: map tasks push partition frames to the RSS server

@@ -278,3 +278,71 @@ def test_collect_agg_state_through_exchange():
     got = {k: (sorted(cl), sorted(cs))
            for k, cl, cs in zip(out["k"], out["cl"], out["cs"])}
     assert got == {1: (["a", "b"], ["a", "b"]), 2: (["c", "c"], ["c"])}
+
+
+def test_aqe_partition_coalescing(tmp_path):
+    """Small adjacent reducers merge into one read task (Spark
+    coalescePartitions); results identical, metric records the merges."""
+    import pyarrow.parquet as pq
+
+    from blaze_tpu.config import config_override
+    from blaze_tpu.ops.parquet import scan_node_for_files
+    from blaze_tpu.runtime.session import Session
+
+    rng = np.random.default_rng(5)
+    tbl = pa.table({"k": pa.array(rng.integers(0, 100, 5000), type=pa.int64()),
+                    "v": pa.array(rng.integers(0, 10, 5000), type=pa.int64())})
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(tbl, p)
+    scan = scan_node_for_files([p], num_partitions=2)
+    partial = N.Agg(scan, E.AggExecMode.HASH_AGG, [("k", E.Column("k"))], [
+        N.AggColumn(E.AggExpr(E.AggFunction.SUM, [E.Column("v")]),
+                    E.AggMode.PARTIAL, "s")])
+    ex = N.ShuffleExchange(partial, N.HashPartitioning([E.Column("k")], 16))
+    final = N.Agg(ex, E.AggExecMode.HASH_AGG, [("k", E.Column("k"))], [
+        N.AggColumn(E.AggExpr(E.AggFunction.SUM, [E.Column("v")]),
+                    E.AggMode.FINAL, "s")])
+    plan = N.Sort(N.ShuffleExchange(final, N.SinglePartitioning(1)),
+                  [E.SortOrder(E.Column("k"))])
+    with Session() as s:
+        out = s.execute_to_table(plan).to_pydict()
+        assert s.metrics.total("coalesced_partitions") >= 10
+    df = tbl.to_pandas().groupby("k").v.sum()
+    assert out["k"] == df.index.tolist()
+    assert out["s"] == df.tolist()
+    with config_override(coalesce_partitions_enable=False):
+        with Session() as s2:
+            out2 = s2.execute_to_table(plan).to_pydict()
+            assert s2.metrics.total("coalesced_partitions") == 0
+    assert out2 == out
+
+
+def test_coalescing_blocked_under_join(tmp_path):
+    """A partition-zipping parent (SMJ) must keep both exchanges at the full
+    reducer count — coalescing one side would misalign the zip."""
+    import pyarrow.parquet as pq
+
+    from blaze_tpu.config import config_override
+    from blaze_tpu.ops.parquet import scan_node_for_files
+    from blaze_tpu.runtime.session import Session
+
+    rng = np.random.default_rng(7)
+    left = pa.table({"lk": pa.array(rng.integers(0, 50, 2000), type=pa.int64()),
+                     "lv": pa.array(rng.integers(0, 5, 2000), type=pa.int64())})
+    right = pa.table({"rk": pa.array(np.arange(50), type=pa.int64()),
+                      "rv": pa.array(np.arange(50) * 2, type=pa.int64())})
+    lp, rp = str(tmp_path / "l.parquet"), str(tmp_path / "r.parquet")
+    pq.write_table(left, lp)
+    pq.write_table(right, rp)
+    lex = N.ShuffleExchange(scan_node_for_files([lp]),
+                            N.HashPartitioning([E.Column("lk")], 8))
+    rex = N.ShuffleExchange(scan_node_for_files([rp]),
+                            N.HashPartitioning([E.Column("rk")], 8))
+    smj = N.SortMergeJoin(N.Sort(lex, [E.SortOrder(E.Column("lk"))]),
+                          N.Sort(rex, [E.SortOrder(E.Column("rk"))]),
+                          [(E.Column("lk"), E.Column("rk"))], N.JoinType.INNER)
+    with config_override(skew_join_enable=False):
+        with Session() as s:
+            out = s.execute_to_table(smj).to_pydict()
+            assert s.metrics.total("coalesced_partitions") == 0
+    assert len(out["lk"]) == 2000
