@@ -410,8 +410,6 @@ def supports_device_merge(op, child_schema: T.Schema) -> bool:
     are device-resident with device-mode aggregate functions."""
     if not op.input_is_partial or not op.groupings:
         return False
-    from blaze_tpu.ops import aggfns
-
     for _, e in op.groupings:
         if not is_device_dtype(E.infer_type(e, child_schema)):
             return False
@@ -450,8 +448,6 @@ class DeviceMergeAgger:
         self.kinds = tuple(self._KINDS[a.agg.fn] for a in op.aggs)
 
     def run(self, batches: List[ColumnarBatch]):
-        import numpy as np
-
         op = self.op
         batches = [b for b in batches if b.num_rows]
         if not batches:
@@ -497,9 +493,6 @@ class DeviceMergeAgger:
             nstate = {"sum": 2, "count": 1, "avg": 2, "min": 2, "max": 2}[kind]
             state = list(outs[p:p + nstate])
             p += nstate
-            if kind in ("min", "max"):
-                # final_column/state_columns expect [val, has]
-                pass
             if final:
                 cols.append(fn.final_column(state, num_groups, capacity))
             else:

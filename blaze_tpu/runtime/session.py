@@ -406,8 +406,8 @@ class Session:
             # AQE partition coalescing (Spark coalescePartitions): adjacent
             # small reducers merge into one read task; sound because merging
             # WHOLE reducer partitions keeps every group/range confined to
-            # one partition, and the _dist_ok guard blocks it under
-            # partition-zipping ancestors
+            # one partition, and the _zip_ok guard blocks it under
+            # partition-zipping ancestors (joins/unions)
             self.metrics.add("coalesced_partitions", num_reducers - len(groups))
             self.resources[rid] = _CoalescedBlockProvider(indexes, groups)
             num_reducers = len(groups)
@@ -549,11 +549,14 @@ class Session:
         target = self.conf.advisory_partition_bytes
         groups, cur, cur_bytes = [], [], 0
         for r in range(num_reducers):
-            cur.append(r)
-            cur_bytes += int(sizes[r])
-            if cur_bytes >= target:
+            # close the open group BEFORE a partition that would overflow it
+            # (Spark's rule) — otherwise a huge reducer absorbs the small run
+            # before it and the merged task far exceeds the advisory size
+            if cur and cur_bytes + int(sizes[r]) > target:
                 groups.append(cur)
                 cur, cur_bytes = [], 0
+            cur.append(r)
+            cur_bytes += int(sizes[r])
         if cur:
             groups.append(cur)
         return groups if len(groups) < num_reducers else None
