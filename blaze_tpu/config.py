@@ -59,6 +59,11 @@ class Config:
     coalesce_partitions_enable: bool = True
     advisory_partition_bytes: int = 8 << 20
 
+    # Task retry policy for transient failures (deterministic errors fail
+    # fast; reference delegates this to Spark's TaskScheduler).
+    task_max_retries: int = 2
+    task_retry_backoff_s: float = 0.2
+
     # Device HBM budget for resident batch data (bytes). None = ask the device.
     hbm_budget: Optional[int] = None
 

@@ -346,3 +346,36 @@ def test_coalescing_blocked_under_join(tmp_path):
             out = s.execute_to_table(smj).to_pydict()
             assert s.metrics.total("coalesced_partitions") == 0
     assert len(out["lk"]) == 2000
+
+
+def test_task_retry_classification(tmp_path):
+    """Transient task failures retry with backoff; deterministic ones fail
+    fast (round-1 weak #6: no more blind retry of certain bugs)."""
+    import pyarrow.parquet as pq
+    import pytest
+
+    from blaze_tpu.runtime.session import Session
+
+    with Session() as s:
+        calls = {"n": 0}
+
+        def flaky(p):
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("transient io hiccup")
+            return "ok"
+
+        assert s._run_tasks(flaky, [0]) == ["ok"]
+        assert s.metrics.get("task_retries") == 2
+
+    with Session() as s:
+        det = {"n": 0}
+
+        def broken(p):
+            det["n"] += 1
+            raise ValueError("deterministic bug")
+
+        with pytest.raises(ValueError):
+            s._run_tasks(broken, [0])
+        assert det["n"] == 1, "deterministic errors must not retry"
+        assert s.metrics.get("task_failures") == 1
