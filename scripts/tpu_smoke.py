@@ -149,6 +149,23 @@ def main():
     mesh_out = run_distributed_sum(keys, ones, make_mesh(1))
     assert sum(c for _, c in mesh_out.values()) == len(keys)
     print("mesh exchange kernel OK on device")
+
+    # round-2 device paths on real hardware: the general batch exchange
+    # through Session(mesh) and the device FINAL merge kernel
+    from blaze_tpu.runtime.session import Session as _S
+    from blaze_tpu.utils.device import DEVICE_STATS
+
+    DEVICE_STATS.reset()
+    with _S(mesh=make_mesh(1)) as sm:
+        sm.resources["sales"] = sess.resources["sales"]
+        sm.resources["stores"] = sess.resources["stores"]
+        t0 = time.perf_counter()
+        out3 = sm.execute_to_pydict(plan)
+        t1 = time.perf_counter()
+    assert out3["region"] == exp2.index.tolist()
+    assert out3["n"] == exp2.tolist()
+    print(f"mesh-exchange Session OK in {t1 - t0:.2f}s; "
+          f"device stats: {DEVICE_STATS.snapshot()}")
     print("TPU SMOKE OK")
 
 
