@@ -105,6 +105,20 @@ class WorkerPool:
                 send_msg(w.sock, {"set_shared": shared})
                 recv_msg(w.sock)
 
+        outstanding: Dict[int, dict] = {}  # dispatched, not yet completed
+        out_mu = threading.Lock()
+
+        def steal_speculative():
+            """Idle worker + empty queue: re-run an outstanding task
+            (straggler speculation — Spark's speculative execution; safe
+            because shuffle writes are atomic renames and RSS pushes dedup
+            by attempt; first completion wins)."""
+            with out_mu:
+                for i, msg in outstanding.items():
+                    if i not in results:
+                        return (i, msg, 0)
+            return None
+
         def serve(w: _Worker):
             try:
                 push_shared(w)
@@ -119,7 +133,13 @@ class WorkerPool:
                 try:
                     i, msg, attempt = pending.get(timeout=0.1)
                 except queue.Empty:
-                    continue
+                    spec = steal_speculative()
+                    if spec is None:
+                        continue
+                    i, msg, attempt = spec
+                    log.info("speculatively re-running task %d", i)
+                with out_mu:
+                    outstanding[i] = msg
                 try:
                     send_msg(w.sock, msg)
                     reply = recv_msg(w.sock)
@@ -128,7 +148,7 @@ class WorkerPool:
                     log.warning("worker %d lost running task %d (%s)",
                                 w.wid, i, exc)
                     self._retry_or_fail(pending, errors, done, i, msg, attempt,
-                                        f"worker lost: {exc}")
+                                        f"worker lost: {exc}", results)
                     try:
                         w.kill()
                         w.spawn()
@@ -138,14 +158,16 @@ class WorkerPool:
                         log.error("respawn failed: %s", spawn_exc)
                         return
                 if reply.get("ok"):
-                    results[i] = reply
+                    results.setdefault(i, reply)  # first completion wins
                     if len(results) == len(task_msgs):
                         done.set()
+                elif i in results:
+                    pass  # a speculative copy lost to the original; ignore
                 else:
                     log.warning("task %d failed on worker %d: %s",
                                 i, w.wid, reply.get("error"))
                     self._retry_or_fail(pending, errors, done, i, msg, attempt,
-                                        reply.get("error", "unknown"))
+                                        reply.get("error", "unknown"), results)
 
         threads = [threading.Thread(target=serve, args=(w,), daemon=True)
                    for w in self.workers]
@@ -158,7 +180,10 @@ class WorkerPool:
             raise TaskFailed("; ".join(errors))
         return [results[i] for i in range(len(task_msgs))]
 
-    def _retry_or_fail(self, pending, errors, done, i, msg, attempt, reason):
+    def _retry_or_fail(self, pending, errors, done, i, msg, attempt, reason,
+                       results):
+        if i in results:
+            return  # another (speculative) attempt already completed
         if attempt + 1 <= self.max_task_retries:
             pending.put((i, msg, attempt + 1))
         else:
