@@ -33,8 +33,12 @@ def agg_state_fields(fn: E.AggFunction, arg_t: T.DataType,
         return [("val", result_t), ("has", T.BOOL)]
     if fn in (F.FIRST, F.FIRST_IGNORES_NULL):
         return [("val", result_t), ("valid", T.BOOL), ("order", T.I64)]
-    if fn in (F.COLLECT_LIST, F.COLLECT_SET):
+    if fn in (F.COLLECT_LIST, F.COLLECT_SET, F.BRICKHOUSE_COLLECT):
         return [("items", T.ArrayType(arg_t))]
+    if fn == F.BRICKHOUSE_COMBINE_UNIQUE:
+        # arg is already an array; state unions its elements
+        elem = arg_t.element_type if isinstance(arg_t, T.ArrayType) else arg_t
+        return [("items", T.ArrayType(elem))]
     if fn == F.BLOOM_FILTER:
         return [("bloom", T.BINARY)]
     if fn == F.UDAF:

@@ -262,6 +262,10 @@ class AggFunction(enum.Enum):
     FIRST = "first"
     FIRST_IGNORES_NULL = "first_ignores_null"
     BLOOM_FILTER = "bloom_filter"
+    # brickhouse UDAFs the reference ships natively (auron.proto AggFunction
+    # BRICKHOUSE_COLLECT / BRICKHOUSE_COMBINE_UNIQUE, agg/brickhouse.rs)
+    BRICKHOUSE_COLLECT = "brickhouse_collect"
+    BRICKHOUSE_COMBINE_UNIQUE = "brickhouse_combine_unique"
     UDAF = "udaf"
 
 
@@ -397,6 +401,9 @@ def agg_result_type(fn: AggFunction, arg_t: T.DataType) -> T.DataType:
         if arg_t in (T.I8, T.I16, T.I32, T.I64):
             return T.I64
         return T.F64
-    if fn in (AggFunction.COLLECT_LIST, AggFunction.COLLECT_SET):
+    if fn in (AggFunction.COLLECT_LIST, AggFunction.COLLECT_SET,
+              AggFunction.BRICKHOUSE_COLLECT):
         return T.ArrayType(arg_t)
+    if fn == AggFunction.BRICKHOUSE_COMBINE_UNIQUE:
+        return arg_t  # array in, array out
     return arg_t

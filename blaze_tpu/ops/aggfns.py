@@ -673,6 +673,29 @@ class CollectAgg(AggFunction):
         return sum(64 + 16 * len(v) for v in d.values())
 
 
+class CombineUniqueAgg(CollectAgg):
+    """brickhouse combine_unique: the argument column holds ARRAYS; the
+    aggregate unions their elements per group, deduped (reference:
+    agg/brickhouse.rs combine_unique over UserDefinedArray states)."""
+
+    def __init__(self, agg, arg_type, result_type):
+        elem = arg_type.element_type if isinstance(arg_type, T.ArrayType) else arg_type
+        super().__init__(agg, elem, T.ArrayType(elem), distinct=True)
+
+    def update(self, state, slots, value, validity, mask, order=None):
+        (d,) = state
+        rows = value.to_pylist()
+        for i, items in enumerate(rows):
+            if not mask[i] or items is None:
+                continue
+            s = int(slots[i])
+            lst = d.setdefault(s, [])
+            for v in items:
+                if v is not None and v not in lst:
+                    lst.append(v)
+        return [d]
+
+
 class BloomFilterAgg(AggFunction):
     """bloom_filter aggregate building a Spark-compatible bloom filter over
     int64 values (reference: agg/bloom_filter.rs + spark_bloom_filter.rs)."""
@@ -808,6 +831,10 @@ def create_agg_function(agg: E.AggExpr, input_schema: T.Schema) -> AggFunction:
         return CollectAgg(agg, arg_t, result_t, distinct=False)
     if agg.fn == F.COLLECT_SET:
         return CollectAgg(agg, arg_t, result_t, distinct=True)
+    if agg.fn == F.BRICKHOUSE_COLLECT:
+        return CollectAgg(agg, arg_t, result_t, distinct=False)
+    if agg.fn == F.BRICKHOUSE_COMBINE_UNIQUE:
+        return CombineUniqueAgg(agg, arg_t, result_t)
     if agg.fn == F.BLOOM_FILTER:
         return BloomFilterAgg(agg, arg_t, result_t)
     if agg.fn == F.UDAF:

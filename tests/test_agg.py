@@ -450,3 +450,51 @@ def test_device_final_merge_matches_host_table():
     expect, m_host = run_final(device_merge_max_bytes=0)
     assert m_host.total("device_merge_batches") == 0
     assert got == expect
+
+
+def test_brickhouse_collect_and_combine_unique():
+    """Reference auron.proto AggFunction BRICKHOUSE_COLLECT /
+    BRICKHOUSE_COMBINE_UNIQUE (agg/brickhouse.rs): collect keeps
+    duplicates; combine_unique unions array inputs per group."""
+    data = {
+        "k": pa.array([1, 1, 2, 2], type=pa.int64()),
+        "v": pa.array(["a", "a", "b", "c"]),
+        "arr": pa.array([["x", "y"], ["y", "z"], ["q"], None],
+                        type=pa.list_(pa.string())),
+    }
+    scan = mem_scan(data, num_batches=2)
+    op = AggExec(scan, HASH, [("k", col("k"))], [
+        agg_col(F.BRICKHOUSE_COLLECT, [col("v")], M.COMPLETE, "c"),
+        agg_col(F.BRICKHOUSE_COMBINE_UNIQUE, [col("arr")], M.COMPLETE, "u"),
+    ])
+    out = _sorted_out(op, "k")
+    assert out["k"] == [1, 2]
+    assert out["c"] == [["a", "a"], ["b", "c"]]  # duplicates kept
+    assert [sorted(u) for u in out["u"]] == [["x", "y", "z"], ["q"]]
+
+    # two-stage: states cross a real exchange
+    import tempfile, os
+
+    import pyarrow.parquet as pq
+
+    from blaze_tpu.ops.parquet import scan_node_for_files
+    from blaze_tpu.runtime.session import Session
+
+    td = tempfile.mkdtemp()
+    path = os.path.join(td, "t.parquet")
+    pq.write_table(pa.table(data), path)
+    scan_node = scan_node_for_files([path], num_partitions=2)
+    arr_t = T.ArrayType(T.STRING)
+    partial = N.Agg(scan_node, HASH, [("k", col("k"))], [
+        N.AggColumn(E.AggExpr(F.BRICKHOUSE_COMBINE_UNIQUE, [col("arr")], arr_t),
+                    M.PARTIAL, "u")])
+    ex = N.ShuffleExchange(partial, N.HashPartitioning([col("k")], 2))
+    final = N.Agg(ex, HASH, [("k", col("k"))], [
+        N.AggColumn(E.AggExpr(F.BRICKHOUSE_COMBINE_UNIQUE, [col("arr")], arr_t),
+                    M.FINAL, "u")])
+    plan = N.Sort(N.ShuffleExchange(final, N.SinglePartitioning(1)),
+                  [E.SortOrder(col("k"))])
+    with Session() as s:
+        out2 = s.execute_to_table(plan).to_pydict()
+    assert out2["k"] == [1, 2]
+    assert [sorted(u) for u in out2["u"]] == [["x", "y", "z"], ["q"]]
