@@ -405,5 +405,7 @@ def agg_result_type(fn: AggFunction, arg_t: T.DataType) -> T.DataType:
               AggFunction.BRICKHOUSE_COLLECT):
         return T.ArrayType(arg_t)
     if fn == AggFunction.BRICKHOUSE_COMBINE_UNIQUE:
-        return arg_t  # array in, array out
+        # array in, array out; a scalar argument still yields an array of
+        # its deduped values (matches CombineUniqueAgg/agg_state_fields)
+        return arg_t if isinstance(arg_t, T.ArrayType) else T.ArrayType(arg_t)
     return arg_t

@@ -647,14 +647,18 @@ class CollectAgg(AggFunction):
 
     def merge(self, state, slots, partial_cols, mask, n):
         (plist,) = partial_cols
+        return self._union_rows(state, slots, plist.array.to_pylist(), mask)
+
+    def _union_rows(self, state, slots, rows, mask):
         (d,) = state
-        rows = plist.array.to_pylist()
         for i, items in enumerate(rows):
             if not mask[i] or items is None:
                 continue
             s = int(slots[i])
             lst = d.setdefault(s, [])
             for v in items:
+                if v is None:
+                    continue
                 if not self.distinct or v not in lst:
                     lst.append(v)
         return [d]
@@ -683,17 +687,7 @@ class CombineUniqueAgg(CollectAgg):
         super().__init__(agg, elem, T.ArrayType(elem), distinct=True)
 
     def update(self, state, slots, value, validity, mask, order=None):
-        (d,) = state
-        rows = value.to_pylist()
-        for i, items in enumerate(rows):
-            if not mask[i] or items is None:
-                continue
-            s = int(slots[i])
-            lst = d.setdefault(s, [])
-            for v in items:
-                if v is not None and v not in lst:
-                    lst.append(v)
-        return [d]
+        return self._union_rows(state, slots, value.to_pylist(), mask)
 
 
 class BloomFilterAgg(AggFunction):

@@ -119,10 +119,18 @@ class _WriterState(MemConsumer):
 
     def finish(self):
         """Merge in-memory + spilled per-partition segments into the final
-        data file (partition-major) and write the offset index."""
+        data file (partition-major) and write the offset index. BOTH files
+        publish via per-attempt unique tmp paths + atomic os.replace:
+        concurrent attempts of the same task (retry races, straggler
+        speculation) each write their own staging files and the completed
+        publishes are whole-file swaps — deterministic map output makes
+        either winner equivalent."""
+        import uuid
+
+        attempt = uuid.uuid4().hex
         mem = {pid: payload for pid, payload in self.streams.payloads()}
         offsets = np.zeros(self.n + 1, dtype=np.int64)
-        tmp = self.op.output_data_file + ".tmp"
+        tmp = f"{self.op.output_data_file}.tmp.{attempt}"
         os.makedirs(os.path.dirname(tmp) or ".", exist_ok=True)
         with open(tmp, "wb") as out:
             for pid in range(self.n):
@@ -136,8 +144,10 @@ class _WriterState(MemConsumer):
                     out.write(mem[pid])
             offsets[self.n] = out.tell()
         os.replace(tmp, self.op.output_data_file)
-        with open(self.op.output_index_file, "wb") as idx:
+        itmp = f"{self.op.output_index_file}.tmp.{attempt}"
+        with open(itmp, "wb") as idx:
             idx.write(offsets.astype("<i8").tobytes())
+        os.replace(itmp, self.op.output_index_file)
         self.metrics.add("data_size", int(offsets[self.n]))
         self.streams = _PartitionStreams(self.n, self.ctx.conf.shuffle_compression_codec)
 

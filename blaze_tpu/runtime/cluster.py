@@ -43,6 +43,7 @@ class _Worker:
         self.wid = wid
         self.proc: Optional[subprocess.Popen] = None
         self.sock: Optional[socket.socket] = None
+        self.in_flight = False
 
     def spawn(self):
         env = dict(os.environ)
@@ -67,10 +68,17 @@ class _Worker:
             self.proc.wait(timeout=10)
 
 
+_SPECULATIVE = -1  # attempt marker: failures of a speculative copy are ignored
+
+
 class WorkerPool:
-    def __init__(self, num_workers: int, max_task_retries: int = 2):
+    def __init__(self, num_workers: int, max_task_retries: int = 2,
+                 speculation_min_s: float = 5.0):
         self.num_workers = num_workers
         self.max_task_retries = max_task_retries
+        # a task must have been running this long before an idle worker may
+        # launch its ONE speculative copy (Spark gates on a runtime quantile)
+        self.speculation_min_s = speculation_min_s
         self.repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
         self._sockdir = tempfile.mkdtemp(prefix="blaze_pool_")
@@ -105,18 +113,25 @@ class WorkerPool:
                 send_msg(w.sock, {"set_shared": shared})
                 recv_msg(w.sock)
 
-        outstanding: Dict[int, dict] = {}  # dispatched, not yet completed
+        import time
+
+        outstanding: Dict[int, tuple] = {}  # i -> (msg, started_at)
+        speculated: set = set()
         out_mu = threading.Lock()
 
         def steal_speculative():
-            """Idle worker + empty queue: re-run an outstanding task
-            (straggler speculation — Spark's speculative execution; safe
-            because shuffle writes are atomic renames and RSS pushes dedup
-            by attempt; first completion wins)."""
+            """Idle worker + empty queue: launch ONE speculative copy of a
+            long-outstanding task (straggler speculation, Spark-style but
+            time-gated rather than quantile-gated; safe because both shuffle
+            files and the RSS pushes publish atomically per attempt; first
+            completion wins, speculative failures are ignored)."""
+            now = time.monotonic()
             with out_mu:
-                for i, msg in outstanding.items():
-                    if i not in results:
-                        return (i, msg, 0)
+                for i, (msg, t0) in outstanding.items():
+                    if i not in results and i not in speculated and \
+                            now - t0 >= self.speculation_min_s:
+                        speculated.add(i)
+                        return (i, msg, _SPECULATIVE)
             return None
 
         def serve(w: _Worker):
@@ -138,17 +153,23 @@ class WorkerPool:
                         continue
                     i, msg, attempt = spec
                     log.info("speculatively re-running task %d", i)
-                with out_mu:
-                    outstanding[i] = msg
+                if attempt != _SPECULATIVE:
+                    with out_mu:
+                        outstanding[i] = (msg, time.monotonic())
+                w.in_flight = True
                 try:
                     send_msg(w.sock, msg)
                     reply = recv_msg(w.sock)
                 except (EOFError, OSError) as exc:
+                    if done.is_set():
+                        return  # stage over (e.g. channel reset); stand down
                     # worker lost mid-task: respawn and retry elsewhere
                     log.warning("worker %d lost running task %d (%s)",
                                 w.wid, i, exc)
-                    self._retry_or_fail(pending, errors, done, i, msg, attempt,
-                                        f"worker lost: {exc}", results)
+                    if attempt != _SPECULATIVE:
+                        self._retry_or_fail(pending, errors, done, i, msg,
+                                            attempt, f"worker lost: {exc}",
+                                            results)
                     try:
                         w.kill()
                         w.spawn()
@@ -157,12 +178,14 @@ class WorkerPool:
                     except Exception as spawn_exc:  # pool shrinks
                         log.error("respawn failed: %s", spawn_exc)
                         return
+                finally:
+                    w.in_flight = False
                 if reply.get("ok"):
                     results.setdefault(i, reply)  # first completion wins
                     if len(results) == len(task_msgs):
                         done.set()
-                elif i in results:
-                    pass  # a speculative copy lost to the original; ignore
+                elif attempt == _SPECULATIVE or i in results:
+                    pass  # speculative copies never consume retry budget
                 else:
                     log.warning("task %d failed on worker %d: %s",
                                 i, w.wid, reply.get("error"))
@@ -176,6 +199,16 @@ class WorkerPool:
         done.wait()
         for t in threads:
             t.join(timeout=5)
+        # a serve thread still blocked in recv (losing speculative copy or
+        # straggler original) would desynchronize this worker's
+        # request/reply channel for the NEXT stage — reset such workers
+        for w, t in zip(self.workers, threads):
+            if t.is_alive() or getattr(w, "in_flight", False):
+                try:
+                    w.kill()
+                    w.spawn()
+                except Exception as exc:
+                    log.error("post-stage worker reset failed: %s", exc)
         if errors:
             raise TaskFailed("; ".join(errors))
         return [results[i] for i in range(len(task_msgs))]

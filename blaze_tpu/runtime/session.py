@@ -792,9 +792,23 @@ class Session:
             while True:
                 try:
                     return fn(p)
-                except self._DETERMINISTIC_ERRORS:
-                    self.metrics.add("task_failures", 1)
-                    raise
+                except self._DETERMINISTIC_ERRORS as exc:
+                    import pyarrow as _pa
+
+                    if isinstance(exc, _pa.ArrowInvalid):
+                        # pyarrow IO errors subclass ValueError but are often
+                        # transient (short reads on flaky filesystems): treat
+                        # as retryable, not deterministic
+                        pass
+                    else:
+                        self.metrics.add("task_failures", 1)
+                        raise
+                    attempt += 1
+                    self.metrics.add("task_retries", 1)
+                    if attempt > self.conf.task_max_retries:
+                        self.metrics.add("task_failures", 1)
+                        raise
+                    time.sleep(self.conf.task_retry_backoff_s * (2 ** (attempt - 1)))
                 except Exception as exc:
                     attempt += 1
                     self.metrics.add("task_retries", 1)
