@@ -542,6 +542,10 @@ def _parse_frame(spec: TreeNode):
     if "SpecifiedWindowFrame" in text and "RowFrame" not in text:
         if "UnboundedPreceding" in text and "CurrentRow" in text:
             return None  # RANGE UNBOUNDED .. CURRENT ROW == the default
+        if isinstance(frame, dict):
+            lo = _frame_bound(frame.get("lower"))
+            hi = _frame_bound(frame.get("upper"))
+            return ("range", lo, hi)
         raise UnsupportedNode(f"RANGE frame with offsets: {text[:120]}")
     if "RowFrame" in text and isinstance(frame, dict):
         lo = _frame_bound(frame.get("lower"))

@@ -267,6 +267,53 @@ class WindowExec(Operator):
                 out = out.take(keep)
         return out
 
+    def _range_frame_bounds(self, part: ColumnarBatch, lo, hi, n: int):
+        """Per-row [start, end) over a RANGE frame: searchsorted against the
+        partition's single numeric order key (input is sorted by it). Null
+        order keys form their own run whose frame is exactly that run
+        (Spark: null peers). Descending orders negate the key axis."""
+        if len(self.order_spec) != 1:
+            raise NotImplementedError("RANGE frame needs a single order key")
+        so = self.order_spec[0]
+        ev = ExprEvaluator([so.child], part.schema)
+        col = ev.evaluate(part)[0]
+        arr = col.to_arrow(n)
+        valid = (~np.asarray(arr.is_null())) if arr.null_count else np.ones(n, bool)
+        keys = arr.fill_null(0).to_numpy(zero_copy_only=False).astype(np.float64)
+        if not so.ascending:
+            keys = -keys
+        start = np.zeros(n, np.int64)
+        end_excl = np.full(n, n, np.int64)
+        if valid.all():
+            nn_lo, nn_hi, kk = 0, n, keys
+        else:
+            # the null run is contiguous (sorted input): its rows frame over
+            # the run itself; non-null rows search only the non-null span
+            nn_idx = np.nonzero(valid)[0]
+            nn_lo, nn_hi = int(nn_idx[0]), int(nn_idx[-1]) + 1
+            if not valid[nn_lo:nn_hi].all():
+                raise NotImplementedError("non-contiguous null order keys")
+            null_rows = ~valid
+            run_lo = 0 if null_rows[0] else nn_hi
+            run_hi = nn_lo if null_rows[0] else n
+            start[null_rows] = run_lo
+            end_excl[null_rows] = run_hi
+            kk = keys[nn_lo:nn_hi]
+        # lower bound: key + lo (lo <= 0 for PRECEDING offsets)
+        if lo is not None:
+            targets = keys + float(lo)
+            s = np.searchsorted(kk, targets, side="left") + nn_lo
+            start[valid] = s[valid]
+        else:
+            start[valid] = nn_lo
+        if hi is not None:
+            targets = keys + float(hi)
+            e = np.searchsorted(kk, targets, side="right") + nn_lo
+            end_excl[valid] = e[valid]
+        else:
+            end_excl[valid] = nn_hi
+        return start, end_excl
+
     def _window_agg(self, w: WindowExpr, part: ColumnarBatch, new_peer: np.ndarray):
         n = part.num_rows
         agg = w.agg
@@ -294,15 +341,21 @@ class WindowExec(Operator):
         has_order = bool(self.order_spec)
         masked = np.where(valid, nv, 0) if nv.dtype != object else nv
         frame = tuple(w.frame) if w.frame is not None else None
-        if frame is not None and frame[0] == "rows":
-            # explicit ROWS frame (reference: SpecifiedWindowFrame RowFrame):
-            # per-row [start, end) windows via padded prefix sums
+        if frame is not None and frame[0] in ("rows", "range"):
+            # explicit frame (reference: SpecifiedWindowFrame). ROWS: per-row
+            # [i+lo, i+hi] index windows. RANGE: value windows
+            # [key-|lo|, key+hi] resolved by searchsorted over the
+            # partition's (already sorted) single order key — CURRENT ROW
+            # bounds include peers, matching Spark RANGE semantics.
             lo, hi = frame[1], frame[2]
             idx = np.arange(n)
-            start = np.zeros(n, np.int64) if lo is None else \
-                np.clip(idx + int(lo), 0, n)
-            end_excl = np.full(n, n, np.int64) if hi is None else \
-                np.clip(idx + int(hi) + 1, 0, n)
+            if frame[0] == "rows":
+                start = np.zeros(n, np.int64) if lo is None else \
+                    np.clip(idx + int(lo), 0, n)
+                end_excl = np.full(n, n, np.int64) if hi is None else \
+                    np.clip(idx + int(hi) + 1, 0, n)
+            else:
+                start, end_excl = self._range_frame_bounds(part, lo, hi, n)
             end_excl = np.maximum(end_excl, start)
             zero = masked[0] * 0 if n else 0  # object-safe (Decimal) zero
             cs0 = np.concatenate([[zero], np.cumsum(masked)])

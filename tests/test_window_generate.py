@@ -277,3 +277,58 @@ def test_frontend_rows_frame_converts():
     with Session() as s:
         out = s.execute_to_table(res.plan).to_pydict()
     assert out["s#20"] == [10, 30, 50, 70]  # sliding 2-row sums
+
+
+def test_range_frame_value_windows():
+    """RANGE BETWEEN 2 PRECEDING AND 1 FOLLOWING over a numeric order key:
+    bounds are VALUE offsets resolved against the sorted key (peers
+    included), unlike ROWS index offsets."""
+    data = {"g": pa.array([1] * 6, type=pa.int64()),
+            "o": pa.array([1, 2, 2, 5, 6, 10], type=pa.int64()),
+            "v": pa.array([1, 10, 100, 1000, 10000, 100000], type=pa.int64())}
+    scan = sorted_scan(data, ["g", "o"])
+    from blaze_tpu.ir.nodes import WindowExpr
+    from blaze_tpu.ops.window import WindowExec
+
+    op = WindowExec(scan, [
+        WindowExpr("agg", "s", agg=E.AggExpr(E.AggFunction.SUM, [col("v")]),
+                   frame=("range", -2, 1)),
+    ], [col("g")], [E.SortOrder(col("o"))])
+    out = collect_pydict(op)
+    # windows: o=1 -> keys in [-1,2] = {1,2,2}; o=2 -> [0,3] = {1,2,2};
+    # o=5 -> [3,6] = {5,6}; o=6 -> [4,7] = {5,6}; o=10 -> [8,11] = {10}
+    assert out["s"] == [111, 111, 111, 11000, 11000, 100000]
+
+
+def test_range_frame_nulls_and_descending():
+    data = {"g": pa.array([1] * 5, type=pa.int64()),
+            "o": pa.array([None, 1, 2, 5, 6], type=pa.int64()),
+            "v": pa.array([7, 1, 10, 100, 1000], type=pa.int64())}
+    scan = sorted_scan(data, ["g", "o"])
+    from blaze_tpu.ir.nodes import WindowExpr
+    from blaze_tpu.ops.window import WindowExec
+
+    op = WindowExec(scan, [
+        WindowExpr("agg", "s", agg=E.AggExpr(E.AggFunction.SUM, [col("v")]),
+                   frame=("range", -1, 0)),
+    ], [col("g")], [E.SortOrder(col("o"))])
+    out = collect_pydict(op)
+    # null row frames over the null run only; o=1 -> [0,1]={1}; o=2 ->
+    # [1,2]={1,2}; o=5 -> [4,5]={5}; o=6 -> [5,6]={5,6}
+    assert out["s"] == [7, 1, 11, 100, 1100]
+
+    desc = {"g": pa.array([1] * 3, type=pa.int64()),
+            "o": pa.array([6, 5, 1], type=pa.int64()),
+            "v": pa.array([1000, 100, 1], type=pa.int64())}
+    from blaze_tpu.ops.sort import SortExec
+
+    dscan = SortExec(mem_scan(desc), [E.SortOrder(col("g")),
+                                      E.SortOrder(col("o"), ascending=False)])
+    op2 = WindowExec(dscan, [
+        WindowExpr("agg", "s", agg=E.AggExpr(E.AggFunction.SUM, [col("v")]),
+                   frame=("range", -1, 0)),
+    ], [col("g")], [E.SortOrder(col("o"), ascending=False)])
+    out2 = collect_pydict(op2)
+    # descending: PRECEDING walks toward LARGER values: o=6 -> {6}; o=5 ->
+    # {6,5}; o=1 -> {1}
+    assert out2["s"] == [1000, 1100, 1]
