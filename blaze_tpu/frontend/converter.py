@@ -492,10 +492,22 @@ class SparkPlanConverter:
                     out_scope[eid] = nm
         pspec = [convert_expr(t, scope)
                  for t in decode_field_trees(node.field("partitionSpec"))]
+        otrees = decode_field_trees(node.field("orderSpec"))
         ospec = []
-        for t in decode_field_trees(node.field("orderSpec")):
+        for t in otrees:
             so = convert_expr(t, scope)
             ospec.append(so if isinstance(so, E.SortOrder) else E.SortOrder(so))
+        if any(w.frame is not None and w.frame[0] == "range" and
+               (w.frame[1] is not None or w.frame[2] is not None)
+               for w in wexprs):
+            # the executor resolves RANGE value offsets by searchsorted over
+            # ONE numeric/date/timestamp order key
+            if len(otrees) != 1:
+                raise UnsupportedNode("RANGE offset frame needs 1 order key")
+            key_t = _order_key_type(otrees[0])
+            if key_t is None or not _is_rangeable(key_t):
+                raise UnsupportedNode(
+                    f"RANGE offset frame over order key type {key_t}")
         return N.Window(child, wexprs, pspec, ospec), out_scope
 
     def _convert_expand_exec(self, node, kids):
@@ -525,11 +537,28 @@ class SparkPlanConverter:
             self._attr_scope(out_attrs)
 
 
+def _order_key_type(sort_tree: TreeNode):
+    child = sort_tree.children[0] if sort_tree.children else sort_tree
+    dt = child.field("dataType")
+    if dt is None:
+        return None
+    try:
+        return from_spark_json(dt)
+    except NotImplementedError:
+        return None
+
+
+def _is_rangeable(dt) -> bool:
+    return isinstance(dt, (T.Int8Type, T.Int16Type, T.Int32Type, T.Int64Type,
+                           T.Float32Type, T.Float64Type, T.DateType,
+                           T.TimestampType, T.DecimalType))
+
+
 def _parse_frame(spec: TreeNode):
     """frameSpecification -> None (Spark default semantics) or an explicit
-    ("rows", lower, upper) frame for aggregates-over-window (ops/window.py
-    computes ROWS frames with prefix sums / sliding windows). RANGE frames
-    with value offsets stay unsupported -> fall back."""
+    ("rows"|"range", lower, upper) frame for aggregates-over-window
+    (ops/window.py: prefix sums / sliding windows / value-searchsorted).
+    Unparseable bounds (interval offsets etc.) fall back."""
     frame = spec.field("frameSpecification")
     if frame in (None, {}, []):
         return None
@@ -545,7 +574,8 @@ def _parse_frame(spec: TreeNode):
         if isinstance(frame, dict):
             lo = _frame_bound(frame.get("lower"))
             hi = _frame_bound(frame.get("upper"))
-            return ("range", lo, hi)
+            return ("range", lo, hi)  # executor needs 1 numeric order key;
+            # _convert_window_exec validates that below
         raise UnsupportedNode(f"RANGE frame with offsets: {text[:120]}")
     if "RowFrame" in text and isinstance(frame, dict):
         lo = _frame_bound(frame.get("lower"))
@@ -565,7 +595,11 @@ def _frame_bound(b):
     if "CurrentRow" in text:
         return 0
     if isinstance(b, dict) and "value" in b:
-        return int(b["value"])
+        try:
+            return int(b["value"])
+        except (TypeError, ValueError) as exc:
+            raise UnsupportedNode(
+                f"non-integer window frame bound {b.get('value')!r}") from exc
     raise UnsupportedNode(f"window frame bound {text[:80]}")
 
 

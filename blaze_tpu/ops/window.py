@@ -279,16 +279,27 @@ class WindowExec(Operator):
         col = ev.evaluate(part)[0]
         arr = col.to_arrow(n)
         valid = (~np.asarray(arr.is_null())) if arr.null_count else np.ones(n, bool)
-        keys = arr.fill_null(0).to_numpy(zero_copy_only=False).astype(np.float64)
+        keys = arr.fill_null(0).to_numpy(zero_copy_only=False)
+        if np.issubdtype(keys.dtype, np.datetime64):
+            keys = keys.view(np.int64)
+        if not np.issubdtype(keys.dtype, np.integer):
+            keys = keys.astype(np.float64)  # ints stay exact (2^53+ keys)
         if not so.ascending:
             keys = -keys
         start = np.zeros(n, np.int64)
         end_excl = np.full(n, n, np.int64)
         if valid.all():
             nn_lo, nn_hi, kk = 0, n, keys
+        elif not valid.any():
+            # whole partition is one null peer run: every frame is all of it
+            return start, end_excl
         else:
             # the null run is contiguous (sorted input): its rows frame over
-            # the run itself; non-null rows search only the non-null span
+            # the run itself for offset bounds; UNBOUNDED sides span the
+            # whole partition (Spark UnboundedPreceding/FollowingWindow
+            # FunctionFrame starts/ends at the partition edge, nulls
+            # included). Non-null rows search the non-null span for offset
+            # bounds, partition edges for unbounded ones.
             nn_idx = np.nonzero(valid)[0]
             nn_lo, nn_hi = int(nn_idx[0]), int(nn_idx[-1]) + 1
             if not valid[nn_lo:nn_hi].all():
@@ -296,23 +307,27 @@ class WindowExec(Operator):
             null_rows = ~valid
             run_lo = 0 if null_rows[0] else nn_hi
             run_hi = nn_lo if null_rows[0] else n
-            start[null_rows] = run_lo
-            end_excl[null_rows] = run_hi
+            start[null_rows] = 0 if lo is None else run_lo
+            end_excl[null_rows] = n if hi is None else run_hi
             kk = keys[nn_lo:nn_hi]
         # lower bound: key + lo (lo <= 0 for PRECEDING offsets)
         if lo is not None:
-            targets = keys + float(lo)
-            s = np.searchsorted(kk, targets, side="left") + nn_lo
+            s = np.searchsorted(kk, keys + _offset(keys, lo),
+                                side="left") + nn_lo
             start[valid] = s[valid]
         else:
-            start[valid] = nn_lo
+            start[valid] = 0
         if hi is not None:
-            targets = keys + float(hi)
-            e = np.searchsorted(kk, targets, side="right") + nn_lo
+            e = np.searchsorted(kk, keys + _offset(keys, hi),
+                                side="right") + nn_lo
             end_excl[valid] = e[valid]
         else:
-            end_excl[valid] = nn_hi
+            end_excl[valid] = n
         return start, end_excl
+
+    @staticmethod
+    def _coerce_offset(keys, off):
+        return _offset(keys, off)
 
     def _window_agg(self, w: WindowExpr, part: ColumnarBatch, new_peer: np.ndarray):
         n = part.num_rows
@@ -357,6 +372,7 @@ class WindowExec(Operator):
             else:
                 start, end_excl = self._range_frame_bounds(part, lo, hi, n)
             end_excl = np.maximum(end_excl, start)
+            general_minmax = frame[0] == "range"
             zero = masked[0] * 0 if n else 0  # object-safe (Decimal) zero
             cs0 = np.concatenate([[zero], np.cumsum(masked)])
             cc0 = np.concatenate([[0], np.cumsum(valid.astype(np.int64))])
@@ -364,7 +380,8 @@ class WindowExec(Operator):
             fcnt = cc0[end_excl] - cc0[start]
             if agg.fn in (F.MIN, F.MAX):
                 fval = _frame_minmax(nv, valid, lo, hi, start, end_excl,
-                                     agg.fn == F.MIN, fcnt > 0)
+                                     agg.fn == F.MIN, fcnt > 0,
+                                     general=general_minmax)
         elif has_order:
             csum = np.cumsum(masked)
             ccnt = np.cumsum(valid.astype(np.int64))
@@ -407,8 +424,16 @@ class WindowExec(Operator):
         return HostColumn(result_t, pa.array(out, type=T.to_arrow_type(result_t))), result_t
 
 
+def _offset(keys: np.ndarray, off) -> np.ndarray:
+    """Frame offset in the key's dtype (integer keys keep exact int64
+    arithmetic; float offsets on int keys promote)."""
+    if np.issubdtype(keys.dtype, np.integer) and float(off) == int(off):
+        return np.int64(int(off))
+    return np.float64(off)
+
+
 def _frame_minmax(vals, valid, lo, hi, start, end_excl, is_min: bool,
-                  has: np.ndarray) -> np.ndarray:
+                  has: np.ndarray, general: bool = False) -> np.ndarray:
     """Per-row min/max over ROWS-frame windows [start, end); ``has`` marks
     rows whose frame holds at least one valid value (the caller's fcnt>0).
     Numeric values vectorize: finite (lo, hi) via sentinel-padded sliding
@@ -422,7 +447,10 @@ def _frame_minmax(vals, valid, lo, hi, start, end_excl, is_min: bool,
         lo = max(int(lo), -n)  # clamp: a billion-row PRECEDING offset must
     if hi is not None:
         hi = min(int(hi), n)   # not allocate billion-entry sentinel padding
-    numeric = vals.dtype != object
+    numeric = vals.dtype != object and not general
+    # ``general`` (RANGE value windows): lo/hi are VALUE offsets, so the
+    # index-based fast paths below do not apply — use the per-row scan over
+    # the exact [start, end) bounds
     if numeric:
         if np.issubdtype(vals.dtype, np.floating):
             sent = np.array(np.inf if is_min else -np.inf, vals.dtype)

@@ -332,3 +332,48 @@ def test_range_frame_nulls_and_descending():
     # descending: PRECEDING walks toward LARGER values: o=6 -> {6}; o=5 ->
     # {6,5}; o=1 -> {1}
     assert out2["s"] == [1000, 1100, 1]
+
+
+def test_range_frame_minmax_peers_and_all_null():
+    """Regression (review): RANGE min/max must use value windows, not index
+    windows; all-null order keys frame over the whole null run."""
+    data = {"g": pa.array([1, 1, 1], type=pa.int64()),
+            "o": pa.array([1, 2, 2], type=pa.int64()),
+            "v": pa.array([5, 1, 3], type=pa.int64())}
+    scan = sorted_scan(data, ["g", "o"])
+    from blaze_tpu.ir.nodes import WindowExpr
+    from blaze_tpu.ops.window import WindowExec
+
+    op = WindowExec(scan, [
+        WindowExpr("agg", "mn", agg=E.AggExpr(E.AggFunction.MIN, [col("v")]),
+                   frame=("range", 0, 0)),  # CURRENT ROW..CURRENT ROW = peers
+    ], [col("g")], [E.SortOrder(col("o"))])
+    out = collect_pydict(op)
+    assert out["mn"] == [5, 1, 1]  # the o=2 peers share frame {1,3}
+
+    nulls = {"g": pa.array([1, 1], type=pa.int64()),
+             "o": pa.array([None, None], type=pa.int64()),
+             "v": pa.array([4, 9], type=pa.int64())}
+    scan2 = sorted_scan(nulls, ["g", "o"])
+    op2 = WindowExec(scan2, [
+        WindowExpr("agg", "s", agg=E.AggExpr(E.AggFunction.SUM, [col("v")]),
+                   frame=("range", -1, 0)),
+    ], [col("g")], [E.SortOrder(col("o"))])
+    out2 = collect_pydict(op2)
+    assert out2["s"] == [13, 13]  # whole null run
+
+
+def test_range_frame_unbounded_includes_null_run():
+    data = {"g": pa.array([1, 1, 1], type=pa.int64()),
+            "o": pa.array([None, 1, 2], type=pa.int64()),
+            "v": pa.array([7, 1, 10], type=pa.int64())}
+    scan = sorted_scan(data, ["g", "o"])
+    from blaze_tpu.ir.nodes import WindowExpr
+    from blaze_tpu.ops.window import WindowExec
+
+    op = WindowExec(scan, [
+        WindowExpr("agg", "s", agg=E.AggExpr(E.AggFunction.SUM, [col("v")]),
+                   frame=("range", None, 1)),  # UNBOUNDED PRECEDING..1 FOLLOWING
+    ], [col("g")], [E.SortOrder(col("o"))])
+    out = collect_pydict(op)
+    assert out["s"] == [7, 18, 18]  # unbounded side spans the null run
