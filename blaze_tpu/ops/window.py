@@ -325,10 +325,6 @@ class WindowExec(Operator):
             end_excl[valid] = n
         return start, end_excl
 
-    @staticmethod
-    def _coerce_offset(keys, off):
-        return _offset(keys, off)
-
     def _window_agg(self, w: WindowExpr, part: ColumnarBatch, new_peer: np.ndarray):
         n = part.num_rows
         agg = w.agg
