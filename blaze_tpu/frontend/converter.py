@@ -58,6 +58,7 @@ _JOIN_TYPES = {
     "FullOuter": N.JoinType.FULL,
     "LeftSemi": N.JoinType.LEFT_SEMI,
     "LeftAnti": N.JoinType.LEFT_ANTI,
+    "ExistenceJoin": N.JoinType.EXISTENCE,
     "Cross": N.JoinType.INNER,
 }
 
@@ -416,7 +417,12 @@ class SparkPlanConverter:
         rkeys = [convert_expr(t, scope)
                  for t in decode_field_trees(node.field("rightKeys"))]
         jt = FE._obj_str(node.field("joinType")) or "Inner"
-        jt = jt.rsplit(".", 1)[-1].rstrip("$")
+        if "ExistenceJoin" in jt:
+            # ExistenceJoin(exprId#n): emits probe rows + a boolean "exists"
+            # column (Spark's IN/EXISTS subquery rewrite)
+            jt = "ExistenceJoin"
+        else:
+            jt = jt.rsplit(".", 1)[-1].rstrip("$")
         if jt not in _JOIN_TYPES:
             raise UnsupportedNode(f"join type {jt}")
         cond = None

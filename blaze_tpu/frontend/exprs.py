@@ -235,7 +235,8 @@ def _obj_str(v) -> Optional[str]:
     if isinstance(v, str):
         return v
     if isinstance(v, dict):
-        return str(v.get("object", v.get("class", "")))
+        return str(v.get("object") or v.get("class")
+                   or v.get("product-class") or "")
     return str(v)
 
 

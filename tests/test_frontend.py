@@ -342,3 +342,38 @@ def test_partition_filters_fall_back(store_returns):
                              tables={"store_returns": store_returns})
     assert not res.fully_native
     assert any("partitionFilters" in t for _, t in res.tags)
+
+
+def test_existence_join_converts(store_returns, tmp_path):
+    """Spark's ExistenceJoin(exprId#n) (IN/EXISTS subquery rewrite) maps to
+    the engine's EXISTENCE join."""
+    stores = pa.table({"s_store_sk": pa.array([1, 2, 3], type=pa.int64())})
+    spath = str(tmp_path / "exist_store.parquet")
+    pq.write_table(stores, spath)
+    scan_sr = {"class": f"{P}.FileSourceScanExec", "num-children": 0,
+               "output": [[attr("sr_store_sk", "long", 1)]],
+               "partitionFilters": [], "dataFilters": [],
+               "tableIdentifier": "store_returns"}
+    scan_st = {"class": f"{P}.FileSourceScanExec", "num-children": 0,
+               "output": [[attr("s_store_sk", "long", 3)]],
+               "partitionFilters": [], "dataFilters": [],
+               "tableIdentifier": "store"}
+    bcast = {"class": f"{P}.exchange.BroadcastExchangeExec", "num-children": 1,
+             "mode": {}, "child": 0}
+    join = {"class": f"{P}.joins.BroadcastHashJoinExec", "num-children": 2,
+            "leftKeys": [[attr("sr_store_sk", "long", 1)]],
+            "rightKeys": [[attr("s_store_sk", "long", 3)]],
+            "joinType": {"product-class": f"{SPARK}.catalyst.plans.ExistenceJoin",
+                         "exists": {"product-class": f"{X}.ExprId", "id": 99}},
+            "buildSide": {"object": f"{P}.joins.BuildRight$"},
+            "condition": None, "left": 0, "right": 1}
+    res = convert_spark_plan(json.dumps([join, scan_sr, bcast, scan_st]),
+                             tables={"store_returns": store_returns,
+                                     "store": [spath]})
+    assert res.fully_native, res.tags
+    with Session() as s:
+        out = s.execute_to_table(res.plan).to_pydict()
+    keys = list(out.values())
+    exists_col = [k for k in out if "exists" in k.lower() or k == list(out)[-1]]
+    n_sr = sum(pq.read_table(p).num_rows for p in store_returns)
+    assert len(keys[0]) == n_sr  # every probe row kept, exists flag added
