@@ -143,7 +143,13 @@ def main():
     device = "device"
     if not probe_device():
         # accelerator unreachable: pin cpu BEFORE any backend init so the
-        # run completes; the reported metric is flagged
+        # run completes; the reported metric is flagged. Also drop the TPU
+        # plugin's path entries — its registration can hang under a cpu pin
+        # when the tunnel is wedged
+        sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+        os.environ["PYTHONPATH"] = os.pathsep.join(
+            p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+            if p and ".axon_site" not in p)
         import jax
 
         jax.config.update("jax_platforms", "cpu")

@@ -3,11 +3,19 @@ paths are exercised without TPU hardware (SURVEY.md §4: the reference runs
 its native-operator tests without a JVM; we run ours without a TPU)."""
 
 import os
+import sys
 
 # Must be set before jax import. Force CPU: the suite validates semantics and
 # the 8-device sharding paths; TPU-specific behavior is covered by
 # scripts/tpu_smoke.py driven on real hardware.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Drop the TPU plugin's path entries entirely: its registration handshake can
+# hang indefinitely when the device tunnel is wedged, even under a cpu pin —
+# a cpu-only suite must never touch it.
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+os.environ["PYTHONPATH"] = os.pathsep.join(
+    p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+    if p and ".axon_site" not in p)
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
