@@ -48,6 +48,10 @@ class Config:
     skew_join_factor: float = 3.0
     skew_join_min_bytes: int = 64 << 20
 
+    # scan column pruning / projection pushdown (reference:
+    # ExecuteWithColumnPruning, common/column_pruning.rs:22-48)
+    column_pruning_enable: bool = True
+
     # Device FINAL/PARTIAL_MERGE aggregation buffers all partial-state
     # batches before one merge kernel call; beyond this size it falls back
     # to the spill-capable host table.

@@ -134,6 +134,10 @@ class Session:
         order."""
         from blaze_tpu.utils.logutil import clear_task_context, set_task_context
 
+        if self.conf.column_pruning_enable:
+            from blaze_tpu.ir.optimizer import prune_plan
+
+            plan = prune_plan(plan)
         lowered = self._lower(plan)
         op = build_operator(lowered)
         nparts = op.num_partitions()

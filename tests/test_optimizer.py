@@ -1,0 +1,198 @@
+"""Column-pruning optimizer (projection pushdown into file scans) —
+reference: ExecuteWithColumnPruning, common/column_pruning.rs:22-48."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+from decimal import Decimal
+
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import nodes as N
+from blaze_tpu.ir import types as T
+from blaze_tpu.ir.optimizer import expr_columns, prune_plan
+from blaze_tpu.ops.parquet import scan_node_for_files
+from blaze_tpu.runtime.session import Session
+
+
+@pytest.fixture
+def wide_file(tmp_path):
+    rng = np.random.default_rng(3)
+    tbl = pa.table({
+        "k": pa.array(rng.integers(1, 10, 500), type=pa.int64()),
+        "v": pa.array([Decimal(int(x)).scaleb(-2)
+                       for x in rng.integers(0, 10000, 500)],
+                      type=pa.decimal128(9, 2)),
+        "unused1": pa.array(rng.integers(0, 100, 500), type=pa.int64()),
+        "unused2": pa.array([f"s{i}" for i in range(500)]),
+    })
+    path = str(tmp_path / "wide.parquet")
+    pq.write_table(tbl, path)
+    return path, tbl
+
+
+def _scans(plan):
+    out = []
+    stack = [plan]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (N.ParquetScan, N.OrcScan)):
+            out.append(n)
+        stack.extend(n.children())
+    return out
+
+
+def _scan_names(plan):
+    return [
+        [s.conf.file_schema[i].name for i in s.conf.projection]
+        for s in _scans(plan)
+    ]
+
+
+def _q01_plan(path):
+    scan = scan_node_for_files([path])
+    filt = N.Filter(scan, [E.BinaryExpr(
+        E.BinaryOp.GT, E.Column("v"), E.Literal("5.00", T.DecimalType(9, 2)))])
+    partial = N.Agg(filt, E.AggExecMode.HASH_AGG, [("k", E.Column("k"))], [
+        N.AggColumn(E.AggExpr(E.AggFunction.SUM, [E.Column("v")],
+                              T.DecimalType(19, 2)), E.AggMode.PARTIAL, "total")])
+    ex = N.ShuffleExchange(partial, N.HashPartitioning([E.Column("k")], 2))
+    final = N.Agg(ex, E.AggExecMode.HASH_AGG, [("k", E.Column("k"))], [
+        N.AggColumn(E.AggExpr(E.AggFunction.SUM, [E.Column("v")],
+                              T.DecimalType(19, 2)), E.AggMode.FINAL, "total")])
+    return N.Sort(final, [E.SortOrder(E.Column("total"), ascending=False)])
+
+
+def test_expr_columns():
+    assert expr_columns(E.Column("a")) == frozenset({"a"})
+    assert expr_columns(E.BinaryExpr(
+        E.BinaryOp.ADD, E.Column("a"), E.Column("b"))) == {"a", "b"}
+    assert expr_columns(E.BoundReference(1)) is None
+    assert expr_columns(E.Literal(1, T.I64)) == frozenset()
+
+
+def test_scan_pruned_through_agg_pipeline(wide_file):
+    path, _ = wide_file
+    pruned = prune_plan(_q01_plan(path))
+    assert _scan_names(pruned) == [["k", "v"]]
+
+
+def test_pruned_plan_results_equal(wide_file):
+    path, tbl = wide_file
+    plan = _q01_plan(path)
+    from blaze_tpu.config import get_config
+    import dataclasses as dc
+
+    with Session(conf=dc.replace(get_config(), column_pruning_enable=False)) as s:
+        expected = s.execute_to_pydict(plan)
+    with Session() as s:
+        got = s.execute_to_pydict(_q01_plan(path))
+    assert got == expected
+
+
+def test_count_star_keeps_one_column(wide_file):
+    path, _ = wide_file
+    scan = scan_node_for_files([path])
+    agg = N.Agg(scan, E.AggExecMode.HASH_AGG, [], [
+        N.AggColumn(E.AggExpr(E.AggFunction.COUNT, []), E.AggMode.COMPLETE, "n")])
+    pruned = prune_plan(agg)
+    assert len(_scan_names(pruned)[0]) == 1
+    with Session() as s:
+        assert s.execute_to_pydict(pruned) == {"n": [500]}
+
+
+def test_bound_reference_disables_pruning(wide_file):
+    path, _ = wide_file
+    scan = scan_node_for_files([path])
+    filt = N.Filter(scan, [E.BinaryExpr(
+        E.BinaryOp.GT, E.BoundReference(0), E.Literal(5, T.I64))])
+    agg = N.Agg(filt, E.AggExecMode.HASH_AGG, [("k", E.Column("k"))], [
+        N.AggColumn(E.AggExpr(E.AggFunction.COUNT, []), E.AggMode.COMPLETE, "n")])
+    pruned = prune_plan(agg)
+    assert _scan_names(pruned) == [["k", "v", "unused1", "unused2"]]
+
+
+def test_join_prunes_both_sides(tmp_path):
+    left = pa.table({
+        "lk": pa.array([1, 2, 3], type=pa.int64()),
+        "lv": pa.array([10, 20, 30], type=pa.int64()),
+        "lextra": pa.array(["a", "b", "c"]),
+    })
+    right = pa.table({
+        "rk": pa.array([2, 3, 4], type=pa.int64()),
+        "rv": pa.array([200, 300, 400], type=pa.int64()),
+        "rextra": pa.array(["x", "y", "z"]),
+    })
+    lp, rp = str(tmp_path / "l.parquet"), str(tmp_path / "r.parquet")
+    pq.write_table(left, lp)
+    pq.write_table(right, rp)
+    join = N.SortMergeJoin(
+        N.Sort(scan_node_for_files([lp]), [E.SortOrder(E.Column("lk"))]),
+        N.Sort(scan_node_for_files([rp]), [E.SortOrder(E.Column("rk"))]),
+        on=[(E.Column("lk"), E.Column("rk"))], join_type=N.JoinType.INNER)
+    proj = N.Projection(join, [E.Column("lv"), E.Column("rv")], ["lv", "rv"])
+    pruned = prune_plan(proj)
+    names = sorted(map(tuple, _scan_names(pruned)))
+    assert names == [("lk", "lv"), ("rk", "rv")]
+    with Session() as s:
+        got = s.execute_to_pydict(pruned)
+    assert sorted(zip(got["lv"], got["rv"])) == [(20, 200), (30, 300)]
+
+
+def test_duplicate_join_names_bail(tmp_path):
+    tbl = pa.table({"k": pa.array([1, 2], type=pa.int64()),
+                    "v": pa.array([1, 2], type=pa.int64())})
+    lp, rp = str(tmp_path / "l.parquet"), str(tmp_path / "r.parquet")
+    pq.write_table(tbl, lp)
+    pq.write_table(tbl, rp)
+    join = N.SortMergeJoin(
+        N.Sort(scan_node_for_files([lp]), [E.SortOrder(E.Column("k"))]),
+        N.Sort(scan_node_for_files([rp]), [E.SortOrder(E.Column("k"))]),
+        on=[(E.Column("k"), E.Column("k"))], join_type=N.JoinType.INNER)
+    proj = N.Projection(join, [E.Column("k")], ["k"])
+    pruned = prune_plan(proj)
+    # both sides have k and v: ambiguous by name, scans stay full
+    assert all(names == ["k", "v"] for names in _scan_names(pruned))
+
+
+def test_rename_prunes_by_new_name(wide_file):
+    path, tbl = wide_file
+    scan = scan_node_for_files([path])
+    renamed = N.RenameColumns(scan, ["rk", "rv", "ru1", "ru2"])
+    proj = N.Projection(renamed, [E.Column("rv")], ["rv"])
+    pruned = prune_plan(proj)
+    assert _scan_names(pruned) == [["v"]]
+    with Session() as s:
+        got = s.execute_to_pydict(pruned)
+    assert got["rv"] == tbl["v"].to_pylist()
+
+
+def test_generate_keeps_child_columns(tmp_path):
+    # Generate uses positional required_child_output: its child must not shrink
+    tbl = pa.table({
+        "id": pa.array([1, 2], type=pa.int64()),
+        "arr": pa.array([[1, 2], [3]], type=pa.list_(pa.int64())),
+        "pad": pa.array([9, 9], type=pa.int64()),
+    })
+    path = str(tmp_path / "g.parquet")
+    pq.write_table(tbl, path)
+    scan = scan_node_for_files([path])
+    gen = N.Generate(
+        scan, "explode", [E.Column("arr")], required_child_output=[0],
+        generator_output=T.Schema((T.StructField("e", T.I64),)))
+    pruned = prune_plan(gen)
+    assert _scan_names(pruned) == [["id", "arr", "pad"]]
+
+
+def test_case_branches_counted(wide_file):
+    # regression: Case branches are [(cond, value)] tuples — their columns
+    # must be seen by the requirement analysis
+    path, _ = wide_file
+    scan = scan_node_for_files([path])
+    case = E.Case(
+        [(E.BinaryExpr(E.BinaryOp.GT, E.Column("unused1"), E.Literal(50, T.I64)),
+          E.Column("v"))],
+        E.Literal("0.00", T.DecimalType(9, 2)))
+    proj = N.Projection(scan, [E.Column("k"), case], ["k", "cv"])
+    pruned = prune_plan(proj)
+    assert _scan_names(pruned) == [["k", "v", "unused1"]]
