@@ -1,0 +1,115 @@
+"""Convert-provider SPI: external integrations plug their own plan-node
+converters into the frontend.
+
+Reference: ``AuronConvertProvider`` — the SPI through which the Paimon
+integration converts ``PaimonScan`` nodes the core converter does not know
+(``thirdparty/auron-paimon/.../PaimonConvertProvider``; consulted from
+``AuronConverters.convertSparkPlan`` for otherwise-unconvertible nodes).
+
+A provider sees every plan node the built-in converter has no handler for,
+after its children trial-converted successfully and BEFORE the node is
+tagged as a fallback. It returns ``None`` to pass, or ``(plan, scope)`` to
+claim the node.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+_PROVIDERS: List["ConvertProvider"] = []
+
+
+class ConvertProvider:
+    """SPI base. ``name`` keys the per-provider enable flag
+    (config.enabled_ops, like per-operator gating)."""
+
+    name: str = "provider"
+
+    def try_convert(self, node, converter, kids) -> Optional[Tuple[object, dict]]:
+        """Return (PlanNode, attr-scope) to claim ``node``, or None to pass.
+        ``converter`` is the active SparkPlanConverter (tables/catalog/conf
+        access); ``kids`` holds the already-converted children as
+        (plan, scope) pairs. Raising UnsupportedNode/ValueError records a
+        fallback tag with the reason."""
+        raise NotImplementedError
+
+
+def register_provider(p: ConvertProvider) -> None:
+    _PROVIDERS.append(p)
+
+
+def unregister_provider(p: ConvertProvider) -> None:
+    if p in _PROVIDERS:
+        _PROVIDERS.remove(p)
+
+
+def providers() -> List[ConvertProvider]:
+    return list(_PROVIDERS)
+
+
+class LakeTableScanProvider(ConvertProvider):
+    """Converts ``LakeTableScanExec`` nodes (the Paimon-role external table
+    scan) into native scans over the lake table's committed snapshot, with
+    partition-predicate pruning.
+
+    Node contract (mirroring NativePaimonTableScanExec's conversion inputs):
+    ``location`` or ``tableIdentifier`` resolving to the table root (the
+    identifier is looked up in converter.tables, where the registered
+    "path" plays the catalog role), optional ``partitionFilters`` /
+    ``dataFilters`` condition trees, and ``output`` attributes."""
+
+    name = "lake_table_scan"
+
+    def try_convert(self, node, converter, kids):
+        if node.name not in ("LakeTableScanExec", "PaimonScanExec",
+                             "NativePaimonTableScanExec"):
+            return None
+        from blaze_tpu.frontend import exprs as FE
+        from blaze_tpu.frontend.converter import and_fold_filters, table_ident
+        from blaze_tpu.frontend.treenode import decode_field_trees
+        from blaze_tpu.io.laketable import LakeTable
+        from blaze_tpu.ir import exprs as E
+        from blaze_tpu.ir import nodes as N
+        from blaze_tpu.ir import types as T
+
+        root = node.field("location")
+        if root is None:
+            ident = table_ident(node)
+            roots = converter.tables.get(ident) if ident else None
+            if isinstance(roots, str):
+                root = roots
+            elif isinstance(roots, (list, tuple)) and len(roots) == 1:
+                root = roots[0]
+        if not root:
+            raise ValueError("lake table scan without resolvable location")
+        out_attrs = decode_field_trees(node.field("output") or [])
+        # scan filters reference bare file/partition columns (converter
+        # convention: empty scope, then narrow+rename to the declared attrs)
+        part_pred = and_fold_filters(node.field("partitionFilters"), {})
+        data_pred = and_fold_filters(node.field("dataFilters"), {})
+        num_partitions = int(node.field("numPartitions") or 1)
+        plan = LakeTable(str(root)).scan_node(
+            num_partitions=num_partitions,
+            predicate=data_pred,
+            partition_predicate=part_pred)
+        names = [FE.attr_name(a) for a in out_attrs]
+        bare = [a.field("name") for a in out_attrs]
+        if isinstance(plan, N.EmptyPartitions):
+            # keep the declared attribute schema even for a fully-pruned
+            # scan — parents reference these exact names
+            if names:
+                fields = tuple(
+                    T.StructField(nm, plan.schema[b].dtype, True)
+                    for nm, b in zip(names, bare))
+                plan = N.EmptyPartitions(T.Schema(fields), plan.num_partitions)
+            return plan, converter._attr_scope(out_attrs)
+        if data_pred is not None:
+            plan = N.Filter(plan, [data_pred])
+        if names:
+            if bare != list(plan.output_schema.names):
+                plan = N.Projection(plan, [E.Column(b) for b in bare], bare)
+            plan = N.RenameColumns(plan, names)
+        return plan, converter._attr_scope(out_attrs)
+
+
+register_provider(LakeTableScanProvider())

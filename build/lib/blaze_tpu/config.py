@@ -1,0 +1,151 @@
+"""Engine configuration.
+
+Mirrors the reference's three-tier conf system keyed ``spark.auron.*``
+(``spark-extension/src/main/java/.../AuronConf.java:23-130`` and
+``auron-jni-bridge/src/conf.rs:32-111``): one typed source of truth the whole
+engine reads. Here it is a process-global dataclass with context overrides; a
+frontend (Spark plugin) would populate it from SparkConf.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+from typing import Optional
+
+
+@dataclasses.dataclass
+class Config:
+    # Rows per batch. The reference defaults to 10000 (AuronConf.BATCH_SIZE).
+    # We run much larger batches: the TPU is reached over an RPC tunnel where
+    # every device<->host round trip costs ~25-90ms regardless of size, so
+    # batches must amortize transfer latency; powers of two match the
+    # capacity bucketing and XLA tiling.
+    batch_size: int = 131072
+
+    # Suggested in-memory bytes per batch (reference: suggested_batch_mem_size,
+    # datafusion-ext-commons/src/lib.rs:74-118).
+    suggested_batch_mem_size: int = 8 << 20
+    suggested_batch_mem_size_kway_merge: int = 1 << 20
+
+    # Fraction of the process memory budget handed to the memory manager
+    # (reference: MEMORY_FRACTION=0.6, MemManager::init(total * fraction)).
+    memory_fraction: float = 0.6
+    # Total memory budget in bytes; None = derive from system.
+    memory_total: Optional[int] = None
+    # How long an under-share producer blocks for peers to spill before
+    # spilling itself (reference waits on a condvar with a 10s timeout,
+    # memmgr/mod.rs:301-421; shorter default keeps single-threaded stalls
+    # bounded).
+    mem_wait_timeout_s: float = 2.0
+
+    # AQE skew-join splitting (reference: isSkewJoin + partial shuffle reads
+    # flowing through the IR, AuronConverters.scala:420-489): a reducer
+    # whose stream-side bytes exceed factor x median (and the floor) splits
+    # into map-subset sub-partitions joined against the full other side.
+    skew_join_enable: bool = True
+    skew_join_factor: float = 3.0
+    skew_join_min_bytes: int = 64 << 20
+
+    # scan column pruning / projection pushdown (reference:
+    # ExecuteWithColumnPruning, common/column_pruning.rs:22-48)
+    column_pruning_enable: bool = True
+
+    # Device FINAL/PARTIAL_MERGE aggregation buffers all partial-state
+    # batches before one merge kernel call; beyond this size it falls back
+    # to the spill-capable host table.
+    device_merge_max_bytes: int = 256 << 20
+
+    # AQE small-partition coalescing (Spark's coalescePartitions): adjacent
+    # reducer partitions below the advisory size merge into one read task
+    # when no ancestor relies on the exchange's partition count.
+    coalesce_partitions_enable: bool = True
+    advisory_partition_bytes: int = 8 << 20
+
+    # Task retry policy for transient failures (deterministic errors fail
+    # fast; reference delegates this to Spark's TaskScheduler).
+    task_max_retries: int = 2
+    task_retry_backoff_s: float = 0.2
+
+    # Device HBM budget for resident batch data (bytes). None = ask the device.
+    hbm_budget: Optional[int] = None
+
+    # Compression codec for shuffle/spill streams: "zstd" | "lz4" | "none".
+    # (reference: spark.auron.shuffle.compression.codec, default lz4; we default
+    # to zstd level 1 since the python lz4 binding is absent and libzstd is fast)
+    shuffle_compression_codec: str = "zstd"
+    spill_compression_codec: str = "zstd"
+    zstd_level: int = 1
+
+    # Byte-plane transpose of fixed-width columns before compression
+    # (reference: io/batch_serde.rs TransposeOpt — boosts ratios).
+    serde_transpose: bool = True
+
+    # Partial-agg adaptive skipping (reference: PARTIAL_AGG_SKIPPING_ENABLE,
+    # ratio 0.9 after 50k rows — agg_ctx.rs, AuronConf.java).
+    partial_agg_skipping_enable: bool = True
+    partial_agg_skipping_ratio: float = 0.9
+    partial_agg_skipping_min_rows: int = 50_000
+
+    # SortMergeJoin fallback threshold for shuffled-hash-join memory risk
+    # (reference: SMJ_FALLBACK_* in AuronConf.java).
+    smj_fallback_enable: bool = True
+    smj_fallback_rows_threshold: int = 10_000_000
+    smj_fallback_mem_size_threshold: int = 1 << 30
+
+    # Spill directory (reference spills via JVM OnHeapSpillManager or disk;
+    # we spill device->host->disk files here).
+    spill_dir: str = dataclasses.field(
+        default_factory=lambda: os.environ.get("BLAZE_TPU_SPILL_DIR", "/tmp/blaze_tpu_spill")
+    )
+
+    # Number of host worker threads for IO/decode and task overlap
+    # (reference: tokio worker threads conf). On the tunneled-TPU backend
+    # threads mostly overlap device round trips, not CPU.
+    num_io_threads: int = 4
+
+    # Per-operator enable flags (reference: spark.auron.enable.<op>,
+    # AuronConverters.scala:99-140). Checked by the plan converter/session.
+    enabled_ops: dict = dataclasses.field(default_factory=dict)
+
+    # Trace upstream FilterExec predicates into the device partial-agg
+    # kernel (experimental: compiles pathologically slowly on the axon
+    # remote-compile backend; default off until diagnosed).
+    fused_filter_agg: bool = False
+
+    # Capacity bucketing: device buffers are padded up to the next bucket to
+    # bound XLA recompilation. Buckets are powers of two >= min_capacity.
+    min_capacity: int = 256
+
+    def capacity_for(self, n: int) -> int:
+        cap = self.min_capacity
+        while cap < n:
+            cap <<= 1
+        return cap
+
+    def is_op_enabled(self, op: str) -> bool:
+        return self.enabled_ops.get(op, True)
+
+
+_GLOBAL = Config()
+
+
+def get_config() -> Config:
+    return _GLOBAL
+
+
+def set_config(cfg: Config):
+    global _GLOBAL
+    _GLOBAL = cfg
+
+
+@contextlib.contextmanager
+def config_override(**kwargs):
+    global _GLOBAL
+    old = _GLOBAL
+    _GLOBAL = dataclasses.replace(old, **kwargs)
+    try:
+        yield _GLOBAL
+    finally:
+        _GLOBAL = old

@@ -1,0 +1,1 @@
+from blaze_tpu.core.batch import ColumnarBatch, DeviceColumn, HostColumn, Column  # noqa: F401

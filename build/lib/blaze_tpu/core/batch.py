@@ -1,0 +1,546 @@
+"""Columnar batch representation — the unit of data flow between operators.
+
+The reference streams Arrow ``RecordBatch``es of ~``batch_size`` rows between
+DataFusion operators. On TPU the equivalent is a struct-of-arrays batch whose
+fixed-width columns are dense jax arrays padded to a *capacity bucket* (static
+shapes for XLA) with an explicit ``num_rows`` and per-column validity masks.
+Variable-width columns (string/binary) and nested types stay host-resident as
+Arrow arrays, with on-demand per-batch dictionary codes pushed to the device
+for filtering/grouping (SURVEY.md §7.2 L0').
+
+Padding discipline: rows in ``[num_rows, capacity)`` have ``validity == False``
+and ``data == 0`` so that hashes/sorts over padded tails are deterministic.
+``validity`` means "row exists AND value is non-null"; "row exists" alone is
+``arange(capacity) < num_rows``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from blaze_tpu.config import get_config
+from blaze_tpu.ir import types as T
+
+
+@functools.lru_cache(maxsize=64)
+def _iota(capacity: int) -> jax.Array:
+    """Device-resident ``arange(capacity)`` per capacity bucket (a handful of
+    entries — buckets are powers of two)."""
+    return jnp.arange(capacity)
+
+
+def _row_mask(capacity: int, n: int) -> jax.Array:
+    """Device ``arange(capacity) < n`` mask (validity of a null-free column).
+    Only the iota is cached: caching per (capacity, n) would pin unboundedly
+    many capacity-sized masks in HBM, while the ``< n`` comparison is an
+    async ~free dispatch."""
+    return _iota(capacity) < n
+
+
+def pack_bitmap(validity: np.ndarray) -> pa.Buffer:
+    return pa.py_buffer(np.packbits(validity.astype(np.uint8), bitorder="little").tobytes())
+
+
+def unpack_bitmap(buf, length: int, offset: int = 0) -> np.ndarray:
+    if buf is None:
+        return np.ones(length, dtype=bool)
+    bits = np.unpackbits(np.frombuffer(buf, dtype=np.uint8), bitorder="little")
+    return bits[offset : offset + length].astype(bool)
+
+
+def _decimal128_lo64(arr: pa.Array) -> np.ndarray:
+    """Low 64-bit limb of a decimal128 array's unscaled values. Exact for
+    precision <= 18 (values fit in int64; low limb == two's-complement value)."""
+    buf = arr.buffers()[1]
+    raw = np.frombuffer(buf, dtype=np.int64, offset=arr.offset * 16, count=len(arr) * 2)
+    return raw[0::2].copy()
+
+
+def _int64_to_decimal128(values: np.ndarray, validity: np.ndarray, dt: T.DecimalType) -> pa.Array:
+    n = len(values)
+    data = np.empty((n, 2), dtype=np.int64)
+    data[:, 0] = values
+    data[:, 1] = np.where(values < 0, -1, 0)
+    return pa.Array.from_buffers(
+        pa.decimal128(dt.precision, dt.scale),
+        n,
+        [pack_bitmap(validity), pa.py_buffer(data)],
+    )
+
+
+class Column:
+    """Abstract column. Concrete: DeviceColumn (fixed-width, on device) and
+    HostColumn (var-width/nested, Arrow on host)."""
+
+    dtype: T.DataType
+
+    @property
+    def is_device(self) -> bool:
+        return isinstance(self, DeviceColumn)
+
+
+@dataclasses.dataclass
+class DeviceColumn(Column):
+    """Fixed-width column: dense data padded to capacity + validity mask.
+
+    For DecimalType the data carries the *unscaled* value as int64
+    (precision <= 18 fast path; see SURVEY.md §7.4.4)."""
+
+    dtype: T.DataType
+    data: jax.Array      # shape (capacity,), dtype = dtype.np_dtype (int64 for decimal)
+    validity: jax.Array  # shape (capacity,), bool
+
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    def nbytes(self) -> int:
+        return self.data.nbytes + self.validity.nbytes
+
+    def with_capacity(self, capacity: int) -> "DeviceColumn":
+        cap = self.capacity
+        if capacity == cap:
+            return self
+        if capacity > cap:
+            pad = capacity - cap
+            return DeviceColumn(
+                self.dtype,
+                jnp.pad(self.data, (0, pad)),
+                jnp.pad(self.validity, (0, pad)),
+            )
+        return DeviceColumn(self.dtype, self.data[:capacity], self.validity[:capacity])
+
+    def take_device(self, indices: jax.Array, valid_mask: jax.Array) -> "DeviceColumn":
+        """Gather rows by device indices; valid_mask marks live output rows."""
+        idx = jnp.clip(indices, 0, self.capacity - 1)
+        data = jnp.where(valid_mask, self.data[idx], jnp.zeros((), self.data.dtype))
+        validity = self.validity[idx] & valid_mask
+        return DeviceColumn(self.dtype, data, validity)
+
+    def to_arrow(self, num_rows: int) -> pa.Array:
+        data = np.asarray(self.data[:num_rows])
+        validity = np.asarray(self.validity[:num_rows])
+        return _devcol_to_arrow(self.dtype, data, validity, num_rows)
+
+    @staticmethod
+    def from_numpy(dt: T.DataType, data: np.ndarray, validity: Optional[np.ndarray], capacity: int) -> "DeviceColumn":
+        from blaze_tpu.utils.device import DEVICE_STATS
+
+        n = len(data)
+        buf = np.zeros(capacity, dtype=dt.np_dtype)
+        if validity is None or validity.all():
+            # null-free column: skip the validity upload entirely — the mask
+            # is just "row exists", computed on device and cached per
+            # (capacity, num_rows). On a bandwidth-bound host link this saves
+            # ``capacity`` bytes per column per batch.
+            np.copyto(buf[:n], data, casting="unsafe")
+            DEVICE_STATS.add_to_device(buf.nbytes)
+            return DeviceColumn(dt, jnp.asarray(buf), _row_mask(capacity, n))
+        vbuf = np.zeros(capacity, dtype=bool)
+        np.copyto(buf[:n], np.where(validity, data, np.zeros((), dt.np_dtype)), casting="unsafe")
+        vbuf[:n] = validity
+        DEVICE_STATS.add_to_device(buf.nbytes + vbuf.nbytes)
+        return DeviceColumn(dt, jnp.asarray(buf), jnp.asarray(vbuf))
+
+
+def _devcol_to_arrow(dt: T.DataType, data: np.ndarray, validity: np.ndarray,
+                     num_rows: int) -> pa.Array:
+    if isinstance(dt, T.DecimalType):
+        return _int64_to_decimal128(data, validity, dt)
+    if isinstance(dt, T.BooleanType):
+        return pa.Array.from_buffers(
+            pa.bool_(), num_rows, [pack_bitmap(validity), pack_bitmap(data)]
+        )
+    atype = T.to_arrow_type(dt)
+    return pa.Array.from_buffers(
+        atype, num_rows, [pack_bitmap(validity), pa.py_buffer(np.ascontiguousarray(data))]
+    )
+
+
+@dataclasses.dataclass
+class HostColumn(Column):
+    """Host-resident column (string/binary/nested/decimal>18) as an Arrow array
+    of exactly ``num_rows`` values (no padding on host)."""
+
+    dtype: T.DataType
+    array: pa.Array
+
+    def __post_init__(self):
+        if isinstance(self.array, pa.ChunkedArray):
+            self.array = self.array.combine_chunks()
+
+    def nbytes(self) -> int:
+        return self.array.nbytes
+
+    def take_host(self, indices: np.ndarray) -> "HostColumn":
+        return HostColumn(self.dtype, self.array.take(pa.array(indices, type=pa.int64())))
+
+    def to_arrow(self, num_rows: int) -> pa.Array:
+        assert len(self.array) == num_rows, (len(self.array), num_rows)
+        return self.array
+
+    def dict_encode(self, capacity: int):
+        """Per-batch dictionary encoding: returns (codes DeviceColumn[int32],
+        dictionary pa.Array). Null -> validity False, code 0."""
+        arr = self.array
+        if not pa.types.is_dictionary(arr.type):
+            arr = arr.dictionary_encode()
+        codes = arr.indices
+        validity = ~np.asarray(codes.is_null())
+        codes_np = codes.fill_null(0).to_numpy(zero_copy_only=False).astype(np.int32)
+        col = DeviceColumn.from_numpy(T.I32, codes_np, validity, capacity)
+        return col, arr.dictionary
+
+
+def arrow_fixed_planes(arr: pa.Array, dt: T.DataType):
+    """Arrow fixed-width array -> (np_data, np_validity) planes in the device
+    layout (decimal<=18 as unscaled int64, dates as day int64, bool unpacked)."""
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    n = len(arr)
+    if pa.types.is_dictionary(arr.type):
+        arr = arr.cast(arr.type.value_type)
+    if isinstance(dt, T.DecimalType):
+        assert dt.fits_int64, f"decimal({dt.precision},{dt.scale}) exceeds int64 planes"
+        validity = unpack_bitmap(arr.buffers()[0], n, arr.offset)
+        return _decimal128_lo64(arr), validity
+    validity = ~np.asarray(arr.is_null()) if arr.null_count else np.ones(n, dtype=bool)
+    if isinstance(dt, T.BooleanType):
+        return unpack_bitmap(arr.buffers()[1], n, arr.offset), validity
+    values = arr.fill_null(0).to_numpy(zero_copy_only=False)
+    if np.issubdtype(values.dtype, np.datetime64):
+        if isinstance(dt, T.DateType):
+            values = values.astype("datetime64[D]").view(np.int64)
+        else:
+            values = values.astype("datetime64[us]").view(np.int64)
+    elif values.dtype == np.uint64:
+        # the one lossy unsigned mapping — fail loudly on overflow
+        if n and values[validity].max(initial=0) > np.iinfo(np.int64).max:
+            raise OverflowError("uint64 column exceeds int64 range")
+        values = values.astype(np.int64)
+    return values, validity
+
+
+def _arrow_to_column(arr: pa.Array, dt: T.DataType, capacity: int) -> Column:
+    from blaze_tpu.utils.device import is_device_dtype
+
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    if pa.types.is_dictionary(arr.type):
+        arr = arr.cast(arr.type.value_type)
+    if is_device_dtype(dt):
+        values, validity = arrow_fixed_planes(arr, dt)
+        return DeviceColumn.from_numpy(dt, values, validity, capacity)
+    # host-resident: normalize strings/binary to large_ variants
+    if isinstance(dt, T.StringType) and not pa.types.is_large_string(arr.type):
+        arr = arr.cast(pa.large_utf8())
+    if isinstance(dt, T.BinaryType) and not pa.types.is_large_binary(arr.type):
+        arr = arr.cast(pa.large_binary())
+    return HostColumn(dt, arr)
+
+
+@dataclasses.dataclass
+class ColumnarBatch:
+    schema: T.Schema
+    columns: List[Column]
+    num_rows: int
+
+    def __post_init__(self):
+        assert len(self.columns) == len(self.schema), (
+            len(self.columns), len(self.schema))
+
+    # --- constructors --------------------------------------------------------
+
+    @staticmethod
+    def from_arrow(rb: Union[pa.RecordBatch, pa.Table], schema: Optional[T.Schema] = None,
+                   capacity: Optional[int] = None) -> "ColumnarBatch":
+        if schema is None:
+            schema = T.schema_from_arrow(rb.schema)
+        n = rb.num_rows
+        cap = capacity or get_config().capacity_for(n)
+        cols = [
+            _arrow_to_column(rb.column(i), schema.types[i], cap)
+            for i in range(len(schema))
+        ]
+        return ColumnarBatch(schema, cols, n)
+
+    @staticmethod
+    def from_pydict(data: dict, schema: Optional[T.Schema] = None) -> "ColumnarBatch":
+        if schema is not None:
+            # build in schema order — from_arrow pairs columns positionally
+            tbl = pa.table(
+                {
+                    f.name: pa.array(data[f.name], type=T.to_arrow_type(f.dtype))
+                    for f in schema.fields
+                }
+            )
+        else:
+            tbl = pa.table(data)
+        return ColumnarBatch.from_arrow(tbl, schema)
+
+    @staticmethod
+    def empty(schema: T.Schema, capacity: Optional[int] = None) -> "ColumnarBatch":
+        from blaze_tpu.utils.device import is_device_dtype
+
+        cap = capacity or get_config().min_capacity
+        cols: List[Column] = []
+        for f in schema.fields:
+            if is_device_dtype(f.dtype):
+                cols.append(
+                    DeviceColumn(
+                        f.dtype,
+                        jnp.zeros(cap, dtype=f.dtype.np_dtype),
+                        jnp.zeros(cap, dtype=bool),
+                    )
+                )
+            else:
+                cols.append(HostColumn(f.dtype, pa.array([], type=T.to_arrow_type(f.dtype))))
+        return ColumnarBatch(schema, cols, 0)
+
+    # --- properties ----------------------------------------------------------
+
+    @property
+    def capacity(self) -> int:
+        for c in self.columns:
+            if isinstance(c, DeviceColumn):
+                return c.capacity
+        return get_config().capacity_for(self.num_rows)
+
+    def nbytes(self) -> int:
+        """Accurate in-memory size (reference: arrow/array_size.rs)."""
+        return sum(c.nbytes() for c in self.columns)
+
+    def column(self, i: int) -> Column:
+        return self.columns[i]
+
+    def row_exists_mask(self) -> jax.Array:
+        return _row_mask(self.capacity, self.num_rows)
+
+    # --- transforms ----------------------------------------------------------
+
+    def select(self, indices: Sequence[int]) -> "ColumnarBatch":
+        return ColumnarBatch(
+            self.schema.select(indices), [self.columns[i] for i in indices], self.num_rows
+        )
+
+    def rename(self, names: Sequence[str]) -> "ColumnarBatch":
+        return ColumnarBatch(self.schema.rename(names), self.columns, self.num_rows)
+
+    def with_capacity(self, capacity: int) -> "ColumnarBatch":
+        assert capacity >= self.num_rows, (
+            f"cannot shrink capacity {capacity} below num_rows {self.num_rows}"
+        )
+        cols = [
+            c.with_capacity(capacity) if isinstance(c, DeviceColumn) else c
+            for c in self.columns
+        ]
+        return ColumnarBatch(self.schema, cols, self.num_rows)
+
+    def _device_slots(self):
+        return [i for i, c in enumerate(self.columns) if isinstance(c, DeviceColumn)]
+
+    def take(self, indices: np.ndarray) -> "ColumnarBatch":
+        """Host-driven row gather (indices must be < num_rows). All device
+        columns move in ONE jitted dispatch (core/kernels.py)."""
+        from blaze_tpu.core import kernels
+
+        indices = np.asarray(indices, dtype=np.int64)
+        n = len(indices)
+        cap = get_config().capacity_for(n)
+        slots = self._device_slots()
+        cols: List[Column] = list(self.columns)
+        if slots:
+            datas, valids = kernels.gather_planes(
+                [self.columns[i].data for i in slots],
+                [self.columns[i].validity for i in slots],
+                indices, cap, n)
+            for k, i in enumerate(slots):
+                cols[i] = DeviceColumn(self.columns[i].dtype, datas[k], valids[k])
+        for i, c in enumerate(self.columns):
+            if not isinstance(c, DeviceColumn):
+                cols[i] = c.take_host(indices)
+        return ColumnarBatch(self.schema, cols, n)
+
+    def take_nullable(self, indices: np.ndarray) -> "ColumnarBatch":
+        """Row gather where index -1 yields an all-null row (outer-join null
+        extension)."""
+        from blaze_tpu.core import kernels
+
+        indices = np.asarray(indices, dtype=np.int64)
+        n = len(indices)
+        null_mask = indices < 0
+        cap = get_config().capacity_for(n)
+        slots = self._device_slots()
+        cols: List[Column] = list(self.columns)
+        if slots:
+            datas, valids = kernels.gather_planes(
+                [self.columns[i].data for i in slots],
+                [self.columns[i].validity for i in slots],
+                np.where(null_mask, 0, indices), cap, n, null_mask=null_mask)
+            for k, i in enumerate(slots):
+                cols[i] = DeviceColumn(self.columns[i].dtype, datas[k], valids[k])
+        pa_idx = None
+        for i, c in enumerate(self.columns):
+            if not isinstance(c, DeviceColumn):
+                if pa_idx is None:
+                    pa_idx = pa.Array.from_pandas(
+                        np.where(null_mask, 0, indices), mask=null_mask,
+                        type=pa.int64())
+                cols[i] = HostColumn(c.dtype, c.array.take(pa_idx))
+        schema = T.Schema(
+            tuple(T.StructField(f.name, f.dtype, True) for f in self.schema.fields)
+        ) if null_mask.any() else self.schema
+        return ColumnarBatch(schema, cols, n)
+
+    def slice(self, offset: int, length: int) -> "ColumnarBatch":
+        """Contiguous row window: one jitted dynamic-slice dispatch for all
+        device columns, zero-copy arrow slices for host columns."""
+        from blaze_tpu.core import kernels
+
+        length = max(0, min(length, self.num_rows - offset))
+        cap = get_config().capacity_for(length)
+        slots = self._device_slots()
+        cols: List[Column] = list(self.columns)
+        if slots:
+            if cap > self.capacity:
+                return self.take(np.arange(offset, offset + length))
+            datas, valids = kernels.slice_planes(
+                [self.columns[i].data for i in slots],
+                [self.columns[i].validity for i in slots],
+                offset, length, cap)
+            for k, i in enumerate(slots):
+                cols[i] = DeviceColumn(self.columns[i].dtype, datas[k], valids[k])
+        for i, c in enumerate(self.columns):
+            if not isinstance(c, DeviceColumn):
+                cols[i] = HostColumn(c.dtype, c.array.slice(offset, length))
+        return ColumnarBatch(self.schema, cols, length)
+
+    @staticmethod
+    def concat(batches: List["ColumnarBatch"], schema: Optional[T.Schema] = None) -> "ColumnarBatch":
+        """Coalesce small batches (reference: coalesce_batches_unchecked).
+        Device planes concatenate+compact in one jitted dispatch; host arrays
+        via arrow concat — no arrow round trip for device data (the round-1
+        profiler's top fixed cost)."""
+        from blaze_tpu.core import kernels
+
+        if not batches:
+            if schema is None:
+                raise ValueError("concat of zero batches requires a schema")
+            return ColumnarBatch.empty(schema)
+        batches = [b for b in batches if b.num_rows > 0] or batches[:1]
+        if len(batches) == 1:
+            return batches[0]
+        schema = schema or batches[0].schema
+        total = sum(b.num_rows for b in batches)
+        cap = get_config().capacity_for(total)
+        slots = batches[0]._device_slots()
+        ncols = len(batches[0].columns)
+        cols: List[Column] = [None] * ncols
+        if slots:
+            # concat_planes assumes each batch's device columns share one
+            # capacity (one index space per batch) — normalize stragglers
+            batches = [
+                b if len({b.columns[i].capacity for i in slots}) == 1
+                else b.with_capacity(max(b.columns[i].capacity for i in slots))
+                for b in batches
+            ]
+            datas, valids = kernels.concat_planes(
+                [tuple(b.columns[i].data for b in batches) for i in slots],
+                [tuple(b.columns[i].validity for b in batches) for i in slots],
+                [b.num_rows for b in batches], cap)
+            for k, i in enumerate(slots):
+                cols[i] = DeviceColumn(batches[0].columns[i].dtype, datas[k], valids[k])
+        for i in range(ncols):
+            if cols[i] is None:
+                c0 = batches[0].columns[i]
+                arr = pa.concat_arrays([
+                    b.columns[i].to_arrow(b.num_rows) for b in batches])
+                cols[i] = HostColumn(c0.dtype, arr)
+        return ColumnarBatch(schema, cols, total)
+
+    # --- host boundary -------------------------------------------------------
+
+    def to_arrow(self) -> pa.RecordBatch:
+        from blaze_tpu.utils.device import pull_columns
+
+        pulled = pull_columns(self.columns, self.num_rows)
+        arrays = [
+            c.to_arrow(self.num_rows) if p is None
+            else _devcol_to_arrow(c.dtype, p[0], p[1], self.num_rows)
+            for c, p in zip(self.columns, pulled)
+        ]
+        return pa.RecordBatch.from_arrays(arrays, schema=T.schema_to_arrow(self.schema))
+
+    def to_arrow_batches(self):
+        return [self.to_arrow()]
+
+    def to_pydict(self) -> dict:
+        return self.to_arrow().to_pydict()
+
+    def to_pandas(self):
+        return self.to_arrow().to_pandas()
+
+    def __repr__(self):
+        return f"ColumnarBatch({self.num_rows} rows, schema={self.schema.names})"
+
+
+@dataclasses.dataclass
+class HostBatch:
+    """Host-side mirror of a ColumnarBatch: numpy planes for device columns,
+    arrow arrays for host columns. The staging form for shuffle
+    split/serialize — ONE device pull, then numpy-speed row routing with no
+    further device dispatches (reference: BufferedData stages rows host-side
+    before the partition-id radix sort, buffered_data.rs:48-541)."""
+
+    schema: T.Schema
+    items: list  # per column: (np_data, np_valid) tuple, or pa.Array
+    num_rows: int
+
+    @staticmethod
+    def from_batch(batch: ColumnarBatch) -> "HostBatch":
+        from blaze_tpu.utils.device import pull_columns
+
+        n = batch.num_rows
+        pulled = pull_columns(batch.columns, n)
+        items = [
+            (p[0], p[1]) if p is not None else c.to_arrow(n)
+            for c, p in zip(batch.columns, pulled)
+        ]
+        return HostBatch(batch.schema, items, n)
+
+    def take(self, indices: np.ndarray) -> "HostBatch":
+        pa_idx = None
+        items = []
+        for it in self.items:
+            if isinstance(it, tuple):
+                items.append((it[0][indices], it[1][indices]))
+            else:
+                if pa_idx is None:
+                    pa_idx = pa.array(np.asarray(indices, dtype=np.int64),
+                                      type=pa.int64())
+                items.append(it.take(pa_idx))
+        return HostBatch(self.schema, items, len(indices))
+
+    def slice(self, offset: int, length: int) -> "HostBatch":
+        items = [
+            (it[0][offset:offset + length], it[1][offset:offset + length])
+            if isinstance(it, tuple) else it.slice(offset, length)
+            for it in self.items
+        ]
+        return HostBatch(self.schema, items, length)
+
+    def to_columnar(self, capacity: Optional[int] = None) -> ColumnarBatch:
+        cap = capacity or get_config().capacity_for(self.num_rows)
+        cols: List[Column] = [
+            DeviceColumn.from_numpy(f.dtype, it[0], it[1], cap)
+            if isinstance(it, tuple) else HostColumn(f.dtype, it)
+            for f, it in zip(self.schema.fields, self.items)
+        ]
+        return ColumnarBatch(self.schema, cols, self.num_rows)

@@ -1,0 +1,212 @@
+"""Spark-semantics casts (non-ANSI: invalid conversions yield NULL).
+
+Reference: the spark-compatible cast in
+``datafusion-ext-commons/src/arrow/cast.rs`` (float->int uses Java truncation
+semantics with NaN->0 and saturation; decimal<->numeric via unscaled values;
+string parsing trims and coerces failures to NULL).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from blaze_tpu.exprs import decimal as dec
+from blaze_tpu.ir import types as T
+
+_INT_TYPES = (T.Int8Type, T.Int16Type, T.Int32Type, T.Int64Type)
+_FLOAT_TYPES = (T.Float32Type, T.Float64Type)
+
+_US_PER_DAY = 86_400_000_000
+
+
+def _is_int(dt):
+    return isinstance(dt, _INT_TYPES)
+
+
+def _is_float(dt):
+    return isinstance(dt, _FLOAT_TYPES)
+
+
+def cast_dev(data, validity, frm: T.DataType, to: T.DataType):
+    """Cast a device column; returns (data, validity)."""
+    if frm == to:
+        return data, validity
+    # decimal source
+    if isinstance(frm, T.DecimalType):
+        if isinstance(to, T.DecimalType):
+            return dec.rescale(data, validity, frm.scale, to.scale, to.precision)
+        if _is_int(to):
+            scaled = data // dec.pow10(frm.scale)
+            r = data - scaled * dec.pow10(frm.scale)
+            trunc = jnp.where((r != 0) & (data < 0), scaled + 1, scaled)
+            return trunc.astype(to.np_dtype), validity
+        if _is_float(to):
+            return (data.astype(jnp.float64) / float(10**frm.scale)).astype(to.np_dtype), validity
+        if isinstance(to, T.BooleanType):
+            return data != 0, validity
+        raise NotImplementedError(f"cast decimal -> {to!r}")
+    # decimal target
+    if isinstance(to, T.DecimalType):
+        if _is_int(frm) or isinstance(frm, T.BooleanType):
+            v = data.astype(jnp.int64)
+            if to.scale > 0:
+                out, bad = dec._mul_overflows(v, dec.pow10(to.scale))
+                validity = validity & ~bad
+            else:
+                out = v
+            return dec.check_overflow(out, validity, to.precision)
+        if _is_float(frm):
+            scaled = data.astype(jnp.float64) * float(10**to.scale)
+            rounded = jnp.where(scaled >= 0, jnp.floor(scaled + 0.5), jnp.ceil(scaled - 0.5))
+            ok = jnp.isfinite(scaled) & (jnp.abs(rounded) < float(2**63))
+            out = jnp.where(ok, rounded, 0.0).astype(jnp.int64)
+            return dec.check_overflow(out, validity & ok, to.precision)
+        raise NotImplementedError(f"cast {frm!r} -> decimal")
+    # float -> int: Java semantics (NaN -> 0, saturate at bounds). XLA's
+    # float->int convert is undefined out of range and off-by-one at the
+    # boundary, so mask out-of-range lanes before converting.
+    if _is_float(frm) and _is_int(to):
+        info = np.iinfo(to.np_dtype)
+        x = jnp.trunc(jnp.nan_to_num(data.astype(jnp.float64), nan=0.0))
+        max_f, min_f = float(info.max), float(info.min)
+        in_bounds = (x > min_f) & (x < max_f)
+        xi = jnp.where(in_bounds, x, 0.0).astype(to.np_dtype)
+        out = jnp.where(x >= max_f, info.max, jnp.where(x <= min_f, info.min, xi))
+        return out, validity
+    # bool target
+    if isinstance(to, T.BooleanType):
+        return data != 0, validity
+    # date/timestamp
+    if isinstance(frm, T.DateType) and isinstance(to, T.TimestampType):
+        return data.astype(jnp.int64) * _US_PER_DAY, validity
+    if isinstance(frm, T.TimestampType) and isinstance(to, T.DateType):
+        return (data // _US_PER_DAY).astype(jnp.int32), validity
+    if isinstance(frm, T.TimestampType) and _is_int(to):
+        # spark: timestamp -> long is seconds
+        return (data // 1_000_000).astype(to.np_dtype), validity
+    if _is_int(frm) and isinstance(to, T.TimestampType):
+        return data.astype(jnp.int64) * 1_000_000, validity
+    # plain numeric/bool widening or wrapping narrow (java cast wraps ints)
+    if to.np_dtype is not None:
+        return data.astype(to.np_dtype), validity
+    raise NotImplementedError(f"device cast {frm!r} -> {to!r}")
+
+
+def cast_host(arr: pa.Array, frm: T.DataType, to: T.DataType, try_mode: bool) -> pa.Array:
+    """Cast a host (arrow) array with Spark non-ANSI semantics."""
+    at = T.to_arrow_type(to)
+    if frm == to:
+        return arr
+    if isinstance(frm, T.StringType):
+        return _cast_from_string(arr, to, at)
+    if isinstance(to, T.StringType):
+        return _cast_to_string(arr, frm)
+    try:
+        return pc.cast(arr, at)
+    except pa.ArrowInvalid:
+        if not try_mode:
+            raise
+        out = [None] * len(arr)
+        return pa.array(out, type=at)
+
+
+def _cast_from_string(arr: pa.Array, to: T.DataType, at) -> pa.Array:
+    import pandas as pd
+
+    trimmed = pc.utf8_trim_whitespace(arr)
+    if isinstance(to, (T.Int8Type, T.Int16Type, T.Int32Type, T.Int64Type,
+                       T.Float32Type, T.Float64Type)):
+        s = trimmed.to_pandas()
+        num = pd.to_numeric(s, errors="coerce")
+        vals = num.to_numpy(dtype="float64")
+        input_null = pd.isna(s).to_numpy()
+        if isinstance(to, _INT_TYPES):
+            # exact integer parse first — the float64 path corrupts > 2^53
+            info = np.iinfo(to.np_dtype)
+            out = np.zeros(len(s), dtype=to.np_dtype)
+            mask = np.ones(len(s), dtype=bool)
+            for i, v in enumerate(s):
+                if v is None or (isinstance(v, float) and v != v):
+                    continue
+                try:
+                    iv = int(v)
+                except ValueError:
+                    f = vals[i]
+                    if np.isnan(f) or f > info.max or f < info.min:
+                        continue
+                    iv = int(np.trunc(f))
+                if info.min <= iv <= info.max:
+                    out[i] = iv
+                    mask[i] = False
+            return pa.Array.from_pandas(out, mask=mask, type=at)
+        # float target: "nan" parses to NaN (valid); other failures -> null
+        mask = np.isnan(vals) & ~input_null & ~_is_nan_str(s)
+        return pa.Array.from_pandas(vals.astype(to.np_dtype), mask=mask | input_null, type=at)
+    if isinstance(to, T.BooleanType):
+        lowered = pc.utf8_lower(trimmed)
+        out = []
+        for v in lowered.to_pylist():
+            if v is None:
+                out.append(None)
+            elif v in ("t", "true", "y", "yes", "1"):
+                out.append(True)
+            elif v in ("f", "false", "n", "no", "0"):
+                out.append(False)
+            else:
+                out.append(None)
+        return pa.array(out, type=at)
+    if isinstance(to, (T.DecimalType, T.DateType, T.TimestampType)):
+        out = []
+        for v in trimmed.to_pylist():
+            if v is None:
+                out.append(None)
+                continue
+            try:
+                if isinstance(to, T.DecimalType):
+                    from decimal import Decimal, ROUND_HALF_UP
+
+                    d = Decimal(v).quantize(Decimal(1).scaleb(-to.scale), rounding=ROUND_HALF_UP)
+                    if len(d.as_tuple().digits) - to.scale > to.precision - to.scale:
+                        out.append(None)
+                    else:
+                        out.append(d)
+                elif isinstance(to, T.DateType):
+                    import datetime
+
+                    out.append(datetime.date.fromisoformat(v[:10]))
+                else:
+                    out.append(pa.scalar(v, type=pa.timestamp("us")).as_py())
+            except Exception:
+                out.append(None)
+        return pa.array(out, type=at)
+    if isinstance(to, T.BinaryType):
+        return trimmed.cast(pa.large_binary())
+    raise NotImplementedError(f"cast string -> {to!r}")
+
+
+def _is_nan_str(s):
+    return (s.str.strip().str.lower() == "nan").fillna(False).to_numpy()
+
+
+def _cast_to_string(arr: pa.Array, frm: T.DataType) -> pa.Array:
+    if isinstance(frm, T.BooleanType):
+        return pc.cast(arr, pa.large_utf8())
+    if isinstance(frm, (T.Float32Type, T.Float64Type)):
+        # java Double.toString writes "1.0", arrow writes "1" — fix up integers
+        out = []
+        for v in arr.to_pylist():
+            if v is None:
+                out.append(None)
+            elif v != v:
+                out.append("NaN")
+            elif v in (float("inf"), float("-inf")):
+                out.append("Infinity" if v > 0 else "-Infinity")
+            elif float(v) == int(v) and abs(v) < 1e16:
+                out.append(f"{int(v)}.0")
+            else:
+                out.append(repr(float(v)))
+        return pa.array(out, type=pa.large_utf8())
+    return pc.cast(arr, pa.large_utf8())

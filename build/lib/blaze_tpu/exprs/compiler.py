@@ -1,0 +1,702 @@
+"""Expression evaluator: IR expressions -> columnar values over a batch.
+
+The reference evaluates DataFusion ``PhysicalExpr`` trees with a
+common-subexpression-caching wrapper (``CachedExprsEvaluator``,
+``datafusion-ext-plans/src/common/cached_exprs_evaluator.rs``). Here the
+evaluator walks the expression IR per batch:
+
+- subtrees over fixed-width (device) columns evaluate as vectorized jax ops —
+  eager XLA dispatch per op, whole-expression ``jax.jit`` fusion for the
+  common all-device case via :class:`ExprEvaluator`'s compiled cache;
+- subtrees needing var-width (host) columns evaluate with pyarrow compute;
+- values move between the two worlds only at explicit boundaries.
+
+Null semantics are Spark's: validity propagates through arithmetic,
+comparisons use two-valued logic with null poisoning, AND/OR use Kleene
+logic, division/modulo by zero yield NULL (non-ANSI), CASE picks the first
+branch whose condition is definitively true.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from blaze_tpu.core.batch import Column, ColumnarBatch, DeviceColumn, HostColumn
+from blaze_tpu.exprs import decimal as dec
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import types as T
+
+
+@dataclasses.dataclass
+class DevVal:
+    """Device value: padded data + validity, plus its logical type."""
+
+    dtype: T.DataType
+    data: jax.Array
+    validity: jax.Array
+
+
+@dataclasses.dataclass
+class HostVal:
+    dtype: T.DataType
+    arr: pa.Array
+
+
+Val = Union[DevVal, HostVal]
+
+
+class ExprError(Exception):
+    pass
+
+
+def _is_device_type(dt: T.DataType) -> bool:
+    from blaze_tpu.utils.device import is_device_dtype
+
+    return is_device_dtype(dt)
+
+
+def _is_float(dt: T.DataType) -> bool:
+    return isinstance(dt, (T.Float32Type, T.Float64Type))
+
+
+class ExprEvaluator:
+    """Evaluates a fixed list of expressions against batches of one schema.
+
+    Holds per-partition state (RowNum counter) and caches compiled device
+    subgraphs keyed by batch capacity.
+    """
+
+    def __init__(self, exprs: List[E.Expr], input_schema: T.Schema):
+        self.exprs = exprs
+        self.input_schema = input_schema
+        self.row_num_offset = 0
+        # common-subexpression cache, valid for ONE batch only (reference:
+        # CachedExprsEvaluator's cached_exprs — shared subtrees evaluate once)
+        self._cse: dict = {}
+        self._cse_ref = None  # weakref to the batch the cache belongs to
+        self._cse_keys: dict = {}
+
+    def _reset_cse(self, batch: ColumnarBatch):
+        import weakref
+
+        if self._cse_ref is None or self._cse_ref() is not batch:
+            self._cse.clear()
+            self._cse_ref = weakref.ref(batch)
+
+    # -- public API -----------------------------------------------------------
+
+    def evaluate(self, batch: ColumnarBatch) -> List[Column]:
+        self._reset_cse(batch)
+        out = []
+        for expr in self.exprs:
+            val = self._eval(expr, batch)
+            out.append(self._to_column(val, batch))
+        self.row_num_offset += batch.num_rows
+        return out
+
+    def evaluate_predicate(self, batch: ColumnarBatch) -> jax.Array:
+        """Conjunction of all exprs as a device keep-mask (null -> drop)."""
+        self._reset_cse(batch)
+        mask = None
+        for expr in self.exprs:
+            val = self._eval(expr, batch)
+            dv = self._to_dev(val, batch)
+            keep = dv.data.astype(bool) & dv.validity
+            mask = keep if mask is None else (mask & keep)
+        return mask & batch.row_exists_mask()
+
+    # -- value conversions ----------------------------------------------------
+
+    def _to_column(self, val: Val, batch: ColumnarBatch) -> Column:
+        if isinstance(val, DevVal):
+            data = val.data
+            if data.ndim == 0:  # broadcast scalar literal
+                data = jnp.full(batch.capacity, data)
+                validity = jnp.broadcast_to(val.validity, (batch.capacity,)) & batch.row_exists_mask()
+            else:
+                validity = val.validity & batch.row_exists_mask()
+            return DeviceColumn(val.dtype, data, validity)
+        arr = val.arr
+        if len(arr) != batch.num_rows:  # scalar host literal
+            assert len(arr) == 1
+            arr = pa.concat_arrays([arr] * batch.num_rows) if batch.num_rows else arr.slice(0, 0)
+        return HostColumn(val.dtype, arr)
+
+    def _to_dev(self, val: Val, batch: ColumnarBatch) -> DevVal:
+        if isinstance(val, DevVal):
+            return val
+        col = _arrow_to_devcol(val.arr, val.dtype, batch.capacity)
+        return DevVal(val.dtype, col.data, col.validity)
+
+    def _to_host(self, val: Val, batch: ColumnarBatch) -> HostVal:
+        if isinstance(val, HostVal):
+            arr = val.arr
+            if len(arr) == 1 and batch.num_rows != 1:  # broadcast host literal
+                if arr[0].as_py() is None:
+                    arr = pa.nulls(batch.num_rows, arr.type)
+                else:
+                    arr = pa.array([arr[0].as_py()] * batch.num_rows, arr.type)
+                return HostVal(val.dtype, arr)
+            return val
+        col = DeviceColumn(val.dtype, *_broadcast(val, batch))
+        return HostVal(val.dtype, col.to_arrow(batch.num_rows))
+
+    # -- core recursion -------------------------------------------------------
+
+    def _eval(self, expr: E.Expr, batch: ColumnarBatch) -> Val:
+        key = self._expr_key(expr)
+        if key is not None:
+            cached = self._cse.get(key)
+            if cached is not None:
+                return cached
+        method = getattr(self, "_eval_" + type(expr).__name__, None)
+        if method is None:
+            raise ExprError(f"unsupported expression {type(expr).__name__}")
+        out = method(expr, batch)
+        if key is not None:
+            self._cse[key] = out
+        return out
+
+    def _expr_key(self, expr: E.Expr):
+        """Structural identity for CSE; trees containing stateful or
+        callable-bearing nodes opt out entirely (two distinct lambdas share a
+        qualname, and RowNum advances state per evaluation). Cached per expr
+        object (id) since IR trees are immutable."""
+        if isinstance(expr, (E.Column, E.BoundReference, E.Literal)):
+            return None  # trivial — not worth caching
+        key = self._cse_keys.get(id(expr))
+        if key is None:
+            if _contains_stateful(expr):
+                key = False
+            else:
+                try:
+                    from blaze_tpu.ir.serde import expr_to_json
+
+                    key = expr_to_json(expr)
+                except Exception:
+                    key = False
+            self._cse_keys[id(expr)] = key
+        return key or None
+
+    def _eval_Column(self, expr: E.Column, batch: ColumnarBatch) -> Val:
+        idx = batch.schema.index_of(expr.name)
+        return self._eval_BoundReference(E.BoundReference(idx), batch)
+
+    def _eval_BoundReference(self, expr: E.BoundReference, batch: ColumnarBatch) -> Val:
+        col = batch.columns[expr.index]
+        dt = batch.schema[expr.index].dtype
+        if isinstance(col, DeviceColumn):
+            return DevVal(dt, col.data, col.validity)
+        return HostVal(dt, col.array)
+
+    def _eval_Literal(self, expr: E.Literal, batch: ColumnarBatch) -> Val:
+        return make_literal(expr.value, expr.dtype)
+
+    def _eval_ScalarSubquery(self, expr: E.ScalarSubquery, batch) -> Val:
+        return make_literal(expr.value, expr.dtype)
+
+    def _eval_BinaryExpr(self, expr: E.BinaryExpr, batch: ColumnarBatch) -> Val:
+        op = expr.op
+        lval = self._eval(expr.left, batch)
+        rval = self._eval(expr.right, batch)
+        if isinstance(lval, HostVal) or isinstance(rval, HostVal):
+            if _is_device_type(lval.dtype) and _is_device_type(rval.dtype):
+                lval, rval = self._to_dev(lval, batch), self._to_dev(rval, batch)
+            else:
+                return self._binary_host(op, lval, rval, batch)
+        return self._binary_dev(op, expr, lval, rval)
+
+    def _binary_dev(self, op: E.BinaryOp, expr: E.BinaryExpr, l: DevVal, r: DevVal) -> DevVal:
+        B = E.BinaryOp
+        if op in (B.AND, B.OR):
+            lv, ld = l.validity, l.data.astype(bool)
+            rv, rd = r.validity, r.data.astype(bool)
+            if op == B.AND:
+                dfalse = (lv & ~ld) | (rv & ~rd)
+                dtrue = lv & ld & rv & rd
+            else:
+                dtrue = (lv & ld) | (rv & rd)
+                dfalse = lv & ~ld & rv & ~rd
+            return DevVal(T.BOOL, dtrue, dtrue | dfalse)
+
+        ldt, rdt = l.dtype, r.dtype
+        if op in (B.EQ, B.NEQ, B.LT, B.LTEQ, B.GT, B.GTEQ):
+            ld, rd = self._numeric_align(l, r)
+            fn = {
+                B.EQ: jnp.equal, B.NEQ: jnp.not_equal, B.LT: jnp.less,
+                B.LTEQ: jnp.less_equal, B.GT: jnp.greater, B.GTEQ: jnp.greater_equal,
+            }[op]
+            return DevVal(T.BOOL, fn(ld, rd), l.validity & r.validity)
+
+        # arithmetic
+        res_t = expr.result_type or E.infer_type(
+            E.BinaryExpr(op, E.Literal(None, ldt), E.Literal(None, rdt)), T.Schema(())
+        )
+        validity = l.validity & r.validity
+        if isinstance(res_t, T.DecimalType):
+            if _is_float(ldt) or _is_float(rdt):
+                # float operand: compute in f64, rescale into the result type
+                out = _float_op(op, self._decimal_to_f64(l), self._decimal_to_f64(r))
+                scaled = out * float(10**res_t.scale)
+                rounded = jnp.where(scaled >= 0, jnp.floor(scaled + 0.5), jnp.ceil(scaled - 0.5))
+                ok = jnp.isfinite(scaled) & (jnp.abs(rounded) < float(2**62))
+                data = jnp.where(ok, rounded, 0.0).astype(jnp.int64)
+                data, validity = dec.check_overflow(data, validity & ok, res_t.precision)
+                return DevVal(res_t, data, validity)
+            l = self._coerce_decimal(l)
+            r = self._coerce_decimal(r)
+            return self._decimal_arith(op, l, r, res_t)
+        ld, rd = self._numeric_align(l, r, res_t)
+        if op == B.ADD:
+            out = ld + rd
+        elif op == B.SUB:
+            out = ld - rd
+        elif op == B.MUL:
+            out = ld * rd
+        elif op == B.DIV:
+            zero = rd == 0
+            validity = validity & ~zero
+            den = jnp.where(zero, jnp.ones((), rd.dtype), rd)
+            if jnp.issubdtype(ld.dtype, jnp.integer):
+                out = _java_int_div(ld, den)
+            else:
+                out = ld / den
+        elif op == B.MOD:
+            zero = rd == 0
+            validity = validity & ~zero
+            den = jnp.where(zero, jnp.ones((), rd.dtype), rd)
+            if jnp.issubdtype(ld.dtype, jnp.integer):
+                q = _java_int_div(ld, den)
+                out = ld - q * den
+            else:
+                out = jnp.where(den != 0, ld - jnp.trunc(ld / den) * den, jnp.zeros((), ld.dtype))
+        elif op == B.BIT_AND:
+            out = ld & rd
+        elif op == B.BIT_OR:
+            out = ld | rd
+        elif op == B.BIT_XOR:
+            out = ld ^ rd
+        elif op == B.SHIFT_LEFT:
+            out = ld << (rd % jnp.array(ld.dtype.itemsize * 8, rd.dtype))
+        elif op == B.SHIFT_RIGHT:
+            out = ld >> (rd % jnp.array(ld.dtype.itemsize * 8, rd.dtype))
+        else:
+            raise ExprError(f"unsupported device binary op {op}")
+        return DevVal(res_t, out, validity)
+
+    def _decimal_arith(self, op: E.BinaryOp, l: DevVal, r: DevVal, res_t: T.DecimalType) -> DevVal:
+        B = E.BinaryOp
+        ls, rs = l.dtype.scale, r.dtype.scale
+        if op in (B.ADD, B.SUB):
+            s = max(ls, rs)
+            ld, lv = dec.rescale(l.data, l.validity, ls, s, 19)
+            rd, rv = dec.rescale(r.data, r.validity, rs, s, 19)
+            fn = dec.add if op == B.ADD else dec.sub
+            out, validity = fn(ld, lv, rd, rv)
+            out, validity = dec.rescale(out, validity, s, res_t.scale, res_t.precision)
+        elif op == B.MUL:
+            rescale_down = ls + rs - res_t.scale
+            out, validity = dec.mul(l.data, l.validity, r.data, r.validity,
+                                    rescale_down=max(rescale_down, 0))
+            out, validity = dec.check_overflow(out, validity, res_t.precision)
+        elif op == B.DIV:
+            scale_adjust = res_t.scale - ls + rs
+            out, validity = dec.div(l.data, l.validity, r.data, r.validity, scale_adjust)
+            out, validity = dec.check_overflow(out, validity, res_t.precision)
+        elif op == B.MOD:
+            s = max(ls, rs)
+            ld, lv = dec.rescale(l.data, l.validity, ls, s, 19)
+            rd, rv = dec.rescale(r.data, r.validity, rs, s, 19)
+            zero = rd == 0
+            den = jnp.where(zero, 1, rd)
+            q = _java_int_div(ld, den)
+            out = ld - q * den
+            validity = lv & rv & ~zero
+            out, validity = dec.rescale(out, validity, s, res_t.scale, res_t.precision)
+        else:
+            raise ExprError(f"unsupported decimal op {op}")
+        return DevVal(res_t, out, validity)
+
+    @staticmethod
+    def _coerce_decimal(v: DevVal) -> DevVal:
+        """Treat an integer operand as decimal(_,0) for decimal arithmetic."""
+        if isinstance(v.dtype, T.DecimalType):
+            return v
+        return DevVal(T.DecimalType(18, 0), v.data.astype(jnp.int64), v.validity)
+
+    def _numeric_align(self, l: DevVal, r: DevVal, res_t: Optional[T.DataType] = None):
+        """Promote both sides to a common jnp dtype (decimals: align scales)."""
+        if isinstance(l.dtype, T.DecimalType) and isinstance(r.dtype, T.DecimalType):
+            s = max(l.dtype.scale, r.dtype.scale)
+            ld, _ = dec.rescale(l.data, l.validity, l.dtype.scale, s, 19)
+            rd, _ = dec.rescale(r.data, r.validity, r.dtype.scale, s, 19)
+            return ld, rd
+        if isinstance(l.dtype, T.DecimalType) or isinstance(r.dtype, T.DecimalType):
+            # decimal vs float/int comparison: go through float64
+            ld = self._decimal_to_f64(l)
+            rd = self._decimal_to_f64(r)
+            return ld, rd
+        target = None
+        if res_t is not None and res_t.np_dtype is not None:
+            target = jnp.dtype(res_t.np_dtype)
+        else:
+            target = jnp.promote_types(l.data.dtype, r.data.dtype)
+        return l.data.astype(target), r.data.astype(target)
+
+    @staticmethod
+    def _decimal_to_f64(v: DevVal):
+        if isinstance(v.dtype, T.DecimalType):
+            return v.data.astype(jnp.float64) / float(10 ** v.dtype.scale)
+        return v.data.astype(jnp.float64)
+
+    def _binary_host(self, op: E.BinaryOp, l: Val, r: Val, batch: ColumnarBatch) -> Val:
+        B = E.BinaryOp
+        la = self._to_host(l, batch).arr
+        ra = self._to_host(r, batch).arr
+        fns = {
+            B.EQ: pc.equal, B.NEQ: pc.not_equal, B.LT: pc.less, B.LTEQ: pc.less_equal,
+            B.GT: pc.greater, B.GTEQ: pc.greater_equal,
+        }
+        if op in fns:
+            return HostVal(T.BOOL, fns[op](la, ra))
+        if op == B.AND:
+            return HostVal(T.BOOL, pc.and_kleene(la, ra))
+        if op == B.OR:
+            return HostVal(T.BOOL, pc.or_kleene(la, ra))
+        if op == B.ADD and pa.types.is_large_string(la.type):
+            return HostVal(T.STRING, pc.binary_join_element_wise(la, ra, pa.scalar("", type=pa.large_utf8())))
+        if pa.types.is_floating(la.type) or pa.types.is_floating(ra.type):
+            # exact f64 arithmetic on host (TPU demotes device f64 to f32)
+            lv = la.fill_null(0).to_numpy(zero_copy_only=False).astype(np.float64)
+            rv = ra.fill_null(0).to_numpy(zero_copy_only=False).astype(np.float64)
+            valid = (~np.asarray(pc.is_null(la))) & (~np.asarray(pc.is_null(ra)))
+            with np.errstate(all="ignore"):
+                if op == B.ADD:
+                    out = lv + rv
+                elif op == B.SUB:
+                    out = lv - rv
+                elif op == B.MUL:
+                    out = lv * rv
+                elif op == B.DIV:
+                    valid = valid & (rv != 0)
+                    out = lv / np.where(rv == 0, 1.0, rv)
+                elif op == B.MOD:
+                    valid = valid & (rv != 0)
+                    den = np.where(rv == 0, 1.0, rv)
+                    out = lv - np.trunc(lv / den) * den
+                else:
+                    raise ExprError(f"unsupported host float op {op}")
+            res_t = T.F64
+            return HostVal(res_t, pa.Array.from_pandas(out, mask=~valid,
+                                                       type=pa.float64()))
+        raise ExprError(f"unsupported host binary op {op} on {la.type}")
+
+    # -- unary / predicates ---------------------------------------------------
+
+    def _eval_IsNull(self, expr: E.IsNull, batch) -> Val:
+        v = self._eval(expr.child, batch)
+        if isinstance(v, DevVal):
+            validity = _broadcast(v, batch)[1]
+            return DevVal(T.BOOL, ~validity, jnp.ones(batch.capacity, bool))
+        return HostVal(T.BOOL, pc.is_null(v.arr))
+
+    def _eval_IsNotNull(self, expr: E.IsNotNull, batch) -> Val:
+        v = self._eval(expr.child, batch)
+        if isinstance(v, DevVal):
+            validity = _broadcast(v, batch)[1]
+            return DevVal(T.BOOL, validity, jnp.ones(batch.capacity, bool))
+        return HostVal(T.BOOL, pc.is_valid(v.arr))
+
+    def _eval_Not(self, expr: E.Not, batch) -> Val:
+        v = self._eval(expr.child, batch)
+        if isinstance(v, DevVal):
+            return DevVal(T.BOOL, ~v.data.astype(bool), v.validity)
+        return HostVal(T.BOOL, pc.invert(v.arr))
+
+    def _eval_Case(self, expr: E.Case, batch) -> Val:
+        # evaluate all branches, select first definitively-true condition
+        taken = jnp.zeros(batch.capacity, dtype=bool)
+        out_data = None
+        out_valid = None
+        res_dtype = None
+        host_mode = False
+        vals = []
+        conds = []
+        for cond_e, val_e in expr.branches:
+            conds.append(self._eval(cond_e, batch))
+            vals.append(self._eval(val_e, batch))
+        else_v = self._eval(expr.else_expr, batch) if expr.else_expr is not None else None
+        host_mode = any(isinstance(v, HostVal) and not _is_device_type(v.dtype) for v in vals) or (
+            else_v is not None and isinstance(else_v, HostVal) and not _is_device_type(else_v.dtype)
+        )
+        if host_mode:
+            return self._case_host(conds, vals, else_v, batch)
+        for cv, vv in zip(conds, vals):
+            cdev = self._to_dev(cv, batch)
+            vdev = self._to_dev(vv, batch)
+            cmask = cdev.data.astype(bool) & cdev.validity & ~taken
+            vdata, vvalid = _broadcast(vdev, batch)
+            if out_data is None:
+                res_dtype = vdev.dtype
+                out_data = jnp.where(cmask, vdata, jnp.zeros((), vdata.dtype))
+                out_valid = cmask & vvalid
+            else:
+                out_data = jnp.where(cmask, vdata.astype(out_data.dtype), out_data)
+                out_valid = jnp.where(cmask, vvalid, out_valid)
+            taken = taken | cmask
+        if else_v is not None:
+            edev = self._to_dev(else_v, batch)
+            edata, evalid = _broadcast(edev, batch)
+            out_data = jnp.where(taken, out_data, edata.astype(out_data.dtype))
+            out_valid = jnp.where(taken, out_valid, evalid)
+        else:
+            out_valid = out_valid & taken
+        return DevVal(res_dtype, out_data, out_valid)
+
+    def _case_host(self, conds, vals, else_v, batch) -> HostVal:
+        n = batch.num_rows
+        taken = np.zeros(n, dtype=bool)
+        res_dtype = vals[0].dtype
+        out = [None] * n
+        for cv, vv in zip(conds, vals):
+            ca = self._to_host(cv, batch).arr
+            va = self._to_host(vv, batch).arr
+            cnp = np.asarray(ca.fill_null(False).to_numpy(zero_copy_only=False)).astype(bool)
+            sel = cnp & ~taken
+            va_py = va.to_pylist()
+            for i in np.nonzero(sel)[0]:
+                out[i] = va_py[i]
+            taken |= sel
+        if else_v is not None:
+            ea = self._to_host(else_v, batch).arr.to_pylist()
+            for i in np.nonzero(~taken)[0]:
+                out[i] = ea[i]
+        return HostVal(res_dtype, pa.array(out, type=T.to_arrow_type(res_dtype)))
+
+    def _eval_InList(self, expr: E.InList, batch) -> Val:
+        v = self._eval(expr.child, batch)
+        values = [self._eval(x, batch) for x in expr.values]
+        has_null_item = any(
+            (isinstance(x, DevVal) and x.data.ndim == 0 and not bool(x.validity)) or
+            (isinstance(x, HostVal) and len(x.arr) == 1 and x.arr[0].as_py() is None)
+            for x in values
+        )
+        if isinstance(v, DevVal) and all(isinstance(x, DevVal) for x in values):
+            eq_any = jnp.zeros(batch.capacity, dtype=bool)
+            for x in values:
+                xd, xv = _broadcast(x, batch)
+                ld, rd = self._numeric_align(v, DevVal(x.dtype, xd, xv))
+                eq_any = eq_any | (jnp.equal(ld, rd) & xv)
+            data = eq_any
+            validity = v.validity & (eq_any | ~jnp.array(has_null_item))
+            if expr.negated:
+                data = ~data
+            return DevVal(T.BOOL, data, validity)
+        # host path
+        va = self._to_host(v, batch).arr
+        pylist = [self._host_scalar(x) for x in values]
+        vset = pa.array([p for p in pylist if p is not None], type=va.type)
+        isin = pc.is_in(va, value_set=vset)
+        data = np.asarray(isin.to_numpy(zero_copy_only=False)).astype(bool)
+        valid = ~np.asarray(pc.is_null(va).to_numpy(zero_copy_only=False)).astype(bool)
+        validity = valid & (data | (not has_null_item))
+        if expr.negated:
+            data = ~data
+        return HostVal(T.BOOL, pa.Array.from_pandas(
+            np.where(validity, data, False), mask=np.asarray(~validity), type=pa.bool_()))
+
+    def _host_scalar(self, v: Val):
+        if isinstance(v, HostVal):
+            assert len(v.arr) == 1
+            return v.arr[0].as_py()
+        assert v.data.ndim == 0
+        return v.data.item() if bool(v.validity) else None
+
+    # -- casts ----------------------------------------------------------------
+
+    def _eval_Cast(self, expr: E.Cast, batch) -> Val:
+        v = self._eval(expr.child, batch)
+        return self._cast(v, expr.dtype, batch, try_mode=False)
+
+    def _eval_TryCast(self, expr: E.TryCast, batch) -> Val:
+        v = self._eval(expr.child, batch)
+        return self._cast(v, expr.dtype, batch, try_mode=True)
+
+    def _cast(self, v: Val, to: T.DataType, batch: ColumnarBatch, try_mode: bool) -> Val:
+        from blaze_tpu.exprs.cast import cast_dev, cast_host
+
+        if v.dtype == to:
+            return v
+        if isinstance(v, DevVal) and _is_device_type(to) and _is_device_type(v.dtype):
+            data, validity = cast_dev(v.data, v.validity, v.dtype, to)
+            return DevVal(to, data, validity)
+        hv = self._to_host(v, batch)
+        return HostVal(to, cast_host(hv.arr, hv.dtype, to, try_mode))
+
+    # -- strings (host fast paths) --------------------------------------------
+
+    def _eval_StringStartsWith(self, expr, batch) -> Val:
+        a = self._to_host(self._eval(expr.child, batch), batch).arr
+        return HostVal(T.BOOL, pc.starts_with(a, pattern=expr.prefix))
+
+    def _eval_StringEndsWith(self, expr, batch) -> Val:
+        a = self._to_host(self._eval(expr.child, batch), batch).arr
+        return HostVal(T.BOOL, pc.ends_with(a, pattern=expr.suffix))
+
+    def _eval_StringContains(self, expr, batch) -> Val:
+        a = self._to_host(self._eval(expr.child, batch), batch).arr
+        return HostVal(T.BOOL, pc.match_substring(a, pattern=expr.infix))
+
+    def _eval_Like(self, expr: E.Like, batch) -> Val:
+        a = self._to_host(self._eval(expr.child, batch), batch).arr
+        if expr.escape_char not in ("\\", ""):
+            # translate custom escape to \ for arrow's SQL LIKE
+            pat = re.sub(re.escape(expr.escape_char) + r"(.)", r"\\\1", expr.pattern)
+        else:
+            pat = expr.pattern
+        out = pc.match_like(a, pattern=pat, ignore_case=expr.case_insensitive)
+        if expr.negated:
+            out = pc.invert(out)
+        return HostVal(T.BOOL, out)
+
+    # -- misc -----------------------------------------------------------------
+
+    def _eval_RowNum(self, expr, batch) -> Val:
+        data = jnp.arange(batch.capacity, dtype=jnp.int64) + self.row_num_offset
+        return DevVal(T.I64, data, batch.row_exists_mask())
+
+    def _eval_NamedStruct(self, expr: E.NamedStruct, batch) -> Val:
+        dtype = expr.dtype or E.infer_type(expr, batch.schema)
+        arrays = []
+        for name, e in zip(expr.names, expr.exprs):
+            col = self._to_column(self._eval(e, batch), batch)
+            arrays.append(col.to_arrow(batch.num_rows))
+        st = pa.StructArray.from_arrays(arrays, names=list(expr.names))
+        return HostVal(dtype, st)
+
+    def _eval_GetIndexedField(self, expr: E.GetIndexedField, batch) -> Val:
+        child = self._to_host(self._eval(expr.child, batch), batch)
+        assert isinstance(expr.ordinal, E.Literal)
+        ord_v = expr.ordinal.value
+        if isinstance(child.dtype, T.StructType):
+            field = child.dtype.fields[ord_v]
+            return HostVal(field.dtype, pc.struct_field(child.arr, indices=[ord_v]))
+        # array element (spark 1-based converted to 0-based by the frontend)
+        out = pc.list_element(child.arr, ord_v)
+        return HostVal(child.dtype.element_type, out)
+
+    def _eval_GetMapValue(self, expr: E.GetMapValue, batch) -> Val:
+        child = self._to_host(self._eval(expr.child, batch), batch)
+        key = self._host_scalar(self._eval(expr.key, batch))
+        vt = child.dtype.value_type
+        out = []
+        for row in child.arr.to_pylist():
+            if row is None:
+                out.append(None)
+            else:
+                d = dict(row) if not isinstance(row, dict) else row
+                out.append(d.get(key))
+        return HostVal(vt, pa.array(out, type=T.to_arrow_type(vt)))
+
+    def _eval_ScalarFunction(self, expr: E.ScalarFunction, batch) -> Val:
+        from blaze_tpu.exprs.functions import dispatch_function
+
+        args = [self._eval(a, batch) for a in expr.args]
+        return dispatch_function(expr.name, args, self, batch)
+
+    def _eval_PyUDF(self, expr: E.PyUDF, batch) -> Val:
+        args = [self._to_host(self._eval(a, batch), batch).arr for a in expr.args]
+        out = expr.fn(*args)
+        if not isinstance(out, pa.Array):
+            out = pa.array(out, type=T.to_arrow_type(expr.return_type))
+        return HostVal(expr.return_type, out)
+
+    def _eval_BloomFilterMightContain(self, expr, batch) -> Val:
+        from blaze_tpu.ops.bloom import SparkBloomFilter
+
+        blob = self._host_scalar(self._eval(expr.bloom_filter, batch))
+        if blob is None:
+            return make_literal(None, T.BOOL)
+        bf = SparkBloomFilter.deserialize(blob)
+        v = self._eval(expr.value, batch)
+        dv = self._to_dev(v, batch)
+        hit = bf.might_contain_long(dv.data)
+        return DevVal(T.BOOL, hit, dv.validity)
+
+    def _eval_SortOrder(self, expr: E.SortOrder, batch) -> Val:
+        return self._eval(expr.child, batch)
+
+
+def _contains_stateful(expr: E.Expr) -> bool:
+    if isinstance(expr, (E.RowNum, E.PyUDF)):
+        return True
+    return any(_contains_stateful(c) for c in expr.children())
+
+
+def _broadcast(v: DevVal, batch: ColumnarBatch):
+    """Broadcast scalar DevVals to batch capacity."""
+    data, validity = v.data, v.validity
+    if data.ndim == 0:
+        data = jnp.full(batch.capacity, data)
+    if validity.ndim == 0:
+        validity = jnp.broadcast_to(validity, (batch.capacity,))
+    return data, validity
+
+
+def _float_op(op: E.BinaryOp, ld, rd):
+    B = E.BinaryOp
+    if op == B.ADD:
+        return ld + rd
+    if op == B.SUB:
+        return ld - rd
+    if op == B.MUL:
+        return ld * rd
+    if op == B.DIV:
+        return jnp.where(rd == 0, jnp.nan, ld / jnp.where(rd == 0, 1.0, rd))
+    if op == B.MOD:
+        return jnp.where(rd == 0, jnp.nan, ld - jnp.trunc(ld / jnp.where(rd == 0, 1.0, rd)) * rd)
+    raise ExprError(f"unsupported float/decimal op {op}")
+
+
+def _java_int_div(a, b):
+    """Java-style truncating integer division (jnp // floors)."""
+    q = a // b
+    r = a - q * b
+    adjust = (r != 0) & ((a < 0) != (b < 0))
+    return jnp.where(adjust, q + 1, q)
+
+
+def _arrow_to_devcol(arr: pa.Array, dt: T.DataType, capacity: int) -> DeviceColumn:
+    from blaze_tpu.core.batch import _arrow_to_column
+
+    col = _arrow_to_column(arr, dt, capacity)
+    assert isinstance(col, DeviceColumn)
+    return col
+
+
+def make_literal(value: Any, dtype: T.DataType) -> Val:
+    """Build a scalar Val for a python literal value."""
+    if _is_device_type(dtype):
+        npdt = dtype.np_dtype
+        if value is None:
+            return DevVal(dtype, jnp.zeros((), npdt), jnp.zeros((), bool))
+        v = value
+        if isinstance(dtype, T.DecimalType):
+            from decimal import Decimal
+
+            v = int(Decimal(str(value)).scaleb(dtype.scale).to_integral_value())
+        elif isinstance(dtype, T.TimestampType) and not isinstance(value, (int, np.integer)):
+            v = int(pa.scalar(value, type=pa.timestamp("us")).value)
+        elif isinstance(dtype, T.DateType) and not isinstance(value, (int, np.integer)):
+            v = int(pa.scalar(value, type=pa.date32()).value)
+        return DevVal(dtype, jnp.array(v, npdt), jnp.ones((), bool))
+    at = T.to_arrow_type(dtype)
+    return HostVal(dtype, pa.array([value], type=at))

@@ -1,0 +1,101 @@
+"""Spark-exact decimal128 arithmetic on the scaled-int64 fast path.
+
+The reference implements these as ``spark_check_overflow``,
+``spark_make_decimal``, ``spark_unscaled_value`` and decimal binary arithmetic
+with precision promotion (``datafusion-ext-functions/src/spark_make_decimal.rs``
+etc., promotion rules mirrored from ``NativeConverters.scala:521-697``).
+
+On device a decimal(p<=18, s) value is its unscaled int64; all ops below
+detect int64 overflow explicitly and turn affected rows into NULL (matching
+Spark's non-ANSI behavior of nulling on decimal overflow).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+POW10 = np.array([10**i for i in range(19)], dtype=np.int64)
+
+
+def pow10(k):
+    """10**k as int64 for 0 <= k <= 18 (static python int k)."""
+    return jnp.int64(10 ** int(k))
+
+
+def check_overflow(data, validity, precision: int):
+    """Null out rows where |unscaled| >= 10^precision (spark_check_overflow)."""
+    if precision >= 19:
+        return data, validity
+    bound = pow10(precision)
+    ok = (data < bound) & (data > -bound)
+    return data, validity & ok
+
+
+def add(l_data, l_valid, r_data, r_valid):
+    """Same-scale add with int64 overflow -> null."""
+    s = l_data + r_data
+    # overflow iff operands share sign and sum flips sign
+    ovf = ((l_data >= 0) == (r_data >= 0)) & ((s >= 0) != (l_data >= 0)) & (l_data != 0)
+    return s, l_valid & r_valid & ~ovf
+
+
+def sub(l_data, l_valid, r_data, r_valid):
+    return add(l_data, l_valid, -r_data, r_valid)
+
+
+def _mul_overflows(a, b):
+    p = a * b
+    bad = (a != 0) & ((p // jnp.where(a == 0, 1, a)) != b)
+    return p, bad
+
+
+def mul(l_data, l_valid, r_data, r_valid, rescale_down: int = 0):
+    """Multiply unscaled values (result scale = s1+s2), optionally divide by
+    10^rescale_down with HALF_UP rounding when the bounded result type has a
+    smaller scale."""
+    p, bad = _mul_overflows(l_data, r_data)
+    validity = l_valid & r_valid & ~bad
+    if rescale_down > 0:
+        p = _div_half_up(p, pow10(rescale_down))
+    return p, validity
+
+
+def _div_half_up(num, den):
+    """Integer division with HALF_UP rounding (den > 0)."""
+    q = num // den
+    r = num - q * den
+    # python-style floor division: adjust toward java truncation + half-up
+    neg = num < 0
+    q_trunc = jnp.where(neg & (r != 0), q + 1, q)
+    r_trunc = num - q_trunc * den
+    bump = (2 * jnp.abs(r_trunc)) >= den
+    return jnp.where(bump, q_trunc + jnp.where(neg, -1, 1), q_trunc)
+
+
+def div(l_data, l_valid, r_data, r_valid, scale_adjust: int):
+    """Divide: result_unscaled = l * 10^scale_adjust / r, HALF_UP, where
+    scale_adjust = result_scale - s1 + s2 (so result has result_scale).
+    Division by zero -> null (Spark non-ANSI)."""
+    m = pow10(scale_adjust) if scale_adjust >= 0 else jnp.int64(1)
+    num, bad = _mul_overflows(l_data, m)
+    if scale_adjust < 0:
+        num = _div_half_up(l_data, pow10(-scale_adjust))
+        bad = jnp.zeros_like(l_valid)
+    den_zero = r_data == 0
+    den = jnp.where(den_zero, 1, r_data)
+    q = _div_half_up(num * jnp.where(den < 0, -1, 1), jnp.abs(den))
+    return q, l_valid & r_valid & ~bad & ~den_zero
+
+
+def rescale(data, validity, from_scale: int, to_scale: int, to_precision: int):
+    """Change scale with HALF_UP rounding; overflow -> null (decimal cast)."""
+    if to_scale > from_scale:
+        m = pow10(to_scale - from_scale)
+        out, bad = _mul_overflows(data, m)
+        validity = validity & ~bad
+    elif to_scale < from_scale:
+        out = _div_half_up(data, pow10(from_scale - to_scale))
+    else:
+        out = data
+    return check_overflow(out, validity, to_precision)

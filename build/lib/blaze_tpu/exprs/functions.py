@@ -1,0 +1,736 @@
+"""Scalar function registry with Spark semantics.
+
+Reference: ``native-engine/datafusion-ext-functions`` (spark_strings,
+spark_dates, spark_hash, spark_make_decimal, spark_normalize_nan_and_zero,
+spark_null_if, ...) plus the DataFusion built-ins the IR can name.
+
+Device functions run as vectorized jax ops (dates use civil-calendar integer
+math — no host round trip); var-width string functions run on host via
+pyarrow compute.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from blaze_tpu.ir import types as T
+
+# ---------------------------------------------------------------------------
+# type rules
+# ---------------------------------------------------------------------------
+
+_TYPE_RULES = {}
+
+
+def infer_function_type(name: str, arg_types) -> T.DataType:
+    rule = _TYPE_RULES.get(name)
+    if rule is None:
+        raise NotImplementedError(f"unknown scalar function {name!r}")
+    return rule(arg_types) if callable(rule) else rule
+
+
+def register_type_rule(name: str, rule):
+    _TYPE_RULES[name] = rule
+
+
+for _n in ("year", "month", "day", "dayofmonth", "quarter", "datediff"):
+    register_type_rule(_n, T.I32)
+for _n in ("length", "char_length", "instr"):
+    register_type_rule(_n, T.I32)
+for _n in ("upper", "lower", "trim", "ltrim", "rtrim", "substring", "substr",
+           "concat", "concat_ws", "replace", "repeat", "space", "lpad", "rpad",
+           "reverse", "sha2", "md5", "hex"):
+    register_type_rule(_n, T.STRING)
+for _n in ("sqrt", "exp", "ln", "log", "log2", "log10", "pow", "power",
+           "sin", "cos", "tan", "asin", "acos", "atan", "atan2", "cbrt",
+           "signum", "rint"):
+    register_type_rule(_n, T.F64)
+register_type_rule("murmur3_hash", T.I32)
+register_type_rule("xxhash64", T.I64)
+register_type_rule("crc32", T.I64)
+for _n in ("abs", "negative", "positive", "coalesce", "nullif", "nvl", "ifnull",
+           "greatest", "least", "normalize_nan_and_zero", "round"):
+    register_type_rule(_n, lambda ts: next((t for t in ts if not isinstance(t, T.NullType)), T.NULL))
+register_type_rule("if", lambda ts: ts[1])
+register_type_rule("ceil", T.I64)
+register_type_rule("floor", T.I64)
+register_type_rule("date_add", T.DATE)
+register_type_rule("date_sub", T.DATE)
+register_type_rule("split", T.ArrayType(T.STRING))
+register_type_rule("make_array", lambda ts: T.ArrayType(ts[0] if ts else T.NULL))
+def _array_union_type_rule(ts):
+    for t in ts:
+        if isinstance(t, T.ArrayType) and not isinstance(t.element_type, T.NullType):
+            return t
+    return T.ArrayType(T.NULL)
+
+
+register_type_rule("array_union", _array_union_type_rule)
+register_type_rule("unscaled_value", T.I64)
+register_type_rule("make_decimal", lambda ts: T.DecimalType(38, 18))
+register_type_rule("check_overflow", lambda ts: ts[0])
+register_type_rule("get_json_object", T.STRING)
+register_type_rule("string_space", T.STRING)
+register_type_rule("starts_with", T.BOOL)
+register_type_rule("ends_with", T.BOOL)
+register_type_rule("contains", T.BOOL)
+register_type_rule("isnan", T.BOOL)
+
+
+# ---------------------------------------------------------------------------
+# civil calendar on device (Howard Hinnant's algorithms, integer-only)
+# ---------------------------------------------------------------------------
+
+
+def civil_from_days(days):
+    """date32 days-since-epoch -> (year, month, day), vectorized int32 math."""
+    z = days.astype(jnp.int64) + 719468
+    era = z // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y.astype(jnp.int32), m.astype(jnp.int32), d.astype(jnp.int32)
+
+
+def days_from_civil(y, m, d):
+    y = y.astype(jnp.int64) - (m <= 2)
+    era = y // 400  # '//' already floors; no truncating-division correction
+    yoe = y - era * 400
+    mp = (m + jnp.where(m > 2, -3, 9)).astype(jnp.int64)
+    doy = (153 * mp + 2) // 5 + d.astype(jnp.int64) - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return (era * 146097 + doe - 719468).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
+
+def dispatch_function(name: str, args: List, evaluator, batch):
+    """args are Vals (DevVal|HostVal); returns a Val."""
+    from blaze_tpu.exprs.compiler import DevVal, HostVal
+
+    name = name.lower()
+    fn = _FUNCTIONS.get(name)
+    if fn is None:
+        raise NotImplementedError(f"scalar function {name!r} not implemented")
+    return fn(args, evaluator, batch)
+
+
+def _dev(args, evaluator, batch):
+    return [evaluator._to_dev(a, batch) for a in args]
+
+
+def _host(args, evaluator, batch):
+    return [evaluator._to_host(a, batch).arr for a in args]
+
+
+def _fn_date_part(part):
+    def impl(args, ev, batch):
+        from blaze_tpu.exprs.compiler import DevVal
+
+        (a,) = _dev(args, ev, batch)
+        if isinstance(a.dtype, T.TimestampType):
+            days = a.data // 86_400_000_000
+        else:
+            days = a.data
+        y, m, d = civil_from_days(days)
+        out = {"year": y, "month": m, "day": d, "quarter": (m + 2) // 3}[part]
+        return DevVal(T.I32, out, a.validity)
+
+    return impl
+
+
+def _fn_date_arith(sign):
+    def impl(args, ev, batch):
+        from blaze_tpu.exprs.compiler import DevVal
+
+        a, b = _dev(args, ev, batch)
+        out = a.data.astype(jnp.int32) + sign * b.data.astype(jnp.int32)
+        return DevVal(T.DATE, out, a.validity & b.validity)
+
+    return impl
+
+
+def _fn_datediff(args, ev, batch):
+    from blaze_tpu.exprs.compiler import DevVal
+
+    a, b = _dev(args, ev, batch)
+    return DevVal(T.I32, a.data.astype(jnp.int32) - b.data.astype(jnp.int32),
+                  a.validity & b.validity)
+
+
+def _unary_math(jfn):
+    def impl(args, ev, batch):
+        from blaze_tpu.exprs.compiler import DevVal
+
+        (a,) = _dev(args, ev, batch)
+        x = ev._decimal_to_f64(a) if isinstance(a.dtype, T.DecimalType) else a.data.astype(jnp.float64)
+        return DevVal(T.F64, jfn(x), a.validity)
+
+    return impl
+
+
+def _fn_abs(args, ev, batch):
+    from blaze_tpu.exprs.compiler import DevVal
+
+    (a,) = _dev(args, ev, batch)
+    if a.data.dtype == jnp.bool_:
+        return a
+    return DevVal(a.dtype, jnp.abs(a.data), a.validity)
+
+
+def _fn_negative(args, ev, batch):
+    from blaze_tpu.exprs.compiler import DevVal
+
+    (a,) = _dev(args, ev, batch)
+    return DevVal(a.dtype, -a.data, a.validity)
+
+
+def _fn_round(args, ev, batch):
+    from blaze_tpu.exprs.compiler import DevVal
+    from blaze_tpu.exprs import decimal as dec
+
+    a = ev._to_dev(args[0], batch)
+    scale = 0
+    if len(args) > 1:
+        scale = ev._host_scalar(args[1]) or 0
+    if isinstance(a.dtype, T.DecimalType):
+        out, validity = dec.rescale(a.data, a.validity, a.dtype.scale, scale, 19)
+        out2, validity2 = dec.rescale(out, validity, scale, a.dtype.scale, a.dtype.precision)
+        return DevVal(a.dtype, out2, validity2)
+    if jnp.issubdtype(a.data.dtype, jnp.integer):
+        if scale >= 0:
+            return a
+        # negative scale: round at the 10^-scale digit (HALF_UP), integer math
+        m = jnp.int64(10 ** (-scale))
+        av = a.data.astype(jnp.int64)
+        q = av // m
+        r = av - q * m
+        q = jnp.where((av < 0) & (r != 0), q + 1, q)
+        r = av - q * m
+        bump = (2 * jnp.abs(r)) >= m
+        q = jnp.where(bump, q + jnp.where(av < 0, -1, 1), q)
+        return DevVal(a.dtype, (q * m).astype(a.data.dtype), a.validity)
+    m = 10.0 ** scale
+    x = a.data.astype(jnp.float64) * m
+    # spark HALF_UP for floats
+    out = jnp.where(x >= 0, jnp.floor(x + 0.5), jnp.ceil(x - 0.5)) / m
+    return DevVal(a.dtype, out.astype(a.data.dtype), a.validity)
+
+
+def _fn_ceil_floor(jfn, which):
+    def impl(args, ev, batch):
+        from blaze_tpu.exprs.compiler import DevVal
+
+        (a,) = _dev(args, ev, batch)
+        if isinstance(a.dtype, T.DecimalType):
+            m = jnp.int64(10 ** a.dtype.scale)
+            out = -((-a.data) // m) if which == "ceil" else a.data // m
+            return DevVal(T.I64, out, a.validity)
+        if jnp.issubdtype(a.data.dtype, jnp.integer):
+            return DevVal(T.I64, a.data.astype(jnp.int64), a.validity)
+        return DevVal(T.I64, jfn(a.data.astype(jnp.float64)).astype(jnp.int64), a.validity)
+
+    return impl
+
+
+def _fn_coalesce(args, ev, batch):
+    from blaze_tpu.exprs.compiler import DevVal, HostVal, _broadcast
+
+    if all(isinstance(a, DevVal) for a in args):
+        data, validity = _broadcast(args[0], batch)
+        for a in args[1:]:
+            d2, v2 = _broadcast(a, batch)
+            data = jnp.where(validity, data, d2.astype(data.dtype))
+            validity = validity | v2
+        return DevVal(args[0].dtype, data, validity)
+    arrs = _host(args, ev, batch)
+    out = arrs[0]
+    for a in arrs[1:]:
+        out = pc.coalesce(out, a)
+    return HostVal(args[0].dtype, out)
+
+
+def _fn_nullif(args, ev, batch):
+    from blaze_tpu.exprs.compiler import DevVal
+
+    a, b = _dev(args, ev, batch)
+    ld, rd = ev._numeric_align(a, b)
+    eq = jnp.equal(ld, rd) & a.validity & b.validity
+    return DevVal(a.dtype, a.data, a.validity & ~eq)
+
+
+def _fn_nvl(args, ev, batch):
+    return _fn_coalesce(args, ev, batch)
+
+
+def _fn_if(args, ev, batch):
+    from blaze_tpu.exprs.compiler import DevVal, _broadcast
+
+    c, a, b = args
+    cdev = ev._to_dev(c, batch)
+    adev = ev._to_dev(a, batch)
+    bdev = ev._to_dev(b, batch)
+    cm = cdev.data.astype(bool) & cdev.validity
+    ad, av = _broadcast(adev, batch)
+    bd, bv = _broadcast(bdev, batch)
+    return DevVal(adev.dtype, jnp.where(cm, ad, bd.astype(ad.dtype)),
+                  jnp.where(cm, av, bv))
+
+
+def _fn_greatest_least(jfn):
+    def impl(args, ev, batch):
+        from blaze_tpu.exprs.compiler import DevVal, _broadcast
+
+        devs = _dev(args, ev, batch)
+        data, validity = _broadcast(devs[0], batch)
+        # spark: ignores nulls, returns null only if all null
+        has = validity
+        for a in devs[1:]:
+            d2, v2 = _broadcast(a, batch)
+            d2 = d2.astype(data.dtype)
+            both = has & v2
+            data = jnp.where(both, jfn(data, d2), jnp.where(v2, d2, data))
+            has = has | v2
+        return DevVal(devs[0].dtype, data, has)
+
+    return impl
+
+
+def _fn_isnan(args, ev, batch):
+    from blaze_tpu.exprs.compiler import DevVal
+
+    (a,) = _dev(args, ev, batch)
+    return DevVal(T.BOOL, jnp.isnan(a.data.astype(jnp.float64)) & a.validity,
+                  jnp.ones_like(a.validity))
+
+
+def _fn_normalize_nan_and_zero(args, ev, batch):
+    from blaze_tpu.exprs.compiler import DevVal
+
+    (a,) = _dev(args, ev, batch)
+    x = a.data
+    x = jnp.where(jnp.isnan(x), jnp.array(float("nan"), x.dtype), x)  # canonical nan
+    x = jnp.where(x == 0, jnp.zeros((), x.dtype), x)  # -0.0 -> +0.0
+    return DevVal(a.dtype, x, a.validity)
+
+
+def _fn_pow(args, ev, batch):
+    from blaze_tpu.exprs.compiler import DevVal
+
+    a, b = _dev(args, ev, batch)
+    return DevVal(T.F64, jnp.power(a.data.astype(jnp.float64), b.data.astype(jnp.float64)),
+                  a.validity & b.validity)
+
+
+def _fn_atan2(args, ev, batch):
+    from blaze_tpu.exprs.compiler import DevVal
+
+    a, b = _dev(args, ev, batch)
+    return DevVal(T.F64, jnp.arctan2(a.data.astype(jnp.float64), b.data.astype(jnp.float64)),
+                  a.validity & b.validity)
+
+
+# --- decimal helpers (reference: spark_unscaled_value / spark_make_decimal) --
+
+
+def _fn_unscaled_value(args, ev, batch):
+    from blaze_tpu.exprs.compiler import DevVal
+
+    (a,) = _dev(args, ev, batch)
+    assert isinstance(a.dtype, T.DecimalType)
+    return DevVal(T.I64, a.data, a.validity)
+
+
+def _fn_make_decimal(args, ev, batch):
+    from blaze_tpu.exprs.compiler import DevVal
+    from blaze_tpu.exprs import decimal as dec
+
+    a = ev._to_dev(args[0], batch)
+    precision = ev._host_scalar(args[1]) if len(args) > 1 else 38
+    scale = ev._host_scalar(args[2]) if len(args) > 2 else 18
+    data, validity = dec.check_overflow(a.data, a.validity, min(precision, 18))
+    return DevVal(T.DecimalType(precision, scale), data, validity)
+
+
+def _fn_check_overflow(args, ev, batch):
+    from blaze_tpu.exprs.compiler import DevVal
+    from blaze_tpu.exprs import decimal as dec
+
+    a = ev._to_dev(args[0], batch)
+    assert isinstance(a.dtype, T.DecimalType)
+    data, validity = dec.check_overflow(a.data, a.validity, a.dtype.precision)
+    return DevVal(a.dtype, data, validity)
+
+
+# --- hashes as expressions ---------------------------------------------------
+
+
+def _fn_murmur3(args, ev, batch):
+    from blaze_tpu.exprs.compiler import DevVal
+    from blaze_tpu.exprs import spark_hash as H
+
+    cols = [ev._to_column(a, batch) for a in args]
+    out = H.hash_batch(cols, batch.num_rows, batch.capacity, seed=42, algo="murmur3")
+    buf = np.zeros(batch.capacity, dtype=np.int32)
+    buf[: batch.num_rows] = out
+    return DevVal(T.I32, jnp.asarray(buf), batch.row_exists_mask())
+
+
+def _fn_xxhash64(args, ev, batch):
+    from blaze_tpu.exprs.compiler import DevVal
+    from blaze_tpu.exprs import spark_hash as H
+
+    cols = [ev._to_column(a, batch) for a in args]
+    out = H.hash_batch(cols, batch.num_rows, batch.capacity, seed=42, algo="xxhash64")
+    buf = np.zeros(batch.capacity, dtype=np.int64)
+    buf[: batch.num_rows] = out
+    return DevVal(T.I64, jnp.asarray(buf), batch.row_exists_mask())
+
+
+# --- strings (host) ----------------------------------------------------------
+
+
+def _str1(pcfn, out_t=T.STRING):
+    def impl(args, ev, batch):
+        from blaze_tpu.exprs.compiler import HostVal
+
+        (a,) = _host(args, ev, batch)
+        return HostVal(out_t, pcfn(a))
+
+    return impl
+
+
+def _fn_substring(args, ev, batch):
+    from blaze_tpu.exprs.compiler import HostVal
+
+    a = _host(args[:1], ev, batch)[0]
+    start = ev._host_scalar(args[1])
+    length = ev._host_scalar(args[2]) if len(args) > 2 else None
+    # spark 1-based; 0 behaves like 1; negative counts from end
+    if start > 0:
+        start0 = start - 1
+    elif start == 0:
+        start0 = 0
+    else:
+        start0 = start
+    stop = None if length is None else (start0 + length if start0 >= 0 else min(start0 + length, 0) or None)
+    out = pc.utf8_slice_codeunits(a, start=start0, stop=stop)
+    return HostVal(T.STRING, out)
+
+
+def _fn_length(args, ev, batch):
+    from blaze_tpu.exprs.compiler import HostVal
+
+    (a,) = _host(args, ev, batch)
+    return HostVal(T.I32, pc.cast(pc.utf8_length(a), pa.int32()))
+
+
+def _fn_concat(args, ev, batch):
+    from blaze_tpu.exprs.compiler import HostVal
+
+    arrs = _host(args, ev, batch)
+    return HostVal(T.STRING, pc.binary_join_element_wise(*arrs, pa.scalar("", type=pa.large_utf8())))
+
+
+def _fn_concat_ws(args, ev, batch):
+    from blaze_tpu.exprs.compiler import HostVal
+
+    sep = ev._host_scalar(args[0])
+    arrs = _host(args[1:], ev, batch)
+    # spark concat_ws skips nulls
+    out = pc.binary_join_element_wise(*arrs, pa.scalar(sep, type=pa.large_utf8()), null_handling="skip")
+    return HostVal(T.STRING, out)
+
+
+def _fn_replace(args, ev, batch):
+    from blaze_tpu.exprs.compiler import HostVal
+
+    a = _host(args[:1], ev, batch)[0]
+    pat = ev._host_scalar(args[1])
+    rep = ev._host_scalar(args[2]) if len(args) > 2 else ""
+    return HostVal(T.STRING, pc.replace_substring(a, pattern=pat, replacement=rep))
+
+
+def _fn_split(args, ev, batch):
+    from blaze_tpu.exprs.compiler import HostVal
+
+    a = _host(args[:1], ev, batch)[0]
+    pat = ev._host_scalar(args[1])
+    return HostVal(T.ArrayType(T.STRING), pc.split_pattern_regex(a, pattern=pat))
+
+
+def _fn_repeat(args, ev, batch):
+    from blaze_tpu.exprs.compiler import HostVal
+
+    a = _host(args[:1], ev, batch)[0]
+    n = ev._host_scalar(args[1])
+    return HostVal(T.STRING, pc.binary_repeat(a, max(int(n or 0), 0)))
+
+
+def _fn_space(args, ev, batch):
+    from blaze_tpu.exprs.compiler import DevVal, HostVal
+
+    a = ev._to_host(args[0], batch).arr
+    out = [None if v is None else " " * max(int(v), 0) for v in a.to_pylist()]
+    return HostVal(T.STRING, pa.array(out, type=pa.large_utf8()))
+
+
+def _fn_pad(side):
+    def impl(args, ev, batch):
+        from blaze_tpu.exprs.compiler import HostVal
+
+        a = _host(args[:1], ev, batch)[0]
+        n = int(ev._host_scalar(args[1]))
+        fill = ev._host_scalar(args[2]) if len(args) > 2 else " "
+        if len(fill) == 1:
+            fn = pc.utf8_lpad if side == "l" else pc.utf8_rpad
+            out = fn(a, width=n, padding=fill)
+            out = pc.utf8_slice_codeunits(out, start=0, stop=n)  # spark truncates
+            return HostVal(T.STRING, out)
+        # multi-codepoint pad: arrow only supports one, do it per row
+        vals = []
+        for v in a.to_pylist():
+            if v is None:
+                vals.append(None)
+            elif len(v) >= n:
+                vals.append(v[:n])
+            else:
+                pad = (fill * n)[: n - len(v)]
+                vals.append(pad + v if side == "l" else v + pad)
+        return HostVal(T.STRING, pa.array(vals, type=pa.large_utf8()))
+
+    return impl
+
+
+def _fn_instr(args, ev, batch):
+    from blaze_tpu.exprs.compiler import HostVal
+
+    a = _host(args[:1], ev, batch)[0]
+    sub = ev._host_scalar(args[1])
+    # spark instr is 1-based, 0 when absent
+    idx = pc.find_substring(a, pattern=sub)
+    out = pc.add(idx, 1)
+    return HostVal(T.I32, pc.cast(out, pa.int32()))
+
+
+def _fn_sha2(args, ev, batch):
+    import hashlib
+
+    from blaze_tpu.exprs.compiler import HostVal
+
+    a = ev._to_host(args[0], batch).arr
+    bits = int(ev._host_scalar(args[1])) if len(args) > 1 else 256
+    algo = {0: "sha256", 224: "sha224", 256: "sha256", 384: "sha384", 512: "sha512"}.get(bits)
+    out = []
+    for v in a.to_pylist():
+        if v is None or algo is None:
+            out.append(None)
+        else:
+            data = v.encode() if isinstance(v, str) else v
+            out.append(getattr(hashlib, algo)(data).hexdigest())
+    return HostVal(T.STRING, pa.array(out, type=pa.large_utf8()))
+
+
+def _fn_md5(args, ev, batch):
+    import hashlib
+
+    from blaze_tpu.exprs.compiler import HostVal
+
+    a = ev._to_host(args[0], batch).arr
+    out = []
+    for v in a.to_pylist():
+        if v is None:
+            out.append(None)
+        else:
+            data = v.encode() if isinstance(v, str) else v
+            out.append(hashlib.md5(data).hexdigest())
+    return HostVal(T.STRING, pa.array(out, type=pa.large_utf8()))
+
+
+def _fn_get_json_object(args, ev, batch):
+    """Reference: spark_get_json_object (sonic-rs json path); here python json
+    with the common $.a.b[i] subset."""
+    import json
+
+    from blaze_tpu.exprs.compiler import HostVal
+
+    a = ev._to_host(args[0], batch).arr
+    path = ev._host_scalar(args[1])
+    steps = _parse_json_path(path)
+    out = []
+    for v in a.to_pylist():
+        if v is None or steps is None:
+            out.append(None)
+            continue
+        try:
+            cur = json.loads(v)
+            for s in steps:
+                if isinstance(s, int):
+                    cur = cur[s] if isinstance(cur, list) and -len(cur) <= s < len(cur) else None
+                else:
+                    cur = cur.get(s) if isinstance(cur, dict) else None
+                if cur is None:
+                    break
+            if cur is None:
+                out.append(None)
+            elif isinstance(cur, str):
+                out.append(cur)
+            else:
+                out.append(json.dumps(cur, separators=(",", ":")))
+        except Exception:
+            out.append(None)
+    return HostVal(T.STRING, pa.array(out, type=pa.large_utf8()))
+
+
+def _parse_json_path(path):
+    import re
+
+    if not path or not path.startswith("$"):
+        return None
+    steps = []
+    for m in re.finditer(r"\.([A-Za-z_][A-Za-z0-9_]*)|\[(\d+)\]|\['([^']+)'\]", path[1:]):
+        if m.group(1) is not None:
+            steps.append(m.group(1))
+        elif m.group(2) is not None:
+            steps.append(int(m.group(2)))
+        else:
+            steps.append(m.group(3))
+    return steps
+
+
+def _fn_array_union(args, ev, batch):
+    """brickhouse array_union: element-wise union of array columns with
+    dedup, first-seen order. Result is never null — ``null U null = {}``
+    (reference: brickhouse/array_union.rs semantics)."""
+    from blaze_tpu.exprs.compiler import HostVal
+
+    arrs = [ev._to_host(a, batch).arr for a in args]
+    et = _array_union_element_type([a.dtype for a in args])
+    pylists = [a.to_pylist() for a in arrs]
+    n = len(pylists[0]) if pylists else 0
+    out = []
+    for i in range(n):
+        seen = []
+        seen_set = set()
+        for pl in pylists:
+            items = pl[i]
+            if items is None:
+                continue
+            for v in items:
+                try:
+                    new = v not in seen_set
+                    if new:
+                        seen_set.add(v)
+                except TypeError:  # unhashable nested value
+                    new = v not in seen
+                if new:
+                    seen.append(v)
+        out.append(seen)
+    return HostVal(T.ArrayType(et),
+                   pa.array(out, type=pa.large_list(T.to_arrow_type(et))))
+
+
+def _array_union_element_type(arg_types) -> T.DataType:
+    """First non-null List element type (reference skips DataType::Null)."""
+    for t in arg_types:
+        if isinstance(t, T.ArrayType) and not isinstance(t.element_type, T.NullType):
+            return t.element_type
+    return T.NULL
+
+
+def _fn_make_array(args, ev, batch):
+    from blaze_tpu.exprs.compiler import HostVal
+
+    et = args[0].dtype if args else T.NULL
+    arrs = [ev._to_host(a, batch).arr for a in args]
+    n = batch.num_rows
+    pylists = [a.to_pylist() for a in arrs]
+    rows = [[pl[i] for pl in pylists] for i in range(n)]
+    return HostVal(T.ArrayType(et), pa.array(rows, type=pa.large_list(T.to_arrow_type(et))))
+
+
+_FUNCTIONS = {
+    "year": _fn_date_part("year"),
+    "month": _fn_date_part("month"),
+    "day": _fn_date_part("day"),
+    "dayofmonth": _fn_date_part("day"),
+    "quarter": _fn_date_part("quarter"),
+    "date_add": _fn_date_arith(1),
+    "date_sub": _fn_date_arith(-1),
+    "datediff": _fn_datediff,
+    "sqrt": _unary_math(jnp.sqrt),
+    "exp": _unary_math(jnp.exp),
+    "ln": _unary_math(jnp.log),
+    "log": _unary_math(jnp.log),
+    "log2": _unary_math(jnp.log2),
+    "log10": _unary_math(jnp.log10),
+    "sin": _unary_math(jnp.sin),
+    "cos": _unary_math(jnp.cos),
+    "tan": _unary_math(jnp.tan),
+    "asin": _unary_math(jnp.arcsin),
+    "acos": _unary_math(jnp.arccos),
+    "atan": _unary_math(jnp.arctan),
+    "cbrt": _unary_math(jnp.cbrt),
+    "signum": _unary_math(jnp.sign),
+    "rint": _unary_math(jnp.round),
+    "pow": _fn_pow,
+    "power": _fn_pow,
+    "atan2": _fn_atan2,
+    "abs": _fn_abs,
+    "negative": _fn_negative,
+    "round": _fn_round,
+    "ceil": _fn_ceil_floor(jnp.ceil, "ceil"),
+    "floor": _fn_ceil_floor(jnp.floor, "floor"),
+    "coalesce": _fn_coalesce,
+    "nullif": _fn_nullif,
+    "nvl": _fn_nvl,
+    "ifnull": _fn_nvl,
+    "if": _fn_if,
+    "greatest": _fn_greatest_least(jnp.maximum),
+    "least": _fn_greatest_least(jnp.minimum),
+    "isnan": _fn_isnan,
+    "normalize_nan_and_zero": _fn_normalize_nan_and_zero,
+    "unscaled_value": _fn_unscaled_value,
+    "make_decimal": _fn_make_decimal,
+    "check_overflow": _fn_check_overflow,
+    "murmur3_hash": _fn_murmur3,
+    "xxhash64": _fn_xxhash64,
+    "upper": _str1(pc.utf8_upper),
+    "lower": _str1(pc.utf8_lower),
+    "trim": _str1(pc.utf8_trim_whitespace),
+    "ltrim": _str1(pc.utf8_ltrim_whitespace),
+    "rtrim": _str1(pc.utf8_rtrim_whitespace),
+    "reverse": _str1(pc.utf8_reverse),
+    "substring": _fn_substring,
+    "substr": _fn_substring,
+    "length": _fn_length,
+    "char_length": _fn_length,
+    "concat": _fn_concat,
+    "concat_ws": _fn_concat_ws,
+    "replace": _fn_replace,
+    "split": _fn_split,
+    "repeat": _fn_repeat,
+    "space": _fn_space,
+    "string_space": _fn_space,
+    "lpad": _fn_pad("l"),
+    "rpad": _fn_pad("r"),
+    "instr": _fn_instr,
+    "sha2": _fn_sha2,
+    "md5": _fn_md5,
+    "get_json_object": _fn_get_json_object,
+    "make_array": _fn_make_array,
+    "array_union": _fn_array_union,
+}

@@ -1,0 +1,8 @@
+"""Input-side plan conversion: external (Spark-serialized) physical plans
+into the engine's IR — the standalone analogue of the reference's
+spark-extension conversion layer (SURVEY.md §2.1, AuronConverters.scala)."""
+
+from blaze_tpu.frontend.converter import (ConversionResult, SparkPlanConverter,
+                                          convert_spark_plan)
+
+__all__ = ["ConversionResult", "SparkPlanConverter", "convert_spark_plan"]

@@ -63,26 +63,6 @@ _JOIN_TYPES = {
 }
 
 
-def table_ident(node: TreeNode) -> Optional[str]:
-    """tableIdentifier field -> dotted name (shared with providers)."""
-    ident = node.field("tableIdentifier")
-    if isinstance(ident, dict):
-        ident = ".".join(str(v) for v in ident.values() if v)
-    return str(ident) if ident else None
-
-
-def and_fold_filters(trees, scope: "AttrScope") -> Optional[E.Expr]:
-    """Convert a list of filter condition trees and AND-fold them (shared
-    between scan converters and scan providers)."""
-    if not trees:
-        return None
-    out = None
-    for t in decode_field_trees(trees):
-        e = convert_expr(t, scope)
-        out = e if out is None else E.BinaryExpr(E.BinaryOp.AND, out, e)
-    return out
-
-
 class SparkPlanConverter:
     """One-shot converter for a serialized Spark physical plan."""
 
@@ -140,26 +120,6 @@ class SparkPlanConverter:
                 child_failed = True
         fn = getattr(self, f"_convert_{_snake(name)}", None)
         if fn is None:
-            if child_failed:
-                self._tag(node, "fallback: child not convertible")
-                raise UnsupportedNode(name)
-            # consult the provider SPI (reference: AuronConvertProvider —
-            # the Paimon integration's entry point) before tagging fallback
-            from blaze_tpu.frontend.providers import providers
-
-            for p in providers():
-                if not self.conf.is_op_enabled(p.name):
-                    continue
-                try:
-                    result = p.try_convert(node, self, kids)
-                except (UnsupportedExpr, UnsupportedNode, NotImplementedError,
-                        KeyError, ValueError, TypeError) as exc:
-                    self._tag(node, f"fallback: provider {p.name}: "
-                                    f"{type(exc).__name__}: {exc}")
-                    raise UnsupportedNode(name) from exc
-                if result is not None:
-                    self._tag(node, f"converted (provider {p.name})")
-                    return result
             self._tag(node, f"fallback: no converter for {name}")
             raise UnsupportedNode(name)
         op_key = _snake(name).replace("_exec", "")
@@ -197,7 +157,10 @@ class SparkPlanConverter:
     # ---- scans --------------------------------------------------------------
 
     def _convert_file_source_scan_exec(self, node, kids):
-        ident = table_ident(node)
+        ident = node.field("tableIdentifier")
+        if isinstance(ident, dict):
+            ident = ".".join(str(v) for v in ident.values() if v)
+        ident = str(ident) if ident else None
         if self.catalog is not None and ident in getattr(
                 self.catalog, "tables", {}):
             return self._catalog_scan(node, ident)
@@ -221,8 +184,15 @@ class SparkPlanConverter:
         bare = [a.field("name") for a in out_attrs]
         from blaze_tpu.ops.parquet import scan_node_for_files
 
-        # scan filters reference file columns: empty scope
-        pred = and_fold_filters(node.field("dataFilters"), {})
+        pred = None
+        data_filters = node.field("dataFilters")
+        if data_filters:
+            trees = decode_field_trees(data_filters)
+            scope: AttrScope = {}  # scan filters reference file columns
+            exprs = [convert_expr(t, scope) for t in trees]
+            pred = exprs[0]
+            for e in exprs[1:]:
+                pred = E.BinaryExpr(E.BinaryOp.AND, pred, e)
         scan = scan_node_for_files(list(paths), num_partitions=max(
             1, len(paths)), projection=bare or None, predicate=pred)
         plan: N.PlanNode = scan
@@ -239,8 +209,15 @@ class SparkPlanConverter:
         out_attrs = self._scope_from_output(node) or []
         names = [FE.attr_name(a) for a in out_attrs]
         bare = [a.field("name") for a in out_attrs]
-        ppred = and_fold_filters(node.field("partitionFilters"), {})
-        dpred = and_fold_filters(node.field("dataFilters"), {})
+        scope: AttrScope = {}
+        ppred = None
+        for t in decode_field_trees(node.field("partitionFilters")):
+            e = convert_expr(t, scope)
+            ppred = e if ppred is None else E.BinaryExpr(E.BinaryOp.AND, ppred, e)
+        dpred = None
+        for t in decode_field_trees(node.field("dataFilters")):
+            e = convert_expr(t, scope)
+            dpred = e if dpred is None else E.BinaryExpr(E.BinaryOp.AND, dpred, e)
         t = self.catalog.tables[ident]
         nparts = max(1, min(len(t.files), 4))
         plan = self.catalog.scan_node(

@@ -1,0 +1,291 @@
+"""Spark Catalyst expression JSON -> engine expression IR.
+
+Reference: ``NativeConverters.convertExpr`` (spark-extension/src/main/
+scala/.../NativeConverters.scala:257-1060) — one case per Catalyst
+expression class, raising on anything unsupported so the per-node trial
+conversion (converter.py) can fall the plan node back.
+
+Attribute resolution: Catalyst references columns by ``exprId``; converted
+plans name columns ``{name}#{id}`` (Spark's own display convention), so an
+``AttributeReference`` becomes ``E.Column`` via the attribute scope built
+from the child plan's output."""
+
+from __future__ import annotations
+
+import decimal
+from typing import Dict, List, Optional, Tuple
+
+from blaze_tpu.frontend.spark_types import from_spark_json
+from blaze_tpu.frontend.treenode import TreeNode
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import types as T
+
+
+class UnsupportedExpr(NotImplementedError):
+    pass
+
+
+AttrScope = Dict[int, str]  # exprId.id -> engine column name
+
+
+def attr_name(node: TreeNode) -> str:
+    eid = node.field("exprId") or {}
+    return f"{node.field('name')}#{eid.get('id', '?')}"
+
+
+_BINOPS = {
+    "Add": E.BinaryOp.ADD,
+    "Subtract": E.BinaryOp.SUB,
+    "Multiply": E.BinaryOp.MUL,
+    "Divide": E.BinaryOp.DIV,
+    # IntegralDivide (`div`) is NOT plain DIV: on decimals Spark truncates
+    # to long — unsupported until the engine grows a matching kernel.
+    "Remainder": E.BinaryOp.MOD,
+    "EqualTo": E.BinaryOp.EQ,
+    "LessThan": E.BinaryOp.LT,
+    "LessThanOrEqual": E.BinaryOp.LTEQ,
+    "GreaterThan": E.BinaryOp.GT,
+    "GreaterThanOrEqual": E.BinaryOp.GTEQ,
+    "And": E.BinaryOp.AND,
+    "Or": E.BinaryOp.OR,
+    "BitwiseAnd": E.BinaryOp.BIT_AND,
+    "BitwiseOr": E.BinaryOp.BIT_OR,
+    "BitwiseXor": E.BinaryOp.BIT_XOR,
+    "ShiftLeft": E.BinaryOp.SHIFT_LEFT,
+    "ShiftRight": E.BinaryOp.SHIFT_RIGHT,
+}
+
+# Catalyst scalar-function classes forwarded to the engine's function
+# registry by lowercased name (exprs/functions.py)
+_FUNCTIONS = {
+    "Upper": "upper", "Lower": "lower", "Length": "length",
+    "Substring": "substring", "Concat": "concat", "ConcatWs": "concat_ws",
+    "StringTrim": "trim", "StringTrimLeft": "ltrim", "StringTrimRight": "rtrim",
+    "StringRepeat": "repeat", "StringSpace": "space",
+    "StringLPad": "lpad", "StringRPad": "rpad", "StringReplace": "replace",
+    "Year": "year", "Month": "month", "DayOfMonth": "day",
+    "Quarter": "quarter", "DateDiff": "datediff",
+    "Abs": "abs", "Coalesce": "coalesce", "Sha2": "sha2",
+    "GetJsonObject": "get_json_object",
+    "Murmur3Hash": "hash", "XxHash64": "xxhash64",
+    "NormalizeNaNAndZero": "normalize_nan_and_zero",
+}
+
+_AGG_FNS = {
+    "Sum": E.AggFunction.SUM,
+    "Min": E.AggFunction.MIN,
+    "Max": E.AggFunction.MAX,
+    "Average": E.AggFunction.AVG,
+    "Count": E.AggFunction.COUNT,
+    "CollectList": E.AggFunction.COLLECT_LIST,
+    "CollectSet": E.AggFunction.COLLECT_SET,
+    "First": E.AggFunction.FIRST,
+}
+
+
+def _literal_value(node: TreeNode):
+    dt = from_spark_json(node.field("dataType"))
+    v = node.field("value")
+    if v is None:
+        return E.Literal(None, dt)
+    if isinstance(dt, (T.Int8Type, T.Int16Type, T.Int32Type, T.Int64Type)):
+        v = int(v)
+    elif isinstance(dt, (T.Float32Type, T.Float64Type)):
+        v = float(v)
+    elif isinstance(dt, T.BooleanType):
+        v = v if isinstance(v, bool) else str(v).lower() == "true"
+    elif isinstance(dt, T.DecimalType):
+        v = decimal.Decimal(str(v))
+    elif isinstance(dt, T.DateType):
+        # Catalyst serializes dates as epoch days
+        v = int(v) if not isinstance(v, str) or v.lstrip("-").isdigit() else v
+    elif isinstance(dt, T.TimestampType):
+        v = int(v) if not isinstance(v, str) or v.lstrip("-").isdigit() else v
+    return E.Literal(v, dt)
+
+
+def convert_expr(node: TreeNode, scope: AttrScope) -> E.Expr:
+    """One Catalyst expression tree -> engine expr; raises UnsupportedExpr
+    to trigger the caller's per-node fallback."""
+    name = node.name
+    kids = node.children
+
+    if name == "AttributeReference":
+        eid = (node.field("exprId") or {}).get("id")
+        if eid in scope:
+            return E.Column(scope[eid])
+        # unresolved scope (e.g. leaf scan attributes): fall back to the
+        # bare name, matching file-schema resolution
+        return E.Column(node.field("name"))
+    if name == "Literal":
+        return _literal_value(node)
+    if name == "Alias":
+        return convert_expr(kids[0], scope)
+    if name in _BINOPS:
+        return E.BinaryExpr(_BINOPS[name],
+                            convert_expr(kids[0], scope),
+                            convert_expr(kids[1], scope))
+    if name == "Pmod":
+        # engine MOD is truncating (Java %); Spark pmod(a, b) desugars to
+        # ((a % b) + b) % b, which is exact for the truncating kernel
+        a = convert_expr(kids[0], scope)
+        b = convert_expr(kids[1], scope)
+        inner = E.BinaryExpr(E.BinaryOp.MOD, a, b)
+        return E.BinaryExpr(E.BinaryOp.MOD,
+                            E.BinaryExpr(E.BinaryOp.ADD, inner, b), b)
+    if name == "EqualNullSafe":
+        l, r = (convert_expr(k, scope) for k in kids)
+        eq = E.BinaryExpr(E.BinaryOp.EQ, l, r)
+        both_null = E.BinaryExpr(E.BinaryOp.AND, E.IsNull(l), E.IsNull(r))
+        neither = E.BinaryExpr(E.BinaryOp.AND, E.IsNotNull(l), E.IsNotNull(r))
+        return E.BinaryExpr(E.BinaryOp.OR, both_null,
+                            E.BinaryExpr(E.BinaryOp.AND, neither, eq))
+    if name == "Not":
+        return E.Not(convert_expr(kids[0], scope))
+    if name == "IsNull":
+        return E.IsNull(convert_expr(kids[0], scope))
+    if name == "IsNotNull":
+        return E.IsNotNull(convert_expr(kids[0], scope))
+    if name in ("Cast", "AnsiCast"):
+        return E.Cast(convert_expr(kids[0], scope),
+                      from_spark_json(node.field("dataType")))
+    if name == "TryCast":
+        return E.TryCast(convert_expr(kids[0], scope),
+                         from_spark_json(node.field("dataType")))
+    if name == "In":
+        return E.InList(convert_expr(kids[0], scope),
+                        [convert_expr(k, scope) for k in kids[1:]])
+    if name == "InSet":
+        hset = node.field("hset")
+        if not isinstance(hset, list):
+            raise UnsupportedExpr("InSet without literal hset")
+        child = convert_expr(kids[0], scope)
+        # literals must carry the CHILD's type — hset values serialize as
+        # raw JSON and a mistyped comparison silently matches nothing
+        dt = _guess_type(kids[0])
+        if dt is None:
+            raise UnsupportedExpr("InSet child type unknown")
+        return E.InList(child, [E.Literal(_coerce_literal(v, dt), dt)
+                                for v in hset])
+    if name == "Like":
+        pat = kids[1]
+        if pat.name != "Literal":
+            raise UnsupportedExpr("LIKE with non-literal pattern")
+        return E.Like(convert_expr(kids[0], scope),
+                      str(pat.field("value")),
+                      escape_char=str(node.field("escapeChar", "\\")))
+    if name == "StartsWith":
+        return _string_fast(E.StringStartsWith, kids, scope)
+    if name == "EndsWith":
+        return _string_fast(E.StringEndsWith, kids, scope)
+    if name == "Contains":
+        return _string_fast(E.StringContains, kids, scope)
+    if name == "CaseWhen":
+        return _case_when(node, scope)
+    if name == "If":
+        return E.Case([(convert_expr(kids[0], scope),
+                        convert_expr(kids[1], scope))],
+                      convert_expr(kids[2], scope))
+    if name == "UnaryMinus":
+        c = convert_expr(kids[0], scope)
+        zero_t = _guess_type(node)
+        return E.BinaryExpr(E.BinaryOp.SUB, E.Literal(0, zero_t or T.I64), c)
+    if name in _FUNCTIONS:
+        return E.ScalarFunction(_FUNCTIONS[name],
+                                [convert_expr(k, scope) for k in kids])
+    if name == "SortOrder":
+        direction = _obj_str(node.field("direction"))
+        null_ord = _obj_str(node.field("nullOrdering"))
+        asc = "Desc" not in (direction or "Ascending")
+        nulls_first = "Last" not in (null_ord or ("NullsFirst" if asc else "NullsLast"))
+        return E.SortOrder(convert_expr(kids[0], scope), asc, nulls_first)
+    if name == "KnownFloatingPointNormalized":
+        return convert_expr(kids[0], scope)
+    if name == "PromotePrecision" or name == "CheckOverflow":
+        inner = convert_expr(kids[0], scope)
+        if name == "CheckOverflow":
+            return E.Cast(inner, from_spark_json(node.field("dataType")))
+        return inner
+    raise UnsupportedExpr(f"expression {node.cls}")
+
+
+def _string_fast(cls, kids, scope):
+    pat = kids[1]
+    if pat.name != "Literal":
+        raise UnsupportedExpr("string predicate with non-literal pattern")
+    return cls(convert_expr(kids[0], scope), str(pat.field("value")))
+
+
+def _case_when(node: TreeNode, scope: AttrScope) -> E.Expr:
+    kids = node.children
+    # children: cond1, val1, cond2, val2, ..., [else]
+    pairs = []
+    i = 0
+    while i + 1 < len(kids):
+        pairs.append((convert_expr(kids[i], scope),
+                      convert_expr(kids[i + 1], scope)))
+        i += 2
+    else_e = convert_expr(kids[-1], scope) if len(kids) % 2 == 1 else None
+    return E.Case(pairs, else_e)
+
+
+def _obj_str(v) -> Optional[str]:
+    if v is None:
+        return None
+    if isinstance(v, str):
+        return v
+    if isinstance(v, dict):
+        return str(v.get("object") or v.get("class")
+                   or v.get("product-class") or "")
+    return str(v)
+
+
+def _guess_type(node: TreeNode) -> Optional[T.DataType]:
+    dt = node.field("dataType")
+    if dt is None:
+        return None
+    try:
+        return from_spark_json(dt)
+    except NotImplementedError:
+        return None
+
+
+def _coerce_literal(v, dt: T.DataType):
+    if v is None:
+        return None
+    if isinstance(dt, (T.Int8Type, T.Int16Type, T.Int32Type, T.Int64Type,
+                       T.DateType, T.TimestampType)):
+        return int(v)
+    if isinstance(dt, (T.Float32Type, T.Float64Type)):
+        return float(v)
+    if isinstance(dt, T.DecimalType):
+        return decimal.Decimal(str(v))
+    if isinstance(dt, T.BooleanType):
+        return v if isinstance(v, bool) else str(v).lower() == "true"
+    return v
+
+
+def convert_agg_expr(node: TreeNode, scope: AttrScope
+                     ) -> Tuple[E.AggExpr, str, str]:
+    """An ``AggregateExpression`` tree -> (engine AggExpr, mode, result
+    attribute name). Reference: NativeConverters.convertAggregateExpr."""
+    if node.name != "AggregateExpression":
+        raise UnsupportedExpr(f"aggregate {node.cls}")
+    mode = _obj_str(node.field("mode")) or "Complete"
+    for m in ("PartialMerge", "Partial", "Final", "Complete"):
+        if m in mode:
+            mode = m
+            break
+    fn_node = node.children[0]
+    fname = fn_node.name
+    if fname not in _AGG_FNS:
+        raise UnsupportedExpr(f"aggregate function {fn_node.cls}")
+    fn = _AGG_FNS[fname]
+    args = [convert_expr(k, scope) for k in fn_node.children]
+    if fname == "Count" and len(args) == 1 and isinstance(args[0], E.Literal):
+        args = []  # COUNT(1) / COUNT(*)
+    rt = _guess_type(fn_node)
+    rid = (node.field("resultId") or {}).get("id")
+    # the attribute other nodes reference this aggregate by
+    rname = f"{fname.lower()}#{rid if rid is not None else '?'}"
+    return E.AggExpr(fn, args, rt), mode, rname

@@ -1,0 +1,66 @@
+"""Spark ``DataType`` JSON -> engine types.
+
+Spark serializes types inside TreeNode JSON either as short strings
+("integer", "decimal(7,2)") or as structured objects ({"type": "struct",
+"fields": [...]}) — `org.apache.spark.sql.types.DataType.fromJson` is the
+JVM-side inverse. Reference analogue: ``NativeConverters.convertDataType``
+(spark-extension/src/main/scala/.../NativeConverters.scala:117)."""
+
+from __future__ import annotations
+
+import re
+from typing import Union
+
+from blaze_tpu.ir import types as T
+
+_SIMPLE = {
+    "null": T.NULL,
+    "boolean": T.BOOL,
+    "byte": T.I8,
+    "tinyint": T.I8,
+    "short": T.I16,
+    "smallint": T.I16,
+    "integer": T.I32,
+    "int": T.I32,
+    "long": T.I64,
+    "bigint": T.I64,
+    "float": T.F32,
+    "double": T.F64,
+    "string": T.STRING,
+    "binary": T.BINARY,
+    "date": T.DATE,
+    "timestamp": T.TIMESTAMP,
+    "timestamp_ntz": T.TIMESTAMP,
+}
+
+_DECIMAL_RE = re.compile(r"decimal\((\d+),\s*(-?\d+)\)")
+
+
+def from_spark_json(dt: Union[str, dict]) -> T.DataType:
+    if isinstance(dt, str):
+        s = dt.strip().lower()
+        if s in _SIMPLE:
+            return _SIMPLE[s]
+        m = _DECIMAL_RE.fullmatch(s)
+        if m:
+            return T.DecimalType(int(m.group(1)), int(m.group(2)))
+        if s == "decimal":
+            return T.DecimalType(10, 0)
+        raise NotImplementedError(f"spark type {dt!r}")
+    kind = dt.get("type")
+    if kind == "struct":
+        fields = tuple(
+            T.StructField(f["name"], from_spark_json(f["type"]),
+                          bool(f.get("nullable", True)))
+            for f in dt.get("fields", ()))
+        return T.StructType(fields)
+    if kind == "array":
+        return T.ArrayType(from_spark_json(dt["elementType"]),
+                           bool(dt.get("containsNull", True)))
+    if kind == "map":
+        return T.MapType(from_spark_json(dt["keyType"]),
+                         from_spark_json(dt["valueType"]),
+                         bool(dt.get("valueContainsNull", True)))
+    if kind == "udt":
+        return from_spark_json(dt.get("sqlType", "string"))
+    raise NotImplementedError(f"spark type {dt!r}")

@@ -1,0 +1,85 @@
+"""Decoder for Spark's ``TreeNode.toJSON`` wire form.
+
+Spark serializes any TreeNode (physical plans AND expressions) as a JSON
+array of node objects in PRE-ORDER: each object carries ``class`` (the JVM
+class name), ``num-children``, and its constructor fields; the node's
+children are the next ``num-children`` subtrees of the array, depth-first.
+Constructor fields that ARE children (e.g. ``left``/``right`` of Add) hold
+the child's ordinal instead of the subtree. Nested expression trees inside a
+plan field are themselves serialized as such arrays (possibly doubly nested
+for sequences-of-sequences like Expand projections).
+
+This module rebuilds the tree shape; interpretation of classes/fields lives
+in frontend/exprs.py + frontend/converter.py (reference analogue of the
+conversion layer: AuronConverters.scala / NativeConverters.scala)."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Union
+
+
+@dataclasses.dataclass
+class TreeNode:
+    cls: str           # fully-qualified JVM class
+    fields: Dict[str, Any]
+    children: List["TreeNode"]
+
+    @property
+    def name(self) -> str:
+        """Class base name (after the last dot, '$' suffixes stripped)."""
+        return self.cls.rsplit(".", 1)[-1].rstrip("$")
+
+    def field(self, key: str, default=None):
+        return self.fields.get(key, default)
+
+
+def decode(nodes: Union[str, List[dict]]) -> TreeNode:
+    """One pre-order node array -> tree."""
+    if isinstance(nodes, str):
+        nodes = json.loads(nodes)
+    if not isinstance(nodes, list) or not nodes:
+        raise ValueError("expected a non-empty TreeNode array")
+    pos = 0
+
+    def build() -> TreeNode:
+        nonlocal pos
+        obj = nodes[pos]
+        pos += 1
+        n = int(obj.get("num-children", 0))
+        fields = {k: v for k, v in obj.items()
+                  if k not in ("class", "num-children")}
+        children = [build() for _ in range(n)]
+        return TreeNode(obj["class"], fields, children)
+
+    root = build()
+    if pos != len(nodes):
+        raise ValueError(
+            f"dangling nodes in TreeNode array: consumed {pos} of {len(nodes)}")
+    return root
+
+
+def is_tree_array(v: Any) -> bool:
+    return (isinstance(v, list) and v and isinstance(v[0], dict)
+            and "class" in v[0])
+
+
+def decode_field_trees(v: Any) -> List[TreeNode]:
+    """A plan field holding expression trees: either one tree array or a
+    list of tree arrays (Seq[Expression])."""
+    if v is None:
+        return []
+    if is_tree_array(v):
+        return [decode(v)]
+    if isinstance(v, list):
+        out = []
+        for item in v:
+            if is_tree_array(item):
+                out.append(decode(item))
+            elif isinstance(item, list) and not item:
+                continue
+            else:
+                raise NotImplementedError(f"unrecognized expression field {item!r}")
+        return out
+    raise NotImplementedError(f"unrecognized expression field {v!r}")

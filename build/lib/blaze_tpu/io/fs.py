@@ -1,0 +1,103 @@
+"""Filesystem provider: scheme-dispatched IO for scans, sinks and spills.
+
+Reference: ``datafusion-ext-commons/src/hadoop_fs.rs:28-120`` — FsProvider/
+Fs/FsDataInputWrapper route every file operation through the JVM's Hadoop
+FileSystem, so the native engine reads HDFS/S3/... transparently. The
+standalone analogue: paths with a URL scheme (``s3://``, ``gs://``,
+``memory://`` ...) dispatch through fsspec; bare paths stay on fast posix
+calls. pyarrow's dataset/parquet readers accept fsspec filesystems
+directly, so scans keep their C++ IO path."""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import BinaryIO, List, Optional, Tuple
+
+_SCHEME_RE = re.compile(r"^[a-zA-Z][a-zA-Z0-9+.-]*://")
+
+# test/instrumentation hook: fs_instances[scheme] -> fsspec filesystem
+_REGISTERED = {}
+
+
+def register_filesystem(scheme: str, fs) -> None:
+    """Pin a pre-built fsspec filesystem for a scheme (e.g. a moto S3 stub
+    or an in-memory fs shared with a test)."""
+    _REGISTERED[scheme] = fs
+
+
+def has_scheme(path: str) -> bool:
+    return bool(_SCHEME_RE.match(str(path))) and not str(path).startswith("file://")
+
+
+def get_fs(path: str) -> Tuple[Optional[object], str]:
+    """(fsspec filesystem or None for posix, in-fs path)."""
+    p = str(path)
+    if p.startswith("file://"):
+        return None, p[len("file://"):]
+    if not has_scheme(p):
+        return None, p
+    scheme = p.split("://", 1)[0]
+    if scheme in _REGISTERED:
+        return _REGISTERED[scheme], p.split("://", 1)[1]
+    import fsspec
+
+    fs, fpath = fsspec.core.url_to_fs(p)
+    return fs, fpath
+
+
+def open_input(path: str) -> BinaryIO:
+    fs, p = get_fs(path)
+    if fs is None:
+        return open(p, "rb")
+    return fs.open(p, "rb")
+
+
+def open_output(path: str) -> BinaryIO:
+    fs, p = get_fs(path)
+    if fs is None:
+        os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
+        return open(p, "wb")
+    return fs.open(p, "wb")
+
+
+def getsize(path: str) -> int:
+    fs, p = get_fs(path)
+    if fs is None:
+        return os.path.getsize(p)
+    return int(fs.size(p))
+
+
+def exists(path: str) -> bool:
+    fs, p = get_fs(path)
+    if fs is None:
+        return os.path.exists(p)
+    return bool(fs.exists(p))
+
+
+def makedirs(path: str) -> None:
+    fs, p = get_fs(path)
+    if fs is None:
+        os.makedirs(p, exist_ok=True)
+    else:
+        fs.makedirs(p, exist_ok=True)
+
+
+def listdir(path: str) -> List[str]:
+    """Child paths with the original scheme preserved."""
+    fs, p = get_fs(path)
+    if fs is None:
+        return [os.path.join(p, n) for n in sorted(os.listdir(p))]
+    scheme = str(path).split("://", 1)[0]
+    return [f"{scheme}://{c}" for c in sorted(fs.ls(p, detail=False))]
+
+
+def arrow_filesystem(path: str):
+    """(pyarrow-compatible filesystem or None, in-fs path) — what
+    pyarrow.dataset / ParquetFile want."""
+    fs, p = get_fs(path)
+    if fs is None:
+        return None, p
+    from pyarrow.fs import FSSpecHandler, PyFileSystem
+
+    return PyFileSystem(FSSpecHandler(fs)), p

@@ -1,0 +1,30 @@
+"""Plan and expression IR — the wire contract between a frontend (e.g. a Spark
+plugin in the role of the reference's ``spark-extension``) and the TPU engine.
+
+Reference contract: ``native-engine/auron-serde/proto/auron.proto`` (25 operator
+nodes, expression oneof, AggFunction/AggMode enums, PhysicalRepartition oneof).
+"""
+
+from blaze_tpu.ir.types import (  # noqa: F401
+    DataType,
+    NullType,
+    BooleanType,
+    Int8Type,
+    Int16Type,
+    Int32Type,
+    Int64Type,
+    Float32Type,
+    Float64Type,
+    StringType,
+    BinaryType,
+    DateType,
+    TimestampType,
+    DecimalType,
+    ArrayType,
+    MapType,
+    StructType,
+    StructField,
+    Schema,
+)
+from blaze_tpu.ir import exprs  # noqa: F401
+from blaze_tpu.ir import nodes  # noqa: F401

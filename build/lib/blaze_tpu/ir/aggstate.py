@@ -1,0 +1,93 @@
+"""Partial-aggregate state-field layout — pure IR-level helper.
+
+Single source of truth for the typed columnar state each aggregate carries in
+partial output (see blaze_tpu/ops/aggfns.py module docs for the design
+rationale). Used by both the plan IR (``nodes.Agg.output_schema``) and the
+operator layer, keeping IR free of operator imports.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import types as T
+
+
+def avg_sum_type(arg_t: T.DataType) -> T.DataType:
+    if isinstance(arg_t, T.DecimalType):
+        return T.DecimalType(min(arg_t.precision + 10, 38), arg_t.scale)
+    return T.F64
+
+
+def agg_state_fields(fn: E.AggFunction, arg_t: T.DataType,
+                     result_t: T.DataType) -> List[Tuple[str, T.DataType]]:
+    F = E.AggFunction
+    if fn == F.SUM:
+        return [("sum", result_t), ("has", T.BOOL)]
+    if fn == F.COUNT:
+        return [("count", T.I64)]
+    if fn == F.AVG:
+        return [("sum", avg_sum_type(arg_t)), ("count", T.I64)]
+    if fn in (F.MIN, F.MAX):
+        return [("val", result_t), ("has", T.BOOL)]
+    if fn in (F.FIRST, F.FIRST_IGNORES_NULL):
+        return [("val", result_t), ("valid", T.BOOL), ("order", T.I64)]
+    if fn in (F.COLLECT_LIST, F.COLLECT_SET, F.BRICKHOUSE_COLLECT):
+        return [("items", T.ArrayType(arg_t))]
+    if fn == F.BRICKHOUSE_COMBINE_UNIQUE:
+        # arg is already an array; state unions its elements
+        elem = arg_t.element_type if isinstance(arg_t, T.ArrayType) else arg_t
+        return [("items", T.ArrayType(elem))]
+    if fn == F.BLOOM_FILTER:
+        return [("bloom", T.BINARY)]
+    if fn == F.UDAF:
+        return [("acc", T.BINARY)]
+    raise NotImplementedError(f"agg function {fn}")
+
+
+def agg_output_schema(child_schema: T.Schema, groupings, aggs,
+                      input_is_partial: bool, is_partial_output: bool) -> T.Schema:
+    """Output schema of an Agg node (groupings + state fields or final values)."""
+    if input_is_partial:
+        gfields = [
+            T.StructField(n, child_schema[i].dtype)
+            for i, (n, _) in enumerate(groupings)
+        ]
+    else:
+        gfields = [
+            T.StructField(n, E.infer_type(e, child_schema)) for n, e in groupings
+        ]
+    out = list(gfields)
+    pos = len(groupings)
+    for a in aggs:
+        agg = a.agg
+        if input_is_partial:
+            arg_t = _arg_type_from_state(agg, child_schema, pos)
+        else:
+            arg_t = E.infer_type(agg.args[0], child_schema) if agg.args else T.NULL
+        result_t = agg.return_type or E.agg_result_type(agg.fn, arg_t)
+        if agg.fn == E.AggFunction.COUNT:
+            result_t = T.I64
+        elif agg.fn == E.AggFunction.BLOOM_FILTER:
+            result_t = T.BINARY
+        fields = agg_state_fields(agg.fn, arg_t, result_t)
+        if is_partial_output:
+            out.extend(T.StructField(f"{a.name}#{s}", dt) for s, dt in fields)
+        else:
+            out.append(T.StructField(a.name, result_t))
+        pos += len(fields)
+    return T.Schema(tuple(out))
+
+
+def _arg_type_from_state(agg: E.AggExpr, child_schema: T.Schema, pos: int) -> T.DataType:
+    """Reconstruct the argument type from the value-typed first state field
+    (partial input has no raw arg columns)."""
+    dt = child_schema[pos].dtype
+    if isinstance(dt, T.DecimalType) and agg.fn in (E.AggFunction.SUM, E.AggFunction.AVG):
+        return T.DecimalType(max(dt.precision - 10, 1), dt.scale)
+    if agg.fn == E.AggFunction.AVG and isinstance(dt, T.Float64Type):
+        return T.F64
+    if isinstance(dt, T.ArrayType):
+        return dt.element_type
+    return dt

@@ -1,0 +1,251 @@
+"""Plan optimizer: scan column pruning (projection pushdown).
+
+The reference prunes columns operator-side via ``ExecuteWithColumnPruning``
+(``datafusion-ext-plans/src/common/column_pruning.rs:22-48``): each operator
+asks its child for only the columns it needs, and the parquet/orc scans read
+only those. Here the same analysis runs once over the plan IR before
+execution: walk top-down carrying the set of column NAMES the parent needs,
+and shrink each file scan's ``conf.projection`` to it.
+
+On a TPU whose host link is bandwidth-bound, pruning a scan column saves
+three times: parquet decode, host->device upload, and device compute over
+the padded planes.
+
+Safety rules (this pass must never change results):
+- Analysis is name-based. Any ``BoundReference`` (positional) in a relevant
+  expression makes that subtree's requirement "all columns".
+- Nodes with positional semantics (Union/Expand/Generate) pass "all columns"
+  to their children.
+- Join requirement splitting bails when the two input schemas share a column
+  name (ambiguous by name).
+- Pruning is best-effort: a child may return MORE columns than requested
+  (when something bailed below); every rewritten parent tolerates that
+  because all rebuilt nodes reference columns by name.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import FrozenSet, Optional
+
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import nodes as N
+
+# requirement lattice: None = "all columns" (top); frozenset = exactly these
+Req = Optional[FrozenSet[str]]
+
+
+def expr_columns(e) -> Req:
+    """Column names referenced by an expression; None if unknowable
+    (positional references)."""
+    if isinstance(e, E.BoundReference):
+        return None
+    if isinstance(e, E.Column):
+        return frozenset((e.name,))
+    if isinstance(e, E.ScalarSubquery):
+        # evaluated over its own subplan, not the current scope
+        return frozenset()
+    cols = set()
+
+    def walk(v) -> bool:
+        # descend nested containers: Case branches are [(cond, value), ...],
+        # and future exprs may nest arbitrarily — missing a reference here
+        # would prune a live column, so over-approximate
+        if isinstance(v, E.Expr):
+            sub = expr_columns(v)
+            if sub is None:
+                return False
+            cols.update(sub)
+        elif isinstance(v, (list, tuple)):
+            return all(walk(x) for x in v)
+        elif isinstance(v, dict):
+            return all(walk(x) for x in v.values())
+        return True
+
+    for f in dataclasses.fields(e):
+        if not walk(getattr(e, f.name)):
+            return None
+    return frozenset(cols)
+
+
+def _union(req: Req, *exprs) -> Req:
+    """required ∪ columns of exprs; None-absorbing."""
+    if req is None:
+        return None
+    out = set(req)
+    for e in exprs:
+        if e is None:
+            continue
+        c = expr_columns(e)
+        if c is None:
+            return None
+        out |= c
+    return frozenset(out)
+
+
+def prune_plan(node: N.PlanNode, required: Req = None) -> N.PlanNode:
+    """Rewrite ``node`` so file scans read only columns transitively needed
+    to produce ``required`` output columns (None = all)."""
+    if isinstance(node, (N.ParquetScan, N.OrcScan)):
+        if required is None:
+            return node
+        conf = node.conf
+        keep = [i for i in conf.projection
+                if conf.file_schema[i].name in required]
+        if not keep:
+            # keep one column as the row-count carrier (COUNT(*)-style plans)
+            keep = list(conf.projection[:1])
+        if keep == list(conf.projection):
+            return node
+        return dataclasses.replace(
+            node, conf=dataclasses.replace(conf, projection=keep))
+
+    if isinstance(node, N.Projection):
+        kept = [(n, e) for n, e in zip(node.names, node.exprs)
+                if required is None or n in required]
+        if not kept:
+            kept = [(node.names[0], node.exprs[0])]
+        child_req: Req = frozenset()
+        for _, e in kept:
+            child_req = _union(child_req, e)
+        child = prune_plan(node.child, child_req)
+        if len(kept) == len(node.names) and child is node.child:
+            return node
+        return dataclasses.replace(
+            node, child=child, exprs=[e for _, e in kept],
+            names=[n for n, _ in kept])
+
+    if isinstance(node, N.Filter):
+        return _rebuild(node, "child",
+                        prune_plan(node.child, _union(required, *node.predicates)))
+
+    if isinstance(node, N.Sort):
+        return _rebuild(node, "child",
+                        prune_plan(node.child, _union(required, *node.sort_orders)))
+
+    if isinstance(node, (N.Limit, N.CoalesceBatches, N.Debug, N.BroadcastExchange)):
+        return _rebuild(node, "child", prune_plan(node.child, required))
+
+    if isinstance(node, N.Agg):
+        if any(a.mode in (E.AggMode.PARTIAL_MERGE, E.AggMode.FINAL)
+               for a in node.aggs):
+            # ANY merge/final-mode agg consumes positional state columns
+            # ('<agg>#<field>', read after the groupings in declaration
+            # order) the expression walk cannot see — need everything.
+            # Per-column check, not input_is_partial: mixed-mode aggs (the
+            # one-distinct rewrite shape) still carry state columns
+            child_req: Req = None
+        else:
+            child_req = frozenset()
+            for _, ge in node.groupings:
+                child_req = _union(child_req, ge)
+            for ac in node.aggs:
+                child_req = _union(child_req, ac.agg)
+        return _rebuild(node, "child", prune_plan(node.child, child_req))
+
+    if isinstance(node, N.Window):
+        if required is None:
+            child_req: Req = None
+        else:
+            child_names = set(node.child.output_schema.names)
+            child_req = frozenset(c for c in required if c in child_names)
+            child_req = _union(child_req, *node.partition_spec)
+            child_req = _union(child_req, *node.order_spec)
+            for w in node.window_exprs:
+                if w.agg is not None:
+                    child_req = _union(child_req, w.agg)
+        return _rebuild(node, "child", prune_plan(node.child, child_req))
+
+    if isinstance(node, N.ShuffleExchange):
+        part = node.partitioning
+        if isinstance(part, N.HashPartitioning):
+            child_req = _union(required, *part.exprs)
+        elif isinstance(part, N.RangePartitioning):
+            child_req = _union(required, *part.sort_orders)
+        else:
+            child_req = required
+        return _rebuild(node, "child", prune_plan(node.child, child_req))
+
+    if isinstance(node, N.RenameColumns):
+        child_schema = node.child.output_schema
+        if required is None or len(set(child_schema.names)) != len(child_schema.names):
+            child = prune_plan(node.child, None)
+            return _rebuild(node, "child", child)
+        pairs = list(zip(child_schema.names, node.renamed_names))
+        keep = frozenset(cn for cn, rn in pairs if rn in required) or \
+            frozenset((pairs[0][0],))
+        child = prune_plan(node.child, keep)
+        rename_map = dict(pairs)
+        try:
+            new_names = [rename_map[cn] for cn in child.output_schema.names]
+        except KeyError:
+            # pruned child surfaced a name outside the original schema —
+            # shouldn't happen, but never let the optimizer break a plan
+            return node
+        if child is node.child and new_names == list(node.renamed_names):
+            return node
+        return dataclasses.replace(node, child=child, renamed_names=new_names)
+
+    if isinstance(node, (N.SortMergeJoin, N.HashJoin, N.BroadcastJoin)):
+        return _prune_join(node, required)
+
+    if isinstance(node, N.BroadcastJoinBuildHashMap):
+        # build-side schema participates in an executor-level cache keyed
+        # externally — never reshape it
+        return _rebuild(node, "child", prune_plan(node.child, None))
+
+    # default: positional semantics (Union/Expand/Generate), sinks
+    # (ShuffleWriter/IpcWriter/ParquetSink/Rss), leaves (IpcReader/FFIReader/
+    # BatchSource/EmptyPartitions) — children must keep their full schema
+    return N.map_children(node, lambda c: prune_plan(c, None))
+
+
+def _rebuild(node: N.PlanNode, field: str, child: N.PlanNode) -> N.PlanNode:
+    if child is getattr(node, field):
+        return node
+    return dataclasses.replace(node, **{field: child})
+
+
+def _prune_join(node, required: Req) -> N.PlanNode:
+    left_names = list(node.left.output_schema.names)
+    right_names = list(node.right.output_schema.names)
+    if set(left_names) & set(right_names):
+        # duplicate names across sides: name-based splitting is ambiguous
+        return N.map_children(node, lambda c: prune_plan(c, None))
+    left_req: Req = frozenset()
+    right_req: Req = frozenset()
+    if required is None:
+        left_req = right_req = None
+    else:
+        jt = node.join_type
+        if jt in (N.JoinType.LEFT_SEMI, N.JoinType.LEFT_ANTI):
+            left_req = frozenset(c for c in required if c in set(left_names))
+        elif jt in (N.JoinType.RIGHT_SEMI, N.JoinType.RIGHT_ANTI):
+            right_req = frozenset(c for c in required if c in set(right_names))
+        else:  # inner/left/right/full/existence output both sides
+            left_req = frozenset(c for c in required if c in set(left_names))
+            right_req = frozenset(c for c in required if c in set(right_names))
+    for le, re in node.on:
+        left_req = _union(left_req, le)
+        right_req = _union(right_req, re)
+    if node.condition is not None:
+        cond_cols = expr_columns(node.condition)
+        if cond_cols is None:
+            left_req = right_req = None
+        else:
+            left_req = None if left_req is None else \
+                left_req | frozenset(c for c in cond_cols if c in set(left_names))
+            right_req = None if right_req is None else \
+                right_req | frozenset(c for c in cond_cols if c in set(right_names))
+    if isinstance(node, N.BroadcastJoin):
+        # the build side feeds the executor-level hash-map cache — keep its
+        # schema stable (see BroadcastJoinBuildHashMap above)
+        if node.broadcast_side == N.JoinSide.RIGHT:
+            right_req = None
+        else:
+            left_req = None
+    left = prune_plan(node.left, left_req)
+    right = prune_plan(node.right, right_req)
+    if left is node.left and right is node.right:
+        return node
+    return dataclasses.replace(node, left=left, right=right)

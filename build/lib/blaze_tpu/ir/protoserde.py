@@ -1,0 +1,825 @@
+"""Protobuf wire serde for the plan IR.
+
+Role parity with the reference's ``auron-serde`` (prost codegen +
+``from_proto.rs``): ``plan_to_proto``/``plan_from_proto`` convert between
+the dataclass IR and the protobuf messages generated from
+``ir/proto/blaze_tpu.proto`` (protoc output checked in). The tagged-JSON
+serde (ir/serde.py) carries the same vocabulary; proto is the compact,
+cross-language contract a JVM frontend would speak."""
+
+from __future__ import annotations
+
+import importlib
+import pickle
+from typing import Any
+
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import nodes as N
+from blaze_tpu.ir import types as T
+from blaze_tpu.ir.proto import blaze_tpu_pb2 as pb
+
+_SIMPLE = {"null": T.NULL, "bool": T.BOOL, "i8": T.I8, "i16": T.I16,
+           "i32": T.I32, "i64": T.I64, "f32": T.F32, "f64": T.F64,
+           "string": T.STRING, "binary": T.BINARY, "date": T.DATE,
+           "timestamp": T.TIMESTAMP}
+_SIMPLE_NAMES = {type(v): k for k, v in _SIMPLE.items()}
+
+
+# ---------------------------------------------------------------------------
+# types
+# ---------------------------------------------------------------------------
+
+def type_to_proto(dt: T.DataType) -> pb.DataType:
+    m = pb.DataType()
+    cls = type(dt)
+    if cls in _SIMPLE_NAMES:
+        m.name = _SIMPLE_NAMES[cls]
+    elif isinstance(dt, T.DecimalType):
+        m.name = "decimal"
+        m.precision = dt.precision
+        m.scale = dt.scale
+    elif isinstance(dt, T.ArrayType):
+        m.name = "array"
+        m.element.CopyFrom(type_to_proto(dt.element_type))
+    elif isinstance(dt, T.MapType):
+        m.name = "map"
+        m.key.CopyFrom(type_to_proto(dt.key_type))
+        m.value.CopyFrom(type_to_proto(dt.value_type))
+    elif isinstance(dt, T.StructType):
+        m.name = "struct"
+        for f in dt.fields:
+            m.fields.append(field_to_proto(f))
+    else:
+        raise NotImplementedError(f"proto type {dt!r}")
+    return m
+
+
+def type_from_proto(m: pb.DataType) -> T.DataType:
+    if m.name in _SIMPLE:
+        return _SIMPLE[m.name]
+    if m.name == "decimal":
+        return T.DecimalType(m.precision, m.scale)
+    if m.name == "array":
+        return T.ArrayType(type_from_proto(m.element))
+    if m.name == "map":
+        return T.MapType(type_from_proto(m.key), type_from_proto(m.value))
+    if m.name == "struct":
+        return T.StructType(tuple(field_from_proto(f) for f in m.fields))
+    raise NotImplementedError(f"proto type {m.name}")
+
+
+def field_to_proto(f: T.StructField) -> pb.Field:
+    m = pb.Field(name=f.name, nullable=f.nullable)
+    m.dtype.CopyFrom(type_to_proto(f.dtype))
+    return m
+
+
+def field_from_proto(m: pb.Field) -> T.StructField:
+    return T.StructField(m.name, type_from_proto(m.dtype), m.nullable)
+
+
+def schema_to_proto(s: T.Schema) -> pb.Schema:
+    m = pb.Schema()
+    for f in s.fields:
+        m.fields.append(field_to_proto(f))
+    return m
+
+
+def schema_from_proto(m: pb.Schema) -> T.Schema:
+    return T.Schema(tuple(field_from_proto(f) for f in m.fields))
+
+
+# ---------------------------------------------------------------------------
+# literals
+# ---------------------------------------------------------------------------
+
+def literal_to_proto(value: Any, dtype: T.DataType) -> pb.Literal:
+    m = pb.Literal()
+    m.dtype.CopyFrom(type_to_proto(dtype))
+    if value is None:
+        m.is_null = True
+        return m
+    if isinstance(dtype, T.DecimalType):
+        m.decimal = str(value)
+    elif isinstance(dtype, (T.Float32Type, T.Float64Type)):
+        m.f64 = float(value)
+    elif isinstance(dtype, T.BooleanType):
+        m.b = bool(value)
+    elif isinstance(dtype, T.StringType):
+        m.str = str(value)
+    elif isinstance(dtype, T.BinaryType):
+        m.bin = bytes(value)
+    elif isinstance(dtype, (T.DateType, T.TimestampType)) and not isinstance(value, int):
+        m.str = str(value)  # iso string form
+    else:
+        m.i64 = int(value)
+    return m
+
+
+def literal_from_proto(m: pb.Literal):
+    dtype = type_from_proto(m.dtype)
+    if m.is_null:
+        return None, dtype
+    which = m.WhichOneof("value")
+    if which == "decimal":
+        from decimal import Decimal
+
+        return Decimal(m.decimal), dtype
+    if which is None:
+        return None, dtype
+    return getattr(m, which), dtype
+
+
+# ---------------------------------------------------------------------------
+# expressions
+# ---------------------------------------------------------------------------
+
+def expr_to_proto(e: E.Expr) -> pb.ExprNode:
+    m = pb.ExprNode()
+    if isinstance(e, E.Column):
+        m.column = e.name
+    elif isinstance(e, E.BoundReference):
+        m.bound_reference = e.index
+    elif isinstance(e, E.Literal):
+        m.literal.CopyFrom(literal_to_proto(e.value, e.dtype))
+    elif isinstance(e, E.BinaryExpr):
+        m.binary.op = e.op.value
+        m.binary.left.CopyFrom(expr_to_proto(e.left))
+        m.binary.right.CopyFrom(expr_to_proto(e.right))
+        if e.result_type is not None:
+            m.binary.result_type.CopyFrom(type_to_proto(e.result_type))
+    elif isinstance(e, E.IsNull):
+        m.is_null.CopyFrom(expr_to_proto(e.child))
+    elif isinstance(e, E.IsNotNull):
+        m.is_not_null.CopyFrom(expr_to_proto(e.child))
+    elif isinstance(e, E.Not):
+        getattr(m, "not").CopyFrom(expr_to_proto(e.child))
+    elif isinstance(e, E.Case):
+        for c, v in e.branches:
+            b = m.case.branches.add()
+            b.condition.CopyFrom(expr_to_proto(c))
+            b.value.CopyFrom(expr_to_proto(v))
+        if e.else_expr is not None:
+            m.case.else_expr.CopyFrom(expr_to_proto(e.else_expr))
+    elif isinstance(e, E.Cast):
+        m.cast.child.CopyFrom(expr_to_proto(e.child))
+        m.cast.dtype.CopyFrom(type_to_proto(e.dtype))
+    elif isinstance(e, E.TryCast):
+        m.try_cast.child.CopyFrom(expr_to_proto(e.child))
+        m.try_cast.dtype.CopyFrom(type_to_proto(e.dtype))
+    elif isinstance(e, E.InList):
+        m.in_list.child.CopyFrom(expr_to_proto(e.child))
+        for v in e.values:
+            m.in_list.values.append(expr_to_proto(v))
+        m.in_list.negated = e.negated
+    elif isinstance(e, E.Like):
+        m.like.child.CopyFrom(expr_to_proto(e.child))
+        m.like.pattern = e.pattern
+        m.like.negated = e.negated
+        m.like.escape_char = e.escape_char
+        m.like.case_insensitive = e.case_insensitive
+    elif isinstance(e, E.ScalarFunction):
+        m.scalar_function.name = e.name
+        for a in e.args:
+            m.scalar_function.args.append(expr_to_proto(a))
+        if e.return_type is not None:
+            m.scalar_function.return_type.CopyFrom(type_to_proto(e.return_type))
+    elif isinstance(e, E.StringStartsWith):
+        m.starts_with.child.CopyFrom(expr_to_proto(e.child))
+        m.starts_with.pattern = e.prefix
+    elif isinstance(e, E.StringEndsWith):
+        m.ends_with.child.CopyFrom(expr_to_proto(e.child))
+        m.ends_with.pattern = e.suffix
+    elif isinstance(e, E.StringContains):
+        m.contains.child.CopyFrom(expr_to_proto(e.child))
+        m.contains.pattern = e.infix
+    elif isinstance(e, E.RowNum):
+        m.row_num = True
+    elif isinstance(e, E.GetIndexedField):
+        m.get_indexed_field.child.CopyFrom(expr_to_proto(e.child))
+        m.get_indexed_field.ordinal.CopyFrom(expr_to_proto(e.ordinal))
+    elif isinstance(e, E.GetMapValue):
+        m.get_map_value.child.CopyFrom(expr_to_proto(e.child))
+        m.get_map_value.key.CopyFrom(expr_to_proto(e.key))
+    elif isinstance(e, E.NamedStruct):
+        m.named_struct.names.extend(e.names)
+        for x in e.exprs:
+            m.named_struct.exprs.append(expr_to_proto(x))
+    elif isinstance(e, E.BloomFilterMightContain):
+        m.bloom_filter_might_contain.bloom_filter.CopyFrom(expr_to_proto(e.bloom_filter))
+        m.bloom_filter_might_contain.value.CopyFrom(expr_to_proto(e.value))
+    elif isinstance(e, E.ScalarSubquery):
+        m.scalar_subquery.CopyFrom(literal_to_proto(e.value, e.dtype))
+    elif isinstance(e, E.SortOrder):
+        m.sort_order.CopyFrom(sort_order_to_proto(e))
+    elif isinstance(e, E.AggExpr):
+        m.agg.CopyFrom(agg_to_proto(e))
+    elif isinstance(e, E.PyUDF):
+        if _resolvable_function(e.fn):
+            m.py_udf.import_path = f"{e.fn.__module__}:{e.fn.__qualname__}"
+        else:
+            # stateful callable / closure: ship pickled (reference ships
+            # serialized Spark closures the same way)
+            import pickle as _pickle
+
+            m.py_udf.pickled = _pickle.dumps(e.fn, protocol=4)
+        for a in e.args:
+            m.py_udf.args.append(expr_to_proto(a))
+        m.py_udf.return_type.CopyFrom(type_to_proto(e.return_type))
+        m.py_udf.name = e.name
+    else:
+        raise NotImplementedError(f"proto expr {type(e).__name__}")
+    return m
+
+
+def _resolvable_function(fn) -> bool:
+    """True only for plain module-level functions whose import path resolves
+    back to the SAME object — lambdas ('<lambda>'), closures ('<locals>'),
+    bound methods (state-dropping), and callable instances all ship pickled
+    instead."""
+    import types as _types
+
+    if not isinstance(fn, _types.FunctionType):
+        return False
+    qual = getattr(fn, "__qualname__", "")
+    if not qual or "<" in qual:
+        return False
+    try:
+        obj = importlib.import_module(fn.__module__)
+        for part in qual.split("."):
+            obj = getattr(obj, part)
+        return obj is fn
+    except (ImportError, AttributeError):
+        return False
+
+
+def sort_order_to_proto(so: E.SortOrder) -> pb.SortOrderExpr:
+    m = pb.SortOrderExpr(ascending=so.ascending, nulls_first=so.nulls_first)
+    m.child.CopyFrom(expr_to_proto(so.child))
+    return m
+
+
+def sort_order_from_proto(m: pb.SortOrderExpr) -> E.SortOrder:
+    return E.SortOrder(expr_from_proto(m.child), m.ascending, m.nulls_first)
+
+
+def agg_to_proto(a: E.AggExpr) -> pb.AggExpr:
+    m = pb.AggExpr(fn=a.fn.value)
+    for x in a.args:
+        m.args.append(expr_to_proto(x))
+    if a.return_type is not None:
+        m.return_type.CopyFrom(type_to_proto(a.return_type))
+    if a.udaf is not None:
+        m.udaf_pickle = pickle.dumps(a.udaf)
+    return m
+
+
+def agg_from_proto(m: pb.AggExpr) -> E.AggExpr:
+    rt = type_from_proto(m.return_type) if m.HasField("return_type") else None
+    udaf = pickle.loads(m.udaf_pickle) if m.udaf_pickle else None
+    return E.AggExpr(E.AggFunction(m.fn), [expr_from_proto(x) for x in m.args],
+                     rt, udaf)
+
+
+def expr_from_proto(m: pb.ExprNode) -> E.Expr:
+    which = m.WhichOneof("expr")
+    if which == "column":
+        return E.Column(m.column)
+    if which == "bound_reference":
+        return E.BoundReference(m.bound_reference)
+    if which == "literal":
+        v, dt = literal_from_proto(m.literal)
+        return E.Literal(v, dt)
+    if which == "binary":
+        rt = type_from_proto(m.binary.result_type) if m.binary.HasField("result_type") else None
+        return E.BinaryExpr(E.BinaryOp(m.binary.op), expr_from_proto(m.binary.left),
+                            expr_from_proto(m.binary.right), rt)
+    if which == "is_null":
+        return E.IsNull(expr_from_proto(m.is_null))
+    if which == "is_not_null":
+        return E.IsNotNull(expr_from_proto(m.is_not_null))
+    if which == "not":
+        return E.Not(expr_from_proto(getattr(m, "not")))
+    if which == "case":
+        branches = [(expr_from_proto(b.condition), expr_from_proto(b.value))
+                    for b in m.case.branches]
+        else_e = expr_from_proto(m.case.else_expr) if m.case.HasField("else_expr") else None
+        return E.Case(branches, else_e)
+    if which == "cast":
+        return E.Cast(expr_from_proto(m.cast.child), type_from_proto(m.cast.dtype))
+    if which == "try_cast":
+        return E.TryCast(expr_from_proto(m.try_cast.child),
+                         type_from_proto(m.try_cast.dtype))
+    if which == "in_list":
+        return E.InList(expr_from_proto(m.in_list.child),
+                        [expr_from_proto(v) for v in m.in_list.values],
+                        m.in_list.negated)
+    if which == "like":
+        return E.Like(expr_from_proto(m.like.child), m.like.pattern,
+                      m.like.negated, m.like.escape_char or "\\",
+                      m.like.case_insensitive)
+    if which == "scalar_function":
+        rt = type_from_proto(m.scalar_function.return_type) \
+            if m.scalar_function.HasField("return_type") else None
+        return E.ScalarFunction(m.scalar_function.name,
+                                [expr_from_proto(a) for a in m.scalar_function.args],
+                                rt)
+    if which == "starts_with":
+        return E.StringStartsWith(expr_from_proto(m.starts_with.child),
+                                  m.starts_with.pattern)
+    if which == "ends_with":
+        return E.StringEndsWith(expr_from_proto(m.ends_with.child),
+                                m.ends_with.pattern)
+    if which == "contains":
+        return E.StringContains(expr_from_proto(m.contains.child),
+                                m.contains.pattern)
+    if which == "row_num":
+        return E.RowNum()
+    if which == "get_indexed_field":
+        return E.GetIndexedField(expr_from_proto(m.get_indexed_field.child),
+                                 expr_from_proto(m.get_indexed_field.ordinal))
+    if which == "get_map_value":
+        return E.GetMapValue(expr_from_proto(m.get_map_value.child),
+                             expr_from_proto(m.get_map_value.key))
+    if which == "named_struct":
+        return E.NamedStruct(list(m.named_struct.names),
+                             [expr_from_proto(x) for x in m.named_struct.exprs])
+    if which == "bloom_filter_might_contain":
+        return E.BloomFilterMightContain(
+            expr_from_proto(m.bloom_filter_might_contain.bloom_filter),
+            expr_from_proto(m.bloom_filter_might_contain.value))
+    if which == "scalar_subquery":
+        v, dt = literal_from_proto(m.scalar_subquery)
+        return E.ScalarSubquery(v, dt)
+    if which == "sort_order":
+        return sort_order_from_proto(m.sort_order)
+    if which == "agg":
+        return agg_from_proto(m.agg)
+    if which == "py_udf":
+        if m.py_udf.pickled:
+            import pickle as _pickle
+
+            fn = _pickle.loads(m.py_udf.pickled)
+        else:
+            mod, qual = m.py_udf.import_path.split(":")
+            fn = importlib.import_module(mod)
+            for part in qual.split("."):
+                fn = getattr(fn, part)
+        return E.PyUDF(fn, [expr_from_proto(a) for a in m.py_udf.args],
+                       type_from_proto(m.py_udf.return_type), m.py_udf.name)
+    raise NotImplementedError(f"proto expr {which}")
+
+
+# ---------------------------------------------------------------------------
+# partitioning
+# ---------------------------------------------------------------------------
+
+def partitioning_to_proto(p) -> pb.Partitioning:
+    m = pb.Partitioning()
+    if isinstance(p, N.SinglePartitioning):
+        m.single.num_partitions = p.num_partitions
+    elif isinstance(p, N.HashPartitioning):
+        for e in p.exprs:
+            m.hash.exprs.append(expr_to_proto(e))
+        m.hash.num_partitions = p.num_partitions
+    elif isinstance(p, N.RoundRobinPartitioning):
+        m.round_robin.num_partitions = p.num_partitions
+    elif isinstance(p, N.RangePartitioning):
+        for so in p.sort_orders:
+            m.range.sort_orders.append(sort_order_to_proto(so))
+        m.range.num_partitions = p.num_partitions
+        for row in p.bounds:
+            br = m.range.bounds.add()
+            for v in row:
+                br.values.append(literal_to_proto(v, _infer_literal_type(v)))
+    else:
+        raise NotImplementedError(f"proto partitioning {p!r}")
+    return m
+
+
+def _infer_literal_type(v) -> T.DataType:
+    from decimal import Decimal
+
+    if isinstance(v, bool):
+        return T.BOOL
+    if isinstance(v, int):
+        return T.I64
+    if isinstance(v, float):
+        return T.F64
+    if isinstance(v, Decimal):
+        return T.DecimalType(38, max(0, -v.as_tuple().exponent))
+    if isinstance(v, bytes):
+        return T.BINARY
+    return T.STRING
+
+
+def partitioning_from_proto(m: pb.Partitioning):
+    which = m.WhichOneof("scheme")
+    if which == "single":
+        return N.SinglePartitioning(m.single.num_partitions or 1)
+    if which == "hash":
+        return N.HashPartitioning([expr_from_proto(e) for e in m.hash.exprs],
+                                  m.hash.num_partitions)
+    if which == "round_robin":
+        return N.RoundRobinPartitioning(m.round_robin.num_partitions)
+    if which == "range":
+        bounds = []
+        for br in m.range.bounds:
+            bounds.append(tuple(literal_from_proto(v)[0] for v in br.values))
+        return N.RangePartitioning(
+            [sort_order_from_proto(so) for so in m.range.sort_orders],
+            m.range.num_partitions, bounds)
+    raise NotImplementedError(f"proto partitioning {which}")
+
+
+# ---------------------------------------------------------------------------
+# scans
+# ---------------------------------------------------------------------------
+
+def conf_to_proto(c: N.FileScanConf) -> pb.FileScanConf:
+    m = pb.FileScanConf()
+    for g in c.file_groups:
+        gm = m.file_groups.add()
+        for f in g.files:
+            fm = gm.files.add()
+            fm.path = f.path
+            fm.size = f.size
+            if f.range is not None:
+                fm.range.start = f.range.start
+                fm.range.end = f.range.end
+            for i, v in enumerate(f.partition_values):
+                dt = (c.partition_schema[i].dtype
+                      if i < len(c.partition_schema) else _infer_literal_type(v))
+                fm.partition_values.append(literal_to_proto(v, dt))
+    m.file_schema.CopyFrom(schema_to_proto(c.file_schema))
+    m.projection.extend(c.projection)
+    m.partition_schema.CopyFrom(schema_to_proto(c.partition_schema))
+    return m
+
+
+def conf_from_proto(m: pb.FileScanConf) -> N.FileScanConf:
+    groups = []
+    for gm in m.file_groups:
+        files = []
+        for fm in gm.files:
+            rng = N.FileRange(fm.range.start, fm.range.end) \
+                if fm.HasField("range") else None
+            pvals = tuple(literal_from_proto(v)[0] for v in fm.partition_values)
+            files.append(N.PartitionedFile(fm.path, fm.size, rng, pvals))
+        groups.append(N.FileGroup(files))
+    return N.FileScanConf(groups, schema_from_proto(m.file_schema),
+                          list(m.projection), schema_from_proto(m.partition_schema))
+
+
+# ---------------------------------------------------------------------------
+# plan nodes
+# ---------------------------------------------------------------------------
+
+def plan_to_proto(node: N.PlanNode) -> pb.PlanNode:
+    m = pb.PlanNode()
+    if isinstance(node, N.ParquetScan):
+        m.parquet_scan.conf.CopyFrom(conf_to_proto(node.conf))
+        if node.predicate is not None:
+            m.parquet_scan.predicate.CopyFrom(expr_to_proto(node.predicate))
+    elif isinstance(node, N.OrcScan):
+        m.orc_scan.conf.CopyFrom(conf_to_proto(node.conf))
+        if node.predicate is not None:
+            m.orc_scan.predicate.CopyFrom(expr_to_proto(node.predicate))
+        m.orc_scan.force_positional_evolution = node.force_positional_evolution
+    elif isinstance(node, N.IpcReader):
+        m.ipc_reader.schema.CopyFrom(schema_to_proto(node.schema))
+        m.ipc_reader.resource_id = node.resource_id
+        m.ipc_reader.num_partitions = node.num_partitions
+    elif isinstance(node, N.FFIReader):
+        m.ffi_reader.schema.CopyFrom(schema_to_proto(node.schema))
+        m.ffi_reader.resource_id = node.resource_id
+        m.ffi_reader.num_partitions = node.num_partitions
+    elif isinstance(node, N.EmptyPartitions):
+        m.empty_partitions.schema.CopyFrom(schema_to_proto(node.schema))
+        m.empty_partitions.num_partitions = node.num_partitions
+    elif isinstance(node, N.Projection):
+        m.projection.child.CopyFrom(plan_to_proto(node.child))
+        for e in node.exprs:
+            m.projection.exprs.append(expr_to_proto(e))
+        m.projection.names.extend(node.names)
+    elif isinstance(node, N.Filter):
+        m.filter.child.CopyFrom(plan_to_proto(node.child))
+        for e in node.predicates:
+            m.filter.predicates.append(expr_to_proto(e))
+    elif isinstance(node, N.Sort):
+        m.sort.child.CopyFrom(plan_to_proto(node.child))
+        for so in node.sort_orders:
+            m.sort.sort_orders.append(sort_order_to_proto(so))
+        if node.fetch_limit is not None:
+            m.sort.fetch_limit = node.fetch_limit
+            m.sort.has_fetch_limit = True
+    elif isinstance(node, N.Limit):
+        m.limit.child.CopyFrom(plan_to_proto(node.child))
+        m.limit.limit = node.limit
+    elif isinstance(node, N.CoalesceBatches):
+        m.coalesce_batches.child.CopyFrom(plan_to_proto(node.child))
+        m.coalesce_batches.batch_size = node.batch_size or 0
+    elif isinstance(node, N.RenameColumns):
+        m.rename_columns.child.CopyFrom(plan_to_proto(node.child))
+        m.rename_columns.renamed_names.extend(node.renamed_names)
+    elif isinstance(node, N.Debug):
+        m.debug.child.CopyFrom(plan_to_proto(node.child))
+        m.debug.debug_id = node.debug_id
+    elif isinstance(node, N.Expand):
+        m.expand.child.CopyFrom(plan_to_proto(node.child))
+        for proj in node.projections:
+            pm = m.expand.projections.add()
+            for e in proj:
+                pm.exprs.append(expr_to_proto(e))
+        m.expand.schema.CopyFrom(schema_to_proto(node.schema))
+    elif isinstance(node, N.Agg):
+        m.agg.child.CopyFrom(plan_to_proto(node.child))
+        m.agg.exec_mode = node.exec_mode.value
+        for name, e in node.groupings:
+            gm = m.agg.groupings.add()
+            gm.name = name
+            gm.expr.CopyFrom(expr_to_proto(e))
+        for a in node.aggs:
+            am = m.agg.aggs.add()
+            am.agg.CopyFrom(agg_to_proto(a.agg))
+            am.mode = a.mode.value
+            am.name = a.name
+        m.agg.supports_partial_skipping = node.supports_partial_skipping
+    elif isinstance(node, N.Window):
+        m.window.child.CopyFrom(plan_to_proto(node.child))
+        for w in node.window_exprs:
+            wm = m.window.window_exprs.add()
+            wm.kind = w.kind
+            wm.name = w.name
+            if w.agg is not None:
+                wm.agg.CopyFrom(agg_to_proto(w.agg))
+            if w.return_type is not None:
+                wm.return_type.CopyFrom(type_to_proto(w.return_type))
+            if w.frame is not None:
+                ftype, lo, hi = w.frame
+                wm.has_frame = True
+                wm.frame_type = ftype
+                if lo is not None:
+                    wm.has_lower = True
+                    wm.lower = int(lo)
+                if hi is not None:
+                    wm.has_upper = True
+                    wm.upper = int(hi)
+        for e in node.partition_spec:
+            m.window.partition_spec.append(expr_to_proto(e))
+        for so in node.order_spec:
+            m.window.order_spec.append(sort_order_to_proto(so))
+        if node.group_limit is not None:
+            m.window.group_limit = node.group_limit
+            m.window.has_group_limit = True
+        m.window.output_window_cols = node.output_window_cols
+    elif isinstance(node, N.Generate):
+        m.generate.child.CopyFrom(plan_to_proto(node.child))
+        m.generate.generator = node.generator
+        for e in node.generator_args:
+            m.generate.generator_args.append(expr_to_proto(e))
+        m.generate.required_child_output.extend(node.required_child_output)
+        m.generate.generator_output.CopyFrom(schema_to_proto(node.generator_output))
+        m.generate.outer = node.outer
+        if node.udtf is not None:
+            m.generate.udtf_import_path = \
+                f"{node.udtf.__module__}:{node.udtf.__qualname__}"
+    elif isinstance(node, N.SortMergeJoin):
+        m.sort_merge_join.left.CopyFrom(plan_to_proto(node.left))
+        m.sort_merge_join.right.CopyFrom(plan_to_proto(node.right))
+        for l, r in node.on:
+            om = m.sort_merge_join.on.add()
+            om.left.CopyFrom(expr_to_proto(l))
+            om.right.CopyFrom(expr_to_proto(r))
+        m.sort_merge_join.join_type = node.join_type.value
+        for asc, nf in (node.sort_options or []):
+            sm = m.sort_merge_join.sort_options.add()
+            sm.ascending = asc
+            sm.nulls_first = nf
+        if node.condition is not None:
+            m.sort_merge_join.condition.CopyFrom(expr_to_proto(node.condition))
+    elif isinstance(node, N.HashJoin):
+        m.hash_join.left.CopyFrom(plan_to_proto(node.left))
+        m.hash_join.right.CopyFrom(plan_to_proto(node.right))
+        for l, r in node.on:
+            om = m.hash_join.on.add()
+            om.left.CopyFrom(expr_to_proto(l))
+            om.right.CopyFrom(expr_to_proto(r))
+        m.hash_join.join_type = node.join_type.value
+        m.hash_join.build_side = node.build_side.value
+        if node.condition is not None:
+            m.hash_join.condition.CopyFrom(expr_to_proto(node.condition))
+    elif isinstance(node, N.BroadcastJoin):
+        m.broadcast_join.left.CopyFrom(plan_to_proto(node.left))
+        m.broadcast_join.right.CopyFrom(plan_to_proto(node.right))
+        for l, r in node.on:
+            om = m.broadcast_join.on.add()
+            om.left.CopyFrom(expr_to_proto(l))
+            om.right.CopyFrom(expr_to_proto(r))
+        m.broadcast_join.join_type = node.join_type.value
+        m.broadcast_join.broadcast_side = node.broadcast_side.value
+        m.broadcast_join.cached_build_hash_map_id = node.cached_build_hash_map_id
+        if node.condition is not None:
+            m.broadcast_join.condition.CopyFrom(expr_to_proto(node.condition))
+    elif isinstance(node, N.BroadcastJoinBuildHashMap):
+        m.broadcast_join_build_hash_map.child.CopyFrom(plan_to_proto(node.child))
+        for e in node.keys:
+            m.broadcast_join_build_hash_map.keys.append(expr_to_proto(e))
+    elif isinstance(node, N.Union):
+        for c in node.inputs:
+            m.union.inputs.append(plan_to_proto(c))
+        m.union.num_partitions = node.num_partitions
+        for i, p in node.in_partitions:
+            im = m.union.in_partitions.add()
+            im.input = i
+            im.partition = p
+    elif isinstance(node, N.ShuffleWriter):
+        m.shuffle_writer.child.CopyFrom(plan_to_proto(node.child))
+        m.shuffle_writer.partitioning.CopyFrom(partitioning_to_proto(node.partitioning))
+        m.shuffle_writer.output_data_file = node.output_data_file
+        m.shuffle_writer.output_index_file = node.output_index_file
+    elif isinstance(node, N.RssShuffleWriter):
+        m.rss_shuffle_writer.child.CopyFrom(plan_to_proto(node.child))
+        m.rss_shuffle_writer.partitioning.CopyFrom(partitioning_to_proto(node.partitioning))
+        m.rss_shuffle_writer.rss_writer_resource_id = node.rss_writer_resource_id
+    elif isinstance(node, N.IpcWriter):
+        m.ipc_writer.child.CopyFrom(plan_to_proto(node.child))
+        m.ipc_writer.consumer_resource_id = node.consumer_resource_id
+    elif isinstance(node, N.ParquetSink):
+        m.parquet_sink.child.CopyFrom(plan_to_proto(node.child))
+        m.parquet_sink.fs_path = node.fs_path
+        m.parquet_sink.num_dyn_parts = node.num_dyn_parts
+        for k, v in node.props.items():
+            m.parquet_sink.props[k] = v
+    elif isinstance(node, N.ShuffleExchange):
+        m.shuffle_exchange.child.CopyFrom(plan_to_proto(node.child))
+        m.shuffle_exchange.partitioning.CopyFrom(partitioning_to_proto(node.partitioning))
+    elif isinstance(node, N.BroadcastExchange):
+        m.broadcast_exchange.child.CopyFrom(plan_to_proto(node.child))
+    else:
+        raise NotImplementedError(f"proto plan node {type(node).__name__}")
+    return m
+
+
+def plan_from_proto(m: pb.PlanNode) -> N.PlanNode:
+    which = m.WhichOneof("node")
+    if which == "parquet_scan":
+        pred = expr_from_proto(m.parquet_scan.predicate) \
+            if m.parquet_scan.HasField("predicate") else None
+        return N.ParquetScan(conf_from_proto(m.parquet_scan.conf), pred)
+    if which == "orc_scan":
+        pred = expr_from_proto(m.orc_scan.predicate) \
+            if m.orc_scan.HasField("predicate") else None
+        return N.OrcScan(conf_from_proto(m.orc_scan.conf), pred,
+                         m.orc_scan.force_positional_evolution)
+    if which == "ipc_reader":
+        return N.IpcReader(schema_from_proto(m.ipc_reader.schema),
+                           m.ipc_reader.resource_id, m.ipc_reader.num_partitions or 1)
+    if which == "ffi_reader":
+        return N.FFIReader(schema_from_proto(m.ffi_reader.schema),
+                           m.ffi_reader.resource_id, m.ffi_reader.num_partitions or 1)
+    if which == "empty_partitions":
+        return N.EmptyPartitions(schema_from_proto(m.empty_partitions.schema),
+                                 m.empty_partitions.num_partitions or 1)
+    if which == "projection":
+        return N.Projection(plan_from_proto(m.projection.child),
+                            [expr_from_proto(e) for e in m.projection.exprs],
+                            list(m.projection.names))
+    if which == "filter":
+        return N.Filter(plan_from_proto(m.filter.child),
+                        [expr_from_proto(e) for e in m.filter.predicates])
+    if which == "sort":
+        fetch = m.sort.fetch_limit if m.sort.has_fetch_limit else None
+        return N.Sort(plan_from_proto(m.sort.child),
+                      [sort_order_from_proto(so) for so in m.sort.sort_orders],
+                      fetch)
+    if which == "limit":
+        return N.Limit(plan_from_proto(m.limit.child), m.limit.limit)
+    if which == "coalesce_batches":
+        return N.CoalesceBatches(plan_from_proto(m.coalesce_batches.child),
+                                 m.coalesce_batches.batch_size or None)
+    if which == "rename_columns":
+        return N.RenameColumns(plan_from_proto(m.rename_columns.child),
+                               list(m.rename_columns.renamed_names))
+    if which == "debug":
+        return N.Debug(plan_from_proto(m.debug.child), m.debug.debug_id)
+    if which == "expand":
+        return N.Expand(plan_from_proto(m.expand.child),
+                        [[expr_from_proto(e) for e in pm.exprs]
+                         for pm in m.expand.projections],
+                        schema_from_proto(m.expand.schema))
+    if which == "agg":
+        return N.Agg(
+            plan_from_proto(m.agg.child), E.AggExecMode(m.agg.exec_mode),
+            [(g.name, expr_from_proto(g.expr)) for g in m.agg.groupings],
+            [N.AggColumn(agg_from_proto(a.agg), E.AggMode(a.mode), a.name)
+             for a in m.agg.aggs],
+            m.agg.supports_partial_skipping)
+    if which == "window":
+        wes = []
+        for wm in m.window.window_exprs:
+            agg = agg_from_proto(wm.agg) if wm.HasField("agg") else None
+            rt = type_from_proto(wm.return_type) if wm.HasField("return_type") else None
+            frame = None
+            if wm.has_frame:
+                frame = (wm.frame_type,
+                         wm.lower if wm.has_lower else None,
+                         wm.upper if wm.has_upper else None)
+            wes.append(N.WindowExpr(wm.kind, wm.name, agg, rt, frame))
+        gl = m.window.group_limit if m.window.has_group_limit else None
+        return N.Window(plan_from_proto(m.window.child), wes,
+                        [expr_from_proto(e) for e in m.window.partition_spec],
+                        [sort_order_from_proto(so) for so in m.window.order_spec],
+                        gl, m.window.output_window_cols)
+    if which == "generate":
+        udtf = None
+        if m.generate.udtf_import_path:
+            mod, qual = m.generate.udtf_import_path.split(":")
+            udtf = importlib.import_module(mod)
+            for part in qual.split("."):
+                udtf = getattr(udtf, part)
+        return N.Generate(plan_from_proto(m.generate.child), m.generate.generator,
+                          [expr_from_proto(e) for e in m.generate.generator_args],
+                          list(m.generate.required_child_output),
+                          schema_from_proto(m.generate.generator_output),
+                          m.generate.outer, udtf)
+    if which == "sort_merge_join":
+        j = m.sort_merge_join
+        return N.SortMergeJoin(
+            plan_from_proto(j.left), plan_from_proto(j.right),
+            [(expr_from_proto(o.left), expr_from_proto(o.right)) for o in j.on],
+            N.JoinType(j.join_type),
+            [(s.ascending, s.nulls_first) for s in j.sort_options] or None,
+            expr_from_proto(j.condition) if j.HasField("condition") else None)
+    if which == "hash_join":
+        j = m.hash_join
+        return N.HashJoin(
+            plan_from_proto(j.left), plan_from_proto(j.right),
+            [(expr_from_proto(o.left), expr_from_proto(o.right)) for o in j.on],
+            N.JoinType(j.join_type), N.JoinSide(j.build_side),
+            expr_from_proto(j.condition) if j.HasField("condition") else None)
+    if which == "broadcast_join":
+        j = m.broadcast_join
+        return N.BroadcastJoin(
+            plan_from_proto(j.left), plan_from_proto(j.right),
+            [(expr_from_proto(o.left), expr_from_proto(o.right)) for o in j.on],
+            N.JoinType(j.join_type), N.JoinSide(j.broadcast_side),
+            j.cached_build_hash_map_id,
+            expr_from_proto(j.condition) if j.HasField("condition") else None)
+    if which == "broadcast_join_build_hash_map":
+        return N.BroadcastJoinBuildHashMap(
+            plan_from_proto(m.broadcast_join_build_hash_map.child),
+            [expr_from_proto(e) for e in m.broadcast_join_build_hash_map.keys])
+    if which == "union":
+        return N.Union([plan_from_proto(c) for c in m.union.inputs],
+                       m.union.num_partitions,
+                       [(im.input, im.partition) for im in m.union.in_partitions])
+    if which == "shuffle_writer":
+        return N.ShuffleWriter(plan_from_proto(m.shuffle_writer.child),
+                               partitioning_from_proto(m.shuffle_writer.partitioning),
+                               m.shuffle_writer.output_data_file,
+                               m.shuffle_writer.output_index_file)
+    if which == "rss_shuffle_writer":
+        return N.RssShuffleWriter(
+            plan_from_proto(m.rss_shuffle_writer.child),
+            partitioning_from_proto(m.rss_shuffle_writer.partitioning),
+            m.rss_shuffle_writer.rss_writer_resource_id)
+    if which == "ipc_writer":
+        return N.IpcWriter(plan_from_proto(m.ipc_writer.child),
+                           m.ipc_writer.consumer_resource_id)
+    if which == "parquet_sink":
+        return N.ParquetSink(plan_from_proto(m.parquet_sink.child),
+                             m.parquet_sink.fs_path, m.parquet_sink.num_dyn_parts,
+                             dict(m.parquet_sink.props))
+    if which == "shuffle_exchange":
+        return N.ShuffleExchange(plan_from_proto(m.shuffle_exchange.child),
+                                 partitioning_from_proto(m.shuffle_exchange.partitioning))
+    if which == "broadcast_exchange":
+        return N.BroadcastExchange(plan_from_proto(m.broadcast_exchange.child))
+    raise NotImplementedError(f"proto plan node {which}")
+
+
+def plan_to_bytes(node: N.PlanNode) -> bytes:
+    return plan_to_proto(node).SerializeToString()
+
+
+def plan_from_bytes(data: bytes) -> N.PlanNode:
+    m = pb.PlanNode()
+    m.ParseFromString(data)
+    return plan_from_proto(m)
+
+
+def task_definition_to_bytes(stage_id: int, partition_id: int, task_id: int,
+                             plan: N.PlanNode) -> bytes:
+    m = pb.TaskDefinition(stage_id=stage_id, partition_id=partition_id,
+                          task_id=task_id)
+    m.plan.CopyFrom(plan_to_proto(plan))
+    return m.SerializeToString()
+
+
+def task_definition_from_bytes(data: bytes):
+    m = pb.TaskDefinition()
+    m.ParseFromString(data)
+    from blaze_tpu.ops.base import TaskContext
+
+    return TaskContext(m.stage_id, m.partition_id, m.task_id), plan_from_proto(m.plan)

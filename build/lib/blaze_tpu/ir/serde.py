@@ -1,0 +1,185 @@
+"""Wire serialization of the plan/expression IR.
+
+Reference: ``auron-serde`` (prost/protobuf codegen over ``auron.proto`` +
+``from_proto.rs``). Here the wire format is tagged JSON over the IR
+dataclasses — language-neutral and diffable; a protobuf binding can be layered
+on the same tag vocabulary for a JVM frontend. Callables (PyUDF fns, UDAF
+objects) serialize via cloudpickle-free pickling of their import path when
+possible, else raise.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import enum
+import importlib
+import json
+from typing import Any
+
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import nodes as N
+from blaze_tpu.ir import types as T
+
+# ---------------------------------------------------------------------------
+# data types
+# ---------------------------------------------------------------------------
+
+_SIMPLE_TYPES = {
+    "null": T.NULL, "bool": T.BOOL, "i8": T.I8, "i16": T.I16, "i32": T.I32,
+    "i64": T.I64, "f32": T.F32, "f64": T.F64, "string": T.STRING,
+    "binary": T.BINARY, "date": T.DATE, "timestamp": T.TIMESTAMP,
+}
+_SIMPLE_NAMES = {type(v): k for k, v in _SIMPLE_TYPES.items()}
+
+
+def type_to_json(dt: T.DataType) -> Any:
+    cls = type(dt)
+    if cls in _SIMPLE_NAMES:
+        return _SIMPLE_NAMES[cls]
+    if isinstance(dt, T.DecimalType):
+        return {"t": "decimal", "precision": dt.precision, "scale": dt.scale}
+    if isinstance(dt, T.ArrayType):
+        return {"t": "array", "element": type_to_json(dt.element_type)}
+    if isinstance(dt, T.MapType):
+        return {"t": "map", "key": type_to_json(dt.key_type),
+                "value": type_to_json(dt.value_type)}
+    if isinstance(dt, T.StructType):
+        return {"t": "struct", "fields": [
+            {"name": f.name, "type": type_to_json(f.dtype), "nullable": f.nullable}
+            for f in dt.fields]}
+    raise NotImplementedError(f"serde for {dt!r}")
+
+
+def type_from_json(j: Any) -> T.DataType:
+    if isinstance(j, str):
+        return _SIMPLE_TYPES[j]
+    t = j["t"]
+    if t == "decimal":
+        return T.DecimalType(j["precision"], j["scale"])
+    if t == "array":
+        return T.ArrayType(type_from_json(j["element"]))
+    if t == "map":
+        return T.MapType(type_from_json(j["key"]), type_from_json(j["value"]))
+    if t == "struct":
+        return T.StructType(tuple(
+            T.StructField(f["name"], type_from_json(f["type"]), f["nullable"])
+            for f in j["fields"]))
+    raise NotImplementedError(f"serde for {j}")
+
+
+def schema_to_json(s: T.Schema) -> Any:
+    return [
+        {"name": f.name, "type": type_to_json(f.dtype), "nullable": f.nullable}
+        for f in s.fields
+    ]
+
+
+def schema_from_json(j: Any) -> T.Schema:
+    return T.Schema(tuple(
+        T.StructField(f["name"], type_from_json(f["type"]), f["nullable"]) for f in j
+    ))
+
+
+# ---------------------------------------------------------------------------
+# generic dataclass-tree serde (expressions and plan nodes)
+# ---------------------------------------------------------------------------
+
+_EXPR_CLASSES = {c.__name__: c for c in vars(E).values()
+                 if isinstance(c, type) and issubclass(c, E.Expr) and c is not E.Expr}
+_NODE_CLASSES = {c.__name__: c for c in vars(N).values()
+                 if isinstance(c, type) and issubclass(c, N.PlanNode) and c is not N.PlanNode}
+_AUX_CLASSES = {c.__name__: c for c in (
+    N.SinglePartitioning, N.HashPartitioning, N.RoundRobinPartitioning,
+    N.RangePartitioning, N.FileRange, N.PartitionedFile, N.FileGroup,
+    N.FileScanConf, N.AggColumn, N.WindowExpr,
+)}
+_ENUM_CLASSES = {c.__name__: c for c in (
+    E.BinaryOp, E.AggFunction, E.AggMode, E.AggExecMode, N.JoinType, N.JoinSide,
+)}
+
+
+def _encode(obj: Any) -> Any:
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, bytes):
+        return {"__bytes__": base64.b64encode(obj).decode()}
+    if isinstance(obj, enum.Enum):
+        return {"__enum__": type(obj).__name__, "v": obj.name}
+    if isinstance(obj, T.DataType):
+        return {"__type__": type_to_json(obj)}
+    if isinstance(obj, T.Schema):
+        return {"__schema__": schema_to_json(obj)}
+    if isinstance(obj, (list, tuple)):
+        return [_encode(x) for x in obj]
+    if isinstance(obj, dict):
+        return {"__dict__": {k: _encode(v) for k, v in obj.items()}}
+    if dataclasses.is_dataclass(obj):
+        name = type(obj).__name__
+        if name not in _EXPR_CLASSES and name not in _NODE_CLASSES and name not in _AUX_CLASSES:
+            raise NotImplementedError(f"serde for dataclass {name}")
+        out = {"__cls__": name}
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name)
+            if callable(v) and not isinstance(v, (E.Expr, N.PlanNode)):
+                out[f.name] = {"__callable__": f"{v.__module__}:{v.__qualname__}"}
+            else:
+                out[f.name] = _encode(v)
+        return out
+    if isinstance(obj, T.StructField):
+        return {"__field__": [obj.name, type_to_json(obj.dtype), obj.nullable]}
+    raise NotImplementedError(f"serde for {type(obj)}")
+
+
+def _decode(j: Any) -> Any:
+    if j is None or isinstance(j, (bool, int, float, str)):
+        return j
+    if isinstance(j, list):
+        return [_decode(x) for x in j]
+    if "__bytes__" in j:
+        return base64.b64decode(j["__bytes__"])
+    if "__enum__" in j:
+        return _ENUM_CLASSES[j["__enum__"]][j["v"]]
+    if "__type__" in j:
+        return type_from_json(j["__type__"])
+    if "__schema__" in j:
+        return schema_from_json(j["__schema__"])
+    if "__dict__" in j:
+        return {k: _decode(v) for k, v in j["__dict__"].items()}
+    if "__field__" in j:
+        n, t, nl = j["__field__"]
+        return T.StructField(n, type_from_json(t), nl)
+    if "__callable__" in j:
+        mod, qual = j["__callable__"].split(":")
+        obj = importlib.import_module(mod)
+        for part in qual.split("."):
+            obj = getattr(obj, part)
+        return obj
+    if "__cls__" in j:
+        name = j["__cls__"]
+        cls = _EXPR_CLASSES.get(name) or _NODE_CLASSES.get(name) or _AUX_CLASSES[name]
+        kwargs = {k: _decode(v) for k, v in j.items() if k != "__cls__"}
+        # dataclasses with tuple fields accept lists fine; Case branches need tuples
+        obj = cls(**kwargs)
+        if isinstance(obj, E.Case):
+            obj.branches = [tuple(b) for b in obj.branches]
+        if isinstance(obj, (N.SortMergeJoin, N.HashJoin, N.BroadcastJoin)):
+            obj.on = [tuple(p) for p in obj.on]
+        return obj
+    raise NotImplementedError(f"serde for {j}")
+
+
+def plan_to_json(plan: N.PlanNode) -> str:
+    return json.dumps(_encode(plan))
+
+
+def plan_from_json(s: str) -> N.PlanNode:
+    return _decode(json.loads(s))
+
+
+def expr_to_json(expr: E.Expr) -> str:
+    return json.dumps(_encode(expr))
+
+
+def expr_from_json(s: str) -> E.Expr:
+    return _decode(json.loads(s))

@@ -1,0 +1,836 @@
+"""Aggregate functions over slot-indexed accumulators, dual-mode.
+
+Reference: ``datafusion-ext-plans/src/agg/`` — typed accumulator columns
+(``acc.rs:43-730``) updated vectorized per IdxSelection, with
+freeze/unfreeze for spill.
+
+Two accumulation modes, chosen per function by where its values can live
+with exact semantics (see blaze_tpu/utils/device.py):
+
+- **device**: accumulators are jax arrays; updates are XLA scatter ops
+  (``array.at[slots].add/min/max``) — ints, decimals(<=18), dates,
+  timestamps, f32, and f64 on backends with real float64;
+- **host**: accumulators are numpy arrays updated via ``np.ufunc.at``
+  (still vectorized) — f64 on TPU (which silently demotes f64 to f32),
+  strings/binary via per-slot python objects (collect/min/max/first).
+
+Partial-state representation: unlike the reference (which packs all
+accumulators into one opaque binary column ``#9223372036854775807`` because
+state must traverse *Spark's* row-oriented shuffle), partial output here uses
+**typed columnar state fields** (e.g. sum -> [sum, has]) — our own shuffle
+moves columns natively, so keeping state columnar avoids a pack/unpack pass
+and lets the exchange compress per-plane. The opaque-binary contract can be
+restored at a Spark boundary by serializing these fields.
+
+NaN caveat: device scatter min/max follows XLA semantics (NaN propagates);
+Spark orders NaN as largest. Plans aggregating floats should normalize NaNs
+first (the converter inserts normalize_nan_and_zero, as Spark does).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from blaze_tpu.core.batch import Column, DeviceColumn, HostColumn
+from blaze_tpu.exprs import decimal as dec
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import types as T
+from blaze_tpu.utils.device import is_device_dtype
+
+_I64_MAX = np.iinfo(np.int64).max
+
+
+def _grow(arr, capacity, fill=0):
+    if arr.shape[0] >= capacity:
+        return arr
+    if isinstance(arr, np.ndarray):
+        out = np.full(capacity, fill, dtype=arr.dtype)
+        out[: arr.shape[0]] = arr
+        return out
+    if fill == 0:
+        return jnp.pad(arr, (0, capacity - arr.shape[0]))
+    return jnp.concatenate([arr, jnp.full(capacity - arr.shape[0], fill, arr.dtype)])
+
+
+def _sentinel_np(np_dtype, which: str):
+    if np.issubdtype(np_dtype, np.floating):
+        return np.array(np.inf if which == "min" else -np.inf, np_dtype)
+    if np_dtype == np.bool_:
+        return np.array(which == "min", np_dtype)
+    info = np.iinfo(np_dtype)
+    return np.array(info.max if which == "min" else info.min, np_dtype)
+
+
+def _arr_np(arr: pa.Array, np_dtype) -> Tuple[np.ndarray, np.ndarray]:
+    """pa.Array -> (values, validity) numpy pair."""
+    valid = ~np.asarray(arr.is_null()) if arr.null_count else np.ones(len(arr), bool)
+    fill = False if pa.types.is_boolean(arr.type) else 0
+    vals = arr.fill_null(fill).to_numpy(zero_copy_only=False).astype(np_dtype, copy=False)
+    return vals, valid
+
+
+def _col_np(col: Column, n: int, np_dtype) -> Tuple[np.ndarray, np.ndarray]:
+    if isinstance(col, DeviceColumn):
+        return (np.asarray(col.data[:n]).astype(np_dtype, copy=False),
+                np.asarray(col.validity[:n]))
+    return _arr_np(col.array, np_dtype)
+
+
+def _host_col_out(dtype: T.DataType, vals: np.ndarray, valid: np.ndarray) -> HostColumn:
+    at = T.to_arrow_type(dtype)
+    if isinstance(dtype, T.DecimalType):
+        # vals carry unscaled python ints (object array, exact for p > 18);
+        # overflow beyond the precision becomes NULL (Spark non-ANSI)
+        from decimal import Decimal
+
+        bound = 10 ** dtype.precision
+        out = [
+            Decimal(int(v)).scaleb(-dtype.scale)
+            if ok and -bound < int(v) < bound else None
+            for v, ok in zip(vals, valid)
+        ]
+        return HostColumn(dtype, pa.array(out, type=at))
+    return HostColumn(dtype, pa.Array.from_pandas(vals, mask=~valid, type=at))
+
+
+def _decimal_unscaled_np(arr: pa.Array, scale: int):
+    """(object array of unscaled python ints, validity) — exact for any
+    precision (Spark hashes/aggregates wide decimals as BigIntegers)."""
+    valid = ~np.asarray(arr.is_null()) if arr.null_count else np.ones(len(arr), bool)
+    vals = np.empty(len(arr), dtype=object)
+    for i, d in enumerate(arr.to_pylist()):
+        vals[i] = 0 if d is None else int(d.scaleb(scale))
+    return vals, valid
+
+
+class AggFunction:
+    """One aggregate over one arg expression; stateless descriptor, state is
+    passed explicitly."""
+
+    def __init__(self, agg: E.AggExpr, arg_type: T.DataType, result_type: T.DataType):
+        self.agg = agg
+        self.arg_type = arg_type
+        self.result_type = result_type
+        self.host = False  # overridden per function
+
+    def state_fields(self) -> List[Tuple[str, T.DataType]]:
+        raise NotImplementedError
+
+    def init_state(self, capacity: int) -> List[Any]:
+        raise NotImplementedError
+
+    def grow(self, state: List[Any], capacity: int) -> List[Any]:
+        return [_grow(s, capacity) if hasattr(s, "shape") else s for s in state]
+
+    def update(self, state, slots, value, validity, mask, order=None):
+        """Accumulate raw values (PARTIAL). Device mode: slots/value/validity
+        are device arrays, mask is the row-exists device mask. Host mode:
+        slots/mask are numpy, value is a pa.Array."""
+        raise NotImplementedError
+
+    def merge(self, state, slots, partial_cols: List[Column], mask, n: int):
+        raise NotImplementedError
+
+    def state_columns(self, state, num_slots: int, capacity: int) -> List[Column]:
+        raise NotImplementedError
+
+    def final_column(self, state, num_slots: int, capacity: int) -> Column:
+        raise NotImplementedError
+
+    def mem_used(self, state) -> int:
+        return sum(s.nbytes for s in state if hasattr(s, "nbytes"))
+
+
+class SumAgg(AggFunction):
+    def __init__(self, agg, arg_type, result_type):
+        super().__init__(agg, arg_type, result_type)
+        self.host = not is_device_dtype(result_type)
+        self._decimal_obj = self.host and isinstance(result_type, T.DecimalType)
+        if self._decimal_obj:
+            self._npdt = np.dtype(object)  # unscaled python ints, exact
+        elif isinstance(result_type, T.DecimalType):
+            self._npdt = np.dtype(np.int64)
+        else:
+            self._npdt = result_type.np_dtype
+
+    def state_fields(self):
+        return [("sum", self.result_type), ("has", T.BOOL)]
+
+    def init_state(self, capacity):
+        if self.host:
+            return [np.zeros(capacity, self._npdt), np.zeros(capacity, bool)]
+        return [jnp.zeros(capacity, self._npdt), jnp.zeros(capacity, bool)]
+
+    def _rescale_arg(self, v, m):
+        if isinstance(self.arg_type, T.DecimalType) and isinstance(self.result_type, T.DecimalType):
+            if self.result_type.scale != self.arg_type.scale:
+                v, _ = dec.rescale(v, m, self.arg_type.scale, self.result_type.scale, 19)
+        return v
+
+    def extract_host(self, value: pa.Array, in_scale: Optional[int] = None):
+        """(values, validity) numpy pair for host accumulation; decimals as
+        exact unscaled python ints rescaled to the result scale."""
+        if self._decimal_obj:
+            scale = self.result_type.scale if in_scale is None else in_scale
+            vals, valid = _decimal_unscaled_np(value, scale)
+            if in_scale is not None and in_scale != self.result_type.scale:
+                m = 10 ** (self.result_type.scale - in_scale)
+                vals = np.array([v * m for v in vals], dtype=object)
+            return vals, valid
+        return _arr_np(value, self._npdt)
+
+    def update(self, state, slots, value, validity, mask, order=None):
+        acc, has = state
+        if self.host:
+            in_scale = self.arg_type.scale if isinstance(self.arg_type, T.DecimalType) else None
+            vals, valid = self.extract_host(value, in_scale)
+            m = valid & mask
+            np.add.at(acc, slots[m], vals[m])
+            has[slots[m]] = True
+            return [acc, has]
+        m = validity & mask
+        v = self._rescale_arg(value.astype(acc.dtype), m)
+        acc = acc.at[slots].add(jnp.where(m, v, jnp.zeros((), acc.dtype)), mode="drop")
+        has = has.at[slots].max(m, mode="drop")
+        return [acc, has]
+
+    def merge(self, state, slots, partial_cols, mask, n):
+        acc, has = state
+        psum, phas = partial_cols
+        if self.host:
+            if self._decimal_obj:
+                assert isinstance(psum, HostColumn)
+                vals, valid = _decimal_unscaled_np(psum.array, self.result_type.scale)
+            else:
+                vals, valid = _col_np(psum, n, self._npdt)
+            hvals, _ = _col_np(phas, n, np.bool_)
+            m = valid & hvals & mask
+            np.add.at(acc, slots[m], vals[m])
+            has[slots[m]] = True
+            return [acc, has]
+        m = phas.data.astype(bool) & phas.validity & mask
+        acc = acc.at[slots].add(jnp.where(m, psum.data.astype(acc.dtype), 0), mode="drop")
+        has = has.at[slots].max(m, mode="drop")
+        return [acc, has]
+
+    def state_columns(self, state, num_slots, capacity):
+        acc, has = self.grow(state, capacity)
+        if self.host:
+            return [_host_col_out(self.result_type, acc[:num_slots], has[:num_slots]),
+                    _host_col_out(T.BOOL, has[:num_slots], np.ones(num_slots, bool))]
+        return [DeviceColumn(self.result_type, acc, has),
+                DeviceColumn(T.BOOL, has, jnp.ones(capacity, bool))]
+
+    def final_column(self, state, num_slots, capacity):
+        acc, has = self.grow(state, capacity)
+        if self.host:
+            return _host_col_out(self.result_type, acc[:num_slots], has[:num_slots])
+        if isinstance(self.result_type, T.DecimalType):
+            acc, has = dec.check_overflow(acc, has, self.result_type.precision)
+        return DeviceColumn(self.result_type, acc, has)
+
+
+class CountAgg(AggFunction):
+    def state_fields(self):
+        return [("count", T.I64)]
+
+    def init_state(self, capacity):
+        return [jnp.zeros(capacity, jnp.int64)]
+
+    def update(self, state, slots, value, validity, mask, order=None):
+        (acc,) = state
+        if isinstance(value, pa.Array):  # host-resident arg: count on host mask
+            valid = ~np.asarray(value.is_null()) if value.null_count else \
+                np.ones(len(value), bool)
+            m = valid & mask
+            accn = np.asarray(acc)
+            np.add.at(accn, slots[m], 1)
+            return [jnp.asarray(accn)]
+        m = mask if value is None else (validity & mask)
+        acc = acc.at[slots].add(m.astype(jnp.int64), mode="drop")
+        return [acc]
+
+    def merge(self, state, slots, partial_cols, mask, n):
+        (pcol,) = partial_cols
+        (acc,) = state
+        if isinstance(pcol, HostColumn) or isinstance(slots, np.ndarray):
+            vals, valid = _col_np(pcol, n, np.int64)
+            accn = np.asarray(acc)
+            m = valid & (np.asarray(mask)[:n] if hasattr(mask, "shape") else mask)
+            np.add.at(accn, slots[:n][m] if len(slots) > n else slots[m], vals[m])
+            return [jnp.asarray(accn)]
+        v = jnp.where(pcol.validity & mask, pcol.data, 0)
+        acc = acc.at[slots].add(v, mode="drop")
+        return [acc]
+
+    def state_columns(self, state, num_slots, capacity):
+        (acc,) = self.grow(state, capacity)
+        return [DeviceColumn(T.I64, acc, jnp.ones(capacity, bool))]
+
+    def final_column(self, state, num_slots, capacity):
+        (acc,) = self.grow(state, capacity)
+        return DeviceColumn(T.I64, acc, jnp.ones(capacity, bool))
+
+
+class AvgAgg(AggFunction):
+    """State: [sum (sum-type), count i64]; final divides with Spark scale
+    rules (decimal avg result scale via converter result_type)."""
+
+    def __init__(self, agg, arg_type, result_type):
+        super().__init__(agg, arg_type, result_type)
+        if isinstance(arg_type, T.DecimalType):
+            self.sum_type = T.DecimalType(min(arg_type.precision + 10, 38), arg_type.scale)
+        else:
+            self.sum_type = T.F64
+        self._sum = SumAgg(agg, arg_type, self.sum_type)
+        self._cnt = CountAgg(agg, arg_type, T.I64)
+        self.host = self._sum.host
+
+    def state_fields(self):
+        return [("sum", self.sum_type), ("count", T.I64)]
+
+    def init_state(self, capacity):
+        if self.host:
+            return [np.zeros(capacity, self._sum._npdt), np.zeros(capacity, np.int64)]
+        return [self._sum.init_state(capacity)[0], self._cnt.init_state(capacity)[0]]
+
+    def grow(self, state, capacity):
+        return [_grow(state[0], capacity), _grow(state[1], capacity)]
+
+    def update(self, state, slots, value, validity, mask, order=None):
+        s, c = state
+        if self.host:
+            in_scale = self.arg_type.scale if isinstance(self.arg_type, T.DecimalType) else None
+            vals, valid = self._sum.extract_host(value, in_scale)
+            m = valid & mask
+            np.add.at(s, slots[m], vals[m])
+            np.add.at(c, slots[m], 1)
+            return [s, c]
+        s = self._sum.update([s, jnp.zeros_like(mask)], slots, value, validity, mask)[0]
+        c = self._cnt.update([c], slots, value, validity, mask)[0]
+        return [s, c]
+
+    def merge(self, state, slots, partial_cols, mask, n):
+        psum, pcnt = partial_cols
+        s, c = state
+        if self.host:
+            if self._sum._decimal_obj:
+                vals, valid = _decimal_unscaled_np(psum.array, self.sum_type.scale)
+            else:
+                vals, valid = _col_np(psum, n, self._sum._npdt)
+            m = valid & mask
+            np.add.at(s, slots[m], vals[m])
+            cvals, cvalid = _col_np(pcnt, n, np.int64)
+            mc = cvalid & mask
+            np.add.at(c, slots[mc], cvals[mc])
+            return [s, c]
+        m = psum.validity & mask
+        s = s.at[slots].add(jnp.where(m, psum.data.astype(s.dtype), 0), mode="drop")
+        c = c.at[slots].add(jnp.where(pcnt.validity & mask, pcnt.data, 0), mode="drop")
+        return [s, c]
+
+    def state_columns(self, state, num_slots, capacity):
+        s, c = self.grow(state, capacity)
+        if self.host:
+            cn = c
+            return [_host_col_out(self.sum_type, s[:num_slots], cn[:num_slots] > 0),
+                    DeviceColumn(T.I64, jnp.asarray(cn.astype(np.int64)),
+                                 jnp.ones(capacity, bool))]
+        return [DeviceColumn(self.sum_type, s, c > 0),
+                DeviceColumn(T.I64, c, jnp.ones(capacity, bool))]
+
+    def final_column(self, state, num_slots, capacity):
+        s, c = self.grow(state, capacity)
+        if self.host:
+            has = c > 0
+            if self._sum._decimal_obj:
+                from decimal import ROUND_HALF_UP, Decimal
+
+                q = Decimal(1).scaleb(-self.result_type.scale)
+                bound = Decimal(10) ** (self.result_type.precision - self.result_type.scale)
+                out = []
+                for i in range(num_slots):
+                    if not has[i]:
+                        out.append(None)
+                        continue
+                    v = (Decimal(int(s[i])).scaleb(-self.sum_type.scale)
+                         / Decimal(int(c[i]))).quantize(q, rounding=ROUND_HALF_UP)
+                    out.append(v if abs(v) < bound else None)
+                return HostColumn(self.result_type,
+                                  pa.array(out, type=T.to_arrow_type(self.result_type)))
+            out = s.astype(np.float64) / np.where(has, c, 1)
+            return _host_col_out(T.F64, out[:num_slots], has[:num_slots])
+        has = c > 0
+        cnz = jnp.where(has, c, 1)
+        if isinstance(self.result_type, T.DecimalType):
+            scale_adjust = self.result_type.scale - self.sum_type.scale
+            out, validity = dec.div(s, has, cnz, has, scale_adjust)
+            out, validity = dec.check_overflow(out, validity, self.result_type.precision)
+            return DeviceColumn(self.result_type, out, validity)
+        out = s.astype(jnp.float64) / cnz.astype(jnp.float64)
+        return DeviceColumn(T.F64, out, has)
+
+
+class MinMaxAgg(AggFunction):
+    def __init__(self, agg, arg_type, result_type, which: str):
+        super().__init__(agg, arg_type, result_type)
+        self.which = which
+        # numerics stay vectorized (numpy ufunc.at when host); var-width
+        # values and wide decimals use per-slot python objects (exact
+        # Decimal comparisons for p > 18)
+        if isinstance(arg_type, T.DecimalType):
+            self.numeric = arg_type.fits_int64
+        else:
+            self.numeric = arg_type.np_dtype is not None
+        self.host = not is_device_dtype(arg_type)
+        self._npdt = np.dtype(np.int64) if isinstance(arg_type, T.DecimalType) else (
+            arg_type.np_dtype if self.numeric else None)
+
+    def state_fields(self):
+        return [("val", self.result_type), ("has", T.BOOL)]
+
+    def init_state(self, capacity):
+        if self.host and not self.numeric:
+            return [dict(), None]
+        if self.host:
+            return [np.full(capacity, _sentinel_np(self._npdt, self.which)),
+                    np.zeros(capacity, bool)]
+        return [jnp.full(capacity, _sentinel_np(self._npdt, self.which).item(),
+                         self._npdt),
+                jnp.zeros(capacity, bool)]
+
+    def grow(self, state, capacity):
+        if self.host and not self.numeric:
+            return state
+        val, has = state
+        if val.shape[0] >= capacity:
+            return state
+        return [_grow(val, capacity, fill=_sentinel_np(val.dtype, self.which).item()),
+                _grow(has, capacity)]
+
+    def update(self, state, slots, value, validity, mask, order=None):
+        if self.host and not self.numeric:
+            return self._update_obj(state, slots, value.to_pylist(), mask)
+        if self.host:
+            val, has = state
+            vals, valid = _arr_np(value, self._npdt)
+            m = valid & mask
+            ufn = np.minimum if self.which == "min" else np.maximum
+            ufn.at(val, slots[m], vals[m])
+            has[slots[m]] = True
+            return [val, has]
+        acc, has = state
+        m = validity & mask
+        sent = jnp.array(_sentinel_np(acc.dtype, self.which).item(), acc.dtype)
+        v = jnp.where(m, value.astype(acc.dtype), sent)
+        acc = acc.at[slots].min(v, mode="drop") if self.which == "min" else \
+            acc.at[slots].max(v, mode="drop")
+        has = has.at[slots].max(m, mode="drop")
+        return [acc, has]
+
+    def _update_obj(self, state, slots, vals, mask):
+        d, _ = state
+        better = (lambda a, b: a < b) if self.which == "min" else (lambda a, b: a > b)
+        for i, v in enumerate(vals):
+            if not mask[i] or v is None:
+                continue
+            s = int(slots[i])
+            cur = d.get(s)
+            if cur is None or better(v, cur):
+                d[s] = v
+        return [d, None]
+
+    def merge(self, state, slots, partial_cols, mask, n):
+        pval, phas = partial_cols
+        if self.host and not self.numeric:
+            return self._update_obj(state, slots, pval.array.to_pylist(), mask)
+        if self.host:
+            val, has = state
+            vals, valid = _col_np(pval, n, self._npdt)
+            hvals, _ = _col_np(phas, n, np.bool_)
+            m = valid & hvals & mask
+            ufn = np.minimum if self.which == "min" else np.maximum
+            ufn.at(val, slots[m], vals[m])
+            has[slots[m]] = True
+            return [val, has]
+        m = phas.data.astype(bool) & phas.validity & mask
+        acc, has = state
+        sent = jnp.array(_sentinel_np(acc.dtype, self.which).item(), acc.dtype)
+        v = jnp.where(m, pval.data.astype(acc.dtype), sent)
+        acc = acc.at[slots].min(v, mode="drop") if self.which == "min" else \
+            acc.at[slots].max(v, mode="drop")
+        has = has.at[slots].max(m, mode="drop")
+        return [acc, has]
+
+    def state_columns(self, state, num_slots, capacity):
+        if self.host and not self.numeric:
+            d = state[0]
+            vals = [d.get(i) for i in range(num_slots)]
+            has = [i in d for i in range(num_slots)]
+            return [
+                HostColumn(self.result_type, pa.array(vals, type=T.to_arrow_type(self.result_type))),
+                HostColumn(T.BOOL, pa.array(has, type=pa.bool_())),
+            ]
+        val, has = self.grow(state, capacity)
+        if self.host:
+            return [_host_col_out(self.result_type, np.where(has, val, 0)[:num_slots], has[:num_slots]),
+                    _host_col_out(T.BOOL, has[:num_slots], np.ones(num_slots, bool))]
+        return [DeviceColumn(self.result_type, jnp.where(has, val, 0), has),
+                DeviceColumn(T.BOOL, has, jnp.ones(capacity, bool))]
+
+    def final_column(self, state, num_slots, capacity):
+        return self.state_columns(state, num_slots, capacity)[0]
+
+    def mem_used(self, state):
+        if self.host and not self.numeric:
+            d = state[0]
+            return 64 * len(d)
+        return super().mem_used(state)
+
+
+class FirstAgg(AggFunction):
+    """FIRST / FIRST_IGNORES_NULL: winner = smallest global row order; two
+    scatter passes (order min, then conditional value write)."""
+
+    def __init__(self, agg, arg_type, result_type, ignores_null: bool):
+        super().__init__(agg, arg_type, result_type)
+        self.ignores_null = ignores_null
+        self.host = not is_device_dtype(arg_type)
+
+    def state_fields(self):
+        return [("val", self.result_type), ("valid", T.BOOL), ("order", T.I64)]
+
+    def init_state(self, capacity):
+        if self.host:
+            return [dict(), None, None]  # slot -> (order, value)
+        return [
+            jnp.zeros(capacity, self.result_type.np_dtype if not isinstance(
+                self.result_type, T.DecimalType) else np.int64),
+            jnp.zeros(capacity, bool),
+            jnp.full(capacity, _I64_MAX, jnp.int64),
+        ]
+
+    def grow(self, state, capacity):
+        if self.host:
+            return state
+        val, valid, order = state
+        if val.shape[0] >= capacity:
+            return state
+        return [_grow(val, capacity), _grow(valid, capacity),
+                _grow(order, capacity, fill=_I64_MAX)]
+
+    def update(self, state, slots, value, validity, mask, order=None):
+        if self.host:
+            vals = value.to_pylist()
+            d = state[0]
+            order_np = np.asarray(order)
+            for i, v in enumerate(vals):
+                if not mask[i]:
+                    continue
+                if self.ignores_null and v is None:
+                    continue
+                s = int(slots[i])
+                o = int(order_np[i])
+                cur = d.get(s)
+                if cur is None or o < cur[0]:
+                    d[s] = (o, v)
+            return [d, None, None]
+        val, valid, best = state
+        m = (validity & mask) if self.ignores_null else mask
+        o = jnp.where(m, order, _I64_MAX)
+        best = best.at[slots].min(o, mode="drop")
+        win = m & (o == best.at[slots].get(mode="fill", fill_value=_I64_MAX))
+        val = _scatter_where(val, slots, value.astype(val.dtype), win)
+        valid = _scatter_where(valid, slots, validity & m, win)
+        return [val, valid, best]
+
+    def merge(self, state, slots, partial_cols, mask, n):
+        pval, pvalid, porder = partial_cols
+        if self.host:
+            d = state[0]
+            vals = pval.array.to_pylist() if isinstance(pval, HostColumn) else \
+                np.asarray(pval.data[:n]).tolist()
+            orders, _ = _col_np(porder, n, np.int64)
+            pv, _ = _col_np(pvalid, n, np.bool_)
+            for i in range(n):
+                if not mask[i] or orders[i] == _I64_MAX:
+                    continue
+                s = int(slots[i])
+                o = int(orders[i])
+                v = vals[i] if pv[i] else None
+                cur = d.get(s)
+                if cur is None or o < cur[0]:
+                    d[s] = (o, v)
+            return [d, None, None]
+        val, valid, best = state
+        m = mask & (porder.data != _I64_MAX)
+        o = jnp.where(m, porder.data, _I64_MAX)
+        best = best.at[slots].min(o, mode="drop")
+        win = m & (o == best.at[slots].get(mode="fill", fill_value=_I64_MAX))
+        val = _scatter_where(val, slots, pval.data.astype(val.dtype), win)
+        valid = _scatter_where(valid, slots, pval.validity & phas_true(pvalid) & win, win)
+        return [val, valid, best]
+
+    def state_columns(self, state, num_slots, capacity):
+        if self.host:
+            d = state[0]
+            vals = [d[i][1] if i in d else None for i in range(num_slots)]
+            has = [i in d for i in range(num_slots)]
+            orders = [d[i][0] if i in d else _I64_MAX for i in range(num_slots)]
+            return [
+                HostColumn(self.result_type, pa.array(vals, type=T.to_arrow_type(self.result_type))),
+                HostColumn(T.BOOL, pa.array(has, type=pa.bool_())),
+                HostColumn(T.I64, pa.array(orders, type=pa.int64())),
+            ]
+        val, valid, best = self.grow(state, capacity)
+        ones = jnp.ones(capacity, bool)
+        return [
+            DeviceColumn(self.result_type, val, valid),
+            DeviceColumn(T.BOOL, valid, ones),
+            DeviceColumn(T.I64, best, ones),
+        ]
+
+    def final_column(self, state, num_slots, capacity):
+        return self.state_columns(state, num_slots, capacity)[0]
+
+    def mem_used(self, state):
+        if self.host:
+            return 96 * len(state[0])
+        return super().mem_used(state)
+
+
+def phas_true(pvalid):
+    return pvalid.data.astype(bool) & pvalid.validity
+
+
+def _scatter_where(arr, slots, values, cond):
+    """arr[slots[i]] = values[i] where cond[i] (losers write out of range and
+    are dropped)."""
+    n = arr.shape[0]
+    safe_slots = jnp.where(cond, slots, n)
+    return arr.at[safe_slots].set(values, mode="drop")
+
+
+class CollectAgg(AggFunction):
+    """collect_list / collect_set — per-slot python lists (reference:
+    agg/collect.rs)."""
+
+    def __init__(self, agg, arg_type, result_type, distinct: bool):
+        super().__init__(agg, arg_type, result_type)
+        self.distinct = distinct
+        self.host = True
+
+    def state_fields(self):
+        return [("items", T.ArrayType(self.arg_type))]
+
+    def init_state(self, capacity):
+        return [dict()]
+
+    def grow(self, state, capacity):
+        return state
+
+    def update(self, state, slots, value, validity, mask, order=None):
+        (d,) = state
+        vals = value.to_pylist()
+        for i, v in enumerate(vals):
+            if not mask[i] or v is None:
+                continue
+            s = int(slots[i])
+            lst = d.setdefault(s, [])
+            if not self.distinct or v not in lst:
+                lst.append(v)
+        return [d]
+
+    def merge(self, state, slots, partial_cols, mask, n):
+        (plist,) = partial_cols
+        return self._union_rows(state, slots, plist.array.to_pylist(), mask)
+
+    def _union_rows(self, state, slots, rows, mask):
+        (d,) = state
+        for i, items in enumerate(rows):
+            if not mask[i] or items is None:
+                continue
+            s = int(slots[i])
+            lst = d.setdefault(s, [])
+            for v in items:
+                if v is None:
+                    continue
+                if not self.distinct or v not in lst:
+                    lst.append(v)
+        return [d]
+
+    def state_columns(self, state, num_slots, capacity):
+        (d,) = state
+        vals = [d.get(i, []) for i in range(num_slots)]
+        at = pa.large_list(T.to_arrow_type(self.arg_type))
+        return [HostColumn(T.ArrayType(self.arg_type), pa.array(vals, type=at))]
+
+    def final_column(self, state, num_slots, capacity):
+        return self.state_columns(state, num_slots, capacity)[0]
+
+    def mem_used(self, state):
+        (d,) = state
+        return sum(64 + 16 * len(v) for v in d.values())
+
+
+class CombineUniqueAgg(CollectAgg):
+    """brickhouse combine_unique: the argument column holds ARRAYS; the
+    aggregate unions their elements per group, deduped (reference:
+    agg/brickhouse.rs combine_unique over UserDefinedArray states)."""
+
+    def __init__(self, agg, arg_type, result_type):
+        elem = arg_type.element_type if isinstance(arg_type, T.ArrayType) else arg_type
+        super().__init__(agg, elem, T.ArrayType(elem), distinct=True)
+
+    def update(self, state, slots, value, validity, mask, order=None):
+        return self._union_rows(state, slots, value.to_pylist(), mask)
+
+
+class BloomFilterAgg(AggFunction):
+    """bloom_filter aggregate building a Spark-compatible bloom filter over
+    int64 values (reference: agg/bloom_filter.rs + spark_bloom_filter.rs)."""
+
+    def __init__(self, agg, arg_type, result_type, expected_items: int = 1_000_000,
+                 num_bits: int = 8_388_608):
+        super().__init__(agg, arg_type, T.BINARY)
+        self.expected_items = expected_items
+        self.num_bits = num_bits
+        self.host = True
+
+    def state_fields(self):
+        return [("bloom", T.BINARY)]
+
+    def init_state(self, capacity):
+        from blaze_tpu.ops.bloom import SparkBloomFilter
+
+        return [{0: SparkBloomFilter.create(self.expected_items, self.num_bits)}]
+
+    def grow(self, state, capacity):
+        return state
+
+    def update(self, state, slots, value, validity, mask, order=None):
+        (d,) = state
+        vals, valid = _arr_np(value, np.int64) if isinstance(value, pa.Array) else (
+            np.asarray(value), np.asarray(validity))
+        m = valid & np.asarray(mask)[: len(vals)]
+        d[0].put_longs(vals[m])
+        return [d]
+
+    def merge(self, state, slots, partial_cols, mask, n):
+        from blaze_tpu.ops.bloom import SparkBloomFilter
+
+        (pcol,) = partial_cols
+        (d,) = state
+        for blob in pcol.array.to_pylist():
+            if blob is not None:
+                d[0].merge(SparkBloomFilter.deserialize(blob))
+        return [d]
+
+    def state_columns(self, state, num_slots, capacity):
+        (d,) = state
+        blob = d[0].serialize()
+        return [HostColumn(T.BINARY, pa.array([blob] * num_slots, type=pa.large_binary()))]
+
+    def final_column(self, state, num_slots, capacity):
+        return self.state_columns(state, num_slots, capacity)[0]
+
+    def mem_used(self, state):
+        (d,) = state
+        return d[0].words.nbytes
+
+
+class UDAFAgg(AggFunction):
+    """Python UDAF: object with initialize()/update(acc, value)/merge(a, b)/
+    evaluate(acc) — the host-callback analogue of the reference's
+    SparkUDAFWrapperContext JNI round-trip."""
+
+    def __init__(self, agg, arg_type, result_type):
+        super().__init__(agg, arg_type, result_type)
+        self.udaf = agg.udaf
+        self.host = True
+
+    def state_fields(self):
+        return [("acc", T.BINARY)]
+
+    def init_state(self, capacity):
+        return [dict()]
+
+    def grow(self, state, capacity):
+        return state
+
+    def update(self, state, slots, value, validity, mask, order=None):
+        (d,) = state
+        vals = value.to_pylist()
+        for i, v in enumerate(vals):
+            if not mask[i]:
+                continue
+            s = int(slots[i])
+            if s not in d:
+                d[s] = self.udaf.initialize()
+            d[s] = self.udaf.update(d[s], v)
+        return [d]
+
+    def merge(self, state, slots, partial_cols, mask, n):
+        import pickle
+
+        (pcol,) = partial_cols
+        (d,) = state
+        for i, blob in enumerate(pcol.array.to_pylist()):
+            if not mask[i] or blob is None:
+                continue
+            s = int(slots[i])
+            other = pickle.loads(blob)
+            if s not in d:
+                d[s] = self.udaf.initialize()
+            d[s] = self.udaf.merge(d[s], other)
+        return [d]
+
+    def state_columns(self, state, num_slots, capacity):
+        import pickle
+
+        (d,) = state
+        vals = [pickle.dumps(d[i]) if i in d else None for i in range(num_slots)]
+        return [HostColumn(T.BINARY, pa.array(vals, type=pa.large_binary()))]
+
+    def final_column(self, state, num_slots, capacity):
+        (d,) = state
+        vals = [self.udaf.evaluate(d[i]) if i in d else None for i in range(num_slots)]
+        return HostColumn(self.result_type,
+                          pa.array(vals, type=T.to_arrow_type(self.result_type)))
+
+
+def create_agg_function(agg: E.AggExpr, input_schema: T.Schema) -> AggFunction:
+    arg_t = E.infer_type(agg.args[0], input_schema) if agg.args else T.NULL
+    result_t = agg.return_type or E.agg_result_type(agg.fn, arg_t)
+    F = E.AggFunction
+    if agg.fn == F.SUM:
+        return SumAgg(agg, arg_t, result_t)
+    if agg.fn == F.COUNT:
+        return CountAgg(agg, arg_t, T.I64)
+    if agg.fn == F.AVG:
+        return AvgAgg(agg, arg_t, result_t)
+    if agg.fn == F.MIN:
+        return MinMaxAgg(agg, arg_t, result_t, "min")
+    if agg.fn == F.MAX:
+        return MinMaxAgg(agg, arg_t, result_t, "max")
+    if agg.fn == F.FIRST:
+        return FirstAgg(agg, arg_t, result_t, ignores_null=False)
+    if agg.fn == F.FIRST_IGNORES_NULL:
+        return FirstAgg(agg, arg_t, result_t, ignores_null=True)
+    if agg.fn == F.COLLECT_LIST:
+        return CollectAgg(agg, arg_t, result_t, distinct=False)
+    if agg.fn == F.COLLECT_SET:
+        return CollectAgg(agg, arg_t, result_t, distinct=True)
+    if agg.fn == F.BRICKHOUSE_COLLECT:
+        return CollectAgg(agg, arg_t, result_t, distinct=False)
+    if agg.fn == F.BRICKHOUSE_COMBINE_UNIQUE:
+        return CombineUniqueAgg(agg, arg_t, result_t)
+    if agg.fn == F.BLOOM_FILTER:
+        return BloomFilterAgg(agg, arg_t, result_t)
+    if agg.fn == F.UDAF:
+        return UDAFAgg(agg, arg_t, result_t)
+    raise NotImplementedError(f"agg function {agg.fn}")

@@ -1,0 +1,113 @@
+"""Operator protocol and per-task execution context.
+
+Reference: DataFusion ``ExecutionPlan`` impls driven by
+``ExecutionContext`` (``datafusion-ext-plans/src/common/execution_context.rs:69``)
+— execute/coalesce/stat/output_with_sender/cancel. Here an operator is a
+schema-carrying object whose ``execute(partition, ctx)`` returns a python
+generator of ColumnarBatches; generators give us the same pull-based
+streaming the reference gets from tokio streams, with cooperative
+cancellation checked between batches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, Iterator, List, Optional
+
+from blaze_tpu.config import Config, get_config
+from blaze_tpu.core.batch import ColumnarBatch
+from blaze_tpu.ir import types as T
+from blaze_tpu.runtime.metrics import MetricNode
+
+
+class TaskCancelled(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class TaskContext:
+    """Identity of one task: (stage, partition, attempt) — reference:
+    TaskDefinition/PartitionId in auron.proto:729-740."""
+
+    stage_id: int = 0
+    partition_id: int = 0
+    task_id: int = 0
+
+
+class ExecContext:
+    """Per-task context handed to every operator: conf, metrics root, memory
+    manager, the resource map (reference: JniBridge.resourcesMap), and the
+    cooperative-cancellation flag (reference: is_task_running)."""
+
+    def __init__(
+        self,
+        task: Optional[TaskContext] = None,
+        conf: Optional[Config] = None,
+        metrics: Optional[MetricNode] = None,
+        resources: Optional[Dict[str, Any]] = None,
+        mem_manager=None,
+    ):
+        self.task = task or TaskContext()
+        self.conf = conf or get_config()
+        self.metrics = metrics or MetricNode("root")
+        self.resources = resources if resources is not None else {}
+        self._cancelled = threading.Event()
+        if mem_manager is None:
+            from blaze_tpu.runtime.memmgr import MemManager
+
+            mem_manager = MemManager.get_or_init(self.conf)
+        self.mem = mem_manager
+
+    def cancel(self):
+        self._cancelled.set()
+
+    @property
+    def is_cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    def check_cancelled(self):
+        if self.is_cancelled:
+            raise TaskCancelled(f"task {self.task} cancelled")
+
+
+class Operator:
+    """Base operator. Subclasses set ``schema`` and ``children`` and implement
+    ``_execute``; the base wraps it with batch/row counting and cancellation."""
+
+    schema: T.Schema
+    children: List["Operator"]
+
+    def __init__(self, schema: T.Schema, children: List["Operator"]):
+        self.schema = schema
+        self.children = children
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def num_partitions(self) -> int:
+        if self.children:
+            return self.children[0].num_partitions()
+        return 1
+
+    def execute(self, partition: int, ctx: ExecContext, metrics: Optional[MetricNode] = None
+                ) -> Iterator[ColumnarBatch]:
+        node = metrics if metrics is not None else ctx.metrics
+        node.name = self.name
+        for batch in self._execute(partition, ctx, node):
+            ctx.check_cancelled()
+            node.add("output_rows", batch.num_rows)
+            node.add("output_batches", 1)
+            yield batch
+
+    def _execute(self, partition: int, ctx: ExecContext, metrics: MetricNode
+                 ) -> Iterator[ColumnarBatch]:
+        raise NotImplementedError
+
+    def execute_child(self, i: int, partition: int, ctx: ExecContext,
+                      metrics: MetricNode) -> Iterator[ColumnarBatch]:
+        return self.children[i].execute(partition, ctx, metrics.child(i))
+
+    def __repr__(self):
+        return f"{self.name}({', '.join(repr(c) for c in self.children)})"

@@ -1,0 +1,326 @@
+"""Join-key canonicalization and the build-side hash map.
+
+Reference: ``joins/join_hash_map.rs:44-284`` — an open-addressing table over
+packed MapValues with SIMD-ish probing, serializable for broadcast. The TPU
+re-design (SURVEY.md §7.4.2): random-access hash probing is hostile to the
+device, so keys are interned on host exactly like the aggregation path —
+vectorized per-batch dedup (``np.unique`` over the packed key matrix, C
+speed) with dict lookups only on per-batch *distinct* keys — and the build
+side becomes a CSR layout (slot -> contiguous build-row range) that turns
+probing into vectorized gather/repeat, which the device executes well.
+
+Null join keys never match (Spark equi-join semantics): rows with any null
+key get code -1 on both sides."""
+
+from __future__ import annotations
+
+import functools
+import pickle
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from blaze_tpu.core.batch import Column, ColumnarBatch, DeviceColumn
+from blaze_tpu.exprs.compiler import ExprEvaluator
+from blaze_tpu.ir import exprs as E
+
+
+def key_codes(batch: ColumnarBatch, cols: List[Column], key_map: Dict,
+              insert: bool) -> np.ndarray:
+    """Map each row's key tuple to an integer code. ``insert`` adds unseen
+    keys (build side); otherwise unseen -> -1 (probe side). Rows with any
+    null key always get -1."""
+    n = batch.num_rows
+    if n == 0:
+        return np.empty(0, dtype=np.int64)
+    all_device = all(isinstance(c, DeviceColumn) for c in cols)
+    if all_device:
+        from blaze_tpu.utils.device import pull_columns
+
+        pulled = pull_columns(cols, n)
+        mats = []
+        null_any = np.zeros(n, dtype=bool)
+        for c, (data, valid) in zip(cols, pulled):
+            null_any |= ~valid
+            if data.dtype == np.float64:
+                d = np.where(valid, data, 0.0)
+                # canonicalize before viewing bits: -0.0 -> +0.0 and every
+                # NaN payload -> the quiet NaN, so float keys match by Spark
+                # equality (not bit pattern) even without a frontend
+                # normalize_nan_and_zero projection
+                d = np.where(d == 0.0, 0.0, d)
+                d = np.where(np.isnan(d), np.float64(np.nan), d)
+                d64 = d.view(np.int64)
+            elif data.dtype == np.float32:
+                d = np.where(valid, data, np.float32(0))
+                d = np.where(d == np.float32(0), np.float32(0), d)
+                d = np.where(np.isnan(d), np.float32(np.nan), d)
+                d64 = d.view(np.int32).astype(np.int64)
+            else:
+                d64 = np.where(valid, data, 0).astype(np.int64)
+            mats.append(d64)
+        mat = np.column_stack(mats)
+        view = np.ascontiguousarray(mat).view(
+            np.dtype((np.void, mat.dtype.itemsize * mat.shape[1]))).ravel()
+        uniq, inverse = np.unique(view, return_inverse=True)
+        lut = np.empty(len(uniq), dtype=np.int64)
+        for i, u in enumerate(uniq):
+            kb = u.tobytes()
+            code = key_map.get(kb)
+            if code is None:
+                if insert:
+                    code = len(key_map)
+                    key_map[kb] = code
+                else:
+                    code = -1
+            lut[i] = code
+        codes = lut[inverse]
+        codes[null_any] = -1
+        return codes
+    # host path: canonical python tuples
+    def _canon(v):
+        if isinstance(v, float):
+            if v != v:
+                return float("nan")  # one canonical NaN payload
+            if v == 0.0:
+                return 0.0  # fold -0.0
+        return v
+
+    pylists = [c.to_arrow(n).to_pylist() for c in cols]
+    codes = np.empty(n, dtype=np.int64)
+    for i in range(n):
+        key = tuple(_canon(pl[i]) for pl in pylists)
+        if any(v is None for v in key):
+            codes[i] = -1
+            continue
+        kb = pickle.dumps(key, protocol=4)
+        code = key_map.get(kb)
+        if code is None:
+            if insert:
+                code = len(key_map)
+                key_map[kb] = code
+            else:
+                code = -1
+        codes[i] = code
+    return codes
+
+
+def _canon_words(data: np.ndarray) -> np.ndarray:
+    """Numpy values -> canonical int64 key words (floats: -0.0 folded,
+    NaN payloads unified — Spark float equality, see key_codes)."""
+    if data.dtype == np.float64:
+        d = np.where(data == 0.0, 0.0, data)
+        d = np.where(np.isnan(d), np.float64(np.nan), d)
+        return d.view(np.int64)
+    if data.dtype == np.float32:
+        d = np.where(data == np.float32(0), np.float32(0), data)
+        d = np.where(np.isnan(d), np.float32(np.nan), d)
+        return d.view(np.int32).astype(np.int64)
+    return data.astype(np.int64)
+
+
+@functools.lru_cache(maxsize=None)
+def _probe_fn(dtype_str: str, nk: int):
+    """Module-level cache: one jitted probe per (dtype, key count) — a
+    per-call closure would recompile for every probe batch."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def probe(uniq, d, v):
+        if jnp.issubdtype(d.dtype, jnp.floating):
+            d = jnp.where(d == 0, jnp.zeros((), d.dtype), d)
+            d = jnp.where(jnp.isnan(d), jnp.array(float("nan"), d.dtype), d)
+            if d.dtype == jnp.float32:
+                w = d.view(jnp.int32).astype(jnp.int64)
+            else:
+                w = d.view(jnp.int64)
+        else:
+            w = d.astype(jnp.int64)
+        idx = jnp.searchsorted(uniq, w)
+        cidx = jnp.clip(idx, 0, max(nk - 1, 0))
+        hit = v & (idx < nk) & (uniq[cidx] == w)
+        return jnp.where(hit, idx, -1)
+
+    return probe
+
+
+def _searchsorted_probe(sorted_keys, data, validity, n_keys: int):
+    """Jitted device probe: canonical word -> rank in sorted_keys or -1."""
+    return _probe_fn(str(data.dtype), n_keys)(sorted_keys, data, validity)
+
+
+class JoinHashMap:
+    """Build-side map: key code -> contiguous range of build rows (CSR over
+    the concatenated, code-sorted build batch).
+
+    Two code assignments share the CSR layout:
+
+    - **device probe** (single fixed-width key): codes are ranks in the
+      SORTED unique-key array; the probe looks keys up with a jitted
+      ``searchsorted`` on device — no per-row host work (reference analogue:
+      the prefetched group-of-8 probe of ``joins/join_hash_map.rs:44-284``,
+      re-designed as binary search per SURVEY.md §7.2 L2').
+    - **host interning** (multi-column / var-width keys): vectorized
+      ``np.unique`` dedup + dict lookups on per-batch distincts.
+    """
+
+    def __init__(self, batch: ColumnarBatch, key_map: Optional[Dict],
+                 offsets: np.ndarray, schema,
+                 sorted_keys: Optional[np.ndarray] = None):
+        self.batch = batch          # build rows sorted by key code
+        self.key_map = key_map
+        self.offsets = offsets      # (num_codes + 1,) row ranges
+        self.schema = schema
+        self.sorted_keys = sorted_keys  # device-probe path: sorted unique keys
+        # one-element cell so per-task copies of a cached map SHARE the
+        # device-resident sorted-key upload (one transfer per executor, not
+        # one per probe task)
+        self._dev_cell = [None]
+        self.matched = np.zeros(batch.num_rows, dtype=bool)
+
+    @property
+    def num_codes(self) -> int:
+        return len(self.offsets) - 1
+
+    @staticmethod
+    def build(batches: List[ColumnarBatch], key_exprs: List[E.Expr],
+              schema) -> "JoinHashMap":
+        key_cols = []
+        kept = []
+        for b in batches:
+            if b.num_rows == 0:
+                continue
+            ev = ExprEvaluator(key_exprs, b.schema)
+            key_cols.append(ev.evaluate(b))
+            kept.append(b)
+        if not kept:
+            empty = ColumnarBatch.empty(schema)
+            return JoinHashMap(empty, {}, np.zeros(1, np.int64), schema)
+        if len(key_exprs) == 1 and all(
+                isinstance(cols[0], DeviceColumn) for cols in key_cols):
+            return JoinHashMap._build_sorted(kept, key_cols, schema)
+        key_map: Dict = {}
+        code_arrays = [key_codes(b, cols, key_map, insert=True)
+                       for b, cols in zip(kept, key_cols)]
+        big = ColumnarBatch.concat(kept, schema)
+        codes = np.concatenate(code_arrays)
+        ncodes = len(key_map)
+        return JoinHashMap._from_codes(big, codes, ncodes, key_map, None, schema)
+
+    @staticmethod
+    def _build_sorted(kept, key_cols, schema) -> "JoinHashMap":
+        """Single fixed-width key: codes are ranks in the sorted unique-key
+        array (canonical int64 words), enabling the device searchsorted
+        probe."""
+        from blaze_tpu.utils.device import pull_columns
+
+        words = []
+        valids = []
+        for b, cols in zip(kept, key_cols):
+            (data, valid), = pull_columns(cols, b.num_rows)
+            words.append(_canon_words(data))
+            valids.append(valid)
+        big = ColumnarBatch.concat(kept, schema)
+        w = np.concatenate(words)
+        v = np.concatenate(valids)
+        uniq = np.unique(w[v])
+        codes = np.searchsorted(uniq, w)
+        codes = np.where(v & (codes < len(uniq)) &
+                         (uniq[np.clip(codes, 0, max(len(uniq) - 1, 0))] == w),
+                         codes, -1) if len(uniq) else np.full(len(w), -1)
+        return JoinHashMap._from_codes(big, codes, len(uniq), None, uniq, schema)
+
+    @staticmethod
+    def _from_codes(big, codes, ncodes, key_map, sorted_keys, schema):
+        # null-keyed build rows (-1) can never match: give them code
+        # num_codes so they sort to the tail outside every CSR range
+        codes = np.where(codes < 0, ncodes, codes)
+        order = np.argsort(codes, kind="stable")
+        sorted_codes = codes[order]
+        big = big.take(order)
+        counts = np.bincount(sorted_codes, minlength=ncodes + 1)[: ncodes + 1]
+        offsets = np.zeros(ncodes + 1, dtype=np.int64)
+        np.cumsum(counts[:ncodes], out=offsets[1:])
+        return JoinHashMap(big, key_map, offsets, schema, sorted_keys)
+
+    def probe_codes(self, batch: ColumnarBatch, cols: List[Column]) -> Tuple[np.ndarray, bool]:
+        """Row key -> code for this map; returns (codes, used_device_probe)."""
+        if self.sorted_keys is not None and len(cols) == 1 and \
+                isinstance(cols[0], DeviceColumn):
+            return self._device_probe(batch, cols[0]), True
+        if self.key_map is None:
+            # sorted-key map probed host-side (single fixed-width key whose
+            # probe column happens to live on host): same canonical words,
+            # numpy searchsorted
+            from blaze_tpu.core.batch import arrow_fixed_planes
+
+            assert len(cols) == 1
+            data, valid = arrow_fixed_planes(
+                cols[0].to_arrow(batch.num_rows), cols[0].dtype)
+            w = _canon_words(data)
+            uniq = self.sorted_keys
+            if len(uniq) == 0:
+                return np.full(batch.num_rows, -1, np.int64), False
+            codes = np.searchsorted(uniq, w)
+            hit = valid & (codes < len(uniq)) & \
+                (uniq[np.clip(codes, 0, len(uniq) - 1)] == w)
+            return np.where(hit, codes, -1), False
+        return key_codes(batch, cols, self.key_map, insert=False), False
+
+    def _device_probe(self, batch: ColumnarBatch, col: DeviceColumn) -> np.ndarray:
+        import jax.numpy as jnp
+
+        if self._dev_cell[0] is None:
+            self._dev_cell[0] = jnp.asarray(
+                self.sorted_keys if len(self.sorted_keys)
+                else np.zeros(1, np.int64))
+        codes = _searchsorted_probe(
+            self._dev_cell[0], col.data, col.validity,
+            len(self.sorted_keys))
+        return np.asarray(codes)[: batch.num_rows]
+
+    def probe(self, codes: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """codes (n,) -> (probe_idx, build_idx, match_counts): all matching
+        row pairs, vectorized."""
+        valid = (codes >= 0) & (codes < self.num_codes)
+        safe = np.where(valid, codes, 0)
+        starts = self.offsets[safe]
+        ends = self.offsets[safe + 1]
+        counts = np.where(valid, ends - starts, 0)
+        total = int(counts.sum())
+        if total == 0:
+            return (np.empty(0, np.int64), np.empty(0, np.int64), counts)
+        probe_idx = np.repeat(np.arange(len(codes)), counts)
+        base = np.repeat(np.cumsum(counts) - counts, counts)
+        build_idx = np.repeat(starts, counts) + (np.arange(total) - base)
+        return probe_idx, build_idx, counts
+
+    # -- broadcast serialization (reference: JoinHashMap::try_into_bytes) -----
+
+    def serialize(self) -> bytes:
+        import io
+
+        from blaze_tpu.io.batch_serde import BatchWriter
+
+        buf = io.BytesIO()
+        BatchWriter(buf).write_batch(self.batch)
+        payload = {
+            "key_map": self.key_map,
+            "offsets": self.offsets,
+            "sorted_keys": self.sorted_keys,
+            "batch": buf.getvalue(),
+        }
+        return pickle.dumps(payload, protocol=4)
+
+    @staticmethod
+    def deserialize(blob: bytes, schema) -> "JoinHashMap":
+        import io
+
+        from blaze_tpu.io.batch_serde import BatchReader
+
+        payload = pickle.loads(blob)
+        batches = list(BatchReader(io.BytesIO(payload["batch"])))
+        batch = batches[0] if batches else ColumnarBatch.empty(schema)
+        return JoinHashMap(batch, payload["key_map"], payload["offsets"], schema,
+                           payload.get("sorted_keys"))

@@ -1,0 +1,301 @@
+"""Sort-merge join: streaming cursors over key-sorted inputs.
+
+Reference: ``sort_merge_join_exec.rs:57-375`` + ``joins/smj/*.rs`` +
+``joins/stream_cursor.rs`` — inner/left/right/full/semi/anti/existence over
+StreamCursors that advance equal-key runs. Here cursors compare host
+key-tuples (total order incl. null rank, shared with the sort operator) and
+each equal-key run pair emits its cross product via vectorized gathers;
+rows with null join keys never match (Spark equi-join semantics)."""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from blaze_tpu.core.batch import ColumnarBatch, DeviceColumn
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import types as T
+from blaze_tpu.ir.nodes import JoinType, _join_output_schema
+from blaze_tpu.ops import sort_keys as SK
+from blaze_tpu.ops.base import Operator
+
+
+class _SideCursor:
+    """Iterates a sorted child as (key_tuple, rows) runs; a run's rows may
+    span batches (reference: StreamCursor)."""
+
+    def __init__(self, batch_iter, key_exprs: List[E.Expr],
+                 sort_options: List[Tuple[bool, bool]], schema):
+        self.it = batch_iter
+        self.orders = [
+            E.SortOrder(e, asc, nf) for e, (asc, nf) in zip(key_exprs, sort_options)
+        ]
+        self.schema = schema
+        self.batch: Optional[ColumnarBatch] = None
+        self.keys: Optional[list] = None
+        self.pos = 0
+        self.exhausted = False
+        self._advance_batch()
+
+    def _advance_batch(self) -> bool:
+        for b in self.it:
+            if b.num_rows == 0:
+                continue
+            self.batch = b
+            self.keys = SK.host_keys_matrix(b, self.orders)
+            self.pos = 0
+            return True
+        self.batch = None
+        self.exhausted = True
+        return False
+
+    def peek_key(self):
+        return self.keys[self.pos]
+
+    def key_is_null(self) -> bool:
+        return any(part[0] != 1 for part in self.peek_key())
+
+    def next_run(self) -> Tuple[tuple, List[Tuple[ColumnarBatch, int, int]]]:
+        """Pop the run of rows equal to the current key."""
+        key = self.peek_key()
+        segments = []
+        while True:
+            start = self.pos
+            n = self.batch.num_rows
+            while self.pos < n and self.keys[self.pos] == key:
+                self.pos += 1
+            if self.pos > start:
+                segments.append((self.batch, start, self.pos))
+            if self.pos < n:
+                return key, segments
+            if not self._advance_batch():
+                return key, segments
+
+    def skip_nulls(self) -> List[Tuple[ColumnarBatch, int, int]]:
+        """Pop all leading null-keyed rows (they sort together at the null
+        rank); returns their segments for outer emission."""
+        segments = []
+        while not self.exhausted and self.key_is_null():
+            _, segs = self.next_run()
+            segments.extend(segs)
+        return segments
+
+
+def _materialize(segments: List[Tuple[ColumnarBatch, int, int]], schema) -> ColumnarBatch:
+    parts = [b.slice(s, e - s) for b, s, e in segments]
+    return ColumnarBatch.concat(parts, schema)
+
+
+class SortMergeJoinExec(Operator):
+    def __init__(self, left: Operator, right: Operator,
+                 on: List[Tuple[E.Expr, E.Expr]], join_type: JoinType,
+                 sort_options: Optional[List[Tuple[bool, bool]]] = None,
+                 condition: Optional[E.Expr] = None):
+        self.on = on
+        self.join_type = join_type
+        self.sort_options = sort_options or [(True, True)] * len(on)
+        # extra non-equi condition over left+right columns (reference: SMJ
+        # inequality-join option); key-matched pairs failing it are unmatched
+        self.condition = condition
+        self._pair_schema = left.schema + right.schema
+        schema = _join_output_schema(left.schema, right.schema, join_type)
+        super().__init__(schema, [left, right])
+
+    def num_partitions(self):
+        return self.children[0].num_partitions()
+
+    def _execute(self, partition, ctx, metrics):
+        jt = self.join_type
+        lcur = _SideCursor(self.execute_child(0, partition, ctx, metrics),
+                           [l for l, _ in self.on], self.sort_options,
+                           self.children[0].schema)
+        rcur = _SideCursor(self.execute_child(1, partition, ctx, metrics),
+                           [r for _, r in self.on], self.sort_options,
+                           self.children[1].schema)
+        emitter = _Emitter(self, ctx.conf.batch_size)
+
+        keep_left_unmatched = jt in (JoinType.LEFT, JoinType.FULL,
+                                     JoinType.LEFT_ANTI, JoinType.EXISTENCE)
+        keep_right_unmatched = jt in (JoinType.RIGHT, JoinType.FULL,
+                                      JoinType.RIGHT_ANTI)
+
+        while not lcur.exhausted or not rcur.exhausted:
+            # null-keyed rows can never match: treat as unmatched
+            lnull = lcur.skip_nulls() if not lcur.exhausted else []
+            rnull = rcur.skip_nulls() if not rcur.exhausted else []
+            if lnull and keep_left_unmatched:
+                yield from emitter.left_unmatched(_materialize(lnull, lcur.schema))
+            if rnull and keep_right_unmatched:
+                yield from emitter.right_unmatched(_materialize(rnull, rcur.schema))
+            if lcur.exhausted and rcur.exhausted:
+                break
+            if lcur.exhausted:
+                if keep_right_unmatched:
+                    _, segs = rcur.next_run()
+                    yield from emitter.right_unmatched(_materialize(segs, rcur.schema))
+                else:
+                    rcur.next_run()
+                continue
+            if rcur.exhausted:
+                if keep_left_unmatched:
+                    _, segs = lcur.next_run()
+                    yield from emitter.left_unmatched(_materialize(segs, lcur.schema))
+                else:
+                    lcur.next_run()
+                continue
+            lk, rk = lcur.peek_key(), rcur.peek_key()
+            if lk < rk:
+                _, segs = lcur.next_run()
+                if keep_left_unmatched:
+                    yield from emitter.left_unmatched(_materialize(segs, lcur.schema))
+            elif rk < lk:
+                _, segs = rcur.next_run()
+                if keep_right_unmatched:
+                    yield from emitter.right_unmatched(_materialize(segs, rcur.schema))
+            else:
+                _, lsegs = lcur.next_run()
+                _, rsegs = rcur.next_run()
+                lrun = _materialize(lsegs, lcur.schema)
+                rrun = _materialize(rsegs, rcur.schema)
+                yield from emitter.matched(lrun, rrun)
+        yield from emitter.flush()
+
+
+class _Emitter:
+    """Join-type-aware output assembly with batch-size buffering."""
+
+    def __init__(self, op: SortMergeJoinExec, batch_size: int):
+        self.op = op
+        self.batch_size = batch_size
+        self.buf: List[ColumnarBatch] = []
+        self.rows = 0
+        if op.condition is not None:
+            from blaze_tpu.exprs.compiler import ExprEvaluator
+
+            # one evaluator for all runs: keeps the CSE/jit caches warm
+            self.cond_ev = ExprEvaluator([op.condition], op._pair_schema)
+
+    def _push(self, batch: Optional[ColumnarBatch]):
+        if batch is None or batch.num_rows == 0:
+            return
+        self.buf.append(batch)
+        self.rows += batch.num_rows
+        while self.rows >= self.batch_size:
+            merged = ColumnarBatch.concat(self.buf, self.op.schema)
+            out, rest = merged.slice(0, self.batch_size), merged.slice(
+                self.batch_size, merged.num_rows)
+            self.buf = [rest] if rest.num_rows else []
+            self.rows = rest.num_rows
+            yield out
+
+    def flush(self):
+        if self.buf:
+            yield ColumnarBatch.concat(self.buf, self.op.schema)
+            self.buf, self.rows = [], 0
+
+    # -- emission by join type ------------------------------------------------
+
+    def matched(self, lrun: ColumnarBatch, rrun: ColumnarBatch):
+        jt = self.op.join_type
+        nl, nr = lrun.num_rows, rrun.num_rows
+        cond = self.op.condition
+        if cond is None:
+            # no pair expansion for the non-pair join types (a skewed run
+            # would otherwise allocate O(nl*nr) just to learn "all matched")
+            if jt == JoinType.LEFT_SEMI:
+                yield from self._push(lrun)
+                return
+            if jt == JoinType.RIGHT_SEMI:
+                yield from self._push(rrun)
+                return
+            if jt in (JoinType.LEFT_ANTI, JoinType.RIGHT_ANTI):
+                return
+            if jt == JoinType.EXISTENCE:
+                yield from self._push(
+                    self._with_exists(lrun, np.ones(nl, dtype=bool)))
+                return
+        li = np.repeat(np.arange(nl), nr)
+        ri = np.tile(np.arange(nr), nl)
+        if cond is not None:
+            lout = lrun.take(li)
+            rout = rrun.take(ri)
+            pair = ColumnarBatch(self.op._pair_schema,
+                                 lout.columns + rout.columns, nl * nr)
+            keep = np.asarray(self.cond_ev.evaluate_predicate(pair))[: nl * nr]
+            li, ri = li[keep], ri[keep]
+        l_matched = np.zeros(nl, dtype=bool)
+        l_matched[li] = True
+        r_matched = np.zeros(nr, dtype=bool)
+        r_matched[ri] = True
+
+        if jt == JoinType.LEFT_SEMI:
+            idx = np.nonzero(l_matched)[0]
+            if len(idx):
+                yield from self._push(lrun.take(idx))
+            return
+        if jt == JoinType.RIGHT_SEMI:
+            idx = np.nonzero(r_matched)[0]
+            if len(idx):
+                yield from self._push(rrun.take(idx))
+            return
+        if jt == JoinType.LEFT_ANTI:
+            idx = np.nonzero(~l_matched)[0]  # condition-failed rows
+            if len(idx):
+                yield from self._push(lrun.take(idx))
+            return
+        if jt == JoinType.RIGHT_ANTI:
+            idx = np.nonzero(~r_matched)[0]
+            if len(idx):
+                yield from self._push(rrun.take(idx))
+            return
+        if jt == JoinType.EXISTENCE:
+            yield from self._push(self._with_exists(lrun, l_matched))
+            return
+        if len(li):
+            lout = lrun.take(li)
+            rout = rrun.take(ri)
+            yield from self._push(
+                ColumnarBatch(self.op.schema, lout.columns + rout.columns, len(li)))
+        # key-matched rows whose every pair failed the condition are
+        # unmatched for outer purposes
+        if cond is not None:
+            lun = np.nonzero(~l_matched)[0]
+            if len(lun):
+                yield from self.left_unmatched(lrun.take(lun))
+            run_ = np.nonzero(~r_matched)[0]
+            if len(run_):
+                yield from self.right_unmatched(rrun.take(run_))
+
+    def left_unmatched(self, lrun: ColumnarBatch):
+        jt = self.op.join_type
+        if jt in (JoinType.LEFT_ANTI,):
+            yield from self._push(lrun)
+            return
+        if jt == JoinType.EXISTENCE:
+            yield from self._push(
+                self._with_exists(lrun, np.zeros(lrun.num_rows, dtype=bool)))
+            return
+        if jt in (JoinType.LEFT, JoinType.FULL):
+            rnulls = ColumnarBatch.empty(self.op.children[1].schema).take_nullable(
+                np.full(lrun.num_rows, -1, np.int64))
+            yield from self._push(
+                ColumnarBatch(self.op.schema, lrun.columns + rnulls.columns,
+                              lrun.num_rows))
+
+    def right_unmatched(self, rrun: ColumnarBatch):
+        jt = self.op.join_type
+        if jt == JoinType.RIGHT_ANTI:
+            yield from self._push(rrun)
+            return
+        if jt in (JoinType.RIGHT, JoinType.FULL):
+            lnulls = ColumnarBatch.empty(self.op.children[0].schema).take_nullable(
+                np.full(rrun.num_rows, -1, np.int64))
+            yield from self._push(
+                ColumnarBatch(self.op.schema, lnulls.columns + rrun.columns,
+                              rrun.num_rows))
+
+    def _with_exists(self, lrun: ColumnarBatch, flags: np.ndarray) -> ColumnarBatch:
+        exists = DeviceColumn.from_numpy(T.BOOL, np.asarray(flags, dtype=bool),
+                                         None, lrun.capacity)
+        return ColumnarBatch(self.op.schema, lrun.columns + [exists], lrun.num_rows)

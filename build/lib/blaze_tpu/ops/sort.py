@@ -1,0 +1,276 @@
+"""External sort: device lexicographic sort + spilled-run merge, with TopK.
+
+Reference: ``sort_exec.rs:88-1608`` — in-memory row-key blocks, loser-tree
+k-way merge of squeezed spill blocks, key pruning, optional fetch limit
+(TopK), and the ``execute_with_key_rows`` fast path shared with SMJ.
+
+TPU design: per-run sorting happens on device via ``jax.lax.sort`` over
+normalized u64 key operands (ops/sort_keys.py) with an index payload; runs
+that exceed the memory budget spill as compressed batch streams with their
+key columns appended; the final pass k-way-merges runs on host. Sorts whose
+keys include var-width columns run on host via arrow sort_indices.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from blaze_tpu.core.batch import ColumnarBatch, DeviceColumn
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import types as T
+from blaze_tpu.ops import sort_keys as SK
+from blaze_tpu.ops.base import ExecContext, Operator
+from blaze_tpu.runtime.memmgr import MemConsumer, SpillFile
+
+def sort_batch(batch: ColumnarBatch, sort_orders: List[E.SortOrder],
+               limit: Optional[int] = None) -> ColumnarBatch:
+    """Sort one batch fully (device path when possible)."""
+    if batch.num_rows <= 1:
+        return batch
+    if SK.supports_device_sort(batch.schema, sort_orders):
+        operands = SK.key_operands(batch, sort_orders)
+        idx = _device_sort_indices(operands, batch.capacity)
+        indices = np.asarray(idx)[: batch.num_rows]
+    else:
+        indices = SK.host_sort_indices(batch, sort_orders)
+    if limit is not None:
+        indices = indices[:limit]
+    return batch.take(indices)
+
+
+def _device_sort_indices(operands: List[jnp.ndarray], capacity: int) -> jnp.ndarray:
+    iota = jnp.arange(capacity, dtype=jnp.int32)
+    sorted_ops = jax.lax.sort(tuple(operands) + (iota,), num_keys=len(operands))
+    return sorted_ops[-1]
+
+
+class SortExec(Operator):
+    def __init__(self, child: Operator, sort_orders: List[E.SortOrder],
+                 fetch_limit: Optional[int] = None):
+        self.sort_orders = sort_orders
+        self.fetch_limit = fetch_limit
+        super().__init__(child.schema, [child])
+
+    def _execute(self, partition, ctx, metrics):
+        if self.fetch_limit is not None and self.fetch_limit <= 100_000:
+            yield from self._execute_topk(partition, ctx, metrics)
+            return
+        yield from self._execute_full(partition, ctx, metrics)
+
+    # -- TopK path (reference: sort with fetch) -------------------------------
+
+    def _execute_topk(self, partition, ctx, metrics):
+        k = self.fetch_limit
+        current: Optional[ColumnarBatch] = None
+        staged: List[ColumnarBatch] = []
+        staged_rows = 0
+        for batch in self.execute_child(0, partition, ctx, metrics):
+            staged.append(batch)
+            staged_rows += batch.num_rows
+            if staged_rows >= max(4 * k, ctx.conf.batch_size):
+                current = self._merge_topk(current, staged, k, metrics)
+                staged, staged_rows = [], 0
+        if staged:
+            current = self._merge_topk(current, staged, k, metrics)
+        if current is not None and current.num_rows > 0:
+            yield current
+
+    def _merge_topk(self, current, staged, k, metrics):
+        with metrics.timer("elapsed_compute"):
+            parts = ([current] if current is not None else []) + staged
+            merged = ColumnarBatch.concat(parts, self.schema)
+            return sort_batch(merged, self.sort_orders, limit=k)
+
+    # -- full sort with spill -------------------------------------------------
+
+    def _execute_full(self, partition, ctx, metrics):
+        device = SK.supports_device_sort(self.children[0].schema, self.sort_orders)
+        state = _SortState(self, ctx, metrics, device)
+        ctx.mem.register(state)
+        try:
+            for batch in self.execute_child(0, partition, ctx, metrics):
+                state.insert(batch)
+            yield from state.output()
+        finally:
+            ctx.mem.unregister(state)
+            state.release()
+
+
+class _SortState(MemConsumer):
+    def __init__(self, op: SortExec, ctx: ExecContext, metrics, device: bool):
+        super().__init__("SortExec", spillable=True)
+        self.op = op
+        self.ctx = ctx
+        self.metrics = metrics
+        self.device = device
+        self.staged: List[ColumnarBatch] = []
+        self.staged_bytes = 0
+        self.runs: List[SpillFile] = []
+
+    def insert(self, batch: ColumnarBatch):
+        self.staged.append(batch)
+        self.staged_bytes += batch.nbytes()
+        self.update_mem_used(self.staged_bytes)
+
+    def spill(self) -> int:
+        if not self.staged:
+            return 0
+        freed = self.staged_bytes
+        run = self._sorted_run()
+        if self.device:
+            # squeeze normalized keys into the spilled run so the merge
+            # phase never re-evaluates sort keys (reference: squeezed key
+            # blocks in sort_exec.rs); u64 keys store order-preserving as
+            # i64 via a sign-bit flip (host-side numpy — no device bitcasts)
+            run = _append_key_columns(run, SK.merge_keys_matrix(run, self.op.sort_orders))
+        spill = SpillFile("sort")
+        with self.metrics.timer("spill_io_time"):
+            spill.writer.write_batch(run)
+            spill.finish_write()
+        self.metrics.add("spilled_bytes", spill.size)
+        self.metrics.add("spill_count", 1)
+        self.runs.append(spill)
+        self.staged, self.staged_bytes = [], 0
+        return freed
+
+    def _sorted_run(self) -> ColumnarBatch:
+        merged = ColumnarBatch.concat(self.staged, self.op.schema)
+        return sort_batch(merged, self.op.sort_orders)
+
+    def output(self) -> Iterator[ColumnarBatch]:
+        batch_size = self.ctx.conf.batch_size
+        if not self.runs:
+            if not self.staged:
+                return
+            with self.metrics.timer("elapsed_compute"):
+                merged = self._sorted_run()
+            for off in range(0, merged.num_rows, batch_size):
+                yield merged.slice(off, batch_size)
+            return
+        if self.staged:
+            self.spill()
+        yield from self._merge_runs(batch_size)
+
+    def _merge_runs(self, batch_size: int):
+        """K-way merge of sorted spilled runs (reference: loser-tree merge)."""
+        cursors = []
+        for rid, run in enumerate(self.runs):
+            it = iter(run.read_batches())
+            cur = _RunCursor(rid, it, self.device, self.op.sort_orders)
+            if cur.advance_batch():
+                cursors.append(cur)
+        heap = [(c.key(), c.rid, c) for c in cursors]
+        heapq.heapify(heap)
+        out_parts: List[ColumnarBatch] = []
+        pending: List[int] = []
+
+        def flush_pending(cur):
+            nonlocal pending
+            if pending:
+                out_parts.append(cur.batch.take(np.array(pending, dtype=np.int64)))
+                pending = []
+
+        while heap:
+            _, _, cur = heapq.heappop(heap)
+            pending.append(cur.pos)
+            # drain any rows from this run that stay the minimum
+            while True:
+                if not cur.step():
+                    flush_pending(cur)
+                    if not cur.advance_batch():
+                        break
+                    heapq.heappush(heap, (cur.key(), cur.rid, cur))
+                    break
+                if heap and (cur.key(), cur.rid) > heap[0][:2]:
+                    flush_pending(cur)
+                    heapq.heappush(heap, (cur.key(), cur.rid, cur))
+                    break
+                pending.append(cur.pos)
+            total = sum(b.num_rows for b in out_parts)
+            if total >= batch_size:
+                yield ColumnarBatch.concat(out_parts, self.op.schema)
+                out_parts = []
+        if out_parts:
+            yield ColumnarBatch.concat(out_parts, self.op.schema)
+
+    def release(self):
+        for r in self.runs:
+            r.release()
+        self.runs = []
+        self.staged = []
+
+
+_KEY_PREFIX = "#sortkey"
+
+
+def _append_key_columns(run: ColumnarBatch, keys_u64: np.ndarray) -> ColumnarBatch:
+    """Attach the (n, 2k) uint64 merge-key matrix as i64 columns."""
+    from blaze_tpu.core.batch import DeviceColumn
+
+    n = run.num_rows
+    fields = list(run.schema.fields)
+    cols = list(run.columns)
+    flipped = (keys_u64 ^ np.uint64(1 << 63)).view(np.int64)
+    for i in range(keys_u64.shape[1]):
+        fields.append(T.StructField(f"{_KEY_PREFIX}{i}", T.I64, False))
+        cols.append(DeviceColumn.from_numpy(T.I64, flipped[:, i], None, run.capacity))
+    return ColumnarBatch(T.Schema(tuple(fields)), cols, n)
+
+
+def _strip_key_columns(batch: ColumnarBatch):
+    """Split a spilled run into (data batch, key matrix as flipped i64) —
+    key tuples compare identically to the unflipped u64 ordering."""
+    base = [i for i, f in enumerate(batch.schema.fields)
+            if not f.name.startswith(_KEY_PREFIX)]
+    keyi = [i for i, f in enumerate(batch.schema.fields)
+            if f.name.startswith(_KEY_PREFIX)]
+    if not keyi:
+        return batch, None
+    n = batch.num_rows
+    from blaze_tpu.utils.device import pull_columns
+
+    pulled = pull_columns([batch.columns[i] for i in keyi], n)
+    keys = np.stack([p[0] for p in pulled], axis=1)
+    return batch.select(base), keys
+
+
+class _RunCursor:
+    __slots__ = ("rid", "it", "device", "orders", "batch", "keys", "pos")
+
+    def __init__(self, rid, it, device, orders):
+        self.rid = rid
+        self.it = it
+        self.device = device
+        self.orders = orders
+        self.batch = None
+        self.keys = None
+        self.pos = 0
+
+    def advance_batch(self) -> bool:
+        for b in self.it:
+            if b.num_rows == 0:
+                continue
+            if self.device:
+                self.batch, keys = _strip_key_columns(b)
+                if keys is None:  # legacy run without squeezed keys
+                    keys = (SK.merge_keys_matrix(self.batch, self.orders)
+                            ^ np.uint64(1 << 63)).view(np.int64)
+                self.keys = [tuple(r) for r in keys]
+            else:
+                self.batch = b
+                self.keys = SK.host_keys_matrix(b, self.orders)
+            self.pos = 0
+            return True
+        return False
+
+    def key(self):
+        return self.keys[self.pos]
+
+    def step(self) -> bool:
+        self.pos += 1
+        return self.pos < self.batch.num_rows

@@ -1,0 +1,185 @@
+"""Sort-key normalization for device and host sorting.
+
+The reference converts sort/group keys to a byte-comparable row format
+(arrow-row RowConverter; key pruning in sort_exec.rs). On TPU we feed
+``jax.lax.sort`` *native-dtype* operand pairs — (null_rank u8, value) per
+key — because v5e has no native 64-bit and XLA's X64 rewriting does not
+implement the f64<->s64 bitcasts the classic u64-key trick needs. XLA's
+float sort comparator is already a total order with NaN sorting last
+(matching Spark's NaN-is-largest) once NaNs are canonicalized to the
+positive quiet NaN; descending is bitwise-NOT for ints and negation for
+floats.
+
+Host-side (spill-merge comparisons, numpy is free to bitcast) keys normalize
+to a (n, 2k) uint64 matrix via the total-order bit trick. Sorts whose keys
+include var-width columns run fully on host via arrow ``sort_indices``
+(SURVEY.md §7.4.3).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from blaze_tpu.core.batch import ColumnarBatch
+from blaze_tpu.exprs.compiler import ExprEvaluator, _broadcast
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import types as T
+
+
+def supports_device_sort(schema: T.Schema, sort_orders: List[E.SortOrder]) -> bool:
+    from blaze_tpu.utils.device import is_device_dtype
+
+    return all(is_device_dtype(E.infer_type(so.child, schema)) for so in sort_orders)
+
+
+# ---------------------------------------------------------------------------
+# device operands (native dtypes, no 64-bit bitcasts)
+# ---------------------------------------------------------------------------
+
+
+def key_operands(batch: ColumnarBatch, sort_orders: List[E.SortOrder],
+                 evaluator: Optional[ExprEvaluator] = None) -> List[jnp.ndarray]:
+    """Build lax.sort operands [null_rank0, val0, null_rank1, val1, ...];
+    padding rows sort last."""
+    ev = evaluator or ExprEvaluator([so.child for so in sort_orders], batch.schema)
+    cols = [ev._to_dev(ev._eval(so.child, batch), batch) for so in sort_orders]
+    exists = batch.row_exists_mask()
+    operands = []
+    for so, v in zip(sort_orders, cols):
+        data, validity = _broadcast(v, batch)
+        validity = validity & exists
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            canonical = jnp.array(float("nan"), data.dtype)
+            val = jnp.where(jnp.isnan(data), canonical, data)
+            if not so.ascending:
+                val = -val
+            val = jnp.where(validity, val, jnp.zeros((), data.dtype))
+        elif data.dtype == jnp.bool_:
+            val = data.astype(jnp.uint8)
+            if not so.ascending:
+                val = jnp.uint8(1) - val
+            val = jnp.where(validity, val, jnp.zeros((), jnp.uint8))
+        else:
+            val = data
+            if not so.ascending:
+                val = ~val
+            val = jnp.where(validity, val, jnp.zeros((), val.dtype))
+        # null rank: 0 = nulls first, 2 = nulls last; valid rows rank 1;
+        # padding rows rank 3 (always last)
+        null_rank = jnp.where(validity, 1, 0 if so.nulls_first else 2)
+        null_rank = jnp.where(exists, null_rank, 3).astype(jnp.uint8)
+        operands.append(null_rank)
+        operands.append(val)
+    return operands
+
+
+# ---------------------------------------------------------------------------
+# host-side normalized keys (merge comparisons)
+# ---------------------------------------------------------------------------
+
+
+def _orderable_u64_np(data: np.ndarray, validity: np.ndarray) -> np.ndarray:
+    """numpy total-order normalization to uint64 (ascending)."""
+    if data.dtype == np.float64:
+        canonical = np.float64("nan")
+        d = np.where(np.isnan(data), canonical, data)
+        bits = d.view(np.int64)
+        u = bits.view(np.uint64)
+        return np.where(bits >= 0, u | np.uint64(1 << 63), ~u)
+    if data.dtype == np.float32:
+        canonical = np.float32("nan")
+        d = np.where(np.isnan(data), canonical, data)
+        bits = d.view(np.int32)
+        u = bits.view(np.uint32).astype(np.uint64)
+        return np.where(bits >= 0, u | np.uint64(1 << 31), (~u) & np.uint64(0xFFFFFFFF))
+    if data.dtype == np.bool_:
+        return data.astype(np.uint64)
+    v = data.astype(np.int64)
+    return v.view(np.uint64) ^ np.uint64(1 << 63)
+
+
+def merge_keys_matrix(batch: ColumnarBatch, sort_orders: List[E.SortOrder]) -> np.ndarray:
+    """(n, 2k) uint64 matrix whose row tuples compare in sort order."""
+    ev = ExprEvaluator([so.child for so in sort_orders], batch.schema)
+    cols = ev.evaluate(batch)
+    n = batch.num_rows
+    mats = []
+    for so, c in zip(sort_orders, cols):
+        data = np.asarray(c.data[:n])
+        validity = np.asarray(c.validity[:n])
+        key = _orderable_u64_np(data, validity)
+        if not so.ascending:
+            key = ~key
+        key = np.where(validity, key, np.uint64(0))
+        rank = np.where(validity, 1, 0 if so.nulls_first else 2).astype(np.uint64)
+        mats.append(rank)
+        mats.append(key)
+    return np.stack(mats, axis=1) if mats else np.zeros((n, 0), np.uint64)
+
+
+def host_sort_indices(batch: ColumnarBatch, sort_orders: List[E.SortOrder],
+                      evaluator: Optional[ExprEvaluator] = None) -> np.ndarray:
+    """Multi-key sort on host via arrow (var-width keys)."""
+    ev = evaluator or ExprEvaluator([so.child for so in sort_orders], batch.schema)
+    cols = ev.evaluate(batch)
+    arrays = [c.to_arrow(batch.num_rows) for c in cols]
+    placements = {so.nulls_first for so in sort_orders}
+    if len(placements) > 1:
+        # arrow's sort has one global null placement; mixed per-key
+        # placements fall back to a python sort over comparable key tuples
+        rows = host_keys_matrix(batch, sort_orders)
+        return np.array(sorted(range(batch.num_rows), key=rows.__getitem__),
+                        dtype=np.int64)
+    tbl = pa.table({f"k{i}": a for i, a in enumerate(arrays)})
+    keys = [(f"k{i}", "ascending" if so.ascending else "descending")
+            for i, so in enumerate(sort_orders)]
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", FutureWarning)
+        idx = pc.sort_indices(
+            tbl, options=pc.SortOptions(
+                sort_keys=keys,
+                null_placement="at_start" if sort_orders[0].nulls_first else "at_end",
+            )
+        )
+    return np.asarray(idx)
+
+
+def host_keys_matrix(batch: ColumnarBatch, sort_orders: List[E.SortOrder]) -> list:
+    """Merge keys for host-sorted (string) runs: python-comparable tuples."""
+    ev = ExprEvaluator([so.child for so in sort_orders], batch.schema)
+    cols = ev.evaluate(batch)
+    arrays = [c.to_arrow(batch.num_rows).to_pylist() for c in cols]
+    rows = []
+    for i in range(batch.num_rows):
+        rows.append(tuple(_host_key_part(arrays[k][i], so)
+                          for k, so in enumerate(sort_orders)))
+    return rows
+
+
+class _Rev:
+    """Reverses comparison order for descending host keys."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __lt__(self, other):
+        return other.v < self.v
+
+    def __eq__(self, other):
+        return self.v == other.v
+
+
+def _host_key_part(v, so: E.SortOrder):
+    null_rank = (0 if so.nulls_first else 2) if v is None else 1
+    if v is None:
+        return (null_rank, 0)
+    return (null_rank, _Rev(v) if not so.ascending else v)

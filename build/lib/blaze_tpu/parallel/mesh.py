@@ -1,0 +1,429 @@
+"""Device-mesh distributed execution: the ICI shuffle path.
+
+The reference's exchange transport is Spark's BlockManager/netty between
+executors (SURVEY.md §5.8). On a TPU slice the native transport is ICI:
+hash repartitioning becomes ``jax.lax.all_to_all`` inside a ``shard_map``
+over a device mesh, broadcast becomes mesh replication, and global
+aggregation merges with ``psum`` — XLA inserts the collectives
+(scaling-book recipe: pick a mesh, annotate shardings, let XLA place
+collectives on ICI).
+
+Two layers:
+
+- :func:`exchange_and_aggregate` — a single jittable SPMD step: local
+  partial aggregation, all-to-all row exchange routed by spark-exact
+  murmur3 pmod (so a row lands on the same reducer a file-based shuffle
+  would pick), local final aggregation. This is the building block the
+  mesh session composes and what ``__graft_entry__.dryrun_multichip``
+  compiles.
+- :func:`make_mesh` — mesh construction over the available devices.
+
+Fixed shapes: each device ships one (num_devices, capacity) tile pair per
+exchanged column — rows not routed to a peer are masked, not compacted, so
+the collective is static-shaped (SURVEY.md §7.4.1)."""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from blaze_tpu.exprs.spark_hash import murmur3_int64
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "data") -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def pmod(hashes: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Spark pmod partition routing from int32 murmur3 hashes."""
+    h = hashes.view(jnp.int32).astype(jnp.int64) if hashes.dtype == jnp.uint32 else hashes.astype(jnp.int64)
+    return ((h % n) + n) % n
+
+
+def _sorted_segment_agg(keys, vals, valid, num_segments: int):
+    """Group-by-key via device sort + segment-sum (SURVEY.md §7.4.2: prefer
+    sort-based grouping over hash tables on TPU). Returns padded
+    (unique_keys, sums, counts, seg_valid)."""
+    big = jnp.iinfo(jnp.int64).max
+    skeys = jnp.where(valid, keys, big)
+    order = jnp.argsort(skeys)
+    k = skeys[order]
+    v = jnp.where(valid, vals, 0)[order]
+    is_new = jnp.concatenate([jnp.ones(1, bool), k[1:] != k[:-1]])
+    seg_ids = jnp.cumsum(is_new) - 1
+    sums = jax.ops.segment_sum(v, seg_ids, num_segments=num_segments)
+    counts = jax.ops.segment_sum(
+        valid[order].astype(jnp.int64), seg_ids, num_segments=num_segments)
+    first_idx = jax.ops.segment_min(
+        jnp.arange(k.shape[0]), seg_ids, num_segments=num_segments)
+    uk = k[jnp.clip(first_idx, 0, k.shape[0] - 1)]
+    seg_valid = (counts > 0) & (uk != big)
+    return jnp.where(seg_valid, uk, 0), sums, counts, seg_valid
+
+
+def exchange_and_aggregate(mesh: Mesh, capacity: int, axis: str = "data"):
+    """Build the jitted SPMD step: (keys, vals, valid) sharded over the mesh
+    -> per-device (unique_keys, sums, counts, valid) after one all-to-all
+    exchange. Each device holds a (capacity,) shard."""
+    n = mesh.shape[axis]
+
+    def step(keys, vals, valid):
+        # --- local partial aggregation (combiner before the exchange)
+        pk, ps, pc, pv = _sorted_segment_agg(keys, vals, valid, capacity)
+
+        # --- route each partial group to its reducer (spark-exact murmur3)
+        h = murmur3_int64(pk, jnp.full(pk.shape, 42, jnp.uint32))
+        pid = pmod(h.view(jnp.int32), n)
+        pid = jnp.where(pv, pid, n)  # invalid rows route nowhere
+
+        # --- build (n, capacity) masked tiles and exchange over ICI
+        tile_mask = (pid[None, :] == jnp.arange(n)[:, None]) & pv[None, :]
+        tk = jnp.where(tile_mask, pk[None, :], 0)
+        ts = jnp.where(tile_mask, ps[None, :], 0)
+        tc = jnp.where(tile_mask, pc[None, :], 0)
+        tm = tile_mask
+        tk, ts, tc, tm = [
+            jax.lax.all_to_all(t, axis, split_axis=0, concat_axis=0, tiled=False)
+            for t in (tk, ts, tc, tm)
+        ]
+        # received: (n, capacity) from every peer -> flatten and re-aggregate
+        rk = tk.reshape(-1)
+        rs = ts.reshape(-1)
+        rc = tc.reshape(-1)
+        rm = tm.reshape(-1)
+        big = jnp.iinfo(jnp.int64).max
+        skeys = jnp.where(rm, rk, big)
+        order = jnp.argsort(skeys)
+        k = skeys[order]
+        is_new = jnp.concatenate([jnp.ones(1, bool), k[1:] != k[:-1]])
+        seg_ids = jnp.cumsum(is_new) - 1
+        nseg = rk.shape[0]  # a reducer may receive up to n*capacity groups
+        sums = jax.ops.segment_sum(jnp.where(rm, rs, 0)[order], seg_ids,
+                                   num_segments=nseg)
+        counts = jax.ops.segment_sum(jnp.where(rm, rc, 0)[order], seg_ids,
+                                     num_segments=nseg)
+        first_idx = jax.ops.segment_min(jnp.arange(k.shape[0]), seg_ids,
+                                        num_segments=nseg)
+        uk = k[jnp.clip(first_idx, 0, k.shape[0] - 1)]
+        out_valid = (counts > 0) & (uk != big)
+        # global row count sanity via psum (every reducer learns the total)
+        total_rows = jax.lax.psum(jnp.sum(valid.astype(jnp.int64)), axis)
+        return (jnp.where(out_valid, uk, 0), sums, counts, out_valid, total_rows)
+
+    from jax import shard_map
+
+    sharded = shard_map(
+        step, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis)),
+        out_specs=(P(axis), P(axis), P(axis), P(axis), P()),
+    )
+    return jax.jit(sharded)
+
+
+def broadcast_join_sum(mesh: Mesh, capacity: int, build_capacity: int,
+                       axis: str = "data"):
+    """Build the jitted SPMD broadcast-join step: the build side (sorted
+    keys + payload) is REPLICATED across the mesh (the broadcast strategy,
+    SURVEY.md §2.5.6), the probe side is sharded; each device probes via
+    ``searchsorted`` (log-n vectorized lookup — TPU-friendly, no hash table,
+    SURVEY.md §7.2 L2') and the global matched-row count merges with psum.
+
+    Returns per-device (matched_mask, gathered_payload, global_matches)."""
+    n = mesh.shape[axis]
+
+    def step(probe_keys, probe_valid, build_keys, build_vals, build_n):
+        # build side is replicated: sorted keys enable binary-search probing
+        idx = jnp.searchsorted(build_keys, probe_keys)
+        idx = jnp.clip(idx, 0, build_capacity - 1)
+        hit = (build_keys[idx] == probe_keys) & probe_valid & \
+            (idx < build_n)
+        payload = jnp.where(hit, build_vals[idx], 0)
+        total = jax.lax.psum(jnp.sum(hit.astype(jnp.int64)), axis)
+        return hit, payload, total
+
+    from jax import shard_map
+
+    sharded = shard_map(
+        step, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(), P(), P()),
+        out_specs=(P(axis), P(axis), P()),
+    )
+    return jax.jit(sharded)
+
+
+def run_broadcast_join(probe_keys: np.ndarray, build_keys: np.ndarray,
+                       build_vals: np.ndarray, mesh: Optional[Mesh] = None,
+                       axis: str = "data"):
+    """Host-facing: inner-join probe rows against a small replicated build
+    side over the whole mesh; returns (payload per probe row or None,
+    total matches)."""
+    mesh = mesh or make_mesh()
+    n = mesh.shape[axis]
+    total = len(probe_keys)
+    per = -(-total // n)
+    capacity = 1
+    while capacity < per:
+        capacity *= 2
+    bcap = 1
+    while bcap < max(len(build_keys), 1):
+        bcap *= 2
+    order = np.argsort(build_keys, kind="stable")
+    bk = np.full(bcap, np.iinfo(np.int64).max, dtype=np.int64)
+    bv = np.zeros(bcap, dtype=np.int64)
+    bk[: len(build_keys)] = np.asarray(build_keys)[order]
+    bv[: len(build_keys)] = np.asarray(build_vals)[order]
+    pk = np.zeros(n * capacity, dtype=np.int64)
+    pm = np.zeros(n * capacity, dtype=bool)
+    for d in range(n):
+        lo, hi = d * per, min((d + 1) * per, total)
+        if hi > lo:
+            pk[d * capacity : d * capacity + (hi - lo)] = probe_keys[lo:hi]
+            pm[d * capacity : d * capacity + (hi - lo)] = True
+    step = broadcast_join_sum(mesh, capacity, bcap, axis)
+    with mesh:
+        hit, payload, tot = step(jnp.asarray(pk), jnp.asarray(pm),
+                                 jnp.asarray(bk), jnp.asarray(bv),
+                                 jnp.int64(len(build_keys)))
+    hit, payload = np.asarray(hit), np.asarray(payload)
+    out = []
+    for d in range(n):
+        lo, hi = d * per, min((d + 1) * per, total)
+        for i in range(hi - lo):
+            j = d * capacity + i
+            out.append(int(payload[j]) if hit[j] else None)
+    return out, int(tot)
+
+
+# ---------------------------------------------------------------------------
+# General ColumnarBatch exchange (the engine's exchange, not a demo kernel)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis", "nplanes"))
+def _exchange_step(mesh, axis, nplanes, pids, live, *planes):
+    """SPMD all-to-all of masked row tiles, built once per (mesh, plane
+    structure). Each device holds (capacity,) shards; device d sends row i to
+    peer pids[i]; received rows land flattened in (n*capacity,) with a live
+    mask. Static shapes throughout (SURVEY.md §7.4.1): rows are masked, not
+    compacted, so XLA lays the collective on ICI with no host round trip."""
+    from jax import shard_map
+
+    n = mesh.shape[axis]
+
+    def step(pids, live, *planes):
+        tile_mask = (pids[None, :] == jnp.arange(n)[:, None]) & live[None, :]
+        outs = []
+        for p in planes:
+            t = jnp.where(tile_mask, p[None, :], jnp.zeros((), p.dtype))
+            t = jax.lax.all_to_all(t, axis, split_axis=0, concat_axis=0)
+            outs.append(t.reshape(-1))
+        m = jax.lax.all_to_all(tile_mask, axis, split_axis=0, concat_axis=0)
+        return (m.reshape(-1),) + tuple(outs)
+
+    sharded = shard_map(
+        step, mesh=mesh,
+        in_specs=(P(axis),) * (2 + nplanes),
+        out_specs=(P(axis),) * (1 + nplanes),
+    )
+    return sharded(pids, live, *planes)
+
+
+class MeshBatchExchange:
+    """Exchange real ColumnarBatches over the ICI mesh — the TPU-native
+    replacement for the reference's file/netty shuffle transport
+    (``shuffle/buffered_data.rs:48-541`` + ``ipc_reader_exec.rs:132-325``,
+    SURVEY.md §5.8 "TPU-native equivalent").
+
+    Columns of any engine type move: device columns (ints, floats, dates,
+    timestamps, decimal<=18 as unscaled int64, agg partial states) ship as
+    raw planes + validity; host columns (strings, wide decimals) ship as
+    dictionary codes against a driver-built global dictionary and are
+    rematerialized on the reducer. Partition ids come from the SAME
+    Repartitioner as the file path (spark-exact murmur3 pmod), so a row
+    lands on the same reducer either way."""
+
+    def __init__(self, mesh: Mesh, axis: Optional[str] = None):
+        assert len(mesh.axis_names) == 1, (
+            f"MeshBatchExchange needs a 1-D mesh, got axes {mesh.axis_names}")
+        self.mesh = mesh
+        self.axis = axis or mesh.axis_names[0]
+        self.n = mesh.shape[self.axis]
+
+    def run(self, schema, shard_batches: List[Optional["object"]],
+            shard_pids: List[Optional[np.ndarray]],
+            num_reducers: int) -> List["object"]:
+        """shard_batches[s]: ColumnarBatch (or None) held by mesh slot s;
+        shard_pids[s]: per-row reducer ids. Returns one host-resident
+        HostBatch per reducer (num_reducers <= mesh size)."""
+        from blaze_tpu.config import get_config
+        from blaze_tpu.core.batch import HostBatch, HostColumn
+        from blaze_tpu.ir import types as T
+        from blaze_tpu.utils.device import pull_columns
+
+        import pyarrow as pa
+
+        n = self.n
+        assert num_reducers <= n, (num_reducers, n)
+        assert len(shard_batches) == n
+
+        cap = get_config().capacity_for(
+            max([b.num_rows for b in shard_batches if b is not None] or [1]))
+
+        # --- host staging: one pull per shard, global dict for host columns
+        from blaze_tpu.utils.device import is_device_dtype
+
+        ncols = len(schema)
+        host_slots = [i for i, f in enumerate(schema.fields)
+                      if not is_device_dtype(f.dtype)]
+        dictionaries: dict = {}
+        shard_items = []  # per shard: list of (np_data, np_valid) per column
+        from blaze_tpu.core.batch import arrow_fixed_planes
+
+        for s, b in enumerate(shard_batches):
+            if b is None or b.num_rows == 0:
+                shard_items.append(None)
+                continue
+            pulled = pull_columns(b.columns, b.num_rows)
+            items = []
+            for i, c in enumerate(b.columns):
+                if i in host_slots:
+                    items.append(c.array if isinstance(c, HostColumn)
+                                 else c.to_arrow(b.num_rows))
+                elif pulled[i] is not None:
+                    items.append(pulled[i])
+                else:
+                    # fixed-width value materialized host-side (e.g. generic
+                    # agg output): extract planes without a device round trip
+                    items.append(arrow_fixed_planes(c.array, schema[i].dtype))
+            shard_items.append(items)
+        for i in host_slots:
+            arrays = [it[i] for it in shard_items if it is not None]
+            if not arrays:
+                dictionaries[i] = pa.array(
+                    [], type=T.to_arrow_type(schema[i].dtype))
+                continue
+            combined = pa.concat_arrays(
+                [a.combine_chunks() if isinstance(a, pa.ChunkedArray) else a
+                 for a in arrays])
+            denc = combined.dictionary_encode()
+            dictionaries[i] = denc.dictionary
+            codes = denc.indices
+            off = 0
+            for it in shard_items:
+                if it is None:
+                    continue
+                k = len(it[i])
+                sl = codes.slice(off, k)
+                valid = ~np.asarray(sl.is_null()) if sl.null_count \
+                    else np.ones(k, bool)
+                it[i] = (sl.fill_null(0).to_numpy(zero_copy_only=False)
+                         .astype(np.int32), valid)
+                off += k
+
+        # --- build global sharded planes: (n*cap,) per column data/validity
+        from jax.sharding import NamedSharding
+
+        sharding = NamedSharding(self.mesh, P(self.axis))
+        gpids = np.full(n * cap, n, dtype=np.int32)  # n == route nowhere
+        glive = np.zeros(n * cap, dtype=bool)
+        gdatas, gvalids = [], []
+        for i in range(ncols):
+            dt = np.int32 if i in host_slots else \
+                shard_items_dtype(shard_items, i)
+            gdatas.append(np.zeros(n * cap, dtype=dt))
+            gvalids.append(np.zeros(n * cap, dtype=bool))
+        for s, it in enumerate(shard_items):
+            if it is None:
+                continue
+            k = len(shard_pids[s])
+            base = s * cap
+            gpids[base:base + k] = shard_pids[s]
+            glive[base:base + k] = True
+            for i in range(ncols):
+                gdatas[i][base:base + k] = it[i][0]
+                gvalids[i][base:base + k] = it[i][1]
+
+        planes = []
+        for i in range(ncols):
+            planes.append(jax.device_put(gdatas[i], sharding))
+            planes.append(jax.device_put(gvalids[i], sharding))
+        with self.mesh:
+            outs = _exchange_step(
+                self.mesh, self.axis, len(planes),
+                jax.device_put(gpids, sharding),
+                jax.device_put(glive, sharding), *planes)
+        out_live = np.asarray(outs[0])
+        out_planes = [np.asarray(o) for o in outs[1:]]
+
+        # --- rebuild one HOST batch per reducer (numpy compaction of live
+        # rows). Host-resident on purpose: the session may hold the result in
+        # its resource map across stages, and pinning every intermediate
+        # exchange in HBM would accumulate device memory the way shuffle
+        # files never do — the reducer re-materializes on first read.
+        out_cap = n * cap
+        results = []
+        for r in range(num_reducers):
+            seg = slice(r * out_cap, (r + 1) * out_cap)
+            rows = np.nonzero(out_live[seg])[0]
+            items = []
+            for i, f in enumerate(schema.fields):
+                d = out_planes[2 * i][seg][rows]
+                v = out_planes[2 * i + 1][seg][rows]
+                if i in host_slots:
+                    codes = pa.array(d, type=pa.int32()) if v.all() else \
+                        pa.array(np.where(v, d, 0), type=pa.int32(), mask=~v)
+                    items.append(dictionaries[i].take(codes))
+                else:
+                    items.append((d, v))
+            results.append(HostBatch(schema, items, len(rows)))
+        return results
+
+
+def shard_items_dtype(shard_items, i):
+    for it in shard_items:
+        if it is not None:
+            return it[i][0].dtype
+    return np.int64
+
+
+def run_distributed_sum(keys: np.ndarray, vals: np.ndarray,
+                        mesh: Optional[Mesh] = None,
+                        axis: str = "data") -> dict:
+    """Host-facing helper: global group-by-sum over all mesh devices; returns
+    {key: (sum, count)} gathered on host (used by tests and the dryrun)."""
+    mesh = mesh or make_mesh()
+    n = mesh.shape[axis]
+    total = len(keys)
+    per = -(-total // n)
+    capacity = 1
+    while capacity < per:
+        capacity *= 2
+    kbuf = np.zeros(n * capacity, dtype=np.int64)
+    vbuf = np.zeros(n * capacity, dtype=np.int64)
+    mbuf = np.zeros(n * capacity, dtype=bool)
+    for d in range(n):
+        lo, hi = d * per, min((d + 1) * per, total)
+        if hi > lo:
+            kbuf[d * capacity : d * capacity + (hi - lo)] = keys[lo:hi]
+            vbuf[d * capacity : d * capacity + (hi - lo)] = vals[lo:hi]
+            mbuf[d * capacity : d * capacity + (hi - lo)] = True
+    step = exchange_and_aggregate(mesh, capacity, axis)
+    with mesh:
+        uk, sums, counts, valid, total_rows = step(
+            jnp.asarray(kbuf), jnp.asarray(vbuf), jnp.asarray(mbuf))
+    uk, sums, counts, valid = map(np.asarray, (uk, sums, counts, valid))
+    assert int(total_rows) == int(mbuf.sum())
+    out = {}
+    for i in np.nonzero(valid)[0]:
+        k = int(uk[i])
+        s, c = out.get(k, (0, 0))
+        out[k] = (s + int(sums[i]), c + int(counts[i]))
+    return out

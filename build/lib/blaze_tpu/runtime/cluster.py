@@ -1,0 +1,245 @@
+"""Driver-side worker pool: OS-process executors for shuffle map stages.
+
+Reference: Spark schedules map tasks onto executor JVMs and retries failed
+or lost tasks (``AuronShuffleManager`` + Spark's TaskScheduler, SURVEY.md
+§3.3/§5.3). Standalone equivalents here:
+
+- ``WorkerPool`` spawns ``python -m blaze_tpu.runtime.worker`` subprocesses
+  that dial back over a unix socket;
+- tasks ship as protobuf ``TaskDefinition`` bytes (the SAME wire contract a
+  JVM frontend would use — the proto seam is exercised across a real
+  process boundary);
+- a worker dying mid-task (socket EOF) or erroring marks the task for
+  retry on another worker, up to ``max_task_retries``; dead workers are
+  respawned to keep the fleet size.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import queue
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+from typing import Dict, List, Optional
+
+import logging
+
+from blaze_tpu.runtime.ipc import recv_msg, send_msg
+
+log = logging.getLogger("blaze_tpu.cluster")
+
+
+class TaskFailed(RuntimeError):
+    pass
+
+
+class _Worker:
+    def __init__(self, pool: "WorkerPool", wid: int):
+        self.pool = pool
+        self.wid = wid
+        self.proc: Optional[subprocess.Popen] = None
+        self.sock: Optional[socket.socket] = None
+        self.in_flight = False
+
+    def spawn(self):
+        env = dict(os.environ)
+        env.setdefault("BLAZE_WORKER_PLATFORM", "cpu")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "blaze_tpu.runtime.worker",
+             self.pool.sock_path],
+            env=env, cwd=self.pool.repo_root)
+        self.sock, _ = self.pool.listener.accept()
+        hello = recv_msg(self.sock)
+        log.info("worker %d up (pid %s)", self.wid, hello.get("hello"))
+
+    def kill(self):
+        try:
+            if self.sock is not None:
+                self.sock.close()
+        except OSError:
+            pass
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(timeout=10)
+
+
+_SPECULATIVE = -1  # attempt marker: failures of a speculative copy are ignored
+
+
+class WorkerPool:
+    def __init__(self, num_workers: int, max_task_retries: int = 2,
+                 speculation_min_s: float = 5.0):
+        self.num_workers = num_workers
+        self.max_task_retries = max_task_retries
+        # a task must have been running this long before an idle worker may
+        # launch its ONE speculative copy (Spark gates on a runtime quantile)
+        self.speculation_min_s = speculation_min_s
+        self.repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        self._sockdir = tempfile.mkdtemp(prefix="blaze_pool_")
+        self.sock_path = os.path.join(self._sockdir, "driver.sock")
+        self.listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self.listener.bind(self.sock_path)
+        self.listener.listen(num_workers + 4)
+        self.workers: List[_Worker] = []
+        self._mu = threading.Lock()
+        for i in range(num_workers):
+            w = _Worker(self, i)
+            w.spawn()
+            self.workers.append(w)
+
+    # -- scheduling -----------------------------------------------------------
+
+    def run_tasks(self, task_msgs: List[dict],
+                  shared: Optional[dict] = None) -> List[dict]:
+        """Run every task to completion (unordered internally, ordered
+        results); failed/lost tasks retry on a (re)spawned worker.
+        ``shared`` (stage-level resources) ships ONCE per worker, not per
+        task message."""
+        pending: "queue.Queue" = queue.Queue()
+        for i, msg in enumerate(task_msgs):
+            pending.put((i, msg, 0))
+        results: Dict[int, dict] = {}
+        errors: List[str] = []
+        done = threading.Event()
+
+        def push_shared(w: _Worker):
+            if shared is not None:
+                send_msg(w.sock, {"set_shared": shared})
+                recv_msg(w.sock)
+
+        import time
+
+        outstanding: Dict[int, tuple] = {}  # i -> (msg, started_at)
+        speculated: set = set()
+        out_mu = threading.Lock()
+
+        def steal_speculative():
+            """Idle worker + empty queue: launch ONE speculative copy of a
+            long-outstanding task (straggler speculation, Spark-style but
+            time-gated rather than quantile-gated; safe because both shuffle
+            files and the RSS pushes publish atomically per attempt; first
+            completion wins, speculative failures are ignored)."""
+            now = time.monotonic()
+            with out_mu:
+                for i, (msg, t0) in outstanding.items():
+                    if i not in results and i not in speculated and \
+                            now - t0 >= self.speculation_min_s:
+                        speculated.add(i)
+                        return (i, msg, _SPECULATIVE)
+            return None
+
+        def serve(w: _Worker):
+            try:
+                push_shared(w)
+            except (EOFError, OSError):
+                try:
+                    w.kill()
+                    w.spawn()
+                    push_shared(w)
+                except Exception:
+                    return
+            while not done.is_set():
+                try:
+                    i, msg, attempt = pending.get(timeout=0.1)
+                except queue.Empty:
+                    spec = steal_speculative()
+                    if spec is None:
+                        continue
+                    i, msg, attempt = spec
+                    log.info("speculatively re-running task %d", i)
+                if attempt != _SPECULATIVE:
+                    with out_mu:
+                        outstanding[i] = (msg, time.monotonic())
+                w.in_flight = True
+                try:
+                    send_msg(w.sock, msg)
+                    reply = recv_msg(w.sock)
+                except (EOFError, OSError) as exc:
+                    if done.is_set():
+                        return  # stage over (e.g. channel reset); stand down
+                    # worker lost mid-task: respawn and retry elsewhere
+                    log.warning("worker %d lost running task %d (%s)",
+                                w.wid, i, exc)
+                    if attempt != _SPECULATIVE:
+                        self._retry_or_fail(pending, errors, done, i, msg,
+                                            attempt, f"worker lost: {exc}",
+                                            results)
+                    try:
+                        w.kill()
+                        w.spawn()
+                        push_shared(w)
+                        continue
+                    except Exception as spawn_exc:  # pool shrinks
+                        log.error("respawn failed: %s", spawn_exc)
+                        return
+                finally:
+                    w.in_flight = False
+                if reply.get("ok"):
+                    results.setdefault(i, reply)  # first completion wins
+                    if len(results) == len(task_msgs):
+                        done.set()
+                elif attempt == _SPECULATIVE or i in results:
+                    pass  # speculative copies never consume retry budget
+                else:
+                    log.warning("task %d failed on worker %d: %s",
+                                i, w.wid, reply.get("error"))
+                    self._retry_or_fail(pending, errors, done, i, msg, attempt,
+                                        reply.get("error", "unknown"), results)
+
+        threads = [threading.Thread(target=serve, args=(w,), daemon=True)
+                   for w in self.workers]
+        for t in threads:
+            t.start()
+        done.wait()
+        for t in threads:
+            t.join(timeout=5)
+        # a serve thread still blocked in recv (losing speculative copy or
+        # straggler original) would desynchronize this worker's
+        # request/reply channel for the NEXT stage — reset such workers
+        for w, t in zip(self.workers, threads):
+            if t.is_alive() or getattr(w, "in_flight", False):
+                try:
+                    w.kill()
+                    w.spawn()
+                except Exception as exc:
+                    log.error("post-stage worker reset failed: %s", exc)
+        if errors:
+            raise TaskFailed("; ".join(errors))
+        return [results[i] for i in range(len(task_msgs))]
+
+    def _retry_or_fail(self, pending, errors, done, i, msg, attempt, reason,
+                       results):
+        if i in results:
+            return  # another (speculative) attempt already completed
+        if attempt + 1 <= self.max_task_retries:
+            pending.put((i, msg, attempt + 1))
+        else:
+            errors.append(f"task {i}: {reason} (after {attempt + 1} attempts)")
+            done.set()
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def kill_worker(self, wid: int):
+        """Test hook: hard-kill one worker process (simulates executor loss)."""
+        self.workers[wid].proc.kill()
+
+    def close(self):
+        for w in self.workers:
+            try:
+                if w.sock is not None:
+                    send_msg(w.sock, {"shutdown": True})
+            except OSError:
+                pass
+            w.kill()
+        self.listener.close()
+        try:
+            os.unlink(self.sock_path)
+            os.rmdir(self._sockdir)
+        except OSError:
+            pass

@@ -1,0 +1,161 @@
+"""HTTP profiling/observability service.
+
+Reference: the feature-gated poem server started lazily on first
+``callNative`` (``auron/src/http/mod.rs:26-100``) with ``/debug/pprof/profile``
+(CPU pprof) and ``/debug/pprof/heap`` (jemalloc). Here a stdlib HTTP server
+bound to a free port exposes:
+
+- ``/debug/metrics``           — the session metric tree as JSON
+- ``/debug/pprof/profile?seconds=N&frequency=H`` — wall-clock stack sampling
+  across ALL threads (sys._current_frames), pprof-style aggregated stacks
+- ``/debug/memory``            — process RSS + memory-manager accounting
+- ``/debug/config``            — the active engine config
+- ``/debug/device``            — device residency: transfer bytes/calls +
+  jitted-kernel dispatch counts/time (utils/device.DEVICE_STATS)
+
+Start with ``ProfilingService.start(session)``; idempotent per process."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+
+class ProfilingService:
+    _instance: Optional["ProfilingService"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, server: ThreadingHTTPServer, port: int):
+        self.server = server
+        self.port = port
+
+    @classmethod
+    def start(cls, session=None) -> "ProfilingService":
+        with cls._lock:
+            if cls._instance is not None:
+                if session is not None:
+                    cls._instance.server.blaze_session = session
+                return cls._instance
+
+            class Handler(BaseHTTPRequestHandler):
+                def log_message(self, *args):
+                    pass
+
+                def _send(self, body: str, ctype: str = "application/json"):
+                    data = body.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+
+                def do_GET(self):
+                    url = urlparse(self.path)
+                    if url.path == "/debug/metrics":
+                        sess = getattr(self.server, "blaze_session", None)
+                        tree = sess.metrics.to_dict() if sess is not None else {}
+                        self._send(json.dumps(tree, indent=2))
+                    elif url.path == "/debug/pprof/profile":
+                        # sampling profiler across ALL threads (cProfile only
+                        # hooks the calling thread; engine work runs on task
+                        # pool threads) — the pprof-style stack aggregate
+                        q = parse_qs(url.query)
+                        seconds = min(float(q.get("seconds", ["5"])[0]), 60)
+                        hz = float(q.get("frequency", ["100"])[0])
+                        self._send(_sample_profile(seconds, hz), "text/plain")
+                    elif url.path == "/debug/memory":
+                        from blaze_tpu.runtime.memmgr import MemManager
+
+                        rss = _read_rss()
+                        mm = MemManager._instance
+                        body = {
+                            "process_rss_bytes": rss,
+                            "mem_manager": None if mm is None else {
+                                "total": mm.total,
+                                "used": mm.used,
+                                "spill_count": mm.spill_count,
+                                "total_spilled_bytes": mm.total_spilled_bytes,
+                                "consumers": [
+                                    {"name": c.name, "mem_used": c.mem_used,
+                                     "spillable": c.spillable}
+                                    for c in mm.consumers
+                                ],
+                            },
+                        }
+                        self._send(json.dumps(body, indent=2))
+                    elif url.path == "/debug/config":
+                        from blaze_tpu.config import get_config
+
+                        self._send(json.dumps(dataclasses.asdict(get_config()),
+                                              indent=2, default=str))
+                    elif url.path == "/debug/device":
+                        from blaze_tpu.utils.device import DEVICE_STATS
+
+                        self._send(json.dumps(DEVICE_STATS.snapshot(), indent=2))
+                    else:
+                        self.send_response(404)
+                        self.end_headers()
+
+            server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+            server.blaze_session = session
+            port = server.server_address[1]
+            t = threading.Thread(target=server.serve_forever, daemon=True,
+                                 name="blaze-http")
+            t.start()
+            cls._instance = ProfilingService(server, port)
+            return cls._instance
+
+    @classmethod
+    def stop(cls):
+        with cls._lock:
+            if cls._instance is not None:
+                cls._instance.server.shutdown()
+                cls._instance.server.server_close()  # release the listen fd
+                cls._instance = None
+
+
+def _sample_profile(seconds: float, hz: float) -> str:
+    """Wall-clock stack sampling over every thread via sys._current_frames
+    (the all-thread analogue of the reference's pprof CPU profile)."""
+    import sys
+    import traceback
+    from collections import Counter
+
+    interval = 1.0 / max(hz, 1.0)
+    deadline = time.time() + seconds
+    stacks: Counter = Counter()
+    samples = 0
+    me = threading.get_ident()
+    while time.time() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            stack = tuple(
+                f"{fs.filename.rsplit('/', 1)[-1]}:{fs.lineno}:{fs.name}"
+                for fs in traceback.extract_stack(frame)[-25:]
+            )
+            stacks[stack] += 1
+        samples += 1
+        time.sleep(interval)
+    lines = [f"# wall-clock samples: {samples} over {seconds}s across threads",
+             "function calls sampled (top stacks):"]
+    for stack, count in stacks.most_common(40):
+        lines.append(f"--- {count} samples")
+        lines.extend(f"    {s}" for s in stack[-12:])
+    return "\n".join(lines) + "\n"
+
+
+def _read_rss() -> int:
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        import os
+
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except Exception:
+        return -1

@@ -1,0 +1,39 @@
+"""Length-prefixed pickle frames over a stream socket — the driver<->worker
+control/data channel (reference analogue: the netty block transport +
+executor RPC Spark provides around the native engine, SURVEY.md §5.8;
+standalone, a unix socket plays netty's role)."""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+from typing import Any
+
+_LEN = struct.Struct("<Q")
+
+
+def send_msg(sock: socket.socket, obj: Any):
+    payload = pickle.dumps(obj, protocol=4)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def recv_msg(sock: socket.socket) -> Any:
+    head = _recv_exact(sock, _LEN.size)
+    if head is None:
+        raise EOFError("peer closed")
+    (n,) = _LEN.unpack(head)
+    body = _recv_exact(sock, n)
+    if body is None:
+        raise EOFError("peer closed mid-frame")
+    return pickle.loads(body)
+
+
+def _recv_exact(sock: socket.socket, n: int):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)

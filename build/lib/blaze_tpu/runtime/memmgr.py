@@ -1,0 +1,292 @@
+"""Central memory manager with fair-share spill.
+
+Reference: ``datafusion-ext-plans/src/memmgr/mod.rs:36-457`` — a singleton
+managing registered ``MemConsumer``s; on usage updates it computes the
+per-consumer fair share ``total_managed / num_spillables`` and decides
+Spill / Wait / Nothing. Spills go to (JVM heap | disk) behind the ``Spill``
+trait (``memmgr/spill.rs``); here they go to compressed disk files (the
+device->host hop happens when the consumer serializes its state).
+
+Used by sort/agg/join/shuffle operators: they register as consumers, call
+``acquire``/``update`` as their state grows, and implement ``spill()``.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from typing import BinaryIO, List, Optional
+
+from blaze_tpu.config import Config, get_config
+
+
+class MemConsumer:
+    """Base for spillable operator state (reference: MemConsumer trait).
+
+    Spills are *cooperative*: only the owning task thread ever calls
+    ``spill()`` on its own consumer — either synchronously when its own
+    update crosses the budget, or on its next update after another thread
+    requested it via ``spill_requested`` (operator state is not shareable
+    mid-batch; the reference serializes this through per-consumer async
+    spill tasks, ``memmgr/mod.rs:301-421``)."""
+
+    def __init__(self, name: str, spillable: bool = True):
+        self.name = name
+        self.spillable = spillable
+        self.mem_used = 0
+        self.spill_requested = False
+        self.owner_thread: Optional[int] = None  # set at register time
+        self._manager: Optional["MemManager"] = None
+
+    def spill(self) -> int:
+        """Release memory by spilling state to disk; returns bytes freed."""
+        raise NotImplementedError
+
+    def update_mem_used(self, new_used: int):
+        if self._manager is not None:
+            self._manager.update(self, new_used)
+        else:
+            self.mem_used = new_used
+
+
+class MemManager:
+    _instance: Optional["MemManager"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, total: int, wait_timeout_s: Optional[float] = None):
+        self.total = total
+        self.consumers: List[MemConsumer] = []
+        self._mu = threading.RLock()
+        self._cv = threading.Condition(self._mu)
+        self.total_spilled_bytes = 0
+        self.spill_count = 0
+        self.wait_count = 0
+        self.wait_timeout_s = wait_timeout_s if wait_timeout_s is not None \
+            else get_config().mem_wait_timeout_s
+
+    # -- lifecycle ------------------------------------------------------------
+
+    @classmethod
+    def get_or_init(cls, conf: Optional[Config] = None) -> "MemManager":
+        with cls._lock:
+            if cls._instance is None:
+                conf = conf or get_config()
+                total = conf.memory_total
+                if total is None:
+                    try:
+                        pages = os.sysconf("SC_PHYS_PAGES")
+                        page = os.sysconf("SC_PAGE_SIZE")
+                        total = pages * page
+                    except (ValueError, OSError):
+                        total = 8 << 30
+                cls._instance = cls(int(total * conf.memory_fraction))
+            return cls._instance
+
+    @classmethod
+    def reset(cls):
+        with cls._lock:
+            cls._instance = None
+
+    def register(self, consumer: MemConsumer):
+        with self._mu:
+            consumer._manager = self
+            consumer.owner_thread = threading.get_ident()
+            self.consumers.append(consumer)
+
+    def unregister(self, consumer: MemConsumer):
+        with self._mu:
+            consumer._manager = None
+            consumer.mem_used = 0
+            if consumer in self.consumers:
+                self.consumers.remove(consumer)
+            self._cv.notify_all()  # freed memory may unblock waiters
+
+    # -- accounting -----------------------------------------------------------
+
+    @property
+    def used(self) -> int:
+        with self._mu:
+            return sum(c.mem_used for c in self.consumers)
+
+    def fair_share(self) -> int:
+        with self._mu:
+            n = sum(1 for c in self.consumers if c.spillable) or 1
+            return self.total // n
+
+    def update(self, consumer: MemConsumer, new_used: int):
+        """Record new usage; decide Spill / Wait / Nothing (reference:
+        MemManager::update_consumer_mem_used, memmgr/mod.rs:301-457).
+
+        - over its fair share while the pool is over budget -> the caller
+          spills synchronously (only the owning thread touches its state);
+        - under its share while the pool is over budget -> over-share peers
+          are flagged, and the caller BLOCKS on a condvar until memory frees
+          or the timeout lapses — a producer can no longer overshoot the
+          budget unboundedly between peer updates;
+        - on timeout with the pool still over budget, the caller spills
+          itself if it can (progress guarantee: a stalled peer that never
+          reaches its next update must not wedge the query)."""
+        import time
+
+        me = threading.get_ident()
+        deadline = None
+        growing = new_used > consumer.mem_used
+        while True:
+            action = "none"
+            with self._cv:
+                consumer.mem_used = new_used
+                if consumer.spill_requested and consumer.spillable:
+                    action = "spill"
+                elif self.used > self.total and growing:
+                    # a shrinking update must NEVER block — freeing memory
+                    # while waiting for someone else to free memory inverts
+                    # the backpressure
+                    share = self.fair_share()
+                    if consumer.spillable and consumer.mem_used > share:
+                        action = "spill"
+                    else:
+                        foreign_peer = False
+                        for c in self.consumers:
+                            if c is not consumer and c.spillable and \
+                                    c.mem_used > share:
+                                c.spill_requested = True
+                                # a peer on the CALLING thread can only spill
+                                # on its own next update — which this wait
+                                # would block; wait only for peers that
+                                # another thread can actually advance
+                                if c.owner_thread != me:
+                                    foreign_peer = True
+                        if foreign_peer:
+                            action = "wait"
+                        elif consumer.spillable and consumer.mem_used > 0:
+                            action = "spill"  # make progress single-threaded
+                if action == "wait":
+                    now = time.monotonic()
+                    if deadline is None:
+                        deadline = now + self.wait_timeout_s
+                        self.wait_count += 1
+                    if now >= deadline:
+                        action = "timeout"
+                    else:
+                        self._cv.wait(min(deadline - now, 0.05))
+            if action == "spill" or (
+                    action == "timeout" and consumer.spillable and
+                    consumer.mem_used > 0):
+                consumer.spill_requested = False
+                freed = consumer.spill()
+                with self._cv:
+                    self.spill_count += 1
+                    self.total_spilled_bytes += freed
+                    consumer.mem_used = max(0, consumer.mem_used - freed)
+                    self._cv.notify_all()
+                return
+            if action == "wait":
+                continue
+            return
+
+
+class SpillFile:
+    """One spill: a compressed batch stream in the spill dir (reference:
+    Spill trait + try_new_spill; we always use the disk backend)."""
+
+    def __init__(self, prefix: str = "spill"):
+        import uuid
+
+        from blaze_tpu.io import fs as FS
+
+        cfg = get_config()
+        if FS.has_scheme(cfg.spill_dir):
+            # remote spill dir (reference: spills routed through the JVM
+            # Hadoop FS when configured, spill.rs backends)
+            FS.makedirs(cfg.spill_dir)
+            self.path = f"{cfg.spill_dir.rstrip('/')}/{prefix}-{uuid.uuid4().hex}"
+            self._file: Optional[BinaryIO] = _RemoteSpillHandle(self.path)
+        else:
+            os.makedirs(cfg.spill_dir, exist_ok=True)
+            fd, self.path = tempfile.mkstemp(prefix=prefix + "-", dir=cfg.spill_dir)
+            self._file = os.fdopen(fd, "w+b")
+        from blaze_tpu.io.batch_serde import BatchWriter
+
+        self.writer = BatchWriter(self._file, codec=cfg.spill_compression_codec)
+
+    def finish_write(self):
+        self._file.flush()
+
+    def read_batches(self):
+        from blaze_tpu.io.batch_serde import BatchReader
+
+        self._file.seek(0)
+        return BatchReader(self._file)
+
+    @property
+    def size(self) -> int:
+        return self.writer.bytes_written
+
+    def release(self):
+        from blaze_tpu.io import fs as FS
+
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+        if FS.has_scheme(self.path):
+            fs, p = FS.get_fs(self.path)
+            if fs.exists(p):
+                fs.rm(p)
+        elif os.path.exists(self.path):
+            os.unlink(self.path)
+
+    def __del__(self):
+        try:
+            self.release()
+        except Exception:
+            pass
+
+
+class _RemoteSpillHandle:
+    """Read/write file handle over a remote (fsspec) spill object: buffered
+    writes upload on flush; reads open the uploaded object. Supports the
+    SpillFile access pattern (append-writes, then seek(0)+sequential or
+    ranged reads)."""
+
+    def __init__(self, path: str):
+        import io as _io
+
+        self.path = path
+        self._buf = _io.BytesIO()
+        self._uploaded = False
+        self._reader = None
+
+    # write side ------------------------------------------------------------
+    def write(self, b):
+        return self._buf.write(b)
+
+    def tell(self):
+        return self._reader.tell() if self._reader is not None else self._buf.tell()
+
+    def flush(self):
+        from blaze_tpu.io import fs as FS
+
+        with FS.open_output(self.path) as out:
+            out.write(self._buf.getvalue())
+        self._uploaded = True
+
+    # read side -------------------------------------------------------------
+    def seek(self, pos, whence=0):
+        if not self._uploaded:
+            self.flush()
+        if self._reader is None:
+            from blaze_tpu.io import fs as FS
+
+            self._reader = FS.open_input(self.path)
+        return self._reader.seek(pos, whence)
+
+    def read(self, n=-1):
+        if self._reader is None:
+            self.seek(0)
+        return self._reader.read(n)
+
+    def close(self):
+        if self._reader is not None:
+            self._reader.close()
+            self._reader = None

@@ -1,0 +1,94 @@
+"""Metric tree mirroring the plan tree.
+
+Reference: JVM ``MetricNode`` (MetricNode.scala) mirrored by the native
+``ExecutionPlanMetricsSet`` and pushed back at task end
+(``auron/src/metrics.rs``). Canonical names follow
+``NativeHelper.getDefaultNativeMetrics:94-125``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class MetricNode:
+    def __init__(self, name: str, children: Optional[List["MetricNode"]] = None):
+        self.name = name
+        self.children = children or []
+        self.values: Dict[str, int] = {}
+        self._named: Dict[str, "MetricNode"] = {}
+        self._mu = threading.Lock()
+
+    def add(self, metric: str, value: int):
+        with self._mu:
+            self.values[metric] = self.values.get(metric, 0) + int(value)
+
+    def set(self, metric: str, value: int):
+        with self._mu:
+            self.values[metric] = int(value)
+
+    def get(self, metric: str) -> int:
+        return self.values.get(metric, 0)
+
+    def child(self, i: int) -> "MetricNode":
+        with self._mu:
+            while len(self.children) <= i:
+                self.children.append(MetricNode(f"{self.name}.child{len(self.children)}"))
+            return self.children[i]
+
+    def named_child(self, key: str) -> "MetricNode":
+        """Keyed child for driver-side groupings (stages vs result
+        partitions) so namespaces cannot collide."""
+        with self._mu:
+            node = self._named.get(key)
+            if node is None:
+                node = self._named[key] = MetricNode(f"{self.name}.{key}")
+                self.children.append(node)
+            return node
+
+    def timer(self, metric: str) -> "Timer":
+        return Timer(self, metric)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "values": dict(self.values),
+            "children": [c.to_dict() for c in self.children],
+        }
+
+    def total(self, metric: str) -> int:
+        return self.get(metric) + sum(c.total(metric) for c in self.children)
+
+    def merge_dict(self, d: dict):
+        """Fold a serialized metric tree (to_dict of a remote task) into
+        this node — how worker-process task metrics reach the driver's tree
+        (reference: update_spark_metric_node pushing native metrics into the
+        JVM MetricNode mirror at task end). Children merge POSITIONALLY:
+        remote node names embed the remote root's prefix, and name-keyed
+        merging would give pool and in-driver runs different tree shapes."""
+        for k, v in (d.get("values") or {}).items():
+            self.add(k, v)
+        for i, c in enumerate(d.get("children") or []):
+            self.child(i).merge_dict(c)
+
+
+class Timer:
+    """Accumulates nanoseconds into a metric. The reference subtracts
+    downstream send-wait so self-time is accurate
+    (WrappedSender.exclude_time, execution_context.rs:705-730); here operator
+    generators naturally exclude consumer time because timing stops at yield.
+    """
+
+    def __init__(self, node: MetricNode, metric: str):
+        self.node = node
+        self.metric = metric
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        self.node.add(self.metric, time.perf_counter_ns() - self._t0)
+        return False

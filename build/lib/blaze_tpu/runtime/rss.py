@@ -1,0 +1,208 @@
+"""Remote shuffle service stand-in: a push-based shuffle server + client.
+
+Reference: the Celeborn/Uniffle integrations (``thirdparty/auron-celeborn-
+0.5/.../CelebornPartitionWriter.scala:27-74`` + ``shuffle/rss.rs``) — map
+tasks PUSH partition-tagged byte buffers to a remote service instead of
+writing local files; reducers fetch each partition's stream from the
+service. This module provides the same architecture standalone:
+
+- :class:`RssServer` — accepts pushes ``(app, shuffle_id, pid, payload)``
+  and serves fetches ``(app, shuffle_id, pid) -> [payloads]`` over a unix
+  or TCP socket (the single-node CI analogue of the reference's
+  boot-a-celeborn-worker test rig, ``.github/workflows/celeborn.yml``).
+- :class:`RssClient` — the ``RssPartitionWriterBase`` contract
+  (``write(pid, bytes)``, ``flush()``) used by ``RssShuffleWriterExec``,
+  plus ``fetch(pid)`` -> block list for the reader side.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import socketserver
+import tempfile
+import threading
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+from blaze_tpu.runtime.ipc import recv_msg, send_msg
+
+
+class RssServer:
+    """In-memory partition store behind a socket (one per test/cluster)."""
+
+    def __init__(self):
+        self._dir = tempfile.mkdtemp(prefix="blaze_rss_")
+        self.sock_path = os.path.join(self._dir, "rss.sock")
+        # (app, shuffle_id, pid) -> [(map_id, attempt, bytes)]
+        self._store: Dict[Tuple[str, int, int], List[tuple]] = defaultdict(list)
+        # (app, shuffle_id, map_id) -> winning attempt id
+        self._committed: Dict[Tuple[str, int, int], str] = {}
+        self._mu = threading.Lock()
+        server_self = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        msg = recv_msg(self.request)
+                    except EOFError:
+                        return
+                    send_msg(self.request, server_self._handle(msg))
+
+        class _Server(socketserver.ThreadingUnixStreamServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = _Server(self.sock_path, Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="rss-server")
+        self._thread.start()
+
+    def _handle(self, msg: dict) -> dict:
+        op = msg.get("op")
+        key = (msg.get("app", ""), int(msg.get("shuffle_id", 0)),
+               int(msg.get("pid", 0)))
+        if op == "push":
+            # pushes are tagged (map_id, attempt); only blocks of the FIRST
+            # COMMITTED attempt per map are served — a retried map task's
+            # duplicate pushes are discarded at commit time, the same
+            # dedup-by-attempt contract Celeborn gives Spark retries
+            with self._mu:
+                self._store[key].append(
+                    (int(msg.get("map_id", 0)), str(msg.get("attempt", "")),
+                     msg["payload"]))
+            return {"ok": True}
+        if op == "commit_map":
+            mkey = (msg.get("app", ""), int(msg.get("shuffle_id", 0)),
+                    int(msg.get("map_id", 0)))
+            with self._mu:
+                self._committed.setdefault(mkey, str(msg.get("attempt", "")))
+            return {"ok": True, "won": self._committed[mkey] == msg.get("attempt")}
+        if op == "fetch":
+            app, sid, _pid = key
+            with self._mu:
+                blocks = [
+                    payload for (map_id, attempt, payload) in self._store.get(key, [])
+                    if self._committed.get((app, sid, map_id)) == attempt
+                ]
+                return {"ok": True, "blocks": blocks}
+        if op == "stats":
+            with self._mu:
+                return {"ok": True,
+                        "partitions": len(self._store),
+                        "bytes": sum(len(b) for v in self._store.values()
+                                     for _, _, b in v)}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()
+        try:
+            os.unlink(self.sock_path)
+            os.rmdir(self._dir)
+        except OSError:
+            pass
+
+
+class RssClient:
+    """Push/fetch client: implements the RssPartitionWriterBase seam
+    (write/flush) RssShuffleWriterExec pushes through, and the fetch the
+    reducer-side block provider pulls. Safe to pickle (reconnects lazily),
+    so it crosses the driver->worker boundary."""
+
+    def __init__(self, sock_path: str, app: str = "app", shuffle_id: int = 0):
+        self.sock_path = sock_path
+        self.app = app
+        self.shuffle_id = shuffle_id
+        self._sock: Optional[socket.socket] = None
+        self._mu = threading.Lock()
+
+    # -- wire -----------------------------------------------------------------
+
+    def _conn(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.connect(self.sock_path)
+            self._sock = s
+        return self._sock
+
+    def _call(self, msg: dict) -> dict:
+        with self._mu:
+            try:
+                sock = self._conn()
+                send_msg(sock, msg)
+                reply = recv_msg(sock)
+            except (EOFError, OSError):
+                # a half-used stream is desynchronized: drop it so the next
+                # call (e.g. a retried task) reconnects cleanly
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                raise
+        if not reply.get("ok"):
+            raise RuntimeError(f"rss error: {reply.get('error')}")
+        return reply
+
+    # -- writer factory (RssShuffleWriterExec resolves callables with the
+    # partition id, so per-map writers come from here, not __call__) ----------
+
+    def writer_for_map(self, map_id: int) -> "RssMapWriter":
+        return RssMapWriter(self, map_id)
+
+    # -- reader side ----------------------------------------------------------
+
+    def fetch(self, pid: int) -> List[bytes]:
+        return self._call({"op": "fetch", "app": self.app,
+                           "shuffle_id": self.shuffle_id, "pid": pid})["blocks"]
+
+    def __call__(self, pid: int):
+        """Block-provider form for IpcReaderExec."""
+        return [("bytes", b) for b in self.fetch(pid)]
+
+    # -- pickling (worker processes reconnect) --------------------------------
+
+    def __getstate__(self):
+        return {"sock_path": self.sock_path, "app": self.app,
+                "shuffle_id": self.shuffle_id}
+
+    def __setstate__(self, state):
+        self.__init__(**state)
+
+
+class RssWriterFactory:
+    """The resource RssShuffleWriterExec resolves: callable(partition) ->
+    per-map writer with a fresh attempt id (retry-safe commits)."""
+
+    def __init__(self, client: RssClient):
+        self.client = client
+
+    def __call__(self, map_id: int) -> "RssMapWriter":
+        return self.client.writer_for_map(map_id)
+
+
+class RssMapWriter:
+    """One map task's push channel: every block is tagged (map_id, attempt);
+    flush() commits the attempt — the first commit per map wins, so a
+    retried task's duplicates never reach readers."""
+
+    def __init__(self, client: RssClient, map_id: int):
+        import uuid
+
+        self.client = client
+        self.map_id = map_id
+        self.attempt = uuid.uuid4().hex
+
+    def write(self, pid: int, payload: bytes):
+        self.client._call({"op": "push", "app": self.client.app,
+                           "shuffle_id": self.client.shuffle_id, "pid": pid,
+                           "map_id": self.map_id, "attempt": self.attempt,
+                           "payload": payload})
+
+    def flush(self):
+        self.client._call({"op": "commit_map", "app": self.client.app,
+                           "shuffle_id": self.client.shuffle_id,
+                           "map_id": self.map_id, "attempt": self.attempt})

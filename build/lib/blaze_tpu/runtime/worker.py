@@ -1,0 +1,97 @@
+"""Worker process entry: executes TaskDefinitions shipped by the driver.
+
+The reference's executor-side story (SURVEY.md §3.2): a Spark executor JVM
+receives a serialized task, crosses into the native engine via
+``JniBridge.callNative`` with the protobuf ``TaskDefinition``, and streams
+the plan. Here the OS process IS the executor: it connects back to the
+driver's unix socket, then loops — receive {task_bytes (proto
+TaskDefinition), conf, resources} → build the operator tree → run it →
+reply. Shuffle map tasks write data+index files to the shared filesystem
+(the durable hand-off, like Spark local shuffle); the reply carries file
+paths, not rows.
+
+Run as: ``python -m blaze_tpu.runtime.worker <socket-path>``.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import traceback
+
+
+def _configure_platform():
+    """Workers default to the CPU backend: a shuffle-map fleet must not
+    fight over the single tunnel TPU chip (BLAZE_WORKER_PLATFORM overrides
+    for real multi-host TPU deployments)."""
+    import jax
+
+    platform = os.environ.get("BLAZE_WORKER_PLATFORM", "cpu")
+    jax.config.update("jax_platforms", platform)
+
+
+def run_task(msg: dict, shared: dict = None) -> dict:
+    import dataclasses
+
+    from blaze_tpu.config import Config, set_config
+    from blaze_tpu.ir.protoserde import task_definition_from_bytes
+    from blaze_tpu.ops.base import ExecContext, TaskContext
+    from blaze_tpu.runtime.executor import build_operator
+    from blaze_tpu.runtime.metrics import MetricNode
+    from blaze_tpu.utils.logutil import clear_task_context, set_task_context
+
+    conf = Config(**msg["conf"]) if msg.get("conf") else None
+    if conf is not None:
+        set_config(conf)
+    task, plan = task_definition_from_bytes(msg["task_bytes"])
+    op = build_operator(plan)
+    metrics = MetricNode("task")
+    resources = dict(shared or {})
+    resources.update(msg.get("resources") or {})
+    ctx = ExecContext(
+        task=task,
+        conf=conf,
+        resources=resources,
+    )
+    set_task_context(task.stage_id, task.partition_id)
+    try:
+        rows = 0
+        for batch in op.execute(task.partition_id, ctx, metrics):
+            rows += batch.num_rows  # sink plans emit nothing; drain anyway
+        return {"ok": True, "rows": rows, "metrics": metrics.to_dict()}
+    finally:
+        clear_task_context()
+
+
+def main(sock_path: str):
+    import socket
+
+    from blaze_tpu.runtime.ipc import recv_msg, send_msg
+
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.connect(sock_path)
+    send_msg(sock, {"hello": os.getpid()})
+    shared: dict = {}
+    while True:
+        try:
+            msg = recv_msg(sock)
+        except EOFError:
+            return
+        if msg.get("shutdown"):
+            return
+        if "set_shared" in msg:
+            # stage-level resources arrive ONCE per worker, not per task
+            shared = msg["set_shared"] or {}
+            send_msg(sock, {"ok": True})
+            continue
+        try:
+            reply = run_task(msg, shared)
+        except BaseException as exc:  # report, keep serving
+            reply = {"ok": False, "error": f"{type(exc).__name__}: {exc}",
+                     "traceback": traceback.format_exc()}
+        send_msg(sock, reply)
+
+
+if __name__ == "__main__":
+    _configure_platform()
+    main(sys.argv[1])

@@ -1,0 +1,51 @@
+"""Task-context logging.
+
+Reference: ``auron/src/logging.rs:23-43`` — stderr logging with thread-local
+``[stage.partition tid]`` prefixes, level from conf. Here a logging.Filter
+injects the current task context set by the executor."""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+_ctx = threading.local()
+
+
+def set_task_context(stage_id: int, partition_id: int):
+    _ctx.stage = stage_id
+    _ctx.partition = partition_id
+
+
+def clear_task_context():
+    _ctx.stage = None
+    _ctx.partition = None
+
+
+class TaskContextFilter(logging.Filter):
+    def filter(self, record):
+        stage = getattr(_ctx, "stage", None)
+        part = getattr(_ctx, "partition", None)
+        if stage is None:
+            record.task = "driver"
+        else:
+            record.task = f"{stage}.{part}"
+        return True
+
+
+def init_logging(level: str = None):
+    """Configure engine logging (idempotent): stderr with task prefixes,
+    level from BLAZE_TPU_LOG_LEVEL (reference: spark.auron.native.log.level)."""
+    root = logging.getLogger("blaze_tpu")
+    if getattr(root, "_blaze_configured", False):
+        return root
+    level = level or os.environ.get("BLAZE_TPU_LOG_LEVEL", "WARNING")
+    handler = logging.StreamHandler()
+    handler.addFilter(TaskContextFilter())
+    handler.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)s [%(task)s %(threadName)s] %(name)s: %(message)s"))
+    root.addHandler(handler)
+    root.setLevel(level.upper())
+    root._blaze_configured = True
+    return root

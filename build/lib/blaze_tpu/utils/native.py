@@ -1,0 +1,179 @@
+"""ctypes binding for the native host-kernel library (native/).
+
+The engine degrades gracefully: every consumer checks ``lib()`` for None and
+falls back to the numpy implementation. Build once with
+``scripts/build_native.sh`` (cmake + g++); the first import also attempts an
+automatic build when the toolchain is present."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SO_PATH = os.path.join(_REPO_ROOT, "native", "build", "libblaze_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _configure(lib: ctypes.CDLL):
+    lib.bt_version.restype = ctypes.c_int
+    lib.bt_transpose.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                 ctypes.c_size_t, ctypes.c_size_t, ctypes.c_int]
+    lib.bt_murmur3_bytes.argtypes = [ctypes.c_void_p] * 4 + [ctypes.c_size_t]
+    lib.bt_xxh64_bytes.argtypes = [ctypes.c_void_p] * 4 + [ctypes.c_size_t]
+    lib.bt_zstd_compress_bound.restype = ctypes.c_int64
+    lib.bt_zstd_compress_bound.argtypes = [ctypes.c_int64]
+    lib.bt_zstd_compress.restype = ctypes.c_int64
+    lib.bt_zstd_compress.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                     ctypes.c_void_p, ctypes.c_int64, ctypes.c_int]
+    lib.bt_zstd_decompress.restype = ctypes.c_int64
+    lib.bt_zstd_decompress.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                       ctypes.c_void_p, ctypes.c_int64]
+    if hasattr(lib, "bt_lz4_available"):  # absent in v1 prebuilt libraries
+        lib.bt_lz4_available.restype = ctypes.c_int
+        lib.bt_lz4_compress_bound.restype = ctypes.c_int64
+        lib.bt_lz4_compress_bound.argtypes = [ctypes.c_int64]
+        lib.bt_lz4_compress.restype = ctypes.c_int64
+        lib.bt_lz4_compress.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                        ctypes.c_void_p, ctypes.c_int64]
+        lib.bt_lz4_decompress.restype = ctypes.c_int64
+        lib.bt_lz4_decompress.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                          ctypes.c_void_p, ctypes.c_int64]
+
+
+def build(quiet: bool = True) -> bool:
+    """Build the native library with cmake into a per-process temp build dir,
+    then atomically publish the .so — safe against concurrent builders in
+    other processes; returns success."""
+    import shutil
+
+    src = os.path.join(_REPO_ROOT, "native")
+    bld = os.path.join(src, f"build-tmp-{os.getpid()}")
+    try:
+        kw = dict(capture_output=quiet, cwd=_REPO_ROOT, timeout=300)
+        subprocess.run(["cmake", "-S", src, "-B", bld, "-DCMAKE_BUILD_TYPE=Release"],
+                       check=True, **kw)
+        subprocess.run(["cmake", "--build", bld, "--", "-j2"], check=True, **kw)
+        built = os.path.join(bld, "libblaze_native.so")
+        if not os.path.exists(built):
+            return False
+        os.makedirs(os.path.dirname(_SO_PATH), exist_ok=True)
+        tmp_target = _SO_PATH + f".{os.getpid()}"
+        shutil.copy2(built, tmp_target)
+        os.replace(tmp_target, _SO_PATH)  # atomic publish
+        return True
+    except Exception:
+        return False
+    finally:
+        shutil.rmtree(bld, ignore_errors=True)
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """Load the prebuilt library; never compiles on the hot path (numpy
+    fallbacks serve until ensure_built_async's background build lands)."""
+    global _lib, _tried
+    if _lib is not None:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        if not os.path.exists(_SO_PATH):
+            _tried = True  # recheckable via reset by ensure_built_async
+            return None
+        try:
+            l = ctypes.CDLL(_SO_PATH)
+            _configure(l)
+            assert l.bt_version() >= 1
+            _lib = l
+        except Exception:
+            _tried = True
+            _lib = None
+        return _lib
+
+
+_build_thread: Optional[threading.Thread] = None
+
+
+CURRENT_VERSION = 2
+
+
+def ensure_built_async():
+    """Kick off a background build when the library is missing OR a stale
+    version is on disk; callers keep using numpy fallbacks (and the current
+    features they have) until the fresh build loads (Session starts this)."""
+    global _build_thread
+    if os.environ.get("BLAZE_TPU_NO_NATIVE_BUILD"):
+        return
+    if os.path.exists(_SO_PATH):
+        l = lib()
+        if l is not None and l.bt_version() >= CURRENT_VERSION:
+            return
+        # stale prebuilt: rebuild in the background; the loaded copy keeps
+        # serving its own feature set meanwhile
+    with _lock:
+        if _build_thread is not None:
+            return
+
+        def run():
+            global _tried
+            if build():
+                with _lock:
+                    _tried = False  # allow lib() to load the fresh .so
+
+        _build_thread = threading.Thread(target=run, daemon=True,
+                                         name="blaze-native-build")
+        _build_thread.start()
+
+
+# ---------------------------------------------------------------------------
+# typed wrappers (all fall back to None when the library is absent)
+# ---------------------------------------------------------------------------
+
+
+def transpose(raw: np.ndarray, n: int, itemsize: int, forward: bool) -> Optional[np.ndarray]:
+    l = lib()
+    if l is None or n == 0 or itemsize <= 1:
+        return None
+    src = np.ascontiguousarray(raw).view(np.uint8).reshape(-1)
+    dst = np.empty(n * itemsize, dtype=np.uint8)
+    l.bt_transpose(src.ctypes.data, dst.ctypes.data, n, itemsize,
+                   1 if forward else 0)
+    return dst
+
+
+def murmur3_bytes(offsets: np.ndarray, data: np.ndarray, seeds: np.ndarray
+                  ) -> Optional[np.ndarray]:
+    l = lib()
+    if l is None:
+        return None
+    n = len(offsets) - 1
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    seeds = np.ascontiguousarray(seeds, dtype=np.uint32)
+    out = np.empty(n, dtype=np.uint32)
+    l.bt_murmur3_bytes(offsets.ctypes.data, data.ctypes.data,
+                       seeds.ctypes.data, out.ctypes.data, n)
+    return out
+
+
+def xxh64_bytes(offsets: np.ndarray, data: np.ndarray, seeds: np.ndarray
+                ) -> Optional[np.ndarray]:
+    l = lib()
+    if l is None:
+        return None
+    n = len(offsets) - 1
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    seeds = np.ascontiguousarray(seeds, dtype=np.uint64)
+    out = np.empty(n, dtype=np.uint64)
+    l.bt_xxh64_bytes(offsets.ctypes.data, data.ctypes.data,
+                     seeds.ctypes.data, out.ctypes.data, n)
+    return out
