@@ -20,10 +20,52 @@ def avg_sum_type(arg_t: T.DataType) -> T.DataType:
     return T.F64
 
 
+def limb_layout(result_t: T.DataType) -> bool:
+    """Wide-decimal SUM states that stay DEVICE-resident as two int64 limbs.
+
+    A sum into decimal(19..28) overflows one int64 but its total is < 2^95,
+    so it splits exactly into ``lo`` (32 low bits, kept in [0, 2^32)) and
+    ``hi`` (the remaining signed high part): both limbs and every partial
+    limb-sum fit int64, segment-summing on TPU without 128-bit arithmetic.
+    Precision 19..28 covers SUM over any int64-resident decimal (p<=18 ->
+    p+10<=28, Spark's sum-precision rule) — i.e. the arg column is always
+    device-resident too. Wider results (sum over an already-wide column)
+    keep the exact host object path."""
+    return (isinstance(result_t, T.DecimalType) and not result_t.fits_int64
+            and result_t.precision <= 28)
+
+
+def limb_tag(result_t: T.DecimalType) -> str:
+    """State-field name for the low limb, carrying the decimal params so a
+    FINAL-mode consumer can reconstruct types from the wire schema alone."""
+    return f"sum_lo@{result_t.precision}.{result_t.scale}"
+
+
+def parse_limb_tag(field_name: str):
+    """'<agg>#sum_lo@P.S' -> DecimalType(P, S) or None."""
+    marker = "#sum_lo@"
+    i = field_name.find(marker)
+    if i < 0:
+        return None
+    try:
+        p, s = field_name[i + len(marker):].split(".")
+        return T.DecimalType(int(p), int(s))
+    except (ValueError, TypeError):
+        return None
+
+
 def agg_state_fields(fn: E.AggFunction, arg_t: T.DataType,
                      result_t: T.DataType) -> List[Tuple[str, T.DataType]]:
     F = E.AggFunction
     if fn == F.SUM:
+        # limbs only when the arg scale matches (Spark SUM keeps the scale;
+        # a mismatched plan takes the host path, which rescales exactly) —
+        # this condition MUST stay in sync with SumAgg.limbs
+        if limb_layout(result_t) and (
+                not isinstance(arg_t, T.DecimalType)
+                or arg_t.scale == result_t.scale):
+            return [(limb_tag(result_t), T.I64), ("sum_hi", T.I64),
+                    ("has", T.BOOL)]
         return [("sum", result_t), ("has", T.BOOL)]
     if fn == F.COUNT:
         return [("count", T.I64)]
@@ -83,6 +125,9 @@ def agg_output_schema(child_schema: T.Schema, groupings, aggs,
 def _arg_type_from_state(agg: E.AggExpr, child_schema: T.Schema, pos: int) -> T.DataType:
     """Reconstruct the argument type from the value-typed first state field
     (partial input has no raw arg columns)."""
+    limb_t = parse_limb_tag(child_schema[pos].name)
+    if limb_t is not None and agg.fn == E.AggFunction.SUM:
+        return T.DecimalType(max(limb_t.precision - 10, 1), limb_t.scale)
     dt = child_schema[pos].dtype
     if isinstance(dt, T.DecimalType) and agg.fn in (E.AggFunction.SUM, E.AggFunction.AVG):
         return T.DecimalType(max(dt.precision - 10, 1), dt.scale)

@@ -254,16 +254,11 @@ def _partial_arg_schema(a: E.AggExpr, child_schema: T.Schema, pos: int):
     The raw-input arg expressions are meaningless against the partial child
     schema, so synthesize a one-column schema from the value-typed first
     state field and rewrite the agg to reference it."""
-    dt = child_schema[pos].dtype
-    if isinstance(dt, T.DecimalType) and a.fn in (E.AggFunction.SUM, E.AggFunction.AVG):
-        # partial sum state carries the widened precision; reverse it
-        arg = T.DecimalType(max(dt.precision - 10, 1), dt.scale)
-    elif a.fn == E.AggFunction.AVG and isinstance(dt, T.Float64Type):
-        arg = T.F64
-    elif isinstance(dt, T.ArrayType):
-        arg = dt.element_type
-    else:
-        arg = dt
+    from blaze_tpu.ir.aggstate import _arg_type_from_state
+
+    # single source of truth for state->arg reconstruction (incl. the
+    # wide-decimal limb tag): ir/aggstate
+    arg = _arg_type_from_state(a, child_schema, pos)
     schema = T.Schema((T.StructField("arg", arg),))
     if a.args:
         a = E.AggExpr(a.fn, [E.Column("arg")], a.return_type, a.udaf)

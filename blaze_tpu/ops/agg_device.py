@@ -104,7 +104,10 @@ class DevicePartialAgger:
                 rescale = fn.result_type.scale - fn.arg_type.scale
             if kind == "avg" and isinstance(fn.arg_type, T.DecimalType):
                 rescale = fn.sum_type.scale - fn.arg_type.scale
-            if kind == "sum":
+            if kind == "sum" and getattr(fn, "limbs", False):
+                # wide-decimal sum: two-int64-limb accumulation on device
+                kind, rescale, acc_dt = "sum2", 0, ""
+            elif kind == "sum":
                 acc_dt = "int64" if isinstance(fn.result_type, T.DecimalType) \
                     else str(np.dtype(fn.result_type.np_dtype))
             elif kind == "avg":
@@ -226,7 +229,13 @@ class DevicePartialAgger:
             pos += 2
             ci += 1
         for a, fn, (kind, _, _) in zip(self.op.aggs, self.fns, self.specs):
-            if kind in ("sum",):
+            if kind == "sum2":
+                lo, hi, has = outs[pos], outs[pos + 1], outs[pos + 2]; pos += 3
+                cols.append(DeviceColumn(T.I64, lo, out_valid_mask))
+                cols.append(DeviceColumn(T.I64, hi, out_valid_mask))
+                cols.append(DeviceColumn(T.BOOL, has, out_valid_mask))
+                ci += 3
+            elif kind in ("sum",):
                 s, has = outs[pos], outs[pos + 1]; pos += 2
                 cols.append(DeviceColumn(fn.result_type, s, has & out_valid_mask))
                 cols.append(DeviceColumn(T.BOOL, has, out_valid_mask))
@@ -345,7 +354,18 @@ def _merge_kernel(key_dtypes: Tuple[str, ...], kinds: Tuple[str, ...],
         outs = []
         for kind, cols in zip(kinds, states):
             scols = [(d[order], v[order] & s_exists) for d, v in cols]
-            if kind == "sum":
+            if kind == "sum2":
+                (ld, lv), (hd, _hv), (sd, sv) = scols
+                m = lv & sd.astype(bool) & sv
+                slo = jnp.zeros(CAP, jnp.int64).at[seg].add(
+                    jnp.where(m, ld, jnp.int64(0)), mode="drop")
+                shi = jnp.zeros(CAP, jnp.int64).at[seg].add(
+                    jnp.where(m, hd, jnp.int64(0)), mode="drop")
+                carry = slo >> 32
+                slo, shi = slo & jnp.int64(0xFFFFFFFF), shi + carry
+                shas = jnp.zeros(CAP, bool).at[seg].max(m, mode="drop")
+                outs.append((slo, shi, shas))
+            elif kind == "sum":
                 (sd, sv), (hd, hv) = scols
                 m = sv & hd.astype(bool) & hv
                 ssum = jnp.zeros(CAP, sd.dtype).at[seg].add(
@@ -445,7 +465,9 @@ class DeviceMergeAgger:
         self.op = op
         self.child_schema = child_schema
         self.fns = op._make_fns(child_schema)
-        self.kinds = tuple(self._KINDS[a.agg.fn] for a in op.aggs)
+        self.kinds = tuple(
+            "sum2" if getattr(fn, "limbs", False) else self._KINDS[a.agg.fn]
+            for a, fn in zip(op.aggs, self.fns))
 
     def run(self, batches: List[ColumnarBatch]):
         op = self.op
@@ -490,7 +512,8 @@ class DeviceMergeAgger:
             p += 2
         final = not op.is_partial_output
         for a, fn, kind in zip(op.aggs, self.fns, self.kinds):
-            nstate = {"sum": 2, "count": 1, "avg": 2, "min": 2, "max": 2}[kind]
+            nstate = {"sum": 2, "sum2": 3, "count": 1, "avg": 2,
+                      "min": 2, "max": 2}[kind]
             state = list(outs[p:p + nstate])
             p += nstate
             if final:
@@ -524,7 +547,22 @@ def _partial_kernel(key_dtypes: Tuple[str, ...], specs: Tuple[Tuple[str, int], .
         for (kind, rescale, acc_dt), (ad, av) in zip(specs, args):
             sa = ad[order]
             sv = av[order] & s_exists
-            if kind in ("sum", "avg"):
+            if kind == "sum2":
+                # wide-decimal sum as two int64 limbs (lo 32 bits, hi rest):
+                # per-segment limb sums fit int64 for any capacity, totals
+                # renormalize so lo stays in [0, 2^32)
+                x = sa.astype(jnp.int64)
+                vlo = jnp.where(sv, x & jnp.int64(0xFFFFFFFF), jnp.int64(0))
+                vhi = jnp.where(sv, x >> 32, jnp.int64(0))
+                slo = jnp.zeros(nseg_total, jnp.int64).at[seg].add(
+                    vlo, mode="drop")
+                shi = jnp.zeros(nseg_total, jnp.int64).at[seg].add(
+                    vhi, mode="drop")
+                carry = slo >> 32
+                slo, shi = slo & jnp.int64(0xFFFFFFFF), shi + carry
+                shas = jnp.zeros(nseg_total, bool).at[seg].max(sv, mode="drop")
+                outs.append(("sum2", slo, shi, shas))
+            elif kind in ("sum", "avg"):
                 x = sa.astype(jnp.dtype(acc_dt))  # widen BEFORE accumulating
                 if rescale:
                     x = x * jnp.array(10 ** rescale, x.dtype)
@@ -540,7 +578,7 @@ def _partial_kernel(key_dtypes: Tuple[str, ...], specs: Tuple[Tuple[str, int], .
             elif kind == "count":
                 scnt = jnp.zeros(nseg_total, jnp.int64).at[seg].add(
                     sv.astype(jnp.int64), mode="drop")
-                outs.append(("count", scnt, None))
+                outs.append(("count", scnt))
             else:  # min / max
                 if jnp.issubdtype(sa.dtype, jnp.floating):
                     sent = jnp.array(jnp.inf if kind == "min" else -jnp.inf, sa.dtype)
@@ -573,10 +611,9 @@ def _partial_kernel(key_dtypes: Tuple[str, ...], specs: Tuple[Tuple[str, int], .
             results.append(jnp.where(out_valid, compact(d[first_idx]),
                                      jnp.zeros((), d.dtype)))
             results.append(compact(v[first_idx]) & out_valid)
-        for kind, a, b in outs:
-            results.append(compact(a))
-            if b is not None:
-                results.append(compact(b))
+        for entry in outs:
+            for a in entry[1:]:
+                results.append(compact(a))
         return tuple(results)
 
     return jax.jit(kernel)

@@ -107,6 +107,28 @@ def _decimal_unscaled_np(arr: pa.Array, scale: int):
     return vals, valid
 
 
+def _limb_renorm(lo, hi):
+    """Re-establish the limb invariant lo in [0, 2^32): move accumulated
+    carries into hi. Run after every accumulation round so lo never
+    approaches int64 overflow (per round it grows by <= batch_rows * 2^32,
+    well under 2^63 at any real capacity)."""
+    carry = lo >> 32
+    return lo & jnp.int64(0xFFFFFFFF), hi + carry
+
+
+def _limb_final_column(state, num_slots, result_type: T.DecimalType):
+    """Combine (lo, hi, has) limb state into an exact decimal host column,
+    nulling values that overflow the result precision (Spark
+    check_overflow semantics)."""
+    lo, hi, has = state
+    lo_np = np.asarray(lo)[:num_slots].astype(object)
+    hi_np = np.asarray(hi)[:num_slots].astype(object)
+    has_np = np.asarray(has)[:num_slots]
+    totals = (hi_np << 32) + lo_np  # object ints: exact beyond int64
+    # _host_col_out nulls totals beyond the precision (check_overflow)
+    return _host_col_out(result_type, totals, has_np)
+
+
 class AggFunction:
     """One aggregate over one arg expression; stateless descriptor, state is
     passed explicitly."""
@@ -146,11 +168,24 @@ class AggFunction:
 
 
 class SumAgg(AggFunction):
-    def __init__(self, agg, arg_type, result_type):
+    def __init__(self, agg, arg_type, result_type, allow_limbs=True):
         super().__init__(agg, arg_type, result_type)
-        self.host = not is_device_dtype(result_type)
+        from blaze_tpu.ir.aggstate import limb_layout, limb_tag
+
+        # decimal(19..28) sums stay on device as two int64 limbs (see
+        # ir/aggstate.limb_layout); only wider results take the host path.
+        # Conditions mirror aggstate.agg_state_fields exactly. allow_limbs
+        # is False for the SumAgg embedded in AvgAgg: AVG's state layout
+        # stays [sum, count] and its sum accumulates on the host path.
+        self.limbs = allow_limbs and limb_layout(result_type) and (
+            not isinstance(arg_type, T.DecimalType)
+            or arg_type.scale == result_type.scale)
+        self.host = (not self.limbs) and not is_device_dtype(result_type)
         self._decimal_obj = self.host and isinstance(result_type, T.DecimalType)
-        if self._decimal_obj:
+        if self.limbs:
+            self._limb_tag = limb_tag(result_type)
+            self._npdt = np.dtype(np.int64)
+        elif self._decimal_obj:
             self._npdt = np.dtype(object)  # unscaled python ints, exact
         elif isinstance(result_type, T.DecimalType):
             self._npdt = np.dtype(np.int64)
@@ -158,9 +193,14 @@ class SumAgg(AggFunction):
             self._npdt = result_type.np_dtype
 
     def state_fields(self):
+        if self.limbs:
+            return [(self._limb_tag, T.I64), ("sum_hi", T.I64), ("has", T.BOOL)]
         return [("sum", self.result_type), ("has", T.BOOL)]
 
     def init_state(self, capacity):
+        if self.limbs:
+            return [jnp.zeros(capacity, jnp.int64), jnp.zeros(capacity, jnp.int64),
+                    jnp.zeros(capacity, bool)]
         if self.host:
             return [np.zeros(capacity, self._npdt), np.zeros(capacity, bool)]
         return [jnp.zeros(capacity, self._npdt), jnp.zeros(capacity, bool)]
@@ -184,6 +224,19 @@ class SumAgg(AggFunction):
         return _arr_np(value, self._npdt)
 
     def update(self, state, slots, value, validity, mask, order=None):
+        if self.limbs:
+            lo, hi, has = state
+            m = validity & mask
+            assert not (isinstance(self.arg_type, T.DecimalType)
+                        and self.arg_type.scale != self.result_type.scale), \
+                "SUM keeps the arg scale (Spark rule); limb path assumes it"
+            v = value.astype(jnp.int64)
+            vlo = jnp.where(m, v & jnp.int64(0xFFFFFFFF), jnp.int64(0))
+            vhi = jnp.where(m, v >> 32, jnp.int64(0))
+            lo = lo.at[slots].add(vlo, mode="drop")
+            hi = hi.at[slots].add(vhi, mode="drop")
+            has = has.at[slots].max(m, mode="drop")
+            return list(_limb_renorm(lo, hi)) + [has]
         acc, has = state
         if self.host:
             in_scale = self.arg_type.scale if isinstance(self.arg_type, T.DecimalType) else None
@@ -199,6 +252,16 @@ class SumAgg(AggFunction):
         return [acc, has]
 
     def merge(self, state, slots, partial_cols, mask, n):
+        if self.limbs:
+            lo, hi, has = state
+            plo, phi, phas = partial_cols
+            m = phas.data.astype(bool) & phas.validity & mask
+            lo = lo.at[slots].add(jnp.where(m, plo.data, jnp.int64(0)),
+                                  mode="drop")
+            hi = hi.at[slots].add(jnp.where(m, phi.data, jnp.int64(0)),
+                                  mode="drop")
+            has = has.at[slots].max(m, mode="drop")
+            return list(_limb_renorm(lo, hi)) + [has]
         acc, has = state
         psum, phas = partial_cols
         if self.host:
@@ -218,6 +281,11 @@ class SumAgg(AggFunction):
         return [acc, has]
 
     def state_columns(self, state, num_slots, capacity):
+        if self.limbs:
+            lo, hi, has = self.grow(state, capacity)
+            ones = jnp.ones(capacity, bool)
+            return [DeviceColumn(T.I64, lo, ones), DeviceColumn(T.I64, hi, ones),
+                    DeviceColumn(T.BOOL, has, ones)]
         acc, has = self.grow(state, capacity)
         if self.host:
             return [_host_col_out(self.result_type, acc[:num_slots], has[:num_slots]),
@@ -226,6 +294,8 @@ class SumAgg(AggFunction):
                 DeviceColumn(T.BOOL, has, jnp.ones(capacity, bool))]
 
     def final_column(self, state, num_slots, capacity):
+        if self.limbs:
+            return _limb_final_column(state, num_slots, self.result_type)
         acc, has = self.grow(state, capacity)
         if self.host:
             return _host_col_out(self.result_type, acc[:num_slots], has[:num_slots])
@@ -286,7 +356,7 @@ class AvgAgg(AggFunction):
             self.sum_type = T.DecimalType(min(arg_type.precision + 10, 38), arg_type.scale)
         else:
             self.sum_type = T.F64
-        self._sum = SumAgg(agg, arg_type, self.sum_type)
+        self._sum = SumAgg(agg, arg_type, self.sum_type, allow_limbs=False)
         self._cnt = CountAgg(agg, arg_type, T.I64)
         self.host = self._sum.host
 
