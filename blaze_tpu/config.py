@@ -22,7 +22,7 @@ class Config:
     # every device<->host round trip costs ~25-90ms regardless of size, so
     # batches must amortize transfer latency; powers of two match the
     # capacity bucketing and XLA tiling.
-    batch_size: int = 131072
+    batch_size: int = 262144
 
     # Suggested in-memory bytes per batch (reference: suggested_batch_mem_size,
     # datafusion-ext-commons/src/lib.rs:74-118).

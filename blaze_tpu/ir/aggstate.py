@@ -21,18 +21,31 @@ def avg_sum_type(arg_t: T.DataType) -> T.DataType:
 
 
 def limb_layout(result_t: T.DataType) -> bool:
-    """Wide-decimal SUM states that stay DEVICE-resident as two int64 limbs.
+    """Result types representable as two int64 limbs (see limb_state)."""
+    return (isinstance(result_t, T.DecimalType) and not result_t.fits_int64
+            and result_t.precision <= 28)
+
+
+def limb_state(arg_t: T.DataType, result_t: T.DataType) -> bool:
+    """Should a SUM carry its state as two int64 limbs on device?
 
     A sum into decimal(19..28) overflows one int64 but its total is < 2^95,
     so it splits exactly into ``lo`` (32 low bits, kept in [0, 2^32)) and
     ``hi`` (the remaining signed high part): both limbs and every partial
     limb-sum fit int64, segment-summing on TPU without 128-bit arithmetic.
-    Precision 19..28 covers SUM over any int64-resident decimal (p<=18 ->
-    p+10<=28, Spark's sum-precision rule) — i.e. the arg column is always
-    device-resident too. Wider results (sum over an already-wide column)
-    keep the exact host object path."""
-    return (isinstance(result_t, T.DecimalType) and not result_t.fits_int64
-            and result_t.precision <= 28)
+
+    THE single eligibility predicate — wire schema (agg_state_fields) and
+    operator state (SumAgg) both call it. Requires: a decimal arg that fits
+    int64 (a wider arg is host-resident; its sum keeps the exact host
+    object path) and matching scales (Spark's SUM keeps the arg scale; a
+    mismatched hand-built plan rescales exactly on host instead).
+
+    The decision is made ONCE, on the raw-input side; merge/final-mode
+    consumers must NOT re-derive it — they read it from the wire schema
+    (parse_limb_tag on the first state field's name)."""
+    return (limb_layout(result_t)
+            and isinstance(arg_t, T.DecimalType) and arg_t.fits_int64
+            and arg_t.scale == result_t.scale)
 
 
 def limb_tag(result_t: T.DecimalType) -> str:
@@ -55,15 +68,16 @@ def parse_limb_tag(field_name: str):
 
 
 def agg_state_fields(fn: E.AggFunction, arg_t: T.DataType,
-                     result_t: T.DataType) -> List[Tuple[str, T.DataType]]:
+                     result_t: T.DataType,
+                     limbs: "bool | None" = None) -> List[Tuple[str, T.DataType]]:
+    """State layout per aggregate. ``limbs``: None derives the wide-decimal
+    SUM limb decision from (arg_t, result_t); merge/final-mode callers MUST
+    pass the decision read from the wire schema instead (parse_limb_tag),
+    since arg reconstruction cannot recover a partial side that declined
+    limbs (e.g. a scale-mismatched plan)."""
     F = E.AggFunction
     if fn == F.SUM:
-        # limbs only when the arg scale matches (Spark SUM keeps the scale;
-        # a mismatched plan takes the host path, which rescales exactly) —
-        # this condition MUST stay in sync with SumAgg.limbs
-        if limb_layout(result_t) and (
-                not isinstance(arg_t, T.DecimalType)
-                or arg_t.scale == result_t.scale):
+        if limb_state(arg_t, result_t) if limbs is None else limbs:
             return [(limb_tag(result_t), T.I64), ("sum_hi", T.I64),
                     ("has", T.BOOL)]
         return [("sum", result_t), ("has", T.BOOL)]
@@ -104,8 +118,11 @@ def agg_output_schema(child_schema: T.Schema, groupings, aggs,
     pos = len(groupings)
     for a in aggs:
         agg = a.agg
+        limbs = None
         if input_is_partial:
             arg_t = _arg_type_from_state(agg, child_schema, pos)
+            # layout decided by the partial producer; read it from the wire
+            limbs = parse_limb_tag(child_schema[pos].name) is not None
         else:
             arg_t = E.infer_type(agg.args[0], child_schema) if agg.args else T.NULL
         result_t = agg.return_type or E.agg_result_type(agg.fn, arg_t)
@@ -113,7 +130,7 @@ def agg_output_schema(child_schema: T.Schema, groupings, aggs,
             result_t = T.I64
         elif agg.fn == E.AggFunction.BLOOM_FILTER:
             result_t = T.BINARY
-        fields = agg_state_fields(agg.fn, arg_t, result_t)
+        fields = agg_state_fields(agg.fn, arg_t, result_t, limbs=limbs)
         if is_partial_output:
             out.extend(T.StructField(f"{a.name}#{s}", dt) for s, dt in fields)
         else:

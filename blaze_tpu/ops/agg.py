@@ -79,8 +79,8 @@ class AggExec(Operator):
             fns = []
             pos = len(self.groupings)
             for a in self.aggs:
-                schema, agg = _partial_arg_schema(a.agg, child_schema, pos)
-                fn = aggfns.create_agg_function(agg, schema)
+                schema, agg, limbs = _partial_arg_schema(a.agg, child_schema, pos)
+                fn = aggfns.create_agg_function(agg, schema, limbs=limbs)
                 pos += len(fn.state_fields())
                 fns.append(fn)
             return fns
@@ -254,15 +254,17 @@ def _partial_arg_schema(a: E.AggExpr, child_schema: T.Schema, pos: int):
     The raw-input arg expressions are meaningless against the partial child
     schema, so synthesize a one-column schema from the value-typed first
     state field and rewrite the agg to reference it."""
-    from blaze_tpu.ir.aggstate import _arg_type_from_state
+    from blaze_tpu.ir.aggstate import _arg_type_from_state, parse_limb_tag
 
     # single source of truth for state->arg reconstruction (incl. the
-    # wide-decimal limb tag): ir/aggstate
+    # wide-decimal limb tag): ir/aggstate. The limb-layout decision is the
+    # partial producer's — read it off the wire field name, never re-derive
     arg = _arg_type_from_state(a, child_schema, pos)
+    limbs = parse_limb_tag(child_schema[pos].name) is not None
     schema = T.Schema((T.StructField("arg", arg),))
     if a.args:
         a = E.AggExpr(a.fn, [E.Column("arg")], a.return_type, a.udaf)
-    return schema, a
+    return schema, a, limbs
 
 
 class _PartialSkipper:

@@ -121,9 +121,13 @@ def _limb_final_column(state, num_slots, result_type: T.DecimalType):
     nulling values that overflow the result precision (Spark
     check_overflow semantics)."""
     lo, hi, has = state
-    lo_np = np.asarray(lo)[:num_slots].astype(object)
-    hi_np = np.asarray(hi)[:num_slots].astype(object)
-    has_np = np.asarray(has)[:num_slots]
+    # ONE device->host pull (the tunnel charges a fixed ~70-90ms per sync):
+    # stack the three planes as int64 on device first
+    packed = np.asarray(jnp.stack(
+        [lo[:num_slots], hi[:num_slots], has[:num_slots].astype(jnp.int64)]))
+    lo_np = packed[0].astype(object)
+    hi_np = packed[1].astype(object)
+    has_np = packed[2].astype(bool)
     totals = (hi_np << 32) + lo_np  # object ints: exact beyond int64
     # _host_col_out nulls totals beyond the precision (check_overflow)
     return _host_col_out(result_type, totals, has_np)
@@ -168,18 +172,17 @@ class AggFunction:
 
 
 class SumAgg(AggFunction):
-    def __init__(self, agg, arg_type, result_type, allow_limbs=True):
+    def __init__(self, agg, arg_type, result_type, limbs=None):
         super().__init__(agg, arg_type, result_type)
-        from blaze_tpu.ir.aggstate import limb_layout, limb_tag
+        from blaze_tpu.ir.aggstate import limb_state, limb_tag
 
-        # decimal(19..28) sums stay on device as two int64 limbs (see
-        # ir/aggstate.limb_layout); only wider results take the host path.
-        # Conditions mirror aggstate.agg_state_fields exactly. allow_limbs
-        # is False for the SumAgg embedded in AvgAgg: AVG's state layout
-        # stays [sum, count] and its sum accumulates on the host path.
-        self.limbs = allow_limbs and limb_layout(result_type) and (
-            not isinstance(arg_type, T.DecimalType)
-            or arg_type.scale == result_type.scale)
+        # decimal(19..28) sums stay on device as two int64 limbs. The
+        # eligibility predicate lives in ir/aggstate.limb_state (shared
+        # with the wire-schema derivation). ``limbs``: None derives it;
+        # merge-mode callers pass the decision read from the wire schema,
+        # and AvgAgg passes False (its embedded sum keeps [sum, count]).
+        self.limbs = limb_state(arg_type, result_type) if limbs is None \
+            else bool(limbs)
         self.host = (not self.limbs) and not is_device_dtype(result_type)
         self._decimal_obj = self.host and isinstance(result_type, T.DecimalType)
         if self.limbs:
@@ -356,7 +359,7 @@ class AvgAgg(AggFunction):
             self.sum_type = T.DecimalType(min(arg_type.precision + 10, 38), arg_type.scale)
         else:
             self.sum_type = T.F64
-        self._sum = SumAgg(agg, arg_type, self.sum_type, allow_limbs=False)
+        self._sum = SumAgg(agg, arg_type, self.sum_type, limbs=False)
         self._cnt = CountAgg(agg, arg_type, T.I64)
         self.host = self._sum.host
 
@@ -873,12 +876,16 @@ class UDAFAgg(AggFunction):
                           pa.array(vals, type=T.to_arrow_type(self.result_type)))
 
 
-def create_agg_function(agg: E.AggExpr, input_schema: T.Schema) -> AggFunction:
+def create_agg_function(agg: E.AggExpr, input_schema: T.Schema,
+                        limbs=None) -> AggFunction:
+    """``limbs``: wide-decimal SUM layout override for merge-mode callers
+    that read the partial producer's decision off the wire schema
+    (aggstate.parse_limb_tag); None derives it from the types."""
     arg_t = E.infer_type(agg.args[0], input_schema) if agg.args else T.NULL
     result_t = agg.return_type or E.agg_result_type(agg.fn, arg_t)
     F = E.AggFunction
     if agg.fn == F.SUM:
-        return SumAgg(agg, arg_t, result_t)
+        return SumAgg(agg, arg_t, result_t, limbs=limbs)
     if agg.fn == F.COUNT:
         return CountAgg(agg, arg_t, T.I64)
     if agg.fn == F.AVG:

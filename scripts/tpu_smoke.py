@@ -8,6 +8,7 @@ native-dtype operands, scatter-based aggregation, and spark hashes on device.
 Run: python scripts/tpu_smoke.py   (from the repo root, no JAX_PLATFORMS set)
 """
 
+import os
 import sys
 import time
 
@@ -166,6 +167,50 @@ def main():
     assert out3["n"] == exp2.tolist()
     print(f"mesh-exchange Session OK in {t1 - t0:.2f}s; "
           f"device stats: {DEVICE_STATS.snapshot()}")
+
+    # wide-decimal limb SUM on the chip (round-2 continuation): totals
+    # overflow int64, partial+merge run as two-int64-limb device kernels
+    import tempfile
+
+    import pyarrow.parquet as pq
+
+    from blaze_tpu.ir import nodes as N
+    from blaze_tpu.ops.parquet import scan_node_for_files
+
+    rng2 = np.random.default_rng(19)
+    nw = 20000
+    wk = rng2.integers(1, 9, nw)
+    wu = rng2.integers(7 * 10**16, 9 * 10**16, nw)
+    wtbl = pa.table({
+        "k": pa.array(wk, type=pa.int64()),
+        "v": pa.array([Decimal(int(u)).scaleb(-2) for u in wu],
+                      type=pa.decimal128(17, 2)),
+        "unused": pa.array(rng2.integers(0, 5, nw), type=pa.int64()),
+    })
+    D27 = T.DecimalType(27, 2)
+    with tempfile.TemporaryDirectory() as td:
+        fp = os.path.join(td, "wide.parquet")
+        pq.write_table(wtbl, fp)
+        scan = scan_node_for_files([fp])
+        partial = N.Agg(scan, E.AggExecMode.HASH_AGG, [("k", E.Column("k"))], [
+            N.AggColumn(E.AggExpr(E.AggFunction.SUM, [E.Column("v")], D27),
+                        E.AggMode.PARTIAL, "total")])
+        ex = N.ShuffleExchange(partial, N.HashPartitioning([E.Column("k")], 2))
+        final = N.Agg(ex, E.AggExecMode.HASH_AGG, [("k", E.Column("k"))], [
+            N.AggColumn(E.AggExpr(E.AggFunction.SUM, [E.Column("v")], D27),
+                        E.AggMode.FINAL, "total")])
+        t0 = time.perf_counter()
+        wout = sess.execute_to_pydict(
+            N.Sort(N.ShuffleExchange(final, N.SinglePartitioning(1)),
+                   [E.SortOrder(E.Column("k"))]))
+        t1 = time.perf_counter()
+        wexp = {}
+        for k, u in zip(wk, wu):
+            wexp[int(k)] = wexp.get(int(k), 0) + int(u)
+        assert any(tot > 2**63 for tot in wexp.values())
+        assert wout["k"] == sorted(wexp)
+        assert wout["total"] == [Decimal(wexp[k]).scaleb(-2) for k in sorted(wexp)]
+        print(f"wide-decimal limb SUM (pruned scan) OK in {t1 - t0:.2f}s")
     print("TPU SMOKE OK")
 
 

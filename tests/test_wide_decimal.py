@@ -220,3 +220,57 @@ def test_avg_wide_sum_type_stays_exact(tmp_path):
         for k in sorted(counts)
     ]
     assert out["a"] == exp
+
+
+def test_scale_mismatch_keeps_host_layout_both_sides(tmp_path):
+    # PARTIAL declines limbs (arg scale 2 != result scale 4); the FINAL
+    # side must read that decision off the wire schema, not re-derive it
+    from blaze_tpu.ir.aggstate import agg_state_fields
+
+    mismatched = T.DecimalType(27, 4)
+    fields = agg_state_fields(F.SUM, D17, mismatched)
+    assert [n for n, _ in fields] == ["sum", "has"]
+    tbl, expected = _table(n=800, seed=31)
+    scan = _scan(tbl, tmp_path)
+    partial = N.Agg(scan, E.AggExecMode.HASH_AGG, [("k", E.Column("k"))], [
+        N.AggColumn(E.AggExpr(F.SUM, [E.Column("v")], mismatched),
+                    E.AggMode.PARTIAL, "total")])
+    assert partial.output_schema.names == ["k", "total#sum", "total#has"]
+    final = N.Agg(
+        N.ShuffleExchange(partial, N.HashPartitioning([E.Column("k")], 2)),
+        E.AggExecMode.HASH_AGG, [("k", E.Column("k"))], [
+            N.AggColumn(E.AggExpr(F.SUM, [E.Column("v")], mismatched),
+                        E.AggMode.FINAL, "total")])
+    assert final.output_schema.names == ["k", "total"]
+    with Session() as s:
+        out = s.execute_to_pydict(
+            N.Sort(N.ShuffleExchange(final, N.SinglePartitioning(1)),
+                   [E.SortOrder(E.Column("k"))]))
+    # host path rescales exactly: scale-2 totals reported at scale 4
+    assert out["k"] == sorted(expected)
+    assert out["total"] == [expected[k].quantize(Decimal("0.0001"))
+                            for k in sorted(expected)]
+
+
+def test_wide_arg_stays_host(tmp_path):
+    # SUM over a decimal(19,2) column with declared result decimal(28,2):
+    # the ARG does not fit int64, so limbs must not engage (host object path)
+    from blaze_tpu.ops.aggfns import create_agg_function
+
+    fn = create_agg_function(
+        E.AggExpr(F.SUM, [E.Column("v")], T.DecimalType(28, 2)),
+        T.Schema((T.StructField("v", T.DecimalType(19, 2)),)))
+    assert not fn.limbs and fn.host
+    unscaled = [9 * 10**18, 8 * 10**18, -10**18]
+    tbl = pa.table({
+        "k": pa.array([1, 1, 1], type=pa.int64()),
+        "v": pa.array([Decimal(u).scaleb(-2) for u in unscaled],
+                      type=pa.decimal128(19, 2)),
+    })
+    scan = _scan(tbl, tmp_path)
+    agg = N.Agg(scan, E.AggExecMode.HASH_AGG, [("k", E.Column("k"))], [
+        N.AggColumn(E.AggExpr(F.SUM, [E.Column("v")], T.DecimalType(28, 2)),
+                    E.AggMode.COMPLETE, "total")])
+    with Session() as s:
+        out = s.execute_to_pydict(agg)
+    assert out["total"] == [Decimal(sum(unscaled)).scaleb(-2)]
