@@ -114,6 +114,13 @@ class Config:
     # remote-compile backend; default off until diagnosed).
     fused_filter_agg: bool = False
 
+    # Adaptive device placement (runtime/placement.py — the TPU analogue of
+    # the reference's removeInefficientConverts): "auto" runs each stage
+    # where the measured-link cost model says it is cheapest; "device" /
+    # "host" force the choice. Host-placed stages run the same jitted
+    # kernels pinned to the CPU backend.
+    device_placement: str = "auto"
+
     # Capacity bucketing: device buffers are padded up to the next bucket to
     # bound XLA recompilation. Buckets are powers of two >= min_capacity.
     min_capacity: int = 256

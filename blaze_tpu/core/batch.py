@@ -29,11 +29,18 @@ from blaze_tpu.config import get_config
 from blaze_tpu.ir import types as T
 
 
-@functools.lru_cache(maxsize=64)
+@functools.lru_cache(maxsize=128)
+def _iota_on(capacity: int, device) -> jax.Array:
+    return jnp.arange(capacity)
+
+
 def _iota(capacity: int) -> jax.Array:
     """Device-resident ``arange(capacity)`` per capacity bucket (a handful of
-    entries — buckets are powers of two)."""
-    return jnp.arange(capacity)
+    entries — buckets are powers of two). Keyed by the thread's default
+    device: under adaptive placement (runtime/placement.py) host-placed
+    stages must not pull a cached accelerator-resident iota into CPU-pinned
+    kernels."""
+    return _iota_on(capacity, jax.config.jax_default_device)
 
 
 def _row_mask(capacity: int, n: int) -> jax.Array:

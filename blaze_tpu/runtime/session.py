@@ -141,13 +141,17 @@ class Session:
         lowered = self._lower(plan)
         op = build_operator(lowered)
         nparts = op.num_partitions()
+        where = self._decide_placement(lowered, "result")
 
         def run_partition_stream(p: int):
+            from blaze_tpu.runtime import placement
+
             ctx = self._make_ctx(p)
             set_task_context(0, p)
             try:
-                yield from op.execute(p, ctx,
-                                      self.metrics.named_child(f"result_{p}"))
+                with placement.placed(where):
+                    yield from op.execute(p, ctx,
+                                          self.metrics.named_child(f"result_{p}"))
             finally:
                 clear_task_context()
 
@@ -235,6 +239,17 @@ class Session:
         return False
 
     # -- internals ------------------------------------------------------------
+
+    def _decide_placement(self, stage_root: N.PlanNode, label: str) -> str:
+        """Adaptive device placement per stage (runtime/placement.py — the
+        TPU analogue of removeInefficientConverts): consult the measured
+        link cost model; record the decision in the metric tree."""
+        from blaze_tpu.runtime import placement
+
+        where = placement.decide(stage_root, self.resources, self.conf)
+        self.metrics.add(f"placement_{where}_stages", 1)
+        self.metrics.named_child(label).add(f"placement_{where}", 1)
+        return where
 
     def _make_ctx(self, partition: int, stage: int = 0) -> ExecContext:
         return ExecContext(
@@ -378,8 +393,11 @@ class Session:
         if self.pool is not None:
             outputs = self._run_map_stage_on_pool(node, stage, num_maps, paths_for)
         if outputs is None:
+            where = self._decide_placement(node.child, f"stage_{stage}")
+
             def run_map(m: int):
                 from blaze_tpu.ops.shuffle.writer import ShuffleWriterExec
+                from blaze_tpu.runtime import placement
                 from blaze_tpu.utils.logutil import clear_task_context, set_task_context
 
                 data, index = paths_for(m)
@@ -388,8 +406,9 @@ class Session:
                 task_metrics = self.metrics.named_child(f"stage_{stage}").named_child(f"map_{m}")
                 set_task_context(stage, m)
                 try:
-                    for _ in writer.execute(m, ctx, task_metrics):
-                        pass
+                    with placement.placed(where):
+                        for _ in writer.execute(m, ctx, task_metrics):
+                            pass
                 finally:
                     clear_task_context()
                 return data, index
@@ -589,7 +608,10 @@ class Session:
         if self.pool is not None:
             shipped = self._run_rss_stage_on_pool(node, stage, num_maps, wid)
         if shipped is None:
+            where = self._decide_placement(node.child, f"stage_{stage}")
+
             def run_map(m: int):
+                from blaze_tpu.runtime import placement
                 from blaze_tpu.utils.logutil import clear_task_context, set_task_context
 
                 writer = RssShuffleWriterExec(child_op, node.partitioning, wid)
@@ -598,8 +620,9 @@ class Session:
                     f"stage_{stage}").named_child(f"map_{m}")
                 set_task_context(stage, m)
                 try:
-                    for _ in writer.execute(m, ctx, task_metrics):
-                        pass
+                    with placement.placed(where):
+                        for _ in writer.execute(m, ctx, task_metrics):
+                            pass
                 finally:
                     clear_task_context()
 
@@ -750,9 +773,11 @@ class Session:
 
         cid = f"broadcast_consumer_{stage}"
         self.resources[cid] = _Consumer()
+        where = self._decide_placement(node.child, f"stage_{stage}")
 
         def run_map(m: int):
             from blaze_tpu.ops.shuffle.reader import IpcWriterExec
+            from blaze_tpu.runtime import placement
             from blaze_tpu.utils.logutil import clear_task_context, set_task_context
 
             writer = IpcWriterExec(child_op, cid)
@@ -760,8 +785,9 @@ class Session:
             task_metrics = self.metrics.named_child(f"stage_{stage}").named_child(f"map_{m}")
             set_task_context(stage, m)
             try:
-                for _ in writer.execute(m, ctx, task_metrics):
-                    pass
+                with placement.placed(where):
+                    for _ in writer.execute(m, ctx, task_metrics):
+                        pass
             finally:
                 clear_task_context()
 

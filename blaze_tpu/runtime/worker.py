@@ -55,9 +55,14 @@ def run_task(msg: dict, shared: dict = None) -> dict:
     )
     set_task_context(task.stage_id, task.partition_id)
     try:
+        from blaze_tpu.runtime import placement
+
+        where = placement.decide(plan, resources, conf) if conf is not None \
+            else "device"
         rows = 0
-        for batch in op.execute(task.partition_id, ctx, metrics):
-            rows += batch.num_rows  # sink plans emit nothing; drain anyway
+        with placement.placed(where):
+            for batch in op.execute(task.partition_id, ctx, metrics):
+                rows += batch.num_rows  # sink plans emit nothing; drain anyway
         return {"ok": True, "rows": rows, "metrics": metrics.to_dict()}
     finally:
         clear_task_context()

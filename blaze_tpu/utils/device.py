@@ -71,7 +71,7 @@ DEVICE_STATS = DeviceStats()
 
 
 @functools.cache
-def supports_f64() -> bool:
+def _supports_f64_on(platform: str) -> bool:
     import jax
     import jax.numpy as jnp
 
@@ -82,6 +82,17 @@ def supports_f64() -> bool:
         return bool(np.isfinite(x[0]))
     except Exception:
         return False
+
+
+def supports_f64() -> bool:
+    """Keyed by the thread's effective backend: under adaptive placement
+    (runtime/placement.py) a host-pinned stage has real float64 even when
+    the process default backend (TPU) demotes it."""
+    import jax
+
+    dev = jax.config.jax_default_device
+    platform = dev.platform if dev is not None else jax.default_backend()
+    return _supports_f64_on(platform)
 
 
 def is_device_dtype(dt: T.DataType) -> bool:
