@@ -178,8 +178,7 @@ def _placement_says_host(paths) -> bool:
     est = max((placement.estimate_stage(s, {}) for s in stage_roots),
               key=lambda e: e.input_bytes,
               default=placement.estimate_stage(plan, {}))
-    device_cost, host_cost = placement.stage_costs(est, lp)
-    return host_cost <= device_cost
+    return placement.decide_from_profile(est, lp) == "host"
 
 
 def main():

@@ -32,23 +32,30 @@ import jax
 # spark-exact xxhash64 all require real int64/float64 arithmetic.
 jax.config.update("jax_enable_x64", True)
 
-# Persistent XLA compilation cache: operator kernels recur across processes
-# (shapes come from capacity buckets), and on remote-compile backends a cold
-# kernel build costs tens of seconds. Set BLAZE_TPU_COMPILE_CACHE=0 to
-# disable, or to a directory to relocate. The cache is PARTITIONED by
-# compile context: a remote-compile plugin (PALLAS_AXON_REMOTE_COMPILE)
-# may build CPU executables with the *compile* machine's feature set, and
-# loading those in a plain local process risks SIGILL — so remote- and
-# local-compiled artifacts never share a directory.
-_cc_dir = _os.environ.get("BLAZE_TPU_COMPILE_CACHE") or _os.path.join(
-    _os.path.expanduser("~"), ".cache", "blaze_tpu_xla")
-if _cc_dir != "0":
-    _ctx = "remote" if _os.environ.get(
-        "PALLAS_AXON_REMOTE_COMPILE") == "1" else "local"
-    _cc_dir = _os.path.join(_cc_dir, _ctx)
+def setup_compile_cache():
+    """Persistent XLA compilation cache: operator kernels recur across
+    processes (shapes come from capacity buckets), and on remote-compile
+    backends a cold kernel build costs tens of seconds. Set
+    BLAZE_TPU_COMPILE_CACHE=0 to disable, or to a directory to relocate.
+
+    Called LAZILY (Session/worker init, after any platform pin) and
+    partitioned by the platform set + remote-compile flag: a remote-compile
+    plugin may build executables with the *compile* machine's feature set,
+    and loading those into a process whose compiles are local risks SIGILL
+    — differently-compiled artifacts never share a directory. Reads
+    ``jax.config.jax_platforms`` rather than initializing a backend, so a
+    wedged accelerator cannot hang this call."""
+    cc_dir = _os.environ.get("BLAZE_TPU_COMPILE_CACHE") or _os.path.join(
+        _os.path.expanduser("~"), ".cache", "blaze_tpu_xla")
+    if cc_dir == "0":
+        return
+    platforms = jax.config.jax_platforms or "auto"
+    rc = "rc1" if _os.environ.get(
+        "PALLAS_AXON_REMOTE_COMPILE") == "1" else "rc0"
+    cc_dir = _os.path.join(cc_dir, f"{platforms.replace(',', '_')}-{rc}")
     try:
-        _os.makedirs(_cc_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", _cc_dir)
+        _os.makedirs(cc_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cc_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     except (OSError, AttributeError):
         pass

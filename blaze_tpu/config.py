@@ -110,9 +110,12 @@ class Config:
     enabled_ops: dict = dataclasses.field(default_factory=dict)
 
     # Trace upstream FilterExec predicates into the device partial-agg
-    # kernel (experimental: compiles pathologically slowly on the axon
-    # remote-compile backend; default off until diagnosed).
-    fused_filter_agg: bool = False
+    # kernel. None = auto: ON for stages whose effective platform is the
+    # CPU backend (the compaction it removes is the CPU hot spot, bench
+    # 0.37s -> 0.17s), OFF on accelerator backends where remote-compile
+    # services build the fused kernel pathologically slowly (~100s cold;
+    # amortized by the persistent compile cache). True/False force it.
+    fused_filter_agg: Optional[bool] = None
 
     # Adaptive device placement (runtime/placement.py — the TPU analogue of
     # the reference's removeInefficientConverts): "auto" runs each stage

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import base64
 import dataclasses
+import decimal
 import enum
 import importlib
 import json
@@ -102,6 +103,9 @@ _ENUM_CLASSES = {c.__name__: c for c in (
 def _encode(obj: Any) -> Any:
     if obj is None or isinstance(obj, (bool, int, float, str)):
         return obj
+    if isinstance(obj, decimal.Decimal):
+        # decimal literal values (e.g. a pushed-down filter bound)
+        return {"__decimal__": str(obj)}
     if isinstance(obj, bytes):
         return {"__bytes__": base64.b64encode(obj).decode()}
     if isinstance(obj, enum.Enum):
@@ -136,6 +140,8 @@ def _decode(j: Any) -> Any:
         return j
     if isinstance(j, list):
         return [_decode(x) for x in j]
+    if "__decimal__" in j:
+        return decimal.Decimal(j["__decimal__"])
     if "__bytes__" in j:
         return base64.b64decode(j["__bytes__"])
     if "__enum__" in j:

@@ -93,53 +93,82 @@ def _parse_env() -> Optional[LinkProfile]:
         return None
 
 
+# the probe body runs in a SUBPROCESS: a wedged accelerator transport hangs
+# un-cancellably inside backend calls, so the parent process must never
+# touch the device while measuring. It prints one JSON line on success.
+_PROBE_SRC = r"""
+import json, math, time
+import jax, numpy as np
+import jax.numpy as jnp
+
+platform = jax.default_backend()
+if platform == "cpu":
+    print(json.dumps({"platform": "cpu"}))
+else:
+    z = jnp.zeros((), jnp.int32) + 1
+    z.block_until_ready()
+    sync = math.inf
+    for _ in range(2):
+        t0 = time.perf_counter()
+        float(z + 1)
+        sync = min(sync, time.perf_counter() - t0)
+    h_arr = np.zeros(1 << 19, dtype=np.int64)  # 4 MB
+    t0 = time.perf_counter()
+    d = jax.device_put(h_arr)
+    d.block_until_ready()
+    h2d_t = max(time.perf_counter() - t0 - sync, 1e-6)
+    sl = d[: 1 << 17]  # warm the slice kernel: compile is not transfer
+    sl.block_until_ready()
+    t0 = time.perf_counter()
+    np.asarray(sl)
+    d2h_t = max(time.perf_counter() - t0 - sync, 1e-6)
+    print(json.dumps({
+        "platform": platform,
+        "h2d_bytes_per_s": h_arr.nbytes / h2d_t,
+        "d2h_bytes_per_s": (1 << 20) / d2h_t,
+        "sync_s": sync,
+    }))
+"""
+
+_PROBE_TIMEOUT_S = float(os.environ.get("BLAZE_TPU_PROBE_TIMEOUT", 120.0))
+
+# profile meaning "device unusable this process" — never persisted to the
+# disk cache (a transient wedge must not pin future processes to host)
+_FAILED = LinkProfile("failed", 1.0, 1.0, 60.0)
+
+
 def _probe() -> LinkProfile:
-    """Measure sync latency and both bandwidths with a handful of transfers.
-    Total cost ~4 round trips; runs once per process, lazily, and only when
-    the default backend is not the host CPU."""
-    import time
+    """Measure sync latency and both bandwidths, once per process, lazily.
+    The platform check reads ``jax.config.jax_platforms`` (no backend
+    init); the measurement itself runs in a subprocess with a deadline, so
+    a wedged device can never hang the caller — it just places on host,
+    and the parent process never initializes the accelerator backend."""
+    import subprocess
+    import sys
 
     import jax
-    import numpy as np
 
-    platform = jax.default_backend()
-    if platform == "cpu":
+    if (jax.config.jax_platforms or "") == "cpu":
         return FREE_LINK
     try:
-        import jax.numpy as jnp
+        r = subprocess.run([sys.executable, "-c", _PROBE_SRC],
+                           capture_output=True, timeout=_PROBE_TIMEOUT_S)
+        if r.returncode != 0:
+            raise RuntimeError(r.stderr.decode(errors="replace")[-500:])
+        import json
 
-        # sync latency: tiny scalar round trip (min of 2 to drop warmup)
-        z = jnp.zeros((), jnp.int32) + 1
-        z.block_until_ready()
-        sync = math.inf
-        for _ in range(2):
-            t0 = time.perf_counter()
-            float(z + 1)
-            sync = min(sync, time.perf_counter() - t0)
-        # h2d bandwidth: one 4 MB put
-        h_arr = np.zeros(1 << 19, dtype=np.int64)
-        t0 = time.perf_counter()
-        d = jax.device_put(h_arr)
-        d.block_until_ready()
-        h2d_t = max(time.perf_counter() - t0 - sync, 1e-6)
-        # d2h bandwidth: pull 1 MB of it back (warm the slice kernel first
-        # so remote-compile time is not billed as transfer time)
-        sl = d[: 1 << 17]
-        sl.block_until_ready()
-        t0 = time.perf_counter()
-        np.asarray(sl)
-        d2h_t = max(time.perf_counter() - t0 - sync, 1e-6)
-        prof = LinkProfile(platform, h_arr.nbytes / h2d_t,
-                           (1 << 20) / d2h_t, sync)
+        d = json.loads(r.stdout.decode().strip().splitlines()[-1])
+        if d["platform"] == "cpu":
+            return FREE_LINK
+        prof = LinkProfile(**d)
         log.info("link probe [%s]: h2d %.0f MB/s, d2h %.1f MB/s, sync %.1f ms",
-                 platform, prof.h2d_bytes_per_s / 1e6,
+                 prof.platform, prof.h2d_bytes_per_s / 1e6,
                  prof.d2h_bytes_per_s / 1e6, prof.sync_s * 1e3)
         return prof
     except Exception as exc:  # unreachable/wedged device: treat as unusable
-        log.warning("device link probe failed (%s); placing stages on host", exc)
-        # "failed" platform tag: never persisted to the disk cache — a
-        # transient wedge must not pin future processes to host forever
-        return LinkProfile("failed", 1.0, 1.0, 60.0)
+        log.warning("device link probe failed (%s); placing stages on host",
+                    str(exc)[:200])
+        return _FAILED
 
 
 _CACHE_PATH = os.environ.get(
@@ -189,9 +218,22 @@ def link_profile() -> LinkProfile:
     global _profile
     with _lock:
         if _profile is None:
-            _profile = _parse_env() or _probe()
-            if _profile.platform not in ("cpu", "env", "failed"):
-                _save_cached(_profile)
+            import jax
+
+            env = _parse_env()
+            if env is not None:
+                _profile = env
+            elif (jax.config.jax_platforms or "") == "cpu":
+                # process pinned to the host backend: no link to measure
+                _profile = FREE_LINK
+            else:
+                cached = read_cached_profile()
+                _profile = cached or _probe()
+                # fresh measurements persist; a cache hit does NOT re-save
+                # (that would refresh the TTL forever and block re-probes)
+                if _profile is not cached and \
+                        _profile.platform not in ("cpu", "failed"):
+                    _save_cached(_profile)
         return _profile
 
 
@@ -262,24 +304,31 @@ def stage_costs(est: StageEstimate, lp: LinkProfile):
     return device_cost, host_cost
 
 
+def decide_from_profile(est: StageEstimate, lp: LinkProfile) -> str:
+    """The single decision rule, shared by the per-stage ``decide`` and by
+    drivers consulting the disk-cached profile before backend init
+    (bench.py) — one place for the tie-break and special cases."""
+    if lp.is_colocated:
+        return "device"
+    if est.input_bytes <= 0:
+        # nothing measurable (tiny literals / in-memory source): syncs alone
+        # decide — a slow link makes small stages host-bound
+        return "host"
+    device_cost, host_cost = stage_costs(est, lp)
+    return "device" if device_cost < host_cost else "host"
+
+
 def decide(root: N.PlanNode, resources: dict, conf) -> str:
     """Placement for one stage subtree: "device" or "host"."""
     mode = getattr(conf, "device_placement", "auto")
     if mode in ("device", "host"):
         return mode
     lp = link_profile()
-    if lp.is_colocated:
-        return "device"
     est = estimate_stage(root, resources)
-    if est.input_bytes <= 0:
-        # nothing measurable (tiny literals / in-memory source): syncs alone
-        # decide — a slow link makes small stages host-bound
-        return "host"
-    device_cost, host_cost = stage_costs(est, lp)
-    choice = "device" if device_cost < host_cost else "host"
-    log.info("placement[%s]: in=%.1fMB ops=%d reduces=%s device=%.2fs "
-             "host=%.2fs -> %s", lp.platform, est.input_bytes / 1e6,
-             est.n_ops, est.reduces_output, device_cost, host_cost, choice)
+    choice = decide_from_profile(est, lp)
+    log.info("placement[%s]: in=%.1fMB ops=%d reduces=%s -> %s",
+             lp.platform, est.input_bytes / 1e6, est.n_ops,
+             est.reduces_output, choice)
     return choice
 
 

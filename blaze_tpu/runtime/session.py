@@ -100,8 +100,10 @@ class Session:
         TaskDefinitions to a pool of OS worker processes (runtime/cluster.py)
         — real process isolation with task retry on worker loss, the
         standalone analogue of Spark executors running the native engine."""
+        import blaze_tpu
         from blaze_tpu.utils.native import ensure_built_async
 
+        blaze_tpu.setup_compile_cache()  # after any platform pin
         ensure_built_async()  # background; numpy fallbacks serve meanwhile
         self.conf = conf or get_config()
         self.work_dir = work_dir or tempfile.mkdtemp(prefix="blaze_tpu_session_")

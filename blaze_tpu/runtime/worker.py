@@ -28,6 +28,9 @@ def _configure_platform():
 
     platform = os.environ.get("BLAZE_WORKER_PLATFORM", "cpu")
     jax.config.update("jax_platforms", platform)
+    import blaze_tpu
+
+    blaze_tpu.setup_compile_cache()
 
 
 def run_task(msg: dict, shared: dict = None) -> dict:

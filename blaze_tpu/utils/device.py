@@ -84,15 +84,21 @@ def _supports_f64_on(platform: str) -> bool:
         return False
 
 
+def effective_platform() -> str:
+    """The platform this THREAD's jax ops execute on: the thread-local
+    default device under adaptive placement (runtime/placement.py), else
+    the process default backend."""
+    import jax
+
+    dev = jax.config.jax_default_device
+    return dev.platform if dev is not None else jax.default_backend()
+
+
 def supports_f64() -> bool:
     """Keyed by the thread's effective backend: under adaptive placement
     (runtime/placement.py) a host-pinned stage has real float64 even when
     the process default backend (TPU) demotes it."""
-    import jax
-
-    dev = jax.config.jax_default_device
-    platform = dev.platform if dev is not None else jax.default_backend()
-    return _supports_f64_on(platform)
+    return _supports_f64_on(effective_platform())
 
 
 def is_device_dtype(dt: T.DataType) -> bool:

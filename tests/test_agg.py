@@ -498,3 +498,96 @@ def test_brickhouse_collect_and_combine_unique():
         out2 = s.execute_to_table(plan).to_pydict()
     assert out2["k"] == [1, 2]
     assert [sorted(u) for u in out2["u"]] == [["x", "y", "z"], ["q"]]
+
+
+def test_fused_filter_agg_matches_unfused():
+    """Filter->partial-agg fusion (auto-on for CPU-effective stages) must
+    be result-identical to the separate compaction path, including null
+    keys, null agg args, and a predicate that rejects rows."""
+    from blaze_tpu.ops.basic import FilterExec
+
+    rng = np.random.default_rng(11)
+    n = 4000
+    keys = rng.integers(0, 37, n).astype("int64")
+    vals = rng.integers(-1000, 1000, n).astype("int64")
+    keys_pa = pa.array([None if i % 13 == 0 else int(k)
+                        for i, k in enumerate(keys)], type=pa.int64())
+    vals_pa = pa.array([None if i % 7 == 0 else int(v)
+                        for i, v in enumerate(vals)], type=pa.int64())
+
+    def two_stage():
+        scan = mem_scan({"k": keys_pa, "v": vals_pa}, num_batches=3)
+        filt = FilterExec(scan, [E.BinaryExpr(E.BinaryOp.GT, col("v"),
+                                              E.Literal(-500, T.I64))])
+        partial = AggExec(filt, HASH, [("k", col("k"))], [
+            agg_col(F.SUM, [col("v")], M.PARTIAL, "s", T.I64),
+            agg_col(F.COUNT, [], M.PARTIAL, "c"),
+            agg_col(F.MIN, [col("v")], M.PARTIAL, "mn", T.I64),
+        ])
+        return AggExec(partial, HASH, [("k", col("k"))], [
+            agg_col(F.SUM, [col("s")], M.FINAL, "s", T.I64),
+            agg_col(F.COUNT, [], M.FINAL, "c"),
+            agg_col(F.MIN, [col("mn")], M.FINAL, "mn", T.I64),
+        ])
+
+    outs = {}
+    for fused in (True, False):
+        with config_override(fused_filter_agg=fused):
+            outs[fused] = _sorted_out(two_stage(), "k")
+    assert outs[True] == outs[False]
+    # cross-check non-null keys against a pandas oracle
+    import pandas as pd
+
+    df = pd.DataFrame({"k": keys_pa.to_pandas(), "v": vals_pa.to_pandas()})
+    df = df[df.v > -500]
+    g = df.groupby("k").v.agg(["sum", "count", "min"])
+    got = outs[True]
+    nonnull = [k for k in got["k"] if k is not None]
+    assert nonnull == sorted(int(k) for k in g.index.tolist())
+    for i, k in enumerate(got["k"]):
+        if k is None:
+            continue
+        assert got["s"][i] == int(g.loc[k, "sum"])
+        assert got["c"][i] == int(g.loc[k, "count"])
+        assert got["mn"][i] == int(g.loc[k, "min"])
+
+
+def test_partial_consolidation_single_output_batch():
+    """Per-task consolidation: multi-batch device partials merge into ONE
+    state batch at stream end (reference: AggTable accumulates across the
+    whole partition), shrinking the exchange payload."""
+    from blaze_tpu.ops.base import ExecContext, TaskContext
+    from blaze_tpu.runtime.metrics import MetricNode
+    from blaze_tpu.config import get_config
+
+    rng = np.random.default_rng(3)
+    n = 9000
+    data = {
+        "k": pa.array(rng.integers(0, 23, n), type=pa.int64()),
+        "v": pa.array(rng.integers(0, 100, n), type=pa.int64()),
+    }
+    scan = mem_scan(data, num_batches=5)
+    partial = AggExec(scan, HASH, [("k", col("k"))], [
+        agg_col(F.SUM, [col("v")], M.PARTIAL, "s", T.I64),
+        agg_col(F.AVG, [col("v")], M.PARTIAL, "a", T.F64),
+    ])
+    metrics = MetricNode("t")
+    ctx = ExecContext(task=TaskContext(0, 0), conf=get_config(), resources={})
+    outs = list(partial.execute(0, ctx, metrics))
+    assert len(outs) == 1, [o.num_rows for o in outs]
+    assert outs[0].num_rows == 23
+    assert metrics.to_dict()["values"].get("partials_consolidated") == 1
+    # merged states finalize to the right totals
+    final = AggExec(mem_scan([[o for o in outs]], schema=outs[0].schema),
+                    HASH, [("k", col("k"))], [
+        agg_col(F.SUM, [col("s")], M.FINAL, "s", T.I64),
+        agg_col(F.AVG, [col("a")], M.FINAL, "a", T.F64),
+    ])
+    out = _sorted_out(final, "k")
+    import pandas as pd
+
+    df = pd.DataFrame({"k": data["k"].to_pandas(), "v": data["v"].to_pandas()})
+    g = df.groupby("k").v.agg(["sum", "mean"])
+    assert out["k"] == [int(k) for k in g.index.tolist()]
+    assert out["s"] == [int(x) for x in g["sum"].tolist()]
+    assert out["a"] == pytest.approx(g["mean"].tolist())
