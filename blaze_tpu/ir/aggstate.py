@@ -84,7 +84,14 @@ def agg_state_fields(fn: E.AggFunction, arg_t: T.DataType,
     if fn == F.COUNT:
         return [("count", T.I64)]
     if fn == F.AVG:
-        return [("sum", avg_sum_type(arg_t)), ("count", T.I64)]
+        sum_t = avg_sum_type(arg_t)
+        # wide-decimal AVG rides the same two-int64-limb layout as SUM:
+        # a decimal(9..18) arg's sum type is decimal(19..28) — limb-eligible
+        # exactly when a SUM into it would be
+        if limb_state(arg_t, sum_t) if limbs is None else limbs:
+            return [(limb_tag(sum_t), T.I64), ("sum_hi", T.I64),
+                    ("count", T.I64)]
+        return [("sum", sum_t), ("count", T.I64)]
     if fn in (F.MIN, F.MAX):
         return [("val", result_t), ("has", T.BOOL)]
     if fn in (F.FIRST, F.FIRST_IGNORES_NULL):
@@ -143,7 +150,8 @@ def _arg_type_from_state(agg: E.AggExpr, child_schema: T.Schema, pos: int) -> T.
     """Reconstruct the argument type from the value-typed first state field
     (partial input has no raw arg columns)."""
     limb_t = parse_limb_tag(child_schema[pos].name)
-    if limb_t is not None and agg.fn == E.AggFunction.SUM:
+    if limb_t is not None and agg.fn in (E.AggFunction.SUM, E.AggFunction.AVG):
+        # SUM result / AVG sum type is arg precision + 10 (Spark promotion)
         return T.DecimalType(max(limb_t.precision - 10, 1), limb_t.scale)
     dt = child_schema[pos].dtype
     if isinstance(dt, T.DecimalType) and agg.fn in (E.AggFunction.SUM, E.AggFunction.AVG):

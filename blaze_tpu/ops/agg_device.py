@@ -107,6 +107,9 @@ class DevicePartialAgger:
             if kind == "sum" and getattr(fn, "limbs", False):
                 # wide-decimal sum: two-int64-limb accumulation on device
                 kind, rescale, acc_dt = "sum2", 0, ""
+            elif kind == "avg" and getattr(fn, "limbs", False):
+                # wide-decimal avg: limb sum + count on device
+                kind, rescale, acc_dt = "avg2", 0, ""
             elif kind == "sum":
                 acc_dt = "int64" if isinstance(fn.result_type, T.DecimalType) \
                     else str(np.dtype(fn.result_type.np_dtype))
@@ -235,6 +238,12 @@ class DevicePartialAgger:
                 cols.append(DeviceColumn(T.I64, hi, out_valid_mask))
                 cols.append(DeviceColumn(T.BOOL, has, out_valid_mask))
                 ci += 3
+            elif kind == "avg2":
+                lo, hi, cnt = outs[pos], outs[pos + 1], outs[pos + 2]; pos += 3
+                cols.append(DeviceColumn(T.I64, lo, out_valid_mask))
+                cols.append(DeviceColumn(T.I64, hi, out_valid_mask))
+                cols.append(DeviceColumn(T.I64, cnt, out_valid_mask))
+                ci += 3
             elif kind in ("sum",):
                 s, has = outs[pos], outs[pos + 1]; pos += 2
                 cols.append(DeviceColumn(fn.result_type, s, has & out_valid_mask))
@@ -354,7 +363,7 @@ def _merge_kernel(key_dtypes: Tuple[str, ...], kinds: Tuple[str, ...],
         outs = []
         for kind, cols in zip(kinds, states):
             scols = [(d[order], v[order] & s_exists) for d, v in cols]
-            if kind == "sum2":
+            if kind in ("sum2", "avg2"):
                 (ld, lv), (hd, _hv), (sd, sv) = scols
                 m = lv & sd.astype(bool) & sv
                 slo = jnp.zeros(CAP, jnp.int64).at[seg].add(
@@ -363,8 +372,13 @@ def _merge_kernel(key_dtypes: Tuple[str, ...], kinds: Tuple[str, ...],
                     jnp.where(m, hd, jnp.int64(0)), mode="drop")
                 carry = slo >> 32
                 slo, shi = slo & jnp.int64(0xFFFFFFFF), shi + carry
-                shas = jnp.zeros(CAP, bool).at[seg].max(m, mode="drop")
-                outs.append((slo, shi, shas))
+                if kind == "avg2":
+                    scnt = jnp.zeros(CAP, jnp.int64).at[seg].add(
+                        jnp.where(m, sd, jnp.int64(0)), mode="drop")
+                    outs.append((slo, shi, scnt))
+                else:
+                    shas = jnp.zeros(CAP, bool).at[seg].max(m, mode="drop")
+                    outs.append((slo, shi, shas))
             elif kind == "sum":
                 (sd, sv), (hd, hv) = scols
                 m = sv & hd.astype(bool) & hv
@@ -466,7 +480,8 @@ class DeviceMergeAgger:
         self.child_schema = child_schema
         self.fns = op._make_fns(child_schema)
         self.kinds = tuple(
-            "sum2" if getattr(fn, "limbs", False) else self._KINDS[a.agg.fn]
+            ("sum2" if a.agg.fn == E.AggFunction.SUM else "avg2")
+            if getattr(fn, "limbs", False) else self._KINDS[a.agg.fn]
             for a, fn in zip(op.aggs, self.fns))
 
     def run(self, batches: List[ColumnarBatch]):
@@ -512,7 +527,7 @@ class DeviceMergeAgger:
             p += 2
         final = not op.is_partial_output
         for a, fn, kind in zip(op.aggs, self.fns, self.kinds):
-            nstate = {"sum": 2, "sum2": 3, "count": 1, "avg": 2,
+            nstate = {"sum": 2, "sum2": 3, "count": 1, "avg": 2, "avg2": 3,
                       "min": 2, "max": 2}[kind]
             state = list(outs[p:p + nstate])
             p += nstate
@@ -547,10 +562,11 @@ def _partial_kernel(key_dtypes: Tuple[str, ...], specs: Tuple[Tuple[str, int], .
         for (kind, rescale, acc_dt), (ad, av) in zip(specs, args):
             sa = ad[order]
             sv = av[order] & s_exists
-            if kind == "sum2":
+            if kind in ("sum2", "avg2"):
                 # wide-decimal sum as two int64 limbs (lo 32 bits, hi rest):
                 # per-segment limb sums fit int64 for any capacity, totals
-                # renormalize so lo stays in [0, 2^32)
+                # renormalize so lo stays in [0, 2^32). avg2 additionally
+                # carries the count instead of the has flag
                 x = sa.astype(jnp.int64)
                 vlo = jnp.where(sv, x & jnp.int64(0xFFFFFFFF), jnp.int64(0))
                 vhi = jnp.where(sv, x >> 32, jnp.int64(0))
@@ -560,8 +576,14 @@ def _partial_kernel(key_dtypes: Tuple[str, ...], specs: Tuple[Tuple[str, int], .
                     vhi, mode="drop")
                 carry = slo >> 32
                 slo, shi = slo & jnp.int64(0xFFFFFFFF), shi + carry
-                shas = jnp.zeros(nseg_total, bool).at[seg].max(sv, mode="drop")
-                outs.append(("sum2", slo, shi, shas))
+                if kind == "avg2":
+                    scnt = jnp.zeros(nseg_total, jnp.int64).at[seg].add(
+                        sv.astype(jnp.int64), mode="drop")
+                    outs.append(("avg2", slo, shi, scnt))
+                else:
+                    shas = jnp.zeros(nseg_total, bool).at[seg].max(
+                        sv, mode="drop")
+                    outs.append(("sum2", slo, shi, shas))
             elif kind in ("sum", "avg"):
                 x = sa.astype(jnp.dtype(acc_dt))  # widen BEFORE accumulating
                 if rescale:

@@ -351,30 +351,53 @@ class CountAgg(AggFunction):
 
 class AvgAgg(AggFunction):
     """State: [sum (sum-type), count i64]; final divides with Spark scale
-    rules (decimal avg result scale via converter result_type)."""
+    rules (decimal avg result scale via converter result_type). A
+    decimal(9..18) arg's sum type is decimal(19..28): the sum then rides
+    the same two-int64-limb device layout as SUM (state [lo, hi, count])
+    with an exact host combine+divide at finalization."""
 
-    def __init__(self, agg, arg_type, result_type):
+    def __init__(self, agg, arg_type, result_type, limbs=None):
         super().__init__(agg, arg_type, result_type)
+        from blaze_tpu.ir.aggstate import limb_state, limb_tag
+
         if isinstance(arg_type, T.DecimalType):
             self.sum_type = T.DecimalType(min(arg_type.precision + 10, 38), arg_type.scale)
         else:
             self.sum_type = T.F64
+        self.limbs = limb_state(arg_type, self.sum_type) if limbs is None \
+            else bool(limbs)
         self._sum = SumAgg(agg, arg_type, self.sum_type, limbs=False)
         self._cnt = CountAgg(agg, arg_type, T.I64)
-        self.host = self._sum.host
+        self.host = (not self.limbs) and self._sum.host
+        if self.limbs:
+            self._limb_tag = limb_tag(self.sum_type)
 
     def state_fields(self):
+        if self.limbs:
+            return [(self._limb_tag, T.I64), ("sum_hi", T.I64), ("count", T.I64)]
         return [("sum", self.sum_type), ("count", T.I64)]
 
     def init_state(self, capacity):
+        if self.limbs:
+            return [jnp.zeros(capacity, jnp.int64), jnp.zeros(capacity, jnp.int64),
+                    jnp.zeros(capacity, jnp.int64)]
         if self.host:
             return [np.zeros(capacity, self._sum._npdt), np.zeros(capacity, np.int64)]
         return [self._sum.init_state(capacity)[0], self._cnt.init_state(capacity)[0]]
 
     def grow(self, state, capacity):
-        return [_grow(state[0], capacity), _grow(state[1], capacity)]
+        return [_grow(s, capacity) for s in state]
 
     def update(self, state, slots, value, validity, mask, order=None):
+        if self.limbs:
+            lo, hi, c = state
+            m = validity & mask
+            v = value.astype(jnp.int64)
+            lo = lo.at[slots].add(
+                jnp.where(m, v & jnp.int64(0xFFFFFFFF), jnp.int64(0)), mode="drop")
+            hi = hi.at[slots].add(jnp.where(m, v >> 32, jnp.int64(0)), mode="drop")
+            c = c.at[slots].add(m.astype(jnp.int64), mode="drop")
+            return list(_limb_renorm(lo, hi)) + [c]
         s, c = state
         if self.host:
             in_scale = self.arg_type.scale if isinstance(self.arg_type, T.DecimalType) else None
@@ -388,6 +411,17 @@ class AvgAgg(AggFunction):
         return [s, c]
 
     def merge(self, state, slots, partial_cols, mask, n):
+        if self.limbs:
+            lo, hi, c = state
+            plo, phi, pcnt = partial_cols
+            m = pcnt.data.astype(bool) & pcnt.validity & mask
+            lo = lo.at[slots].add(jnp.where(m, plo.data, jnp.int64(0)),
+                                  mode="drop")
+            hi = hi.at[slots].add(jnp.where(m, phi.data, jnp.int64(0)),
+                                  mode="drop")
+            c = c.at[slots].add(jnp.where(m, pcnt.data, jnp.int64(0)),
+                                mode="drop")
+            return list(_limb_renorm(lo, hi)) + [c]
         psum, pcnt = partial_cols
         s, c = state
         if self.host:
@@ -407,6 +441,11 @@ class AvgAgg(AggFunction):
         return [s, c]
 
     def state_columns(self, state, num_slots, capacity):
+        if self.limbs:
+            lo, hi, c = self.grow(state, capacity)
+            ones = jnp.ones(capacity, bool)
+            return [DeviceColumn(T.I64, lo, ones), DeviceColumn(T.I64, hi, ones),
+                    DeviceColumn(T.I64, c, ones)]
         s, c = self.grow(state, capacity)
         if self.host:
             cn = c
@@ -416,25 +455,37 @@ class AvgAgg(AggFunction):
         return [DeviceColumn(self.sum_type, s, c > 0),
                 DeviceColumn(T.I64, c, jnp.ones(capacity, bool))]
 
+    def _decimal_divide(self, totals, counts, num_slots, has):
+        """Exact Decimal sum/count with Spark HALF_UP rounding and
+        check_overflow nulling. ``totals`` unscaled object ints."""
+        from decimal import ROUND_HALF_UP, Decimal
+
+        q = Decimal(1).scaleb(-self.result_type.scale)
+        bound = Decimal(10) ** (self.result_type.precision - self.result_type.scale)
+        out = []
+        for i in range(num_slots):
+            if not has[i]:
+                out.append(None)
+                continue
+            v = (Decimal(int(totals[i])).scaleb(-self.sum_type.scale)
+                 / Decimal(int(counts[i]))).quantize(q, rounding=ROUND_HALF_UP)
+            out.append(v if abs(v) < bound else None)
+        return HostColumn(self.result_type,
+                          pa.array(out, type=T.to_arrow_type(self.result_type)))
+
     def final_column(self, state, num_slots, capacity):
+        if self.limbs:
+            lo, hi, c = state
+            packed = np.asarray(jnp.stack(
+                [lo[:num_slots], hi[:num_slots], c[:num_slots]]))
+            totals = (packed[1].astype(object) << 32) + packed[0].astype(object)
+            counts = packed[2]
+            return self._decimal_divide(totals, counts, num_slots, counts > 0)
         s, c = self.grow(state, capacity)
         if self.host:
             has = c > 0
             if self._sum._decimal_obj:
-                from decimal import ROUND_HALF_UP, Decimal
-
-                q = Decimal(1).scaleb(-self.result_type.scale)
-                bound = Decimal(10) ** (self.result_type.precision - self.result_type.scale)
-                out = []
-                for i in range(num_slots):
-                    if not has[i]:
-                        out.append(None)
-                        continue
-                    v = (Decimal(int(s[i])).scaleb(-self.sum_type.scale)
-                         / Decimal(int(c[i]))).quantize(q, rounding=ROUND_HALF_UP)
-                    out.append(v if abs(v) < bound else None)
-                return HostColumn(self.result_type,
-                                  pa.array(out, type=T.to_arrow_type(self.result_type)))
+                return self._decimal_divide(s, c, num_slots, has)
             out = s.astype(np.float64) / np.where(has, c, 1)
             return _host_col_out(T.F64, out[:num_slots], has[:num_slots])
         has = c > 0
@@ -889,7 +940,7 @@ def create_agg_function(agg: E.AggExpr, input_schema: T.Schema,
     if agg.fn == F.COUNT:
         return CountAgg(agg, arg_t, T.I64)
     if agg.fn == F.AVG:
-        return AvgAgg(agg, arg_t, result_t)
+        return AvgAgg(agg, arg_t, result_t, limbs=limbs)
     if agg.fn == F.MIN:
         return MinMaxAgg(agg, arg_t, result_t, "min")
     if agg.fn == F.MAX:

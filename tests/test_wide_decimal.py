@@ -199,9 +199,10 @@ def test_device_paths_engage(tmp_path):
 
 
 def test_avg_wide_sum_type_stays_exact(tmp_path):
-    # AVG(decimal(17,2)): sum_type is decimal(27,2) — the embedded SumAgg
-    # must NOT switch to limb layout (AVG state stays [sum, count] on the
-    # exact host path); regression for the limb-leak crash
+    # AVG(decimal(17,2)): sum_type is decimal(27,2) — since round 2's
+    # limb-AVG, the state is [sum_lo, sum_hi, count] on device; the result
+    # must remain exactly equal to Decimal math (originally a regression
+    # test for the embedded-SumAgg limb-leak crash)
     tbl, expected_sums = _table(n=1200, seed=29)
     counts = {}
     for k in tbl["k"].to_pylist():
@@ -274,3 +275,71 @@ def test_wide_arg_stays_host(tmp_path):
     with Session() as s:
         out = s.execute_to_pydict(agg)
     assert out["total"] == [Decimal(sum(unscaled)).scaleb(-2)]
+
+
+def test_avg_limb_schema_and_device_paths(tmp_path):
+    """AVG(decimal(9..18)) carries [sum_lo, sum_hi, count] limb state and
+    the device partial AND merge paths claim it (no host fallback)."""
+    from blaze_tpu.ops import agg_device
+    from blaze_tpu.runtime.executor import build_operator
+
+    tbl, _ = _table(n=400, seed=13)
+    scan = _scan(tbl, tmp_path)
+    partial = N.Agg(scan, E.AggExecMode.HASH_AGG, [("k", E.Column("k"))], [
+        N.AggColumn(E.AggExpr(F.AVG, [E.Column("v")], T.DecimalType(21, 6)),
+                    E.AggMode.PARTIAL, "a")])
+    names = partial.output_schema.names
+    assert names == ["k", "a#sum_lo@27.2", "a#sum_hi", "a#count"]
+    assert [str(f.dtype) for f in partial.output_schema.fields[1:]] == \
+        ["int64", "int64", "int64"]
+    pop = build_operator(partial)
+    assert agg_device.supports_device_partial(pop, pop.children[0].schema)
+    final = N.Agg(
+        N.EmptyPartitions(partial.output_schema, 1), E.AggExecMode.HASH_AGG,
+        [("k", E.Column("k"))], [
+            N.AggColumn(E.AggExpr(F.AVG, [E.Column("v")], T.DecimalType(21, 6)),
+                        E.AggMode.FINAL, "a")])
+    fop = build_operator(final)
+    assert agg_device.supports_device_merge(fop, fop.children[0].schema)
+    assert final.output_schema["a"].dtype == T.DecimalType(21, 6)
+
+
+def test_avg_limb_two_stage_exact(tmp_path):
+    """Two-stage wide AVG over an exchange: negative values and nulls,
+    exact vs python Decimal (HALF_UP at the result scale)."""
+    from decimal import ROUND_HALF_UP
+
+    rng = np.random.default_rng(17)
+    n = 3000
+    unscaled = rng.integers(-9 * 10**16, 9 * 10**16, n)
+    ks = rng.integers(1, 9, n)
+    vals = [None if i % 11 == 0 else Decimal(int(u)).scaleb(-2)
+            for i, u in enumerate(unscaled)]
+    tbl = pa.table({
+        "k": pa.array(ks, type=pa.int64()),
+        "v": pa.array(vals, type=pa.decimal128(17, 2)),
+    })
+    sums, counts = {}, {}
+    for k, v in zip(ks, vals):
+        if v is None:
+            continue
+        sums[int(k)] = sums.get(int(k), Decimal(0)) + v
+        counts[int(k)] = counts.get(int(k), 0) + 1
+    scan = _scan(tbl, tmp_path, nparts=2)
+    rt = T.DecimalType(21, 6)
+    partial = N.Agg(scan, E.AggExecMode.HASH_AGG, [("k", E.Column("k"))], [
+        N.AggColumn(E.AggExpr(F.AVG, [E.Column("v")], rt),
+                    E.AggMode.PARTIAL, "a")])
+    ex = N.ShuffleExchange(partial, N.HashPartitioning([E.Column("k")], 2))
+    final = N.Agg(ex, E.AggExecMode.HASH_AGG, [("k", E.Column("k"))], [
+        N.AggColumn(E.AggExpr(F.AVG, [E.Column("v")], rt),
+                    E.AggMode.FINAL, "a")])
+    plan = N.Sort(N.ShuffleExchange(final, N.SinglePartitioning(1)),
+                  [E.SortOrder(E.Column("k"))])
+    with Session() as s:
+        out = s.execute_to_pydict(plan)
+    q = Decimal(1).scaleb(-6)
+    exp = [(sums[k] / counts[k]).quantize(q, rounding=ROUND_HALF_UP)
+           for k in sorted(sums)]
+    assert out["k"] == sorted(sums)
+    assert out["a"] == exp
