@@ -162,7 +162,7 @@ def _placement_says_host(paths) -> bool:
     from blaze_tpu.ir import nodes as N
     from blaze_tpu.runtime import placement
 
-    lp = placement.read_cached_profile()
+    lp = placement.preinit_profile()
     if lp is None or lp.is_colocated:
         return False
     plan = build_plan(paths)

@@ -116,7 +116,6 @@ class AggExec(Operator):
             child_op = self.children[0]
             source = child_op
             fused_preds = None
-            import jax
 
             # fusion is auto-on when the PROCESS backend is the CPU (local
             # compiles are cheap and the compaction it removes is the CPU
@@ -125,9 +124,11 @@ class AggExec(Operator):
             # remote-compile plugin even its CPU-target kernel builds route
             # through the remote service (~100s cold), so there fusion
             # stays opt-in (amortized by the persistent compile cache).
+            from blaze_tpu.runtime import placement
+
             fuse_conf = ctx.conf.fused_filter_agg
             fuse_ok = fuse_conf if fuse_conf is not None \
-                else jax.default_backend() == "cpu"
+                else placement.backend_is_cpu_hint()
             if fuse_ok and isinstance(child_op, FilterExec) \
                     and supports_fused_filter(
                     child_op, child_op.children[0].schema):

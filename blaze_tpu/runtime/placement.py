@@ -99,6 +99,9 @@ def _parse_env() -> Optional[LinkProfile]:
 _PROBE_SRC = r"""
 import json, math, time
 import jax, numpy as np
+# match the engine's real transfer dtypes: without x64 the int64 probe
+# buffer canonicalizes to int32 and only half the claimed bytes move
+jax.config.update("jax_enable_x64", True)
 import jax.numpy as jnp
 
 platform = jax.default_backend()
@@ -120,12 +123,13 @@ else:
     sl = d[: 1 << 17]  # warm the slice kernel: compile is not transfer
     sl.block_until_ready()
     t0 = time.perf_counter()
-    np.asarray(sl)
+    pulled = np.asarray(sl)
     d2h_t = max(time.perf_counter() - t0 - sync, 1e-6)
     print(json.dumps({
         "platform": platform,
-        "h2d_bytes_per_s": h_arr.nbytes / h2d_t,
-        "d2h_bytes_per_s": (1 << 20) / d2h_t,
+        # byte counts from the arrays that actually crossed the link
+        "h2d_bytes_per_s": d.nbytes / h2d_t,
+        "d2h_bytes_per_s": pulled.nbytes / d2h_t,
         "sync_s": sync,
     }))
 """
@@ -212,6 +216,14 @@ def read_cached_profile() -> Optional[LinkProfile]:
         return LinkProfile(**d)
     except (OSError, ValueError, TypeError):
         return None
+
+
+def preinit_profile() -> Optional[LinkProfile]:
+    """Profile obtainable BEFORE any backend init, with the same precedence
+    link_profile() uses: the BLAZE_TPU_LINK env override first, then the
+    disk cache. Lets drivers (bench.py) make a host-pin decision that
+    cannot disagree with the in-process placement on the same rig."""
+    return _parse_env() or read_cached_profile()
 
 
 def link_profile() -> LinkProfile:
@@ -332,22 +344,66 @@ def decide(root: N.PlanNode, resources: dict, conf) -> str:
     return choice
 
 
+def backend_is_cpu_hint() -> bool:
+    """Best-effort "will this process's default backend be the CPU",
+    decided WITHOUT initializing an accelerator backend where possible:
+    the jax_platforms pin first, then the measured link profile (a
+    ``failed`` probe means the device is unusable — host is the answer),
+    and only when neither decides does it ask jax directly."""
+    import jax
+
+    plats = jax.config.jax_platforms or ""
+    if plats:
+        return plats.split(",")[0] == "cpu"
+    with _lock:
+        lp = _profile
+    if lp is not None:
+        if lp.platform in ("cpu", "failed"):
+            return True
+        if lp.platform != "env":
+            return False  # measured accelerator platform (e.g. "tpu")
+        # "env" is a forced link spec — it says nothing about the backend
+    return jax.default_backend() == "cpu"
+
+
 @contextlib.contextmanager
 def placed(decision: str):
     """Scope a task thread to the decided execution device. "host" pins the
     CPU backend via jax.default_device (thread-local); "device" is the
-    backend default. No-op when the default backend already is the CPU."""
+    backend default. Decides from the jax_platforms pin and the measured
+    profile — NOT jax.default_backend() — so a host placement after a
+    failed probe never initializes (and hangs on) a wedged backend."""
     import jax
 
-    if decision == "host" and jax.default_backend() != "cpu":
+    if decision != "host":
+        yield
+        return
+    plats = jax.config.jax_platforms or ""
+    if plats and plats.split(",")[0] == "cpu":
+        yield  # process already pinned to the host backend
+        return
+    with _lock:
+        lp = _profile
+    if lp is not None and lp.platform == "cpu":
+        yield
+        return
+    if lp is not None and lp.platform == "failed" and not plats:
+        # Device unusable this process and no explicit platform pin to
+        # honor: pin the process to cpu while backends are uninitialized
+        # so neither this task nor the cpu-device lookup below can turn
+        # up the wedged backend. If backends are already initialized the
+        # update is a no-op and the thread-local pin below still lands
+        # on the (already present) cpu device.
         try:
-            cpu = jax.local_devices(backend="cpu")[0]
-        except RuntimeError:
-            # cpu backend excluded (e.g. jax_platforms pinned to tpu only):
-            # nothing to pin to — run on the process default
-            yield
-            return
-        with jax.default_device(cpu):
-            yield
-    else:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    try:
+        cpu = jax.local_devices(backend="cpu")[0]
+    except RuntimeError:
+        # cpu backend excluded (e.g. jax_platforms pinned to tpu only):
+        # nothing to pin to — run on the process default
+        yield
+        return
+    with jax.default_device(cpu):
         yield

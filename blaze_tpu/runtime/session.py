@@ -157,14 +157,17 @@ class Session:
             finally:
                 clear_task_context()
 
-        if nparts <= 1 or self.max_workers <= 1:
-            for p in range(nparts):
-                yield from run_partition_stream(p)
+        if nparts <= 0:
             return
 
-        # concurrent partitions with bounded per-partition queues: device
-        # round trips overlap while memory stays O(queue depth), and batches
-        # still stream out in partition order
+        # Every partition — including a single one — drains through a
+        # producer thread with a bounded queue: the operator generator and
+        # its placement context live entirely on that thread, so placed()'s
+        # thread-local device pin can never stay active on the consumer's
+        # thread between yields, and an abandoned stream unwinds on the
+        # producer rather than a GC finalizer thread (ADVICE r2). With >1
+        # partition the same structure overlaps device round trips while
+        # memory stays O(queue depth); batches stream out in partition order.
         import queue as _queue
 
         DONE = object()
@@ -189,7 +192,8 @@ class Session:
             except BaseException as exc:
                 _put(queues[p], exc)
 
-        with ThreadPoolExecutor(max_workers=min(self.max_workers, nparts)) as pool:
+        with ThreadPoolExecutor(
+                max_workers=max(1, min(self.max_workers, nparts))) as pool:
             try:
                 for p in range(nparts):
                     pool.submit(produce, p)
