@@ -117,6 +117,20 @@ class Config:
     # amortized by the persistent compile cache). True/False force it.
     fused_filter_agg: Optional[bool] = None
 
+    # Dense-bucket grouped aggregation: when a partial agg's group keys are
+    # integers whose observed range fits a small table, the kernel scatters
+    # into range-sized segment tables instead of capacity-sized ones (the
+    # TPU-friendly analogue of the reference's hash table, agg_hash_map.rs
+    # — one scatter-add pass, no sort, no 131k-wide tables for 400 groups).
+    # None = auto: ON when the stage's effective backend is the CPU (the
+    # range probe costs one extra sync, ~free locally, ~70ms per stream on
+    # a tunneled accelerator). True/False force it.
+    dense_agg: Optional[bool] = None
+
+    # Upper bound on the dense-agg bucket-table size (product of per-key
+    # rounded ranges). Ranges beyond this fall back to the sort kernel.
+    dense_agg_max_buckets: int = 65536
+
     # Adaptive device placement (runtime/placement.py — the TPU analogue of
     # the reference's removeInefficientConverts): "auto" runs each stage
     # where the measured-link cost model says it is cheapest; "device" /

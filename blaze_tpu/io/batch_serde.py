@@ -132,8 +132,11 @@ def deserialize_batch(payload: bytes) -> ColumnarBatch:
         pos += blen
         return b
 
-    cols = []
+    from blaze_tpu.core.batch import device_columns
+
+    cols: List = [None] * len(header["cols"])
     next_host = 0
+    dev_items, dev_slots = [], []
     for i, meta in enumerate(header["cols"]):
         f = schema[i]
         if meta["kind"] == "dev":
@@ -150,10 +153,14 @@ def deserialize_batch(payload: bytes) -> ColumnarBatch:
                     arr.reshape(itemsize, n).T)
             data = arr.view(npdt).reshape(n) if n else np.zeros(0, dtype=npdt)
             validity = unpack_bitmap(vraw, n) if n else np.zeros(0, dtype=bool)
-            cols.append(DeviceColumn.from_numpy(f.dtype, data, validity, cap))
+            dev_items.append((f.dtype, data, validity))
+            dev_slots.append(i)
         else:
-            cols.append(HostColumn(f.dtype, host_arrays[next_host]))
+            cols[i] = HostColumn(f.dtype, host_arrays[next_host])
             next_host += 1
+    # all device planes of the batch ride one batched device_put
+    for slot, col in zip(dev_slots, device_columns(dev_items, cap)):
+        cols[slot] = col
     return ColumnarBatch(schema, cols, n)
 
 

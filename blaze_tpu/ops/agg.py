@@ -135,7 +135,8 @@ class AggExec(Operator):
                 source = child_op.children[0]
                 fused_preds = child_op.predicates
             agger = DevicePartialAgger(self, child_schema,
-                                       fused_predicates=fused_preds)
+                                       fused_predicates=fused_preds,
+                                       conf=ctx.conf)
             src_iter = (source.execute(partition, ctx, metrics.child(0).child(0))
                         if source is not child_op else
                         self.execute_child(0, partition, ctx, metrics))

@@ -263,8 +263,10 @@ class JoinHashMap:
             if len(uniq) == 0:
                 return np.full(batch.num_rows, -1, np.int64), False
             codes = np.searchsorted(uniq, w)
-            hit = valid & (codes < len(uniq)) & \
+            hit = (codes < len(uniq)) & \
                 (uniq[np.clip(codes, 0, len(uniq) - 1)] == w)
+            if valid is not None:  # None = all rows valid
+                hit = hit & valid
             return np.where(hit, codes, -1), False
         return key_codes(batch, cols, self.key_map, insert=False), False
 

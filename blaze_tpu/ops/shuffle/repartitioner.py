@@ -29,6 +29,12 @@ class Repartitioner:
         """(num_rows,) int32 partition id per row."""
         raise NotImplementedError
 
+    def partition_ids_host(self, host: HostBatch) -> Optional[np.ndarray]:
+        """Partition ids straight from already-pulled host planes, at numpy
+        speed with no device dispatch. None = no host path (caller falls
+        back to ``partition_ids`` on the device batch)."""
+        return None
+
     def _split_ranges(self, pids: np.ndarray):
         """Stable pid-sort split: (order, [(pid, start, end), ...])."""
         n = len(pids)
@@ -66,7 +72,10 @@ class Repartitioner:
         host = HostBatch.from_batch(batch)
         if self.num_partitions == 1:
             return [(0, host)]
-        order, ranges = self._split_ranges(self.partition_ids(batch))
+        pids = self.partition_ids_host(host)
+        if pids is None:
+            pids = self.partition_ids(batch)
+        order, ranges = self._split_ranges(pids)
         gathered = host.take(order)
         return [(pid, gathered.slice(s, e - s)) for pid, s, e in ranges]
 
@@ -93,6 +102,33 @@ class HashPartitioner(Repartitioner):
         n = np.int64(self.num_partitions)
         return (((hashes.astype(np.int64) % n) + n) % n).astype(np.int32)
 
+    def partition_ids_host(self, host):
+        """Numpy murmur3 over plain-column integer keys of an already
+        pulled batch (the shuffle-write staging path): bit-exact with the
+        device kernel, no dispatch + pull round trip. Non-column exprs,
+        arrow-resident columns, and float keys (NaN/-0.0 normalization
+        lives in the device kernel) decline."""
+        from blaze_tpu.exprs import spark_hash as SH
+
+        names = [f.name for f in host.schema.fields]
+        h = np.full(host.num_rows, 42, dtype=np.uint32)
+        for e in self.exprs:
+            if not isinstance(e, E.Column) or e.name not in names:
+                return None
+            idx = names.index(e.name)
+            it = host.items[idx]
+            if not isinstance(it, tuple):
+                return None
+            kind = SH._dtype_kind(host.schema[idx].dtype)
+            if kind not in ("i32", "i64"):
+                return None
+            data, valid = it
+            new = (SH.murmur3_int64_np(data, h) if kind == "i64"
+                   else SH.murmur3_int32_np(data, h))
+            h = np.where(valid, new, h) if valid is not None else new
+        n = np.int64(self.num_partitions)
+        return (((h.view(np.int32).astype(np.int64) % n) + n) % n).astype(np.int32)
+
 
 class RoundRobinPartitioner(Repartitioner):
     """Round robin with a deterministic start so retried map tasks produce
@@ -108,6 +144,9 @@ class RoundRobinPartitioner(Repartitioner):
         pids = (np.arange(n, dtype=np.int64) + self.next_pid) % self.num_partitions
         self.next_pid = int((self.next_pid + n) % self.num_partitions)
         return pids.astype(np.int32)
+
+    def partition_ids_host(self, host):
+        return self.partition_ids(host)  # only reads num_rows
 
 
 class RangePartitioner(Repartitioner):

@@ -302,7 +302,10 @@ class MeshBatchExchange:
                 else:
                     # fixed-width value materialized host-side (e.g. generic
                     # agg output): extract planes without a device round trip
-                    items.append(arrow_fixed_planes(c.array, schema[i].dtype))
+                    d, v = arrow_fixed_planes(c.array, schema[i].dtype)
+                    if v is None:  # None = all valid
+                        v = np.ones(len(d), bool)
+                    items.append((d, v))
             shard_items.append(items)
         for i in host_slots:
             arrays = [it[i] for it in shard_items if it is not None]

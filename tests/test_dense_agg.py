@@ -1,0 +1,163 @@
+"""Dense-bucket partial aggregation (ops/agg_device.py dense path).
+
+The TPU-friendly analogue of the reference's one-pass hash table
+(``agg/agg_hash_map.rs``): integer keys whose observed range fits a small
+static table scatter straight into range-sized segment slots — no sort, no
+capacity-sized tables. These tests pin the orchestration edges: probe +
+plan, range-overflow widening, all-null-key batches keeping the anchor,
+fallback beyond the bucket cap, and end-to-end equality with the sort
+kernel on nullable multi-key input.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from blaze_tpu.core.batch import ColumnarBatch
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import nodes as N
+from blaze_tpu.ir import types as T
+from blaze_tpu.ops.agg_device import DevicePartialAgger
+from blaze_tpu.runtime.executor import build_operator
+from blaze_tpu.runtime.session import Session
+
+SCHEMA = pa.schema([("k1", pa.int64()), ("k2", pa.int64()), ("v", pa.int64())])
+
+
+def _scan_stub():
+    import tempfile
+
+    import pyarrow.parquet as pq
+
+    from blaze_tpu.ops.parquet import scan_node_for_files
+
+    td = tempfile.mkdtemp(prefix="dense_agg_")
+    pq.write_table(pa.table({"k1": [1], "k2": [0], "v": [1]},
+                            schema=SCHEMA), td + "/t.parquet")
+    return scan_node_for_files([td + "/t.parquet"], num_partitions=1)
+
+
+def _agger(groupings=("k1",)):
+    schema = T.schema_from_arrow(SCHEMA)
+    node = N.Agg(_scan_stub(), E.AggExecMode.HASH_AGG,
+                 [(g, E.Column(g)) for g in groupings], [
+        N.AggColumn(E.AggExpr(E.AggFunction.SUM, [E.Column("v")]),
+                    E.AggMode.PARTIAL, "s")])
+    return DevicePartialAgger(build_operator(node), schema)
+
+
+def _batch(ks, vs):
+    return ColumnarBatch.from_arrow(pa.table(
+        {"k1": pa.array(ks, type=pa.int64()),
+         "k2": pa.array([0] * len(ks), type=pa.int64()),
+         "v": pa.array(vs, type=pa.int64())}))
+
+
+def test_dense_engages_and_anchors_far_from_zero():
+    agger = _agger()
+    out = agger.process(_batch([9_000_001, 9_000_002] * 50, [1] * 100))
+    assert agger._dense_state is not None, "dense plan expected"
+    bases, sizes, out_cap = agger._dense_state
+    assert bases == (9_000_001,) and sizes[0] <= 4
+    got = out.to_arrow().to_pydict()
+    assert sorted(got["k1"]) == [9_000_001, 9_000_002]
+    assert got["s#sum"] == [50, 50]
+
+
+def test_range_overflow_widens_within_budget():
+    agger = _agger()
+    o1 = agger.process(_batch([5, 6, 7] * 100, [1] * 300))
+    o2 = agger.process(_batch([50, 51] * 100, [2] * 200))
+    assert o1.num_rows == 3 and o2.num_rows == 2
+    assert agger._dense_state is not None, "union 5..51 fits: dense stays"
+    assert sorted(o2.to_arrow().to_pydict()["s#sum"]) == [200, 200]
+
+
+def test_range_overflow_beyond_cap_falls_back_correctly():
+    agger = _agger()
+    o1 = agger.process(_batch([5, 6, 7] * 100, [1] * 300))
+    # union with 10005.. would need 16k buckets > batch capacity: dense
+    # disables, the sort kernel takes over, results stay exact
+    o2 = agger.process(_batch([10005, 10006] * 100, [2] * 200))
+    assert agger._dense_ok is False
+    assert sorted(o2.to_arrow().to_pydict()["s#sum"]) == [200, 200]
+    assert o1.num_rows == 3
+
+
+def test_all_null_key_batch_keeps_anchor():
+    agger = _agger()
+    agger.process(_batch([9_000_001, 9_000_002] * 50, [1] * 100))
+    st = agger._dense_state
+    onull = agger.process(_batch([None] * 64, [3] * 64))
+    assert onull.num_rows == 1  # the null-key group
+    assert onull.to_arrow().to_pydict()["s#sum"] == [192]
+    assert agger._dense_state == st, "all-null probe must not move the anchor"
+
+
+def test_non_integer_keys_decline_dense(tmp_path):
+    import pyarrow.parquet as pq
+
+    from blaze_tpu.ops.parquet import scan_node_for_files
+
+    path = str(tmp_path / "f.parquet")
+    pq.write_table(pa.table({"k": pa.array([1.5, 2.5], type=pa.float64()),
+                             "v": pa.array([1, 2], type=pa.int64())}), path)
+    scan = scan_node_for_files([path], num_partitions=1)
+    node = N.Agg(scan, E.AggExecMode.HASH_AGG,
+                 [("k", E.Column("k"))], [
+        N.AggColumn(E.AggExpr(E.AggFunction.SUM, [E.Column("v")]),
+                    E.AggMode.PARTIAL, "s")])
+    op = build_operator(node)
+    agger = DevicePartialAgger(op, op.children[0].schema)
+    assert agger._dense_enabled() is False
+
+
+def test_dense_matches_oracle_multikey_nulls(tmp_path):
+    import pyarrow.parquet as pq
+
+    from blaze_tpu.ops.parquet import scan_node_for_files
+
+    rng = np.random.default_rng(3)
+    n = 50_000
+    k1 = rng.integers(1_000_000, 1_000_050, n).astype(object)
+    k2 = rng.integers(0, 7, n).astype(object)
+    for i in rng.choice(n, 500, replace=False):
+        k1[i] = None
+    for i in rng.choice(n, 300, replace=False):
+        k2[i] = None
+    v = rng.integers(-1000, 1000, n)
+    tbl = pa.table({"k1": pa.array(list(k1), type=pa.int64()),
+                    "k2": pa.array(list(k2), type=pa.int64()),
+                    "v": pa.array(v, type=pa.int64())})
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(tbl, path)
+    scan = scan_node_for_files([path], num_partitions=1)
+
+    def aggs(mode):
+        return [
+            N.AggColumn(E.AggExpr(E.AggFunction.SUM, [E.Column("v")]), mode, "s"),
+            N.AggColumn(E.AggExpr(E.AggFunction.MIN, [E.Column("v")]), mode, "mn"),
+            N.AggColumn(E.AggExpr(E.AggFunction.MAX, [E.Column("v")]), mode, "mx"),
+            N.AggColumn(E.AggExpr(E.AggFunction.COUNT, []), mode, "c"),
+            N.AggColumn(E.AggExpr(E.AggFunction.AVG, [E.Column("v")]), mode, "a"),
+        ]
+
+    keys = [("k1", E.Column("k1")), ("k2", E.Column("k2"))]
+    partial = N.Agg(scan, E.AggExecMode.HASH_AGG, keys, aggs(E.AggMode.PARTIAL))
+    ex = N.ShuffleExchange(partial, N.HashPartitioning([E.Column("k1")], 3))
+    final = N.Agg(ex, E.AggExecMode.HASH_AGG, keys, aggs(E.AggMode.FINAL))
+    plan = N.Sort(N.ShuffleExchange(final, N.SinglePartitioning(1)),
+                  [E.SortOrder(E.Column("k1")), E.SortOrder(E.Column("k2"))])
+    od = Session().execute_to_table(plan).to_pandas()
+
+    df = tbl.to_pandas()
+    g = df.groupby(["k1", "k2"], dropna=False).agg(
+        s=("v", "sum"), mn=("v", "min"), mx=("v", "max"),
+        c=("v", "size"), a=("v", "mean")).reset_index()
+    g = g.sort_values(["k1", "k2"], na_position="first").reset_index(drop=True)
+    assert len(od) == len(g)
+    assert (od.s.values == g.s.values).all()
+    assert (od.mn.values == g.mn.values).all()
+    assert (od.mx.values == g.mx.values).all()
+    assert (od.c.values == g.c.values).all()
+    assert np.allclose(od.a.astype(float).values, g.a.values)
