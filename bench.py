@@ -1,14 +1,25 @@
-"""Benchmark: TPC-DS q01-class pipeline (scan -> filter -> two-stage hash
-aggregate over an exchange -> top-k), the reference's headline workload shape
-(BASELINE.md config 1).
+"""Benchmark: the four BASELINE.md query shapes over a generated TPC-DS-like
+star schema (the reference's headline workloads, driver `BASELINE.json`):
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-``vs_baseline`` is speedup vs a CPU columnar baseline (pandas/arrow doing the
-identical query over the same parquet files) — the stand-in for Blaze-CPU
-until the reference's absolute numbers are recorded (the reference repo
-publishes none, see BASELINE.md).
+  q01  scan -> decimal filter -> two-stage hash agg over an exchange -> top-k
+  q06  group-by agg + broadcast hash join (BHJ)
+  q17  star-schema multi-way join + shuffle exchange
+  q47  sort + window rank within partition (SMJ/window class)
 
-Env knobs: BENCH_ROWS (default 1_000_000), BENCH_PARTITIONS (default 4).
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "shapes"}.
+``value`` is the total engine wall-clock across the four shapes;
+``vs_baseline`` is speedup vs pandas doing the identical queries on the same
+parquet files (the round-1/2 denominator, kept for cross-round
+comparability); ``vs_arrow`` is speedup vs pyarrow Acero (multithreaded C++
+joins/group-bys — the strongest engine available in this image, standing in
+for Blaze-CPU; see BASELINE.md). Per-shape wall-clocks and ratios are under
+"shapes"; q01's entry is directly comparable to BENCH_r01/r02's single
+metric. Every shape's engine output is cross-checked against the pandas
+oracle before any number is reported.
+
+Env knobs: BENCH_ROWS (default 1_000_000 fact rows), BENCH_PARTITIONS
+(default 4), BLAZE_BENCH_TUNNEL_WAIT_S (default 1200: how long to wait for
+a wedged TPU tunnel before falling back to CPU).
 """
 
 import json
@@ -30,113 +41,380 @@ from blaze_tpu.ir import types as T
 
 ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
 PARTS = int(os.environ.get("BENCH_PARTITIONS", 4))
+N_ITEMS = 2000
+N_STORES = 400
+N_CUSTOMERS = 100_000
+
+F = E.AggFunction
 
 
-def probe_device(timeout_s: float = 150.0) -> bool:
-    """The axon TPU sits behind a tunnel that can hang indefinitely; probe
-    it in a SUBPROCESS with a deadline. On failure the caller pins the cpu
-    platform (must happen before this process touches a jax backend) so the
-    bench always reports a number instead of hanging the driver."""
+def _axon_present() -> bool:
+    """Is a TPU plugin plausibly configured? Without one, a failed probe
+    means 'CPU-only machine' and waiting for a tunnel is pointless."""
+    return any(".axon_site" in p for p in sys.path) or \
+        any(".axon_site" in p for p in
+            os.environ.get("PYTHONPATH", "").split(os.pathsep))
+
+
+def probe_device(total_wait_s: float = None) -> bool:
+    """The axon TPU sits behind a tunnel that can hang indefinitely OR be
+    transiently wedged. Probe in a SUBPROCESS with a deadline (a wedged
+    transport hangs un-cancellably inside backend calls) and, when a TPU
+    plugin is configured, RETRY within a bounded budget instead of giving
+    up after one attempt (VERDICT r2 weak #1: a single 150s probe forfeited
+    the round's TPU measurement). On failure the caller pins the cpu
+    platform so the bench always reports a number."""
     import subprocess
 
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax, jax.numpy as jnp; float(jnp.arange(8).sum())"],
-            timeout=timeout_s, capture_output=True)
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    if total_wait_s is None:
+        total_wait_s = float(os.environ.get("BLAZE_BENCH_TUNNEL_WAIT_S", 1200))
+    attempt_timeout = 120.0
+    deadline = time.monotonic() + total_wait_s
+    first = True
+    while first or (_axon_present() and time.monotonic() < deadline):
+        first = False
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax, jax.numpy as jnp; float(jnp.arange(8).sum())"],
+                timeout=min(attempt_timeout,
+                            max(deadline - time.monotonic(), 10.0)),
+                capture_output=True)
+            if r.returncode == 0:
+                return True
+        except Exception:
+            pass
+        if not _axon_present():
+            return False
+        time.sleep(min(60.0, max(deadline - time.monotonic(), 0.0)))
+    return False
+
+
+# --------------------------------------------------------------------------
+# data
+# --------------------------------------------------------------------------
+
+
+def _decimal_array(rng, n, lo, hi, prec=7, scale=2):
+    import decimal
+
+    unscaled = rng.integers(lo, hi, n)
+    return pa.array([decimal.Decimal(int(v)).scaleb(-scale) for v in unscaled],
+                    type=pa.decimal128(prec, scale))
 
 
 def make_data(tmpdir: str):
-    import decimal
-
+    """Star schema: per-partition store_returns (q01) + store_sales fact,
+    and item/customer dims. Same generator seed + column shapes as r01/r02
+    for the q01 table."""
     rng = np.random.default_rng(42)
-    paths = []
     per = ROWS // PARTS
+    paths = {"store_returns": [], "store_sales": []}
     for p in range(PARTS):
-        unscaled = rng.integers(0, 10_000_00, per)
-        amt = pa.array([decimal.Decimal(int(v)).scaleb(-2) for v in unscaled],
-                       type=pa.decimal128(7, 2))
+        # draw order matches r01/r02 (amt first) so the q01 table is
+        # byte-identical across rounds
+        amt = _decimal_array(rng, per, 0, 10_000_00)
         tbl = pa.table({
-            "sr_store_sk": pa.array(rng.integers(1, 400, per), type=pa.int64()),
-            "sr_customer_sk": pa.array(rng.integers(1, 100_000, per), type=pa.int64()),
+            "sr_store_sk": pa.array(rng.integers(1, N_STORES, per), type=pa.int64()),
+            "sr_customer_sk": pa.array(rng.integers(1, N_CUSTOMERS, per), type=pa.int64()),
             "sr_return_amt": amt,
         })
         path = os.path.join(tmpdir, f"sr_{p}.parquet")
         pq.write_table(tbl, path, row_group_size=128 * 1024)
-        paths.append(path)
+        paths["store_returns"].append(path)
+    for p in range(PARTS):
+        tbl = pa.table({
+            "ss_item_sk": pa.array(rng.integers(1, N_ITEMS, per), type=pa.int64()),
+            "ss_store_sk": pa.array(rng.integers(1, N_STORES, per), type=pa.int64()),
+            "ss_quantity": pa.array(rng.integers(1, 100, per), type=pa.int64()),
+            "ss_sales_price": _decimal_array(rng, per, 0, 500_00),
+        })
+        path = os.path.join(tmpdir, f"ss_{p}.parquet")
+        pq.write_table(tbl, path, row_group_size=128 * 1024)
+        paths["store_sales"].append(path)
+    cats = ["Books", "Home", "Electronics", "Music", "Sports", "Shoes",
+            "Women", "Men", "Children", "Jewelry"]
+    item = pa.table({
+        "i_item_sk": pa.array(np.arange(1, N_ITEMS + 1), type=pa.int64()),
+        "i_category_id": pa.array(rng.integers(0, len(cats), N_ITEMS), type=pa.int64()),
+        "i_brand_id": pa.array(rng.integers(1, 60, N_ITEMS), type=pa.int64()),
+        "i_current_price": _decimal_array(rng, N_ITEMS, 0, 300_00),
+    })
+    paths["item"] = [os.path.join(tmpdir, "item.parquet")]
+    pq.write_table(item, paths["item"][0])
+    store = pa.table({
+        "s_store_sk": pa.array(np.arange(1, N_STORES + 1), type=pa.int64()),
+        "s_state_id": pa.array(rng.integers(0, 50, N_STORES), type=pa.int64()),
+    })
+    paths["store"] = [os.path.join(tmpdir, "store.parquet")]
+    pq.write_table(store, paths["store"][0])
     return paths
 
 
-def build_plan(paths):
+def _col(name):
+    return E.Column(name)
+
+
+def _two_stage_agg(child, keys, aggs, nparts):
+    partial = N.Agg(child, E.AggExecMode.HASH_AGG, keys, [
+        N.AggColumn(agg, E.AggMode.PARTIAL, name) for name, agg, _dt in aggs])
+    ex = N.ShuffleExchange(partial, N.HashPartitioning(
+        [e for _, e in keys], nparts))
+    return N.Agg(ex, E.AggExecMode.HASH_AGG, keys, [
+        N.AggColumn(agg, E.AggMode.FINAL, name) for name, agg, _dt in aggs])
+
+
+# --------------------------------------------------------------------------
+# shapes: (engine plan, pandas oracle, acero baseline, result check)
+# --------------------------------------------------------------------------
+
+
+def plan_q01(paths):
     from blaze_tpu.ops.parquet import scan_node_for_files
 
-    scan = scan_node_for_files(paths, num_partitions=PARTS)
+    scan = scan_node_for_files(paths["store_returns"], num_partitions=PARTS)
     filt = N.Filter(scan, [E.BinaryExpr(
-        E.BinaryOp.GT, E.Column("sr_return_amt"),
+        E.BinaryOp.GT, _col("sr_return_amt"),
         E.Literal("500.00", T.DecimalType(7, 2)))])
-    partial = N.Agg(filt, E.AggExecMode.HASH_AGG,
-                    [("sr_store_sk", E.Column("sr_store_sk"))], [
-        N.AggColumn(E.AggExpr(E.AggFunction.SUM, [E.Column("sr_return_amt")],
-                              T.DecimalType(17, 2)), E.AggMode.PARTIAL, "total"),
-        N.AggColumn(E.AggExpr(E.AggFunction.COUNT, []), E.AggMode.PARTIAL, "cnt"),
-    ])
-    ex = N.ShuffleExchange(partial, N.HashPartitioning([E.Column("sr_store_sk")], PARTS))
-    final = N.Agg(ex, E.AggExecMode.HASH_AGG,
-                  [("sr_store_sk", E.Column("sr_store_sk"))], [
-        N.AggColumn(E.AggExpr(E.AggFunction.SUM, [E.Column("sr_return_amt")],
-                              T.DecimalType(17, 2)), E.AggMode.FINAL, "total"),
-        N.AggColumn(E.AggExpr(E.AggFunction.COUNT, []), E.AggMode.FINAL, "cnt"),
-    ])
-    single = N.ShuffleExchange(final, N.SinglePartitioning(1))
-    return N.Sort(single, [E.SortOrder(E.Column("total"), ascending=False)],
+    agg = _two_stage_agg(filt, [("sr_store_sk", _col("sr_store_sk"))], [
+        ("total", E.AggExpr(F.SUM, [_col("sr_return_amt")], T.DecimalType(17, 2)), None),
+        ("cnt", E.AggExpr(F.COUNT, []), None),
+    ], PARTS)
+    single = N.ShuffleExchange(agg, N.SinglePartitioning(1))
+    return N.Sort(single, [E.SortOrder(_col("total"), ascending=False)],
                   fetch_limit=100)
 
 
-def run_engine(paths):
-    from blaze_tpu.runtime.session import Session
-
-    t0 = time.perf_counter()
-    sess = Session()
-    out = sess.execute_to_table(build_plan(paths))
-    t1 = time.perf_counter()
-    return t1 - t0, out
-
-
-def run_baseline(paths):
-    """CPU columnar baseline: pandas over the same parquet."""
+def pandas_q01(dfs):
     import decimal
 
-    import pandas as pd
-
-    t0 = time.perf_counter()
-    df = pd.concat([pq.read_table(p).to_pandas() for p in paths])
+    df = dfs["store_returns"]
     df = df[df.sr_return_amt > decimal.Decimal("500.00")]
     g = df.groupby("sr_store_sk").agg(total=("sr_return_amt", "sum"),
                                       cnt=("sr_store_sk", "size"))
-    g = g.sort_values("total", ascending=False).head(100)
-    t1 = time.perf_counter()
-    return t1 - t0, g
+    return g.sort_values("total", ascending=False).head(100)
 
 
-def run_arrow_baseline(paths):
-    """Strongest locally available engine: pyarrow Acero (multithreaded C++
-    group_by) — recorded alongside, BASELINE.md. duckdb/polars are absent in
-    this image."""
+def acero_q01(tables):
     import decimal
 
     import pyarrow.compute as pc
 
-    t0 = time.perf_counter()
-    tbl = pa.concat_tables([pq.read_table(p) for p in paths])
+    tbl = tables["store_returns"]
     tbl = tbl.filter(pc.greater(tbl["sr_return_amt"],
                                 pa.scalar(decimal.Decimal("500.00"))))
     g = tbl.group_by("sr_store_sk").aggregate(
         [("sr_return_amt", "sum"), ("sr_return_amt", "count")])
-    g = g.sort_by([("sr_return_amt_sum", "descending")]).slice(0, 100)
-    return time.perf_counter() - t0, g
+    return g.sort_by([("sr_return_amt_sum", "descending")]).slice(0, 100)
+
+
+def check_q01(out, oracle):
+    od = out.to_pydict()
+    assert od["sr_store_sk"] == oracle.index.tolist(), "q01 keys mismatch"
+    assert od["total"] == oracle.total.tolist(), "q01 sums mismatch"
+
+
+def plan_q06(paths):
+    from blaze_tpu.ops.parquet import scan_node_for_files
+
+    sales = scan_node_for_files(paths["store_sales"], num_partitions=PARTS)
+    items = scan_node_for_files(paths["item"])
+    join = N.BroadcastJoin(sales, N.BroadcastExchange(items),
+                           [(_col("ss_item_sk"), _col("i_item_sk"))],
+                           N.JoinType.INNER, N.JoinSide.RIGHT, "bench_items")
+    agg = _two_stage_agg(join, [("i_category_id", _col("i_category_id"))], [
+        ("qty", E.AggExpr(F.SUM, [_col("ss_quantity")]), None),
+        ("revenue", E.AggExpr(F.SUM, [_col("ss_sales_price")], T.DecimalType(17, 2)), None),
+    ], PARTS)
+    return N.Sort(N.ShuffleExchange(agg, N.SinglePartitioning(1)),
+                  [E.SortOrder(_col("i_category_id"))])
+
+
+def pandas_q06(dfs):
+    m = dfs["store_sales"].merge(dfs["item"], left_on="ss_item_sk",
+                                 right_on="i_item_sk")
+    return m.groupby("i_category_id").agg(
+        qty=("ss_quantity", "sum"), revenue=("ss_sales_price", "sum")).sort_index()
+
+
+def acero_q06(tables):
+    joined = tables["store_sales"].join(
+        tables["item"], keys="ss_item_sk", right_keys="i_item_sk")
+    g = joined.group_by("i_category_id").aggregate(
+        [("ss_quantity", "sum"), ("ss_sales_price", "sum")])
+    return g.sort_by("i_category_id")
+
+
+def check_q06(out, oracle):
+    od = out.to_pydict()
+    assert od["i_category_id"] == oracle.index.tolist(), "q06 keys mismatch"
+    assert od["qty"] == oracle.qty.tolist(), "q06 qty mismatch"
+    assert od["revenue"] == oracle.revenue.tolist(), "q06 revenue mismatch"
+
+
+def plan_q17(paths):
+    from blaze_tpu.ops.parquet import scan_node_for_files
+
+    sales = scan_node_for_files(paths["store_sales"], num_partitions=PARTS)
+    items = scan_node_for_files(paths["item"])
+    stores = scan_node_for_files(paths["store"])
+    j1 = N.BroadcastJoin(sales, N.BroadcastExchange(items),
+                         [(_col("ss_item_sk"), _col("i_item_sk"))],
+                         N.JoinType.INNER, N.JoinSide.RIGHT, "bench_items17")
+    j2 = N.BroadcastJoin(j1, N.BroadcastExchange(stores),
+                         [(_col("ss_store_sk"), _col("s_store_sk"))],
+                         N.JoinType.INNER, N.JoinSide.RIGHT, "bench_stores17")
+    agg = _two_stage_agg(j2, [("s_state_id", _col("s_state_id")),
+                              ("i_category_id", _col("i_category_id"))], [
+        ("n", E.AggExpr(F.COUNT, []), None),
+        ("qty", E.AggExpr(F.SUM, [_col("ss_quantity")]), None),
+    ], PARTS)
+    return N.Sort(N.ShuffleExchange(agg, N.SinglePartitioning(1)),
+                  [E.SortOrder(_col("s_state_id")),
+                   E.SortOrder(_col("i_category_id"))])
+
+
+def pandas_q17(dfs):
+    m = dfs["store_sales"].merge(dfs["item"], left_on="ss_item_sk",
+                                 right_on="i_item_sk")
+    m = m.merge(dfs["store"], left_on="ss_store_sk", right_on="s_store_sk")
+    return m.groupby(["s_state_id", "i_category_id"]).agg(
+        n=("ss_item_sk", "size"), qty=("ss_quantity", "sum")).sort_index()
+
+
+def acero_q17(tables):
+    j = tables["store_sales"].join(tables["item"], keys="ss_item_sk",
+                                   right_keys="i_item_sk")
+    j = j.join(tables["store"], keys="ss_store_sk", right_keys="s_store_sk")
+    g = j.group_by(["s_state_id", "i_category_id"]).aggregate(
+        [("ss_item_sk", "count"), ("ss_quantity", "sum")])
+    return g.sort_by([("s_state_id", "ascending"),
+                      ("i_category_id", "ascending")])
+
+
+def check_q17(out, oracle):
+    od = out.to_pydict()
+    assert list(zip(od["s_state_id"], od["i_category_id"])) == \
+        oracle.index.tolist(), "q17 keys mismatch"
+    assert od["n"] == oracle.n.tolist(), "q17 counts mismatch"
+    assert od["qty"] == oracle.qty.tolist(), "q17 qty mismatch"
+
+
+def plan_q47(paths):
+    from blaze_tpu.ops.parquet import scan_node_for_files
+
+    sales = scan_node_for_files(paths["store_sales"], num_partitions=PARTS)
+    items = scan_node_for_files(paths["item"])
+    join = N.BroadcastJoin(sales, N.BroadcastExchange(items),
+                           [(_col("ss_item_sk"), _col("i_item_sk"))],
+                           N.JoinType.INNER, N.JoinSide.RIGHT, "bench_items47")
+    agg = _two_stage_agg(join, [("i_category_id", _col("i_category_id")),
+                                ("i_brand_id", _col("i_brand_id"))], [
+        ("qty", E.AggExpr(F.SUM, [_col("ss_quantity")]), None),
+    ], PARTS)
+    single = N.ShuffleExchange(agg, N.SinglePartitioning(1))
+    srt = N.Sort(single, [E.SortOrder(_col("i_category_id")),
+                          E.SortOrder(_col("qty"), ascending=False)])
+    win = N.Window(srt, [N.WindowExpr("rank", "rk")],
+                   [_col("i_category_id")],
+                   [E.SortOrder(_col("qty"), ascending=False)])
+    return N.Filter(win, [E.BinaryExpr(E.BinaryOp.LTEQ, _col("rk"),
+                                       E.Literal(5, T.I32))])
+
+
+def pandas_q47(dfs):
+    m = dfs["store_sales"].merge(dfs["item"], left_on="ss_item_sk",
+                                 right_on="i_item_sk")
+    g = m.groupby(["i_category_id", "i_brand_id"]).ss_quantity.sum().reset_index()
+    g["rk"] = g.groupby("i_category_id").ss_quantity.rank(
+        method="min", ascending=False)
+    return g[g.rk <= 5].sort_values(
+        ["i_category_id", "ss_quantity", "i_brand_id"],
+        ascending=[True, False, True])
+
+
+def acero_q47(tables):
+    j = tables["store_sales"].join(tables["item"], keys="ss_item_sk",
+                                   right_keys="i_item_sk")
+    g = j.group_by(["i_category_id", "i_brand_id"]).aggregate(
+        [("ss_quantity", "sum")])
+    # acero has no window operator: rank the (tiny) agg output in numpy,
+    # mirroring what a window-less engine would bolt on
+    cat = np.asarray(g["i_category_id"])
+    qty = np.asarray(g["ss_quantity_sum"])
+    order = np.lexsort((-qty, cat))
+    cat_s, qty_s = cat[order], qty[order]
+    new_cat = np.concatenate([[True], cat_s[1:] != cat_s[:-1]])
+    grp_start = np.maximum.accumulate(np.where(new_cat, np.arange(len(cat_s)), 0))
+    new_val = np.concatenate([[True], (qty_s[1:] != qty_s[:-1]) | new_cat[1:]])
+    val_start = np.maximum.accumulate(np.where(new_val, np.arange(len(cat_s)), 0))
+    rk = val_start - grp_start + 1
+    return g.take(order[rk <= 5])
+
+
+def check_q47(out, oracle):
+    got = sorted(zip(out.to_pydict()["i_category_id"],
+                     out.to_pydict()["i_brand_id"],
+                     out.to_pydict()["qty"]))
+    want = sorted(zip(oracle.i_category_id, oracle.i_brand_id,
+                      oracle.ss_quantity))
+    assert got == want, "q47 ranked rows mismatch"
+
+
+SHAPES = [
+    # (name, plan, pandas oracle, acero baseline, check, tables the query
+    #  touches — the acero timing reads exactly these, as the engine does)
+    ("q01", plan_q01, pandas_q01, acero_q01, check_q01, ("store_returns",)),
+    ("q06", plan_q06, pandas_q06, acero_q06, check_q06, ("store_sales", "item")),
+    ("q17", plan_q17, pandas_q17, acero_q17, check_q17,
+     ("store_sales", "item", "store")),
+    ("q47", plan_q47, pandas_q47, acero_q47, check_q47, ("store_sales", "item")),
+]
+
+
+# --------------------------------------------------------------------------
+# runners
+# --------------------------------------------------------------------------
+
+
+def run_engine(paths, plan_fn=plan_q01):
+    from blaze_tpu.runtime.session import Session
+
+    t0 = time.perf_counter()
+    with Session() as sess:
+        out = sess.execute_to_table(plan_fn(paths))
+    return time.perf_counter() - t0, out
+
+
+def load_dfs(paths):
+    return {name: pa.concat_tables(
+        [pq.read_table(p) for p in ps]).to_pandas()
+        for name, ps in paths.items()}
+
+
+def run_baseline(paths):
+    """pandas over the same parquet files, all four shapes (read included,
+    matching what the engine pays). The timed results double as the
+    correctness oracles — computed ONCE per bench run."""
+    t0 = time.perf_counter()
+    dfs = load_dfs(paths)
+    oracles = {name: fn(dfs) for name, _p, fn, _a, _c, _t in SHAPES}
+    return time.perf_counter() - t0, oracles
+
+
+def run_arrow_baseline(paths):
+    per_shape = {}
+    total = 0.0
+    for name, _p, _o, acero_fn, _c, tables_used in SHAPES:
+        t0 = time.perf_counter()
+        # read exactly the tables this shape's query touches (the engine's
+        # scan reads the same ones)
+        tables = {n: pa.concat_tables([pq.read_table(p) for p in paths[n]])
+                  for n in tables_used}
+        acero_fn(tables)
+        per_shape[name] = time.perf_counter() - t0
+        total += per_shape[name]
+    return total, per_shape
 
 
 def _pin_cpu():
@@ -153,19 +431,17 @@ def _pin_cpu():
 
 
 def _placement_says_host(paths) -> bool:
-    """Consult the engine's cached link profile (runtime/placement.py) for
-    the REAL bench plan BEFORE initializing the accelerator backend: on a
-    known link-bound rig the dominant (scan) stage places on host, so
-    skipping backend init avoids its turn-up/compile overheads entirely.
-    Without a fresh cached profile (1h TTL) the in-process placement
-    decides per stage instead — and re-measures the link."""
-    from blaze_tpu.ir import nodes as N
+    """Consult the engine's link profile (env override first, then disk
+    cache — runtime/placement.py) for the heaviest bench stage BEFORE
+    initializing the accelerator backend: on a known link-bound rig the
+    dominant (scan) stage places on host, so skipping backend init avoids
+    its turn-up/compile overheads entirely."""
     from blaze_tpu.runtime import placement
 
     lp = placement.preinit_profile()
     if lp is None or lp.is_colocated:
         return False
-    plan = build_plan(paths)
+    plan = plan_q01(paths)
     stage_roots = []
 
     def walk(n):
@@ -192,32 +468,37 @@ def main():
         if tunnel_up and _placement_says_host(paths):
             _pin_cpu()
             device = "host_placed"
-        # warmup run compiles the device kernels
-        run_engine(paths)
         from blaze_tpu.utils.device import DEVICE_STATS
 
-        DEVICE_STATS.reset()
-        engine_s, out = run_engine(paths)
-        dev = DEVICE_STATS.snapshot()
-        baseline_s, base = run_baseline(paths)
-        arrow_s, _ = run_arrow_baseline(paths)
-        # correctness cross-check before reporting numbers
-        od = out.to_pydict()
-        assert od["sr_store_sk"] == base.index.tolist(), "bench result mismatch"
-        assert od["total"] == base.total.tolist(), "bench sums mismatch"
+        baseline_s, oracles = run_baseline(paths)
+        shapes = {}
+        total = 0.0
+        for name, plan_fn, _oracle_fn, _acero_fn, check_fn, _t in SHAPES:
+            run_engine(paths, plan_fn)  # warmup compiles the shape's kernels
+            DEVICE_STATS.reset()
+            engine_s, out = run_engine(paths, plan_fn)
+            dev = DEVICE_STATS.snapshot()
+            check_fn(out, oracles[name])  # correctness gate before numbers
+            shapes[name] = {"value": round(engine_s, 3), "unit": "s",
+                            "device_stats": dev,
+                            # round-1 verdict item 9: device residency share
+                            "device_time_fraction": round(
+                                min(dev["kernel_time_s"] / engine_s, 1.0), 3)
+                            if engine_s else 0.0}
+            total += engine_s
+        arrow_total, arrow_shapes = run_arrow_baseline(paths)
+        for name, _p, _o, _a, _c, _t in SHAPES:
+            shapes[name]["vs_arrow"] = round(
+                arrow_shapes[name] / shapes[name]["value"], 3)
         record = {
-            "metric": f"q01_like_{ROWS}rows_wallclock",
-            "value": round(engine_s, 3),
+            "metric": f"tpcds_4shape_{ROWS}rows_total_wallclock",
+            "value": round(total, 3),
             "unit": "s",
-            # vs pandas (the round-1 denominator — kept for cross-round
-            # comparability; BASELINE.md records the full baseline table)
-            "vs_baseline": round(baseline_s / engine_s, 3),
-            "vs_arrow": round(arrow_s / engine_s, 3),
-            # device residency (VERDICT round-1 item 9): transfer traffic,
-            # kernel dispatches, and the device fraction of engine wall time
-            "device_stats": dev,
-            "device_time_fraction": round(
-                min(dev["kernel_time_s"] / engine_s, 1.0), 3) if engine_s else 0.0,
+            # vs pandas on the identical four queries (the round-1/2
+            # denominator family; BASELINE.md has the full table)
+            "vs_baseline": round(baseline_s / total, 3),
+            "vs_arrow": round(arrow_total / total, 3),
+            "shapes": shapes,
         }
         if device == "cpu_fallback":
             record["note"] = "accelerator unreachable; ran on cpu fallback"
