@@ -183,6 +183,17 @@ class JoinHashMap:
     def num_codes(self) -> int:
         return len(self.offsets) - 1
 
+    @property
+    def unique_single_key(self) -> bool:
+        """Device-probe map whose every key maps to exactly ONE build row
+        (the dimension-table case): code c's rows are [c, c+1), so the code
+        IS the build-row index — enabling the fused device inner-join
+        kernel (ops/joins/bhj.py)."""
+        if getattr(self, "_unique_csr", None) is None:
+            self._unique_csr = self.sorted_keys is not None and bool(
+                np.all(np.diff(self.offsets) == 1))
+        return self._unique_csr
+
     @staticmethod
     def build(batches: List[ColumnarBatch], key_exprs: List[E.Expr],
               schema) -> "JoinHashMap":
