@@ -101,6 +101,40 @@ class AggExec(Operator):
             [dataclasses.replace(a, mode=E.AggMode.PARTIAL_MERGE)
              for a in self.aggs])
 
+    def _try_fuse_join(self, source, partition, ctx, src_metrics):
+        """(FusedJoinSpec, build_map) when ``source`` is an inner,
+        unconditioned, unique-single-key BroadcastJoin whose two sides are
+        all device dtypes — the star-join shape. The statically-eligible
+        join's build map loads HERE; when the runtime check then declines
+        (duplicate keys, host build columns), the loaded map is returned so
+        the caller can drive the unfused probe with it instead of paying a
+        second build."""
+        from blaze_tpu.ir.nodes import JoinType
+        from blaze_tpu.ops.agg_device import FusedJoinSpec
+        from blaze_tpu.ops.joins.bhj import BroadcastJoinExec
+        from blaze_tpu.utils.device import is_device_dtype
+
+        if not isinstance(source, BroadcastJoinExec):
+            return None, None
+        if source.join_type != JoinType.INNER or source.condition is not None:
+            return None, None
+        key_exprs = source._key_exprs(for_build=False)
+        if len(key_exprs) != 1:
+            return None, None
+        probe_schema = source.children[source._probe_child()].schema
+        build_schema = source.children[source._build_child()].schema
+        if not all(is_device_dtype(f.dtype)
+                   for f in probe_schema.fields + build_schema.fields):
+            return None, None
+        bmap = source._load_build_map(partition, ctx, src_metrics)
+        if not FusedJoinSpec.runtime_eligible(bmap):
+            return None, bmap
+        spec = FusedJoinSpec(source, bmap, key_exprs[0],
+                             source._probe_child() == 0,
+                             probe_schema, build_schema)
+        spec.metrics = src_metrics
+        return spec, bmap
+
     def _execute(self, partition, ctx, metrics):
         child_schema = self.children[0].schema
         from blaze_tpu.ops.agg_device import DevicePartialAgger, supports_device_partial
@@ -129,17 +163,40 @@ class AggExec(Operator):
             fuse_conf = ctx.conf.fused_filter_agg
             fuse_ok = fuse_conf if fuse_conf is not None \
                 else placement.backend_is_cpu_hint()
+            src_metrics = metrics.child(0)
             if fuse_ok and isinstance(child_op, FilterExec) \
                     and supports_fused_filter(
                     child_op, child_op.children[0].schema):
                 source = child_op.children[0]
                 fused_preds = child_op.predicates
+                src_metrics = src_metrics.child(0)
+            # a unique-single-key inner BroadcastJoin directly under the
+            # (possibly peeled) filter fuses too: the agg kernel probes the
+            # dim table inline and never materializes the joined rows
+            fused_join, loaded_bmap = self._try_fuse_join(
+                source, partition, ctx, src_metrics) if fuse_ok \
+                else (None, None)
+            join_src = None
+            if fused_join is not None:
+                probe_idx = source._probe_child()
+                source = source.children[probe_idx]
+                src_metrics = src_metrics.child(probe_idx)
+                metrics.add("fused_join_stages", 1)
+            elif loaded_bmap is not None:
+                # statically eligible but runtime-declined: drive the
+                # unfused probe with the ALREADY-LOADED map rather than
+                # letting the join operator build it a second time
+                join_src = source._probe_with_map(loaded_bmap, partition,
+                                                  ctx, src_metrics)
             agger = DevicePartialAgger(self, child_schema,
                                        fused_predicates=fused_preds,
-                                       conf=ctx.conf)
-            src_iter = (source.execute(partition, ctx, metrics.child(0).child(0))
-                        if source is not child_op else
-                        self.execute_child(0, partition, ctx, metrics))
+                                       conf=ctx.conf, fused_join=fused_join)
+            if join_src is not None:
+                src_iter = join_src
+            else:
+                src_iter = (source.execute(partition, ctx, src_metrics)
+                            if source is not child_op else
+                            self.execute_child(0, partition, ctx, metrics))
             # Per-task consolidation: per-batch partials merge into ONE
             # state batch at stream end (reference parity: AggTable
             # accumulates across the whole partition, agg_table.rs:77-305).

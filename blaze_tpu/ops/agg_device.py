@@ -38,6 +38,136 @@ _DEVICE_AGG_FNS = (E.AggFunction.SUM, E.AggFunction.COUNT, E.AggFunction.AVG,
 # jitted fused (filter+partial-agg) kernels, shared across agger instances
 _FUSED_KERNELS = {}
 
+# Sentinel returned by _plan_dense when the probe saw no valid keys and
+# there is no previous plan to anchor to: "no plan yet, re-probe later"
+# as opposed to None's "range too wide, give up on the dense path".
+_DEFER_PLAN = object()
+
+
+class FusedJoinSpec:
+    """Unique-single-key inner BroadcastJoin traced INTO the partial-agg
+    kernel (the TPC-DS star-join shape: fact scan -> dim lookup -> group-by
+    on dim attributes). Instead of materializing the joined batch (compact
+    + re-gather of every column), the agg kernel probes the sorted dim keys
+    with ``searchsorted``, gathers ONLY the dim columns the group/agg
+    expressions touch, and uses the hit mask as the row-exists mask — one
+    dispatch, no intermediate rows (reference analogue: the probe loop of
+    ``joins/bhj/full_join.rs`` feeding ``agg/agg_table.rs`` without an
+    operator boundary; here the fusion is literal, one XLA program)."""
+
+    def __init__(self, join_op, bmap, key_expr, probe_on_left,
+                 probe_schema, build_schema):
+        self.join_op = join_op
+        self.bmap = bmap
+        self.key_expr = key_expr
+        self.probe_on_left = probe_on_left
+        self.probe_schema = probe_schema
+        self.build_schema = build_schema
+        self.nk = len(bmap.sorted_keys)
+        bb = bmap.batch
+        self.cap_b = bb.capacity
+        self.n_build_cols = len(bb.columns)
+        if bmap._dev_cell[0] is None:
+            bmap._dev_cell[0] = jnp.asarray(
+                bmap.sorted_keys if self.nk else np.zeros(1, np.int64))
+        from blaze_tpu.runtime.metrics import MetricNode
+
+        # overridden by the agg operator with the join's real metric node
+        self.metrics = MetricNode("fused_join")
+
+    def trace_view(self) -> "FusedJoinSpec":
+        """Copy with the runtime references (bmap, join op, metrics)
+        stripped. Jit closures cached forever in _FUSED_KERNELS must capture
+        THIS, not the live spec: tracing only needs the structural fields
+        (schemas, key expr, nk/cap_b/n_build_cols) — capturing the live spec
+        would pin the whole broadcast dim table's device buffers for
+        process lifetime."""
+        import copy
+
+        view = copy.copy(self)
+        view.join_op = view.bmap = view.metrics = None
+        return view
+
+    @staticmethod
+    def runtime_eligible(bmap) -> bool:
+        return bool(bmap.unique_single_key) and all(
+            isinstance(c, DeviceColumn) for c in bmap.batch.columns)
+
+    def batch_eligible(self, batch: ColumnarBatch) -> bool:
+        return all(isinstance(c, DeviceColumn) for c in batch.columns)
+
+    def structural_key(self) -> str:
+        from blaze_tpu.ir.serde import expr_to_json
+        import json
+
+        return "join|%s|%s|%s" % (
+            json.dumps(expr_to_json(self.key_expr)),
+            ",".join(str(f.dtype) for f in self.build_schema.fields),
+            int(self.probe_on_left))
+
+    def shape_key(self):
+        return (self.nk, self.cap_b,
+                tuple((f.name, str(f.dtype))
+                      for f in self.probe_schema.fields))
+
+    def jit_args(self, batch: ColumnarBatch):
+        """Extra leading jit arguments: the device-resident sorted dim keys
+        and the build planes (identical arrays every call, so jax reuses
+        the committed buffers)."""
+        flat = [self.bmap._dev_cell[0]]
+        for c in self.bmap.batch.columns:
+            flat += [c.data, c.validity]
+        return flat
+
+    def n_build_planes(self) -> int:
+        return 1 + 2 * self.n_build_cols
+
+    def trace_join(self, joined_schema, num_rows, jflat, pflat):
+        """Traced: probe planes -> (joined tracer batch, hit mask). jflat =
+        [uniq, build planes...]; pflat = probe planes."""
+        uniq = jflat[0]
+        pfields = self.probe_schema.fields
+        pcols = [DeviceColumn(f.dtype, pflat[2 * i], pflat[2 * i + 1])
+                 for i, f in enumerate(pfields)]
+        ptb = ColumnarBatch(self.probe_schema, pcols, num_rows)
+        kev = ExprEvaluator([self.key_expr], self.probe_schema)
+        kev._reset_cse(ptb)
+        kd, kv = _broadcast(kev._to_dev(kev._eval(self.key_expr, ptb), ptb),
+                            ptb)
+        from blaze_tpu.ops.joins.keymap import sorted_probe_traced
+
+        cap_p = ptb.capacity
+        iota = jnp.arange(cap_p, dtype=jnp.int64)
+        exists = iota < num_rows
+        # shared canonical-word + searchsorted membership (keymap is the
+        # single authority for the key encoding)
+        cidx, hit = sorted_probe_traced(uniq, kd, kv & exists, self.nk)
+        bcols = []
+        for i, f in enumerate(self.build_schema.fields):
+            bd, bv = jflat[1 + 2 * i], jflat[2 + 2 * i]
+            bcols.append(DeviceColumn(f.dtype, bd[cidx], bv[cidx] & hit))
+        cols = pcols + bcols if self.probe_on_left else bcols + pcols
+        return ColumnarBatch(joined_schema, cols, num_rows), hit
+
+    def materialize(self, batch: ColumnarBatch, metrics):
+        """Non-device fallback for a single probe batch: run the join for
+        real and feed the joined batch down the unfused agg path."""
+        from blaze_tpu.ir.nodes import JoinType
+
+        cols = ExprEvaluator([self.key_expr],
+                             self.probe_schema).evaluate(batch)
+        out = self.join_op._inner_fast(batch, self.bmap, cols,
+                                       self.probe_on_left, metrics)
+        if out is not NotImplemented:
+            return out
+        codes, on_device = self.bmap.probe_codes(batch, cols)
+        if on_device:
+            metrics.add("device_probe_batches", 1)
+        probe_idx, build_idx, counts = self.bmap.probe(codes)
+        return self.join_op._emit_probe_batch(
+            batch, self.bmap, probe_idx, build_idx, counts, False,
+            self.probe_on_left, JoinType.INNER)
+
 
 def supports_device_partial(op, child_schema: T.Schema) -> bool:
     """Partial-mode hash agg over device keys and device-mode aggregates."""
@@ -80,12 +210,13 @@ class DevicePartialAgger:
     of a compaction round trip plus the kernel."""
 
     def __init__(self, op, child_schema: T.Schema, fused_predicates=None,
-                 conf=None):
+                 conf=None, fused_join: Optional[FusedJoinSpec] = None):
         from blaze_tpu.config import get_config
 
         self.op = op
         self.child_schema = child_schema
         self.fused_predicates = fused_predicates
+        self.fused_join = fused_join
         self.conf = conf or get_config()
         self._fused_cache = {}
         # dense-bucket path state: None = eligibility undecided; False =
@@ -165,32 +296,69 @@ class DevicePartialAgger:
             flat += [d, v]
         return kernel(exists, *flat)
 
+    def _trace_tb_mask(self, num_rows, flat):
+        """Traced: jit inputs -> (tracer batch over the agg's child schema,
+        row keep-mask). With ``fused_join`` the batch is the PROBE side and
+        the joined tracer batch + hit mask come from the join spec; the
+        optional fused predicates then evaluate over the joined schema."""
+        spec = self.fused_join
+        if spec is not None:
+            nb = spec.n_build_planes()
+            tb, mask = spec.trace_join(self.child_schema, num_rows,
+                                       flat[:nb], flat[nb:])
+        else:
+            schema = self.child_schema
+            cols = [DeviceColumn(f.dtype, flat[2 * i], flat[2 * i + 1])
+                    for i, f in enumerate(schema.fields)]
+            tb = ColumnarBatch(schema, cols, num_rows)
+            # inline, NOT tb.row_exists_mask(): that helper caches in a
+            # module lru_cache a traced call would poison
+            mask = jnp.arange(tb.capacity, dtype=jnp.int64) < num_rows
+        if self.fused_predicates:
+            # fresh evaluator per trace: its CSE cache must hold tracers
+            # of THIS trace only
+            pred_ev = ExprEvaluator(list(self.fused_predicates),
+                                    self.child_schema)
+            mask = mask & pred_ev.evaluate_predicate(tb)
+        return tb, mask
+
+    def _jit_flat(self, batch: ColumnarBatch):
+        if self.fused_join is not None:
+            return self.fused_join.jit_args(batch) + self._flat(batch)
+        return self._flat(batch)
+
+    def _trace_clone(self) -> "DevicePartialAgger":
+        """The agger instance jit closures may capture: identical structural
+        state, but fused_join is a trace_view() so the module-cached kernel
+        never pins the broadcast build map's buffers."""
+        import copy
+
+        clone = copy.copy(self)
+        if self.fused_join is not None:
+            clone.fused_join = self.fused_join.trace_view()
+        clone._fused_cache = {}
+        return clone
+
+    def _cap_key(self, batch: ColumnarBatch):
+        return (batch.capacity,
+                tuple((f.name, str(f.dtype)) for f in batch.schema.fields),
+                self.fused_join.shape_key() if self.fused_join else None)
+
     def _fused_fn(self, batch: ColumnarBatch):
-        """Jitted (predicate + flow), cached at MODULE level by structural
-        key — jax.jit caches by function identity, so a per-instance closure
-        would recompile for every partition/run."""
-        cap_key = (batch.capacity,
-                   tuple((f.name, str(f.dtype)) for f in batch.schema.fields))
+        """Jitted (join + predicate + flow), cached at MODULE level by
+        structural key — jax.jit caches by function identity, so a
+        per-instance closure would recompile for every partition/run."""
+        cap_key = self._cap_key(batch)
         fn = self._fused_cache.get(cap_key)
         if fn is not None:
             return fn
         key = (self._structural_key(), cap_key)
         fn = _FUSED_KERNELS.get(key)
         if fn is None:
-            schema = batch.schema
-            preds = self.fused_predicates
-            agger = self
+            agger = self._trace_clone()
 
             def fused(num_rows, *flat):
-                cols = [
-                    DeviceColumn(f.dtype, flat[2 * i], flat[2 * i + 1])
-                    for i, f in enumerate(schema.fields)
-                ]
-                tb = ColumnarBatch(schema, cols, num_rows)
-                # fresh evaluator per trace: its CSE cache must hold tracers
-                # of THIS trace only
-                pred_ev = ExprEvaluator(list(preds), schema)
-                mask = pred_ev.evaluate_predicate(tb)
+                tb, mask = agger._trace_tb_mask(num_rows, flat)
                 return agger._flow(tb, mask)
 
             fn = jax.jit(fused)
@@ -203,6 +371,8 @@ class DevicePartialAgger:
             from blaze_tpu.ir.serde import expr_to_json
 
             parts = [expr_to_json(p) for p in (self.fused_predicates or ())]
+            if self.fused_join is not None:
+                parts.append(self.fused_join.structural_key())
             parts += [f"{n}:{expr_to_json(e)}" for n, e in self.op.groupings]
             parts += [f"{a.name}:{a.mode.value}:{expr_to_json(a.agg)}"
                       for a in self.op.aggs]
@@ -259,29 +429,16 @@ class DevicePartialAgger:
     def _probe_fn(self, batch: ColumnarBatch):
         """Jitted range probe for the fused path (all columns device-
         resident by supports_fused_filter): per group key, (any_valid, min,
-        max) over rows passing the predicate. One dispatch + one small
-        sync, once per stream (and once more per range overflow)."""
-        cap_key = (batch.capacity,
-                   tuple((f.name, str(f.dtype)) for f in batch.schema.fields))
+        max) over rows passing the join + predicate. One dispatch + one
+        small sync, once per stream (and once more per range overflow)."""
+        cap_key = self._cap_key(batch)
         key = ("probe", self._structural_key(), cap_key)
         fn = _FUSED_KERNELS.get(key)
         if fn is None:
-            schema = batch.schema
-            preds = self.fused_predicates
-            agger = self
+            agger = self._trace_clone()
 
             def probe(num_rows, *flat):
-                cols = [DeviceColumn(f.dtype, flat[2 * i], flat[2 * i + 1])
-                        for i, f in enumerate(schema.fields)]
-                tb = ColumnarBatch(schema, cols, num_rows)
-                if preds:
-                    mask = ExprEvaluator(list(preds),
-                                         schema).evaluate_predicate(tb)
-                else:
-                    # inline, NOT tb.row_exists_mask(): that helper caches
-                    # its iota in a module lru_cache, which a traced call
-                    # would poison with this trace's tracers
-                    mask = jnp.arange(tb.capacity, dtype=jnp.int64) < num_rows
+                tb, mask = agger._trace_tb_mask(num_rows, flat)
                 agger.group_ev._reset_cse(tb)
                 rows = []
                 for _, e in agger.op.groupings:
@@ -315,7 +472,13 @@ class DevicePartialAgger:
                     lo = int(prev[0][i])
                     hi = lo + prev[1][i] - 2
                 else:
-                    lo, hi = 0, 0
+                    # No valid keys and nothing to anchor to: planning now
+                    # would pin an artificial [0, 0] anchor that a later
+                    # overflow unions with the real key range, potentially
+                    # blowing past the bucket cap and disabling the dense
+                    # path for the whole stream. Defer so the next batch
+                    # re-probes with real keys.
+                    return _DEFER_PLAN
             else:
                 lo, hi = int(kmin), int(kmax)
                 if prev is not None:
@@ -335,28 +498,21 @@ class DevicePartialAgger:
 
     def _dense_call(self, batch: ColumnarBatch, bases, sizes, out_cap):
         bases_arr = jnp.asarray(np.asarray(bases, np.int64))
-        if self.fused_predicates is not None:
-            cap_key = (batch.capacity,
-                       tuple((f.name, str(f.dtype))
-                             for f in batch.schema.fields))
+        if self.fused_predicates is not None or self.fused_join is not None:
+            cap_key = self._cap_key(batch)
             key = ("dense", self._structural_key(), cap_key, sizes, out_cap)
             fn = _FUSED_KERNELS.get(key)
             if fn is None:
-                schema = batch.schema
-                preds = self.fused_predicates
-                agger = self
+                agger = self._trace_clone()
 
                 def fused(num_rows, b, *flat):
-                    cols = [DeviceColumn(f.dtype, flat[2 * i], flat[2 * i + 1])
-                            for i, f in enumerate(schema.fields)]
-                    tb = ColumnarBatch(schema, cols, num_rows)
-                    mask = ExprEvaluator(list(preds),
-                                         schema).evaluate_predicate(tb)
+                    tb, mask = agger._trace_tb_mask(num_rows, flat)
                     return agger._flow_dense(tb, mask, b, sizes, out_cap)
 
                 fn = jax.jit(fused)
                 _FUSED_KERNELS[key] = fn
-            return fn(jnp.int64(batch.num_rows), bases_arr, *self._flat(batch))
+            return fn(jnp.int64(batch.num_rows), bases_arr,
+                      *self._jit_flat(batch))
         return self._flow_dense(batch, batch.row_exists_mask(), bases_arr,
                                 sizes, out_cap)
 
@@ -402,12 +558,18 @@ class DevicePartialAgger:
         prev = None
         for _ in range(2):
             if st is None:
-                if self.fused_predicates is not None:
+                if self.fused_predicates is not None or \
+                        self.fused_join is not None:
                     pr = np.asarray(self._probe_fn(batch)(
-                        jnp.int64(batch.num_rows), *self._flat(batch)))
+                        jnp.int64(batch.num_rows), *self._jit_flat(batch)))
                 else:
                     pr = np.asarray(self._probe_eager(batch))
                 st = self._plan_dense(pr, batch.capacity, prev)
+                if st is _DEFER_PLAN:
+                    # no valid keys in this batch to anchor a plan: sort
+                    # fallback for this batch, re-probe on the next one
+                    self._dense_state = None
+                    return None
                 if st is None:
                     # observed range too wide for the table cap: stop
                     # probing for the rest of this stream
@@ -431,19 +593,43 @@ class DevicePartialAgger:
         n = batch.num_rows
         if n == 0:
             return None
+        if self.fused_join is not None and \
+                not self.fused_join.batch_eligible(batch):
+            # host-column probe batch: run the join for real, then the
+            # eager (unfused) agg flow over the joined batch
+            jb = self.fused_join.materialize(batch, self.fused_join.metrics)
+            if jb is None or jb.num_rows == 0:
+                return None
+            t0 = _time.perf_counter()
+            exists = jb.row_exists_mask()
+            if self.fused_predicates:
+                exists = ExprEvaluator(
+                    list(self.fused_predicates),
+                    self.child_schema).evaluate_predicate(jb)
+            outs = self._flow(jb, exists)
+            num_groups = int(outs[0])
+            DEVICE_STATS.add_kernel(_time.perf_counter() - t0)
+            if num_groups == 0:
+                return None
+            return self._assemble(outs, num_groups)
         t0 = _time.perf_counter()
         dense = self._try_dense(batch)
         if dense is not None:
             outs, num_groups = dense
         else:
-            if self.fused_predicates is not None:
-                outs = self._fused_fn(batch)(jnp.int64(n), *self._flat(batch))
+            if self.fused_predicates is not None or \
+                    self.fused_join is not None:
+                outs = self._fused_fn(batch)(jnp.int64(n),
+                                             *self._jit_flat(batch))
             else:
                 outs = self._flow(batch, batch.row_exists_mask())
             num_groups = int(outs[0])  # the sync point: kernel completes here
         DEVICE_STATS.add_kernel(_time.perf_counter() - t0)
         if num_groups == 0:
             return None
+        return self._assemble(outs, num_groups)
+
+    def _assemble(self, outs, num_groups: int) -> ColumnarBatch:
         pos = 1
         cols: List[DeviceColumn] = []
         out_valid_mask = outs[pos]; pos += 1
@@ -652,8 +838,15 @@ def _dense_partial_kernel(key_dtypes: Tuple[str, ...],
         for i, (d, v) in enumerate(zip(key_data, key_valid)):
             d64 = d.astype(jnp.int64)
             # code 0 = null key; 1..size-1 = base..base+size-2
-            code = jnp.where(v, d64 - bases[i] + jnp.int64(1), jnp.int64(0))
-            infit = (code >= 0) & (code < sizes[i])
+            diff = d64 - bases[i]  # wrapping int64
+            code = jnp.where(v, diff + jnp.int64(1), jnp.int64(0))
+            # Overflow-safe in-range test: `diff` wraps when |key - base|
+            # exceeds 2^63, which could land a far-away key inside
+            # [0, size) and silently mis-bucket it. Requiring d64 >= base
+            # AND diff >= 0 rejects both the wrapped case (wrapped diff is
+            # negative when d64 >= base) and key == base-1 (which would
+            # collide with the null bucket at code 0).
+            infit = (d64 >= bases[i]) & (diff >= 0) & (diff < sizes[i] - 1)
             fits = fits & jnp.all(jnp.where(exists & v, infit, True))
             seg = seg + jnp.clip(code, 0, sizes[i] - 1) * strides[i]
         seg = jnp.where(exists, seg, S).astype(jnp.int32)

@@ -119,6 +119,36 @@ def _canon_words(data: np.ndarray) -> np.ndarray:
     return data.astype(np.int64)
 
 
+def canon_word_traced(d):
+    """Traceable canonical int64 join word — the single authority shared by
+    every device-side probe (keymap._probe_fn, the fused inner-join kernel
+    in ops/joins/bhj.py, and the join->agg fusion in ops/agg_device.py).
+    Same folding as the host _canon_words: -0.0 -> +0.0, every NaN payload
+    -> the quiet NaN, so float keys match by Spark equality."""
+    import jax.numpy as jnp
+
+    if jnp.issubdtype(d.dtype, jnp.floating):
+        d = jnp.where(d == 0, jnp.zeros((), d.dtype), d)
+        d = jnp.where(jnp.isnan(d), jnp.array(float("nan"), d.dtype), d)
+        return d.view(jnp.int32).astype(jnp.int64) \
+            if d.dtype == jnp.float32 else d.view(jnp.int64)
+    return d.astype(jnp.int64)
+
+
+def sorted_probe_traced(uniq, d, v, nk: int):
+    """Traceable membership probe against sorted canonical keys: returns
+    (rank clipped into [0, nk), hit mask). All device join probes MUST go
+    through this so the key encoding can never desynchronize between the
+    build map and a probe path."""
+    import jax.numpy as jnp
+
+    w = canon_word_traced(d)
+    idx = jnp.searchsorted(uniq, w)
+    cidx = jnp.clip(idx, 0, max(nk - 1, 0))
+    hit = v & (idx < nk) & (uniq[cidx] == w)
+    return cidx, hit
+
+
 @functools.lru_cache(maxsize=None)
 def _probe_fn(dtype_str: str, nk: int):
     """Module-level cache: one jitted probe per (dtype, key count) — a
@@ -128,19 +158,8 @@ def _probe_fn(dtype_str: str, nk: int):
 
     @jax.jit
     def probe(uniq, d, v):
-        if jnp.issubdtype(d.dtype, jnp.floating):
-            d = jnp.where(d == 0, jnp.zeros((), d.dtype), d)
-            d = jnp.where(jnp.isnan(d), jnp.array(float("nan"), d.dtype), d)
-            if d.dtype == jnp.float32:
-                w = d.view(jnp.int32).astype(jnp.int64)
-            else:
-                w = d.view(jnp.int64)
-        else:
-            w = d.astype(jnp.int64)
-        idx = jnp.searchsorted(uniq, w)
-        cidx = jnp.clip(idx, 0, max(nk - 1, 0))
-        hit = v & (idx < nk) & (uniq[cidx] == w)
-        return jnp.where(hit, idx, -1)
+        cidx, hit = sorted_probe_traced(uniq, d, v, nk)
+        return jnp.where(hit, cidx, -1)
 
     return probe
 

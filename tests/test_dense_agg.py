@@ -161,3 +161,47 @@ def test_dense_matches_oracle_multikey_nulls(tmp_path):
     assert (od.mx.values == g.mx.values).all()
     assert (od.c.values == g.c.values).all()
     assert np.allclose(od.a.astype(float).values, g.a.values)
+
+
+def test_first_batch_no_valid_keys_defers_plan():
+    """Round-3 advisor: an all-null (or fully filtered) first batch must not
+    pin an artificial [0, 0] anchor — it defers, and the next batch with
+    real keys plans from its own range."""
+    agger = _agger()
+    o1 = agger.process(_batch([None] * 64, [3] * 64))
+    assert o1.num_rows == 1  # null-key group, via the sort fallback
+    assert o1.to_arrow().to_pydict()["s#sum"] == [192]
+    assert agger._dense_state is None, "no plan should be pinned"
+    assert agger._dense_ok is not False, "dense path must stay available"
+    o2 = agger.process(_batch([9_000_001, 9_000_002] * 50, [1] * 100))
+    assert agger._dense_state is not None, "dense plan expected on real keys"
+    bases, sizes, _ = agger._dense_state
+    assert bases == (9_000_001,), "anchor must come from the real keys"
+    assert sorted(o2.to_arrow().to_pydict()["s#sum"]) == [50, 50]
+
+
+def test_key_just_below_anchor_does_not_merge_into_null_group():
+    """key == base-1 encodes to bucket 0 (the null bucket) under the naive
+    range test; it must instead flip the fits flag and re-plan."""
+    agger = _agger()
+    agger.process(_batch([10, 11] * 50, [1] * 100))
+    assert agger._dense_state is not None
+    o2 = agger.process(_batch([9] * 100, [2] * 100))
+    got = o2.to_arrow().to_pydict()
+    assert got["k1"] == [9], "key 9 must survive as a real (non-null) group"
+    assert got["s#sum"] == [200]
+
+
+def test_int64_extreme_ranges_stay_exact():
+    """Round-3 advisor: keys near opposite int64 extremes make the
+    bucket-code subtraction wrap; the overflow-safe range test must force
+    fallback/re-plan instead of silently mis-bucketing."""
+    hi = 2**63 - 2
+    lo = -(2**63)
+    agger = _agger()
+    o1 = agger.process(_batch([hi, hi + 1] * 50, [1] * 100))
+    assert sorted(o1.to_arrow().to_pydict()["k1"]) == [hi, hi + 1]
+    o2 = agger.process(_batch([lo] * 100, [2] * 100))
+    got = o2.to_arrow().to_pydict()
+    assert got["k1"] == [lo]
+    assert got["s#sum"] == [200]
