@@ -57,6 +57,21 @@ class Config:
     # to the spill-capable host table.
     device_merge_max_bytes: int = 256 << 20
 
+    # Mesh-exchange reducer outputs stay device-resident (HBM, pinned in
+    # the session's resource map until close()) only while the TOTAL
+    # payload across the session's live exchanges stays below this — the
+    # session debits each resident exchange from the budget, and anything
+    # beyond it materializes to host RAM like shuffle files, so stacked
+    # exchanges cannot accumulate unbounded HBM.
+    mesh_device_resident_max_bytes: int = 128 << 20
+
+    # Per-device per-round byte budget for the compacted mesh exchange's
+    # send buffers. Segment capacity is the max per-(shard, reducer) row
+    # count; one skewed reducer would otherwise pad EVERY segment to the
+    # hot size. Beyond the budget the exchange runs in multiple bounded
+    # rounds over the same compiled step.
+    mesh_exchange_round_bytes: int = 256 << 20
+
     # AQE small-partition coalescing (Spark's coalescePartitions): adjacent
     # reducer partitions below the advisory size merge into one read task
     # when no ancestor relies on the exchange's partition count.

@@ -215,6 +215,24 @@ class HostColumn(Column):
         return col, arr.dictionary
 
 
+def decode_dictionary(arr: pa.Array, dt: T.DataType) -> pa.Array:
+    """Dictionary array -> plain large_* values array (plain string/binary
+    arrays are normalized to large_* too — the engine-wide convention).
+    Host kernels without dictionary variants (pc.sort_indices, concat of
+    mixed encodings) decode at THIS boundary; code-aware consumers
+    (exprs/compiler._dict_fast, the mesh exchange) read the dictionary form
+    directly."""
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    if pa.types.is_dictionary(arr.type):
+        arr = arr.cast(arr.type.value_type)
+    if isinstance(dt, T.StringType) and not pa.types.is_large_string(arr.type):
+        arr = arr.cast(pa.large_utf8())
+    if isinstance(dt, T.BinaryType) and not pa.types.is_large_binary(arr.type):
+        arr = arr.cast(pa.large_binary())
+    return arr
+
+
 def arrow_fixed_planes(arr: pa.Array, dt: T.DataType):
     """Arrow fixed-width array -> (np_data, np_validity) planes in the device
     layout (decimal<=18 as unscaled int64, dates as day int64, bool unpacked)."""
@@ -303,7 +321,14 @@ def _arrow_to_column(arr: pa.Array, dt: T.DataType, capacity: int) -> Column:
     if isinstance(arr, pa.ChunkedArray):
         arr = arr.combine_chunks()
     if pa.types.is_dictionary(arr.type):
-        arr = arr.cast(arr.type.value_type)
+        if is_device_dtype(dt) or not isinstance(dt, (T.StringType,
+                                                      T.BinaryType)):
+            arr = arr.cast(arr.type.value_type)
+        else:
+            # keep strings/binary dictionary-encoded: predicates then run
+            # on the device int32 CODES (exprs/compiler._dict_fast) and
+            # exchanges reuse the codes instead of re-encoding
+            return HostColumn(dt, arr)
     if is_device_dtype(dt):
         values, validity = arrow_fixed_planes(arr, dt)
         return DeviceColumn.from_numpy(dt, values, validity, capacity)
@@ -542,8 +567,11 @@ class ColumnarBatch:
         for i in range(ncols):
             if cols[i] is None:
                 c0 = batches[0].columns[i]
-                arr = pa.concat_arrays([
-                    b.columns[i].to_arrow(b.num_rows) for b in batches])
+                arrs = [b.columns[i].to_arrow(b.num_rows) for b in batches]
+                if len({a.type for a in arrs}) > 1:
+                    # mixed dictionary/plain encodings cannot concat raw
+                    arrs = [decode_dictionary(a, c0.dtype) for a in arrs]
+                arr = pa.concat_arrays(arrs)
                 cols[i] = HostColumn(c0.dtype, arr)
         return ColumnarBatch(schema, cols, total)
 

@@ -83,13 +83,63 @@ class ExprEvaluator:
         self._cse: dict = {}
         self._cse_ref = None  # weakref to the batch the cache belongs to
         self._cse_keys: dict = {}
+        # device int32 code columns for dictionary-encoded host arrays,
+        # valid for ONE batch (shared across this batch's predicates)
+        self._dict_codes: dict = {}
 
     def _reset_cse(self, batch: ColumnarBatch):
         import weakref
 
         if self._cse_ref is None or self._cse_ref() is not batch:
             self._cse.clear()
+            self._dict_codes: dict = {}
             self._cse_ref = weakref.ref(batch)
+
+    # -- dictionary-code predicates -------------------------------------------
+
+    def _dict_fast(self, hv, batch: ColumnarBatch, value_fn):
+        """String predicates on dictionary CODES (round-2 verdict item 5,
+        reference: the dictionary fast paths of ``spark_strings.rs``): when
+        a host value wraps a dictionary-encoded arrow array spanning the
+        batch, evaluate the predicate over the K dictionary VALUES once
+        (tiny host compute), then map per-row results through the device
+        int32 codes — the O(rows) work becomes a device gather instead of a
+        host string scan. Returns a BOOL DevVal, or None when not
+        applicable. ``value_fn(dictionary) -> arrow bool array`` computes
+        the per-dictionary-entry result; its nulls propagate as invalid."""
+        orig = getattr(hv, "arr", None)
+        if not isinstance(hv, HostVal) or orig is None:
+            return None
+        arr = orig.combine_chunks() if isinstance(orig, pa.ChunkedArray) \
+            else orig
+        if not pa.types.is_dictionary(arr.type) or \
+                len(arr) != batch.num_rows or batch.num_rows == 0:
+            return None
+        K = len(arr.dictionary)
+        if K == 0:
+            # every row is null: invalid everywhere
+            z = jnp.zeros(batch.capacity, bool)
+            return DevVal(T.BOOL, z, z)
+        res = value_fn(arr.dictionary)
+        rd = np.asarray(pc.fill_null(res, False)
+                        .to_numpy(zero_copy_only=False)).astype(bool)
+        rv = ~np.asarray(pc.is_null(res).to_numpy(zero_copy_only=False))
+        # keyed by the ORIGINAL array object and identity-checked: id() of
+        # a freshly combined temporary could be recycled within the batch
+        # and hand back another column's codes. The cached entry holds the
+        # array reference, pinning the id.
+        entry = self._dict_codes.get(id(orig))
+        if entry is not None and entry[0] is orig:
+            codes = entry[1]
+        else:
+            col = HostColumn(hv.dtype, arr)
+            codes = col.dict_encode(batch.capacity)[0]
+            self._dict_codes[id(orig)] = (orig, codes)
+        cidx = jnp.clip(codes.data, 0, K - 1)
+        lk_d = jnp.asarray(rd)
+        lk_v = jnp.asarray(rv)
+        return DevVal(T.BOOL, lk_d[cidx] & codes.validity,
+                      codes.validity & lk_v[cidx])
 
     # -- public API -----------------------------------------------------------
 
@@ -139,6 +189,14 @@ class ExprEvaluator:
     def _to_host(self, val: Val, batch: ColumnarBatch) -> HostVal:
         if isinstance(val, HostVal):
             arr = val.arr
+            if pa.types.is_dictionary(arr.type):
+                # host kernels (pc.utf8_*, concat, ...) have no dictionary
+                # variants: decode at THIS boundary. Fast paths that work
+                # on codes (_dict_fast) read val.arr before coming here.
+                from blaze_tpu.core.batch import decode_dictionary
+
+                arr = decode_dictionary(arr, val.dtype)
+                val = HostVal(val.dtype, arr)
             if len(arr) == 1 and batch.num_rows != 1:  # broadcast host literal
                 if arr[0].as_py() is None:
                     arr = pa.nulls(batch.num_rows, arr.type)
@@ -211,8 +269,43 @@ class ExprEvaluator:
             if _is_device_type(lval.dtype) and _is_device_type(rval.dtype):
                 lval, rval = self._to_dev(lval, batch), self._to_dev(rval, batch)
             else:
+                out = self._binary_dict_fast(op, lval, rval, batch)
+                if out is not None:
+                    return out
                 return self._binary_host(op, lval, rval, batch)
         return self._binary_dev(op, expr, lval, rval)
+
+    def _binary_dict_fast(self, op: E.BinaryOp, lval, rval,
+                          batch: ColumnarBatch) -> Optional["DevVal"]:
+        """column-vs-literal comparison where the column is dictionary
+        encoded: compare the K dictionary values once, gather by code."""
+        B = E.BinaryOp
+        fns = {
+            B.EQ: pc.equal, B.NEQ: pc.not_equal, B.LT: pc.less,
+            B.LTEQ: pc.less_equal, B.GT: pc.greater, B.GTEQ: pc.greater_equal,
+        }
+        if op not in fns:
+            return None
+        flipped = {B.EQ: B.EQ, B.NEQ: B.NEQ, B.LT: B.GT, B.LTEQ: B.GTEQ,
+                   B.GT: B.LT, B.GTEQ: B.LTEQ}
+
+        def scalar_of(v):
+            if isinstance(v, HostVal) and len(v.arr) == 1:
+                return v.arr[0]
+            if isinstance(v, DevVal) and v.data.ndim == 0:
+                return pa.scalar(v.data.item() if bool(v.validity) else None)
+            return None
+
+        for col, lit, use_op in ((lval, rval, op),
+                                 (rval, lval, flipped[op])):
+            s = scalar_of(lit)
+            if s is None:
+                continue
+            out = self._dict_fast(col, batch,
+                                  lambda d, _f=fns[use_op], _s=s: _f(d, _s))
+            if out is not None:
+                return out
+        return None
 
     def _binary_dev(self, op: E.BinaryOp, expr: E.BinaryExpr, l: DevVal, r: DevVal) -> DevVal:
         B = E.BinaryOp
@@ -500,6 +593,27 @@ class ExprEvaluator:
             if expr.negated:
                 data = ~data
             return DevVal(T.BOOL, data, validity)
+        # dictionary-code path: is_in over the K dictionary values, gathered
+        # by device code (null-item semantics folded into the value result)
+        if isinstance(v, HostVal):
+            pylist0 = [self._host_scalar(x) for x in values]
+
+            def in_values(d, _vals=pylist0, _neg=expr.negated,
+                          _hn=has_null_item):
+                vset = pa.array([p for p in _vals if p is not None],
+                                type=d.type if not pa.types.is_dictionary(
+                                    d.type) else d.type.value_type)
+                data = pc.is_in(d, value_set=vset)
+                dn = np.asarray(data.to_numpy(zero_copy_only=False)).astype(bool)
+                # null list item: misses become NULL, hits stay true
+                validity = dn | (not _hn)
+                out = np.where(validity, dn ^ _neg, False)
+                return pa.array(out, type=pa.bool_(),
+                                mask=~np.asarray(validity, bool))
+
+            out = self._dict_fast(v, batch, in_values)
+            if out is not None:
+                return out
         # host path
         va = self._to_host(v, batch).arr
         pylist = [self._host_scalar(x) for x in values]
@@ -543,29 +657,42 @@ class ExprEvaluator:
 
     # -- strings (host fast paths) --------------------------------------------
 
+    def _string_match(self, expr_child, batch, match_fn) -> Val:
+        """Shared by startswith/endswith/contains/like: dictionary-code
+        gather when the child is dictionary encoded, host scan otherwise."""
+        v = self._eval(expr_child, batch)
+        out = self._dict_fast(v, batch, match_fn)
+        if out is not None:
+            return out
+        return HostVal(T.BOOL, match_fn(self._to_host(v, batch).arr))
+
     def _eval_StringStartsWith(self, expr, batch) -> Val:
-        a = self._to_host(self._eval(expr.child, batch), batch).arr
-        return HostVal(T.BOOL, pc.starts_with(a, pattern=expr.prefix))
+        return self._string_match(
+            expr.child, batch,
+            lambda a, _p=expr.prefix: pc.starts_with(a, pattern=_p))
 
     def _eval_StringEndsWith(self, expr, batch) -> Val:
-        a = self._to_host(self._eval(expr.child, batch), batch).arr
-        return HostVal(T.BOOL, pc.ends_with(a, pattern=expr.suffix))
+        return self._string_match(
+            expr.child, batch,
+            lambda a, _s=expr.suffix: pc.ends_with(a, pattern=_s))
 
     def _eval_StringContains(self, expr, batch) -> Val:
-        a = self._to_host(self._eval(expr.child, batch), batch).arr
-        return HostVal(T.BOOL, pc.match_substring(a, pattern=expr.infix))
+        return self._string_match(
+            expr.child, batch,
+            lambda a, _i=expr.infix: pc.match_substring(a, pattern=_i))
 
     def _eval_Like(self, expr: E.Like, batch) -> Val:
-        a = self._to_host(self._eval(expr.child, batch), batch).arr
         if expr.escape_char not in ("\\", ""):
             # translate custom escape to \ for arrow's SQL LIKE
             pat = re.sub(re.escape(expr.escape_char) + r"(.)", r"\\\1", expr.pattern)
         else:
             pat = expr.pattern
-        out = pc.match_like(a, pattern=pat, ignore_case=expr.case_insensitive)
-        if expr.negated:
-            out = pc.invert(out)
-        return HostVal(T.BOOL, out)
+
+        def like(a, _p=pat, _i=expr.case_insensitive, _n=expr.negated):
+            out = pc.match_like(a, pattern=_p, ignore_case=_i)
+            return pc.invert(out) if _n else out
+
+        return self._string_match(expr.child, batch, like)
 
     # -- misc -----------------------------------------------------------------
 

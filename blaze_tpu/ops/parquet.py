@@ -151,6 +151,14 @@ class ParquetScanExec(Operator):
     def _execute(self, partition, ctx, metrics):
         group = self.conf.file_groups[partition]
         proj_names = [self.conf.file_schema[i].name for i in self.conf.projection]
+        # read string/binary columns dictionary-encoded: scans stay
+        # byte-identical logically, but downstream predicates run on the
+        # device int32 CODES (exprs/compiler._dict_fast) instead of host
+        # string scans, and the codes upload once per batch
+        dict_cols = [self.conf.file_schema[i].name
+                     for i in self.conf.projection
+                     if isinstance(self.conf.file_schema[i].dtype,
+                                   (T.StringType, T.BinaryType))]
         filt = predicate_to_arrow(self.predicate, self.conf.file_schema)
         batch_size = ctx.conf.batch_size
         q: "queue.Queue" = queue.Queue(maxsize=_QUEUE_DEPTH)
@@ -175,7 +183,8 @@ class ParquetScanExec(Operator):
                         # every row group is read by exactly one split
                         from blaze_tpu.io import fs as FS
 
-                        pf = pq.ParquetFile(FS.open_input(pfile.path))
+                        pf = pq.ParquetFile(FS.open_input(pfile.path),
+                                            read_dictionary=dict_cols)
                         rgs = []
                         for i in range(pf.metadata.num_row_groups):
                             rg = pf.metadata.row_group(i)
@@ -195,7 +204,10 @@ class ParquetScanExec(Operator):
                     from blaze_tpu.io import fs as FS
 
                     afs, apath = FS.arrow_filesystem(pfile.path)
-                    ds = pads.dataset(apath, format="parquet", filesystem=afs)
+                    fmt = pads.ParquetFileFormat(
+                        read_options=pads.ParquetReadOptions(
+                            dictionary_columns=dict_cols))
+                    ds = pads.dataset(apath, format=fmt, filesystem=afs)
                     scanner = ds.scanner(columns=proj_names, filter=filt,
                                          batch_size=batch_size)
                     for rb in scanner.to_batches():

@@ -127,7 +127,11 @@ def host_sort_indices(batch: ColumnarBatch, sort_orders: List[E.SortOrder],
     """Multi-key sort on host via arrow (var-width keys)."""
     ev = evaluator or ExprEvaluator([so.child for so in sort_orders], batch.schema)
     cols = ev.evaluate(batch)
-    arrays = [c.to_arrow(batch.num_rows) for c in cols]
+    from blaze_tpu.core.batch import decode_dictionary
+
+    # pc.sort_indices has no dictionary kernel: decode code-encoded strings
+    arrays = [decode_dictionary(c.to_arrow(batch.num_rows),
+                                c.dtype) for c in cols]
     placements = {so.nulls_first for so in sort_orders}
     if len(placements) > 1:
         # arrow's sort has one global null placement; mixed per-key

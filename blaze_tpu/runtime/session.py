@@ -290,8 +290,10 @@ class Session:
                 # literals): sample the child once, derive per-reducer bounds
                 node = dataclasses.replace(
                     node, partitioning=self._sample_range_bounds(node))
-            if self.mesh is not None and \
-                    node.partitioning.num_partitions <= self.mesh.devices.size:
+            # reducer counts beyond the mesh size group G = ceil(R/n)
+            # reducers per device (parallel/mesh.py), so any partitioning
+            # lowers onto the collective
+            if self.mesh is not None:
                 return self._run_mesh_exchange(node)
             if self.rss_sock_path is not None:
                 return self._run_rss_map_stage(node)
@@ -705,13 +707,30 @@ class Session:
                 shard_pids[s] = np.concatenate([shard_pids[s], p])
 
         exchange = MeshBatchExchange(self.mesh)
+        # device residency budgeted ACROSS the session's live exchanges:
+        # results pin HBM in the resource map until close(), so each
+        # exchange only gets what earlier ones have not already pinned
+        pinned = getattr(self, "_mesh_pinned_bytes", 0)
+        remaining = max(0, self.conf.mesh_device_resident_max_bytes - pinned)
         reducer_batches = exchange.run(schema, shard_batches, shard_pids,
-                                       num_reducers)
+                                       num_reducers,
+                                       device_resident_budget=remaining)
+        if exchange.last_device_resident:
+            self._mesh_pinned_bytes = pinned + exchange.last_payload_bytes
         rid = f"mesh_shuffle_{stage}"
-        # HostBatches in the resource map (host RAM, like shuffle files);
-        # the reducer task re-materializes device columns on read
-        self.resources[rid] = lambda r: [reducer_batches[r].to_columnar()] \
-            if reducer_batches[r].num_rows else []
+        # reducer batches (parallel/mesh.py): device-resident ColumnarBatch
+        # for small exchanges (the next stage's device aggregation consumes
+        # them without a host round trip), HostBatch beyond the HBM budget,
+        # None for an empty reducer
+        from blaze_tpu.core.batch import HostBatch as _HB
+
+        def _read(r):
+            rb = reducer_batches[r]
+            if rb is None:
+                return []
+            return [rb.to_columnar() if isinstance(rb, _HB) else rb]
+
+        self.resources[rid] = _read
         return N.CoalesceBatches(
             N.BatchSource(schema=schema, resource_id=rid,
                           num_partitions=num_reducers),

@@ -1,0 +1,157 @@
+"""String predicates on dictionary codes (round-2 verdict item 5,
+exprs/compiler._dict_fast): EQ/IN/LIKE/StartsWith against literals run as a
+K-entry host compute over the dictionary VALUES plus a device gather over
+int32 codes — never a host scan over the rows. Covers: device-mask
+engagement, null handling, flipped literal-vs-column compares, null list
+items, and the non-dictionary fallback."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from blaze_tpu.core.batch import ColumnarBatch
+from blaze_tpu.exprs.compiler import DevVal, ExprEvaluator, HostVal
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import types as T
+from blaze_tpu.runtime.session import Session
+
+
+def _dict_batch(values):
+    arr = pa.array(values).dictionary_encode()
+    t = pa.table({"s": arr, "v": pa.array(range(len(values)),
+                                          type=pa.int64())})
+    return ColumnarBatch.from_arrow(t)
+
+
+VALUES = ["apple", "banana", None, "apricot", "banana", "cherry", None,
+          "apple"]
+
+
+def _mask(ev, batch):
+    """(data, validity) numpy bools from the single-expr evaluator."""
+    ev._reset_cse(batch)
+    out = ev._eval(ev.exprs[0], batch)
+    assert isinstance(out, DevVal), "dictionary fast path must engage"
+    n = batch.num_rows
+    return (np.asarray(out.data)[:n], np.asarray(out.validity)[:n])
+
+
+def test_eq_literal_on_codes():
+    b = _dict_batch(VALUES)
+    ev = ExprEvaluator([E.BinaryExpr(E.BinaryOp.EQ, E.Column("s"),
+                                     E.Literal("banana", T.STRING))],
+                       b.schema)
+    data, valid = _mask(ev, b)
+    assert data.tolist() == [False, True, False, False, True, False, False,
+                             False]
+    assert valid.tolist() == [True, True, False, True, True, True, False,
+                              True]
+
+
+def test_flipped_literal_lt_column():
+    b = _dict_batch(VALUES)
+    # 'banana' < s  ==  s > 'banana'
+    ev = ExprEvaluator([E.BinaryExpr(E.BinaryOp.LT,
+                                     E.Literal("banana", T.STRING),
+                                     E.Column("s"))], b.schema)
+    data, valid = _mask(ev, b)
+    want = [v is not None and v > "banana" for v in VALUES]
+    assert data.tolist() == want
+    assert valid.tolist() == [v is not None for v in VALUES]
+
+
+def test_in_list_on_codes_with_null_item():
+    b = _dict_batch(VALUES)
+    ev = ExprEvaluator([E.InList(E.Column("s"),
+                                 [E.Literal("apple", T.STRING),
+                                  E.Literal(None, T.STRING)], False)],
+                       b.schema)
+    data, valid = _mask(ev, b)
+    # hits true; misses NULL (null list item); null rows NULL
+    assert data.tolist() == [True, False, False, False, False, False, False,
+                             True]
+    assert valid.tolist() == [True, False, False, False, False, False, False,
+                              True]
+
+
+def test_starts_with_and_like_on_codes():
+    b = _dict_batch(VALUES)
+    ev = ExprEvaluator([E.StringStartsWith(E.Column("s"), "ap")], b.schema)
+    data, valid = _mask(ev, b)
+    assert data.tolist() == [True, False, False, True, False, False, False,
+                             True]
+    ev = ExprEvaluator([E.Like(E.Column("s"), "%an%")], b.schema)
+    data, valid = _mask(ev, b)
+    assert data.tolist() == [False, True, False, False, True, False, False,
+                             False]
+    assert valid.tolist() == [v is not None for v in VALUES]
+
+
+def test_non_dictionary_fallback_stays_host():
+    t = pa.table({"s": pa.array(VALUES)})
+    b = ColumnarBatch.from_arrow(t)
+    ev = ExprEvaluator([E.BinaryExpr(E.BinaryOp.EQ, E.Column("s"),
+                                     E.Literal("banana", T.STRING))],
+                       b.schema)
+    ev._reset_cse(b)
+    out = ev._eval(ev.exprs[0], b)
+    assert isinstance(out, HostVal), "plain string arrays keep the host path"
+
+
+def test_parquet_scan_string_filter_end_to_end(tmp_path):
+    """The scan now emits dictionary-encoded strings, so a string filter
+    over parquet runs on codes; results must match the pandas oracle."""
+    from blaze_tpu.ir import nodes as N
+    from blaze_tpu.ops.parquet import scan_node_for_files
+
+    rng = np.random.default_rng(21)
+    n = 20_000
+    cats = ["Books", "Home", "Electronics", "Music", "Sports", None]
+    s = [cats[i] for i in rng.integers(0, len(cats), n)]
+    tbl = pa.table({"cat": pa.array(s, type=pa.string()),
+                    "v": pa.array(rng.integers(0, 100, n), type=pa.int64())})
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(tbl, path)
+    scan = scan_node_for_files([path], num_partitions=2)
+    filt = N.Filter(scan, [E.BinaryExpr(E.BinaryOp.EQ, E.Column("cat"),
+                                        E.Literal("Music", T.STRING))])
+    agg = N.Agg(filt, E.AggExecMode.HASH_AGG, [("cat", E.Column("cat"))], [
+        N.AggColumn(E.AggExpr(E.AggFunction.SUM, [E.Column("v")]),
+                    E.AggMode.PARTIAL, "sv"),
+        N.AggColumn(E.AggExpr(E.AggFunction.COUNT, []),
+                    E.AggMode.PARTIAL, "c")])
+    final = N.Agg(N.ShuffleExchange(agg, N.SinglePartitioning(1)),
+                  E.AggExecMode.HASH_AGG, [("cat", E.Column("cat"))], [
+        N.AggColumn(E.AggExpr(E.AggFunction.SUM, [E.Column("v")]),
+                    E.AggMode.FINAL, "sv"),
+        N.AggColumn(E.AggExpr(E.AggFunction.COUNT, []),
+                    E.AggMode.FINAL, "c")])
+    with Session() as sess:
+        got = sess.execute_to_table(final).to_pydict()
+    df = tbl.to_pandas()
+    m = df[df.cat == "Music"]
+    assert got["cat"] == ["Music"]
+    assert got["sv"] == [int(m.v.sum())]
+    assert got["c"] == [len(m)]
+
+
+def test_string_functions_still_work_on_dict_columns(tmp_path):
+    """Host string kernels have no dictionary variants: _to_host must decode
+    at the boundary so upper/substring/concat over a parquet string column
+    keep working now that scans emit dictionary-encoded strings."""
+    from blaze_tpu.ir import nodes as N
+    from blaze_tpu.ops.parquet import scan_node_for_files
+
+    tbl = pa.table({"s": pa.array(["a", "Bc", None, "def"]),
+                    "v": pa.array([1, 2, 3, 4], type=pa.int64())})
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(tbl, path)
+    scan = scan_node_for_files([path])
+    proj = N.Projection(scan,
+                        [E.ScalarFunction("upper", [E.Column("s")], T.STRING),
+                         E.ScalarFunction("length", [E.Column("s")], T.I32)],
+                        ["u", "l"])
+    with Session() as sess:
+        got = sess.execute_to_table(proj).to_pydict()
+    assert got["u"] == ["A", "BC", None, "DEF"]
+    assert got["l"] == [1, 2, None, 3]

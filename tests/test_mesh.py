@@ -221,3 +221,161 @@ def test_mesh_exchange_empty_input_with_string_column(eight_devices):
     with Session(mesh=make_mesh(8)) as s:
         out = s.execute_to_table(plan).to_pydict()
     assert out == {"k": [], "s": []}
+
+
+def test_mesh_exchange_more_reducers_than_devices(eight_devices, tmp_path):
+    """num_reducers > mesh size: reducers group G = ceil(R/n) per device
+    (round-2 verdict item 4 lifted the old num_reducers <= n cap)."""
+    import pyarrow.parquet as pq
+
+    from blaze_tpu.ops.parquet import scan_node_for_files
+
+    rng = np.random.default_rng(12)
+    n = 5000
+    tbl = pa.table({
+        "k": pa.array(rng.integers(0, 500, n), type=pa.int64()),
+        "v": pa.array(rng.integers(-100, 100, n), type=pa.int64()),
+    })
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(tbl, path)
+    scan = scan_node_for_files([path], num_partitions=2)
+    partial = N.Agg(scan, E.AggExecMode.HASH_AGG, [("k", E.Column("k"))], [
+        N.AggColumn(E.AggExpr(E.AggFunction.SUM, [E.Column("v")]),
+                    E.AggMode.PARTIAL, "s")])
+    ex = N.ShuffleExchange(partial, N.HashPartitioning([E.Column("k")], 13))
+    final = N.Agg(ex, E.AggExecMode.HASH_AGG, [("k", E.Column("k"))], [
+        N.AggColumn(E.AggExpr(E.AggFunction.SUM, [E.Column("v")]),
+                    E.AggMode.FINAL, "s")])
+    plan = N.Sort(N.ShuffleExchange(final, N.SinglePartitioning(1)),
+                  [E.SortOrder(E.Column("k"))])
+    with Session() as s_file:
+        expect = s_file.execute_to_table(plan).to_pydict()
+    with Session(mesh=make_mesh(8)) as s_mesh:
+        got = s_mesh.execute_to_table(plan).to_pydict()
+    assert got == expect
+
+
+def test_mesh_exchange_wire_bytes_compacted(eight_devices):
+    """Compacted segments must carry >=5x less than the old (n, capacity)
+    masked tiles at 8 devices with uniform routing (round-2 verdict item 4's
+    done-bar)."""
+    from blaze_tpu.core.batch import ColumnarBatch
+    from blaze_tpu.parallel.mesh import MeshBatchExchange
+
+    rng = np.random.default_rng(13)
+    per = 60_000
+    mesh = make_mesh(8)
+    ex = MeshBatchExchange(mesh)
+    schema = T.schema_from_arrow(pa.schema([("k", pa.int64()),
+                                            ("v", pa.int64())]))
+    batches, pids = [], []
+    for s in range(8):
+        t = pa.table({
+            "k": pa.array(rng.integers(0, 10**6, per), type=pa.int64()),
+            "v": pa.array(rng.integers(0, 100, per), type=pa.int64())})
+        batches.append(ColumnarBatch.from_arrow(t, schema))
+        pids.append(rng.integers(0, 8, per).astype(np.int32))
+    results = ex.run(schema, batches, pids, 8)
+    total = sum(r.num_rows for r in results if r is not None)
+    assert total == 8 * per
+    assert ex.last_wire_bytes * 5 <= ex.last_wire_bytes_uncompacted, (
+        ex.last_wire_bytes, ex.last_wire_bytes_uncompacted)
+    # device residency: fixed-width outputs stay device columns
+    from blaze_tpu.core.batch import DeviceColumn
+
+    assert all(isinstance(c, DeviceColumn)
+               for r in results if r is not None for c in r.columns)
+
+
+def test_mesh_exchange_large_payload_lands_on_host(eight_devices, monkeypatch):
+    """Exchanges beyond mesh_device_resident_max_bytes materialize to host
+    RAM (HostBatch) so stacked exchanges cannot accumulate HBM."""
+    from blaze_tpu.config import get_config
+    from blaze_tpu.core.batch import ColumnarBatch, HostBatch
+    from blaze_tpu.parallel.mesh import MeshBatchExchange
+
+    rng = np.random.default_rng(14)
+    per = 4096
+    mesh = make_mesh(8)
+    ex = MeshBatchExchange(mesh)
+    schema = T.schema_from_arrow(pa.schema([("k", pa.int64())]))
+    batches = [ColumnarBatch.from_arrow(
+        pa.table({"k": pa.array(rng.integers(0, 10**6, per),
+                               type=pa.int64())}), schema) for _ in range(8)]
+    pids = [rng.integers(0, 8, per).astype(np.int32) for _ in range(8)]
+    monkeypatch.setattr(get_config(), "mesh_device_resident_max_bytes", 1)
+    results = ex.run(schema, batches, pids, 8)
+    assert all(isinstance(r, HostBatch) for r in results if r is not None)
+    total = sum(r.num_rows for r in results if r is not None)
+    assert total == 8 * per
+    got = sorted(int(x) for r in results if r is not None
+                 for x in r.to_columnar().to_arrow()["k"].to_pylist())
+    want = sorted(int(x) for b, p in zip(batches, pids)
+                  for x in b.to_arrow()["k"].to_pylist())
+    assert got == want
+
+
+def test_mesh_exchange_skewed_reducer_runs_bounded_rounds(eight_devices,
+                                                          monkeypatch):
+    """One hot reducer must not blow the send buffers: the exchange caps
+    the per-round segment capacity and loops rounds; results stay exact."""
+    from blaze_tpu.config import get_config
+    from blaze_tpu.core.batch import ColumnarBatch
+    from blaze_tpu.parallel.mesh import MeshBatchExchange
+
+    rng = np.random.default_rng(15)
+    mesh = make_mesh(8)
+    ex = MeshBatchExchange(mesh)
+    schema = T.schema_from_arrow(pa.schema([("k", pa.int64())]))
+    batches, pids = [], []
+    for s in range(8):
+        per = 20_000
+        t = pa.table({"k": pa.array(np.arange(s * per, (s + 1) * per),
+                                    type=pa.int64())})
+        batches.append(ColumnarBatch.from_arrow(t, schema))
+        p = np.zeros(per, np.int32)  # everything routes to reducer 0...
+        p[::50] = rng.integers(1, 8, len(p[::50]))  # ...except a trickle
+        pids.append(p)
+    # tiny round budget: forces multiple rounds
+    monkeypatch.setattr(get_config(), "mesh_exchange_round_bytes", 1 << 20)
+    results = ex.run(schema, batches, pids, 8)
+    got = sorted(int(x) for r in results if r is not None
+                 for x in r.to_columnar().to_arrow()["k"].to_pylist()
+                 ) if hasattr(results[0], "to_columnar") else sorted(
+        int(x) for r in results if r is not None
+        for x in r.to_arrow()["k"].to_pylist())
+    assert got == list(range(8 * 20_000))
+    # reducer 0 holds the hot partition exactly
+    r0 = results[0]
+    r0_rows = r0.num_rows
+    want0 = sum(int((p == 0).sum()) for p in pids)
+    assert r0_rows == want0
+
+
+def test_mesh_reducer_strings_large_typed_and_concatable(eight_devices,
+                                                         tmp_path):
+    """Reducer string columns must come back large_string (engine
+    convention) so they concat with normally-built batches."""
+    import pyarrow.parquet as pq
+
+    from blaze_tpu.core.batch import ColumnarBatch
+    from blaze_tpu.parallel.mesh import MeshBatchExchange
+
+    mesh = make_mesh(8)
+    ex = MeshBatchExchange(mesh)
+    schema = T.schema_from_arrow(pa.schema([("s", pa.string())]))
+    # dictionary-encoded inputs (what parquet scans now produce)
+    batches = [ColumnarBatch.from_arrow(
+        pa.table({"s": pa.array([f"v{j}" for j in range(64)]
+                                ).dictionary_encode()}), schema)
+        for _ in range(8)]
+    pids = [np.arange(64, dtype=np.int32) % 8 for _ in range(8)]
+    results = ex.run(schema, batches, pids, 8)
+    other = ColumnarBatch.from_arrow(
+        pa.table({"s": pa.array(["x", "y"])}), schema)
+    for r in results:
+        if r is None:
+            continue
+        rb = r.to_columnar() if hasattr(r, "to_columnar") else r
+        merged = ColumnarBatch.concat([rb, other], schema)
+        assert merged.num_rows == rb.num_rows + 2
