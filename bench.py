@@ -122,12 +122,19 @@ def make_data(tmpdir: str):
         path = os.path.join(tmpdir, f"sr_{p}.parquet")
         pq.write_table(tbl, path, row_group_size=128 * 1024)
         paths["store_returns"].append(path)
+    # separate stream for the round-4 wide-decimal column so the item/store
+    # dim draws (and q01's table) stay identical across rounds
+    rng_wide = np.random.default_rng(421)
     for p in range(PARTS):
         tbl = pa.table({
             "ss_item_sk": pa.array(rng.integers(1, N_ITEMS, per), type=pa.int64()),
             "ss_store_sk": pa.array(rng.integers(1, N_STORES, per), type=pa.int64()),
             "ss_quantity": pa.array(rng.integers(1, 100, per), type=pa.int64()),
             "ss_sales_price": _decimal_array(rng, per, 0, 500_00),
+            # decimal(38,2): per-group sums exceed int64, exercising the
+            # three-limb device sum (q17's wcost aggregate)
+            "ss_ext_wholesale_cost": _decimal_array(
+                rng_wide, per, 10**14, 9 * 10**16, prec=38, scale=2),
         })
         path = os.path.join(tmpdir, f"ss_{p}.parquet")
         pq.write_table(tbl, path, row_group_size=128 * 1024)
@@ -268,6 +275,9 @@ def plan_q17(paths):
                               ("i_category_id", _col("i_category_id"))], [
         ("n", E.AggExpr(F.COUNT, []), None),
         ("qty", E.AggExpr(F.SUM, [_col("ss_quantity")]), None),
+        # wide-decimal SUM: three-int64-limb device states across the
+        # exchange (round-2 verdict item 7)
+        ("wcost", E.AggExpr(F.SUM, [_col("ss_ext_wholesale_cost")]), None),
     ], PARTS)
     return N.Sort(N.ShuffleExchange(agg, N.SinglePartitioning(1)),
                   [E.SortOrder(_col("s_state_id")),
@@ -279,7 +289,8 @@ def pandas_q17(dfs):
                                  right_on="i_item_sk")
     m = m.merge(dfs["store"], left_on="ss_store_sk", right_on="s_store_sk")
     return m.groupby(["s_state_id", "i_category_id"]).agg(
-        n=("ss_item_sk", "size"), qty=("ss_quantity", "sum")).sort_index()
+        n=("ss_item_sk", "size"), qty=("ss_quantity", "sum"),
+        wcost=("ss_ext_wholesale_cost", "sum")).sort_index()
 
 
 def acero_q17(tables):
@@ -287,7 +298,8 @@ def acero_q17(tables):
                                    right_keys="i_item_sk")
     j = j.join(tables["store"], keys="ss_store_sk", right_keys="s_store_sk")
     g = j.group_by(["s_state_id", "i_category_id"]).aggregate(
-        [("ss_item_sk", "count"), ("ss_quantity", "sum")])
+        [("ss_item_sk", "count"), ("ss_quantity", "sum"),
+         ("ss_ext_wholesale_cost", "sum")])
     return g.sort_by([("s_state_id", "ascending"),
                       ("i_category_id", "ascending")])
 
@@ -298,6 +310,7 @@ def check_q17(out, oracle):
         oracle.index.tolist(), "q17 keys mismatch"
     assert od["n"] == oracle.n.tolist(), "q17 counts mismatch"
     assert od["qty"] == oracle.qty.tolist(), "q17 qty mismatch"
+    assert od["wcost"] == oracle.wcost.tolist(), "q17 wide-decimal sum mismatch"
 
 
 def plan_q47(paths):

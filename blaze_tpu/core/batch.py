@@ -70,6 +70,22 @@ def _decimal128_lo64(arr: pa.Array) -> np.ndarray:
     return raw[0::2].copy()
 
 
+def decimal128_limbs(arr: pa.Array):
+    """(lo_raw, hi, validity) planes of a decimal128 array: lo_raw is the
+    LOW 64 bits as int64 (unsigned semantics — bit 63 may be set), hi the
+    signed high 64 bits. value == hi * 2^64 + uint64(lo_raw), exact for any
+    precision <= 38. The device-side wide-decimal aggregates (3-limb sums,
+    lexicographic min/max) consume these planes."""
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    buf = arr.buffers()[1]
+    raw = np.frombuffer(buf, dtype=np.int64, offset=arr.offset * 16,
+                        count=len(arr) * 2)
+    valid = ~np.asarray(arr.is_null()) if arr.null_count \
+        else np.ones(len(arr), bool)
+    return raw[0::2].copy(), raw[1::2].copy(), valid
+
+
 def _int64_to_decimal128(values: np.ndarray, validity: np.ndarray, dt: T.DecimalType) -> pa.Array:
     n = len(values)
     data = np.empty((n, 2), dtype=np.int64)

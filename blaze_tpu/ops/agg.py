@@ -163,6 +163,17 @@ class AggExec(Operator):
             fuse_conf = ctx.conf.fused_filter_agg
             fuse_ok = fuse_conf if fuse_conf is not None \
                 else placement.backend_is_cpu_hint()
+            # wide-decimal limb aggregates extract their arg planes from
+            # HOST decimal128 arrays (eager pyarrow work a jit trace cannot
+            # perform), so any wide ARG TYPE — even one computed from
+            # all-device columns, e.g. CAST(i64 AS DECIMAL(20,2)) — keeps
+            # the agg on the eager path
+            from blaze_tpu.utils.device import is_device_dtype as _isdev
+
+            if any(a.agg.args and not _isdev(
+                    E.infer_type(a.agg.args[0], child_schema))
+                   for a in self.aggs):
+                fuse_ok = False
             src_metrics = metrics.child(0)
             if fuse_ok and isinstance(child_op, FilterExec) \
                     and supports_fused_filter(
@@ -379,13 +390,14 @@ def _partial_arg_schema(a: E.AggExpr, child_schema: T.Schema, pos: int):
     The raw-input arg expressions are meaningless against the partial child
     schema, so synthesize a one-column schema from the value-typed first
     state field and rewrite the agg to reference it."""
-    from blaze_tpu.ir.aggstate import _arg_type_from_state, parse_limb_tag
+    from blaze_tpu.ir.aggstate import _arg_type_from_state, parse_state_mode
 
     # single source of truth for state->arg reconstruction (incl. the
-    # wide-decimal limb tag): ir/aggstate. The limb-layout decision is the
+    # wide-decimal limb tags): ir/aggstate. The limb-layout decision is the
     # partial producer's — read it off the wire field name, never re-derive
     arg = _arg_type_from_state(a, child_schema, pos)
-    limbs = parse_limb_tag(child_schema[pos].name) is not None
+    m = parse_state_mode(child_schema[pos].name)
+    limbs = m[0] if m is not None else False
     schema = T.Schema((T.StructField("arg", arg),))
     if a.args:
         a = E.AggExpr(a.fn, [E.Column("arg")], a.return_type, a.udaf)

@@ -43,6 +43,10 @@ _FUSED_KERNELS = {}
 # as opposed to None's "range too wide, give up on the dense path".
 _DEFER_PLAN = object()
 
+# aggregate kinds whose ARG is a wide decimal carried as three int64 limb
+# planes (host decimal128 column -> buffer views -> device)
+_WIDE_KINDS = ("sum3", "avg3", "minw", "maxw")
+
 
 class FusedJoinSpec:
     """Unique-single-key inner BroadcastJoin traced INTO the partial-agg
@@ -184,6 +188,14 @@ def supports_device_partial(op, child_schema: T.Schema) -> bool:
         fn = aggfns.create_agg_function(a.agg, child_schema)
         if fn.host:
             return False
+        # non-device args are only eligible as wide-decimal limb
+        # aggregates (limbs '3'/'w'): the agger extracts their limb
+        # planes eagerly from the host decimal128 column. Anything else
+        # host-resident stays on the generic table.
+        if a.agg.args and not is_device_dtype(
+                E.infer_type(a.agg.args[0], child_schema)) and \
+                getattr(fn, "limbs", False) not in ("3", "w"):
+            return False
     return True
 
 
@@ -243,12 +255,21 @@ class DevicePartialAgger:
                 rescale = fn.result_type.scale - fn.arg_type.scale
             if kind == "avg" and isinstance(fn.arg_type, T.DecimalType):
                 rescale = fn.sum_type.scale - fn.arg_type.scale
-            if kind == "sum" and getattr(fn, "limbs", False):
+            lm = getattr(fn, "limbs", False)
+            if kind == "sum" and lm == "2":
                 # wide-decimal sum: two-int64-limb accumulation on device
                 kind, rescale, acc_dt = "sum2", 0, ""
-            elif kind == "avg" and getattr(fn, "limbs", False):
+            elif kind == "avg" and lm == "2":
                 # wide-decimal avg: limb sum + count on device
                 kind, rescale, acc_dt = "avg2", 0, ""
+            elif kind == "sum" and lm == "3":
+                # wide ARG (19..38 digits): three-limb device accumulation;
+                # the arg is a host decimal128 column, evaluated eagerly
+                kind, rescale, acc_dt = "sum3", 0, ""
+            elif kind == "avg" and lm == "3":
+                kind, rescale, acc_dt = "avg3", 0, ""
+            elif kind in ("min", "max") and lm == "w":
+                kind, rescale, acc_dt = kind + "w", 0, ""
             elif kind == "sum":
                 acc_dt = "int64" if isinstance(fn.result_type, T.DecimalType) \
                     else str(np.dtype(fn.result_type.np_dtype))
@@ -275,26 +296,62 @@ class DevicePartialAgger:
             d, val = _broadcast(v, batch)
             key_data.append(d)
             key_valid.append(val & exists)
-        args = []
-        for a, ev in zip(self.op.aggs, self.agg_evs):
-            if ev is None:
-                args.append((jnp.zeros(batch.capacity, jnp.int64), exists))
-            else:
-                dv = ev._to_dev(ev._eval(a.agg.args[0], batch), batch)
-                d, val = _broadcast(dv, batch)
-                args.append((d, val & exists))
+        args = self._eval_args(batch, exists)
         kernel = _partial_kernel(
             tuple(str(d.dtype) for d in key_data),
             tuple(self.specs),
-            tuple(str(a[0].dtype) for a in args),
+            tuple("wide3" if isinstance(a[0], tuple) else str(a[0].dtype)
+                  for a in args),
             batch.capacity,
         )
         flat = []
         for d, v in zip(key_data, key_valid):
             flat += [d, v]
         for d, v in args:
-            flat += [d, v]
+            flat += ([*d, v] if isinstance(d, tuple) else [d, v])
         return kernel(exists, *flat)
+
+    def _eval_args(self, batch: ColumnarBatch, exists):
+        """Per-aggregate (data, valid) pairs; wide-decimal args come back as
+        a (l0, l1, l2) plane tuple extracted from the host decimal128
+        column (eager only — wide args never enter the jitted fused
+        paths)."""
+        args = []
+        for a, ev, (kind, _r, _d) in zip(self.op.aggs, self.agg_evs,
+                                         self.specs):
+            if ev is None:
+                args.append((jnp.zeros(batch.capacity, jnp.int64), exists))
+            elif kind in _WIDE_KINDS:
+                planes, valid = self._wide_arg_planes(
+                    ev._eval(a.agg.args[0], batch), batch)
+                args.append((planes, valid & exists))
+            else:
+                dv = ev._to_dev(ev._eval(a.agg.args[0], batch), batch)
+                d, val = _broadcast(dv, batch)
+                args.append((d, val & exists))
+        return args
+
+    def _wide_arg_planes(self, val, batch: ColumnarBatch):
+        from blaze_tpu.exprs.compiler import HostVal
+        from blaze_tpu.ops.aggfns import _wide_value_limbs
+
+        assert isinstance(val, HostVal), "wide decimal args are host-resident"
+        arr = val.arr
+        if len(arr) == 1 and batch.num_rows != 1:
+            import pyarrow as pa
+
+            arr = pa.concat_arrays([arr] * batch.num_rows) \
+                if batch.num_rows else arr.slice(0, 0)
+        v0, v1, v2, valid = _wide_value_limbs(arr)
+        pad = batch.capacity - len(v0)
+        if pad:
+            z = np.zeros(pad, np.int64)
+            v0 = np.concatenate([v0, z])
+            v1 = np.concatenate([v1, z])
+            v2 = np.concatenate([v2, z])
+            valid = np.concatenate([valid, np.zeros(pad, bool)])
+        return ((jnp.asarray(v0), jnp.asarray(v1), jnp.asarray(v2)),
+                jnp.asarray(valid))
 
     def _trace_tb_mask(self, num_rows, flat):
         """Traced: jit inputs -> (tracer batch over the agg's child schema,
@@ -529,23 +586,17 @@ class DevicePartialAgger:
                 batch)
             key_data.append(d)
             key_valid.append(val & exists)
-        args = []
-        for a, ev in zip(self.op.aggs, self.agg_evs):
-            if ev is None:
-                args.append((jnp.zeros(batch.capacity, jnp.int64), exists))
-            else:
-                d, val = _broadcast(
-                    ev._to_dev(ev._eval(a.agg.args[0], batch), batch), batch)
-                args.append((d, val & exists))
+        args = self._eval_args(batch, exists)
         kernel = _dense_partial_kernel(
             tuple(str(d.dtype) for d in key_data), tuple(self.specs),
-            tuple(str(a[0].dtype) for a in args), batch.capacity,
+            tuple("wide3" if isinstance(a[0], tuple) else str(a[0].dtype)
+                  for a in args), batch.capacity,
             sizes, out_cap)
         flat = []
         for d, v in zip(key_data, key_valid):
             flat += [d, v]
         for d, v in args:
-            flat += [d, v]
+            flat += ([*d, v] if isinstance(d, tuple) else [d, v])
         return kernel(exists, bases, *flat)
 
     def _try_dense(self, batch: ColumnarBatch):
@@ -672,6 +723,15 @@ class DevicePartialAgger:
                 cols.append(DeviceColumn(fn.result_type, v, has & out_valid_mask))
                 cols.append(DeviceColumn(T.BOOL, has, out_valid_mask))
                 ci += 2
+            elif kind in _WIDE_KINDS:
+                a0, a1, a2, last = outs[pos:pos + 4]; pos += 4
+                cols.append(DeviceColumn(T.I64, a0, out_valid_mask))
+                cols.append(DeviceColumn(T.I64, a1, out_valid_mask))
+                cols.append(DeviceColumn(T.I64, a2, out_valid_mask))
+                cols.append(DeviceColumn(
+                    T.I64 if kind == "avg3" else T.BOOL, last,
+                    out_valid_mask))
+                ci += 4
         return ColumnarBatch(schema, cols, num_groups)
 
 
@@ -741,6 +801,35 @@ def _segmentation(exists, canon, key_valid, iota, capacity, key_dtypes):
     return jax.lax.cond(fits, direct_path, sort_path, None)
 
 
+def _segment_lex3(p0, p1, p2, m, seg, nseg, is_max: bool):
+    """Per-segment lexicographic extreme of (p2, p1, p0) wide-decimal value
+    limbs (p2 signed high word decides; p1/p0 nonnegative 32-bit chunks
+    break ties). Returns (b0, b1, b2, has), zeros where empty."""
+    info = jnp.iinfo(jnp.int64)
+    if is_max:
+        b2 = jnp.full(nseg, info.min, jnp.int64).at[seg].max(
+            jnp.where(m, p2, jnp.int64(info.min)), mode="drop")
+        t2 = m & (p2 == b2[seg])
+        b1 = jnp.full(nseg, -1, jnp.int64).at[seg].max(
+            jnp.where(t2, p1, jnp.int64(-1)), mode="drop")
+        t1 = t2 & (p1 == b1[seg])
+        b0 = jnp.full(nseg, -1, jnp.int64).at[seg].max(
+            jnp.where(t1, p0, jnp.int64(-1)), mode="drop")
+    else:
+        b2 = jnp.full(nseg, info.max, jnp.int64).at[seg].min(
+            jnp.where(m, p2, jnp.int64(info.max)), mode="drop")
+        t2 = m & (p2 == b2[seg])
+        b1 = jnp.full(nseg, info.max, jnp.int64).at[seg].min(
+            jnp.where(t2, p1, jnp.int64(info.max)), mode="drop")
+        t1 = t2 & (p1 == b1[seg])
+        b0 = jnp.full(nseg, info.max, jnp.int64).at[seg].min(
+            jnp.where(t1, p0, jnp.int64(info.max)), mode="drop")
+    shas = jnp.zeros(nseg, bool).at[seg].max(m, mode="drop")
+    z = jnp.int64(0)
+    return (jnp.where(shas, b0, z), jnp.where(shas, b1, z),
+            jnp.where(shas, b2, z), shas)
+
+
 def _reduce_aggs(specs, args, seg, nseg_total):
     """Per-aggregate segment reductions shared by the sort-path and
     dense-bucket partial kernels. ``args[i]`` is the i-th aggregate's
@@ -749,7 +838,37 @@ def _reduce_aggs(specs, args, seg, nseg_total):
     tuple per aggregate, each array of length ``nseg_total``."""
     outs = []
     for (kind, rescale, acc_dt), (sa, sv) in zip(specs, args):
-        if kind in ("sum2", "avg2"):
+        if kind in ("sum3", "avg3"):
+            # wide ARG (19..38 digits) as three limbs (l0/l1 32-bit chunks,
+            # l2 the signed high word wrapping mod 2^64 — exact within
+            # decimal(38))
+            p0, p1, p2 = sa
+            s0 = jnp.zeros(nseg_total, jnp.int64).at[seg].add(
+                jnp.where(sv, p0, jnp.int64(0)), mode="drop")
+            s1 = jnp.zeros(nseg_total, jnp.int64).at[seg].add(
+                jnp.where(sv, p1, jnp.int64(0)), mode="drop")
+            s2 = jnp.zeros(nseg_total, jnp.int64).at[seg].add(
+                jnp.where(sv, p2, jnp.int64(0)), mode="drop")
+            c0 = s0 >> 32
+            s0 = s0 & jnp.int64(0xFFFFFFFF)
+            s1 = s1 + c0
+            c1 = s1 >> 32
+            s1 = s1 & jnp.int64(0xFFFFFFFF)
+            s2 = s2 + c1
+            if kind == "avg3":
+                scnt = jnp.zeros(nseg_total, jnp.int64).at[seg].add(
+                    sv.astype(jnp.int64), mode="drop")
+                outs.append(("avg3", s0, s1, s2, scnt))
+            else:
+                shas = jnp.zeros(nseg_total, bool).at[seg].max(
+                    sv, mode="drop")
+                outs.append(("sum3", s0, s1, s2, shas))
+        elif kind in ("minw", "maxw"):
+            p0, p1, p2 = sa
+            b0, b1, b2, shas = _segment_lex3(p0, p1, p2, sv, seg,
+                                             nseg_total, kind == "maxw")
+            outs.append((kind, b0, b1, b2, shas))
+        elif kind in ("sum2", "avg2"):
             # wide-decimal sum as two int64 limbs (lo 32 bits, hi rest):
             # per-segment limb sums fit int64 for any capacity, totals
             # renormalize so lo stays in [0, 2^32). avg2 additionally
@@ -831,8 +950,16 @@ def _dense_partial_kernel(key_dtypes: Tuple[str, ...],
     def kernel(exists, bases, *flat):
         key_data = [flat[2 * i] for i in range(nk)]
         key_valid = [flat[2 * i + 1] for i in range(nk)]
-        args = [(flat[2 * nk + 2 * i], flat[2 * nk + 2 * i + 1] & exists)
-                for i in range(len(specs))]
+        args = []
+        pos = 2 * nk
+        for (kind, _r, _d) in specs:
+            if kind in _WIDE_KINDS:
+                args.append(((flat[pos], flat[pos + 1], flat[pos + 2]),
+                             flat[pos + 3] & exists))
+                pos += 4
+            else:
+                args.append((flat[pos], flat[pos + 1] & exists))
+                pos += 2
         seg = jnp.zeros(capacity, jnp.int64)
         fits = jnp.bool_(True)
         for i, (d, v) in enumerate(zip(key_data, key_valid)):
@@ -926,6 +1053,33 @@ def _merge_kernel(key_dtypes: Tuple[str, ...], kinds: Tuple[str, ...],
                 else:
                     shas = jnp.zeros(CAP, bool).at[seg].max(m, mode="drop")
                     outs.append((slo, shi, shas))
+            elif kind in ("sum3", "avg3"):
+                # three-limb wide-decimal sums: per-limb segment adds with
+                # the shared carry renormalization (aggfns._limb3_renorm)
+                from blaze_tpu.ops.aggfns import _limb3_renorm
+
+                (d0, v0l), (d1, _v1), (d2, _v2), (sd, sv) = scols
+                m = v0l & sd.astype(bool) & sv
+                s0 = jnp.zeros(CAP, jnp.int64).at[seg].add(
+                    jnp.where(m, d0, jnp.int64(0)), mode="drop")
+                s1 = jnp.zeros(CAP, jnp.int64).at[seg].add(
+                    jnp.where(m, d1, jnp.int64(0)), mode="drop")
+                s2 = jnp.zeros(CAP, jnp.int64).at[seg].add(
+                    jnp.where(m, d2, jnp.int64(0)), mode="drop")
+                s0, s1, s2 = _limb3_renorm(s0, s1, s2)
+                if kind == "avg3":
+                    scnt = jnp.zeros(CAP, jnp.int64).at[seg].add(
+                        jnp.where(m, sd, jnp.int64(0)), mode="drop")
+                    outs.append((s0, s1, s2, scnt))
+                else:
+                    shas = jnp.zeros(CAP, bool).at[seg].max(m, mode="drop")
+                    outs.append((s0, s1, s2, shas))
+            elif kind in ("minw", "maxw"):
+                # shared lexicographic segment extreme (_segment_lex3)
+                (d0, v0l), (d1, _v1), (d2, _v2), (hd, hv) = scols
+                m = v0l & hd.astype(bool) & hv
+                outs.append(_segment_lex3(d0, d1, d2, m, seg, CAP,
+                                          kind == "maxw"))
             elif kind == "sum":
                 (sd, sv), (hd, hv) = scols
                 m = sv & hd.astype(bool) & hv
@@ -1026,10 +1180,19 @@ class DeviceMergeAgger:
         self.op = op
         self.child_schema = child_schema
         self.fns = op._make_fns(child_schema)
-        self.kinds = tuple(
-            ("sum2" if a.agg.fn == E.AggFunction.SUM else "avg2")
-            if getattr(fn, "limbs", False) else self._KINDS[a.agg.fn]
-            for a, fn in zip(op.aggs, self.fns))
+
+        def kind_of(a, fn):
+            lm = getattr(fn, "limbs", False)
+            if lm == "2":
+                return "sum2" if a.agg.fn == E.AggFunction.SUM else "avg2"
+            if lm == "3":
+                return "sum3" if a.agg.fn == E.AggFunction.SUM else "avg3"
+            if lm == "w":
+                return "minw" if a.agg.fn == E.AggFunction.MIN else "maxw"
+            return self._KINDS[a.agg.fn]
+
+        self.kinds = tuple(kind_of(a, fn)
+                           for a, fn in zip(op.aggs, self.fns))
 
     def run(self, batches: List[ColumnarBatch]):
         op = self.op
@@ -1075,6 +1238,7 @@ class DeviceMergeAgger:
         final = not op.is_partial_output
         for a, fn, kind in zip(op.aggs, self.fns, self.kinds):
             nstate = {"sum": 2, "sum2": 3, "count": 1, "avg": 2, "avg2": 3,
+                      "sum3": 4, "avg3": 4, "minw": 4, "maxw": 4,
                       "min": 2, "max": 2}[kind]
             state = list(outs[p:p + nstate])
             p += nstate
@@ -1094,8 +1258,16 @@ def _partial_kernel(key_dtypes: Tuple[str, ...], specs: Tuple[Tuple[str, int], .
     def kernel(exists, *flat):
         key_data = [flat[2 * i] for i in range(nk)]
         key_valid = [flat[2 * i + 1] for i in range(nk)]
-        args = [(flat[2 * nk + 2 * i], flat[2 * nk + 2 * i + 1])
-                for i in range(len(specs))]
+        args = []
+        pos = 2 * nk
+        for (kind, _r, _d) in specs:
+            if kind in _WIDE_KINDS:
+                args.append(((flat[pos], flat[pos + 1], flat[pos + 2]),
+                             flat[pos + 3]))
+                pos += 4
+            else:
+                args.append((flat[pos], flat[pos + 1]))
+                pos += 2
         iota = jnp.arange(capacity, dtype=jnp.int32)
         canon = _canonical_keys(key_data, key_valid)
         seg, order = _segmentation(exists, canon, key_valid, iota, capacity,
@@ -1107,7 +1279,8 @@ def _partial_kernel(key_dtypes: Tuple[str, ...], specs: Tuple[Tuple[str, int], .
         # --- per-aggregate segment reductions
         outs = _reduce_aggs(
             specs,
-            [(ad[order], av[order] & s_exists) for ad, av in args],
+            [(tuple(p[order] for p in ad) if isinstance(ad, tuple)
+              else ad[order], av[order] & s_exists) for ad, av in args],
             seg, nseg_total)
         # --- representative row (first of each segment) for key values
         first_idx = jnp.full(nseg_total, capacity - 1, jnp.int32).at[seg].min(

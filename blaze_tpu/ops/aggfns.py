@@ -31,6 +31,7 @@ from __future__ import annotations
 
 from typing import Any, List, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pyarrow as pa
@@ -116,6 +117,86 @@ def _limb_renorm(lo, hi):
     return lo & jnp.int64(0xFFFFFFFF), hi + carry
 
 
+def _limb3_renorm(l0, l1, l2):
+    """Re-establish l0/l1 in [0, 2^32) after an accumulation round; l2
+    absorbs the carries (wrapping mod 2^64 — exact for totals within
+    decimal(38), the same i128-wrapping semantics the reference uses)."""
+    c0 = l0 >> 32
+    l0 = l0 & jnp.int64(0xFFFFFFFF)
+    l1 = l1 + c0
+    c1 = l1 >> 32
+    l1 = l1 & jnp.int64(0xFFFFFFFF)
+    return l0, l1, l2 + c1
+
+
+def _wide_value_limbs(arr: pa.Array):
+    """decimal128 array -> (l0, l1, l2, validity) numpy planes: l0/l1 the
+    low/high 32-bit chunks of the unsigned low word (nonnegative int64),
+    l2 the signed high word. value == (l2 << 64) + (l1 << 32) + l0."""
+    from blaze_tpu.core.batch import decimal128_limbs
+
+    lo_raw, hi, valid = decimal128_limbs(arr)
+    l0 = lo_raw & 0xFFFFFFFF
+    l1 = (lo_raw >> 32) & 0xFFFFFFFF  # arithmetic shift + mask = chunk
+    return l0, l1, hi, valid
+
+
+def _limb3_totals(l0, l1, l2, num_slots, extra=None):
+    """Pull the limb planes (and the optional has/count plane) in ONE sync
+    and combine to exact object ints."""
+    arrs = [l0[:num_slots], l1[:num_slots], l2[:num_slots]]
+    if extra is not None:
+        arrs.append(extra[:num_slots].astype(jnp.int64))
+    packed = np.asarray(jnp.stack(arrs))
+    totals = ((packed[2].astype(object) << 64)
+              + (packed[1].astype(object) << 32) + packed[0].astype(object))
+    if extra is not None:
+        return totals, packed[3]
+    return totals
+
+
+
+
+
+def _lex_scatter_minmax(state, slots, l0, l1, l2, m, is_max: bool):
+    """Per-slot lexicographic min/max of (l2, l1, l0) value triples into
+    ``state`` [s0, s1, s2, has] — the device path for wide-decimal MIN/MAX.
+    Scatter cannot express a lex comparator, so rows group by slot (sort +
+    segment reduce, the module's standard shape) and each slot's batch-best
+    conditionally replaces the running state."""
+    s0, s1, s2, has = state
+    cap = s0.shape[0]
+    n = slots.shape[0]
+    dead = jnp.int64(cap)
+    sl = jnp.where(m, slots.astype(jnp.int64), dead)
+    order = jnp.argsort(sl)
+    sl_s = sl[order]
+    l0s, l1s, l2s, ms = l0[order], l1[order], l2[order], m[order]
+    new = jnp.concatenate([jnp.ones(1, bool), sl_s[1:] != sl_s[:-1]])
+    seg = jnp.cumsum(new) - 1
+    from blaze_tpu.ops.agg_device import _segment_lex3
+
+    b0, b1, b2, seg_any = _segment_lex3(l0s, l1s, l2s, ms, seg, n, is_max)
+    seg_slot = jax.ops.segment_min(jnp.where(ms, sl_s, dead), seg, n)
+    idx = jnp.clip(seg_slot, 0, cap - 1)
+    c0, c1, c2, chas = s0[idx], s1[idx], s2[idx], has[idx]
+    if is_max:
+        better = ((b2 > c2) | ((b2 == c2) & (b1 > c1))
+                  | ((b2 == c2) & (b1 == c1) & (b0 > c0)))
+    else:
+        better = ((b2 < c2) | ((b2 == c2) & (b1 < c1))
+                  | ((b2 == c2) & (b1 == c1) & (b0 < c0)))
+    take = seg_any & (seg_slot < dead) & (better | ~chas)
+    # scatter ONLY the winners (dropped index for the rest): a plain
+    # conditional .set would race stale values across duplicate indices
+    idx_w = jnp.where(take, idx, dead)
+    s0 = s0.at[idx_w].set(b0, mode="drop")
+    s1 = s1.at[idx_w].set(b1, mode="drop")
+    s2 = s2.at[idx_w].set(b2, mode="drop")
+    has = has.at[idx_w].set(True, mode="drop")
+    return [s0, s1, s2, has]
+
+
 def _limb_final_column(state, num_slots, result_type: T.DecimalType):
     """Combine (lo, hi, has) limb state into an exact decimal host column,
     nulling values that overflow the result precision (Spark
@@ -174,19 +255,25 @@ class AggFunction:
 class SumAgg(AggFunction):
     def __init__(self, agg, arg_type, result_type, limbs=None):
         super().__init__(agg, arg_type, result_type)
-        from blaze_tpu.ir.aggstate import limb_state, limb_tag
+        from blaze_tpu.ir.aggstate import limb3_tag, limb_tag, state_mode
 
-        # decimal(19..28) sums stay on device as two int64 limbs. The
-        # eligibility predicate lives in ir/aggstate.limb_state (shared
-        # with the wire-schema derivation). ``limbs``: None derives it;
-        # merge-mode callers pass the decision read from the wire schema,
-        # and AvgAgg passes False (its embedded sum keeps [sum, count]).
-        self.limbs = limb_state(arg_type, result_type) if limbs is None \
-            else bool(limbs)
+        # decimal(19..28) sums stay on device as two int64 limbs ('2');
+        # sums over WIDE args (19..38 digits) as three ('3'). Eligibility
+        # lives in ir/aggstate.state_mode (shared with the wire-schema
+        # derivation). ``limbs``: None derives it; merge-mode callers pass
+        # the decision read from the wire schema, and AvgAgg passes False
+        # (its embedded sum keeps [sum, count])."""
+        if limbs is None:
+            self.limbs = state_mode(E.AggFunction.SUM, arg_type, result_type)
+        else:
+            self.limbs = "2" if limbs is True else (limbs or False)
         self.host = (not self.limbs) and not is_device_dtype(result_type)
         self._decimal_obj = self.host and isinstance(result_type, T.DecimalType)
-        if self.limbs:
+        if self.limbs == "2":
             self._limb_tag = limb_tag(result_type)
+            self._npdt = np.dtype(np.int64)
+        elif self.limbs == "3":
+            self._limb_tag = limb3_tag(result_type, arg_type)
             self._npdt = np.dtype(np.int64)
         elif self._decimal_obj:
             self._npdt = np.dtype(object)  # unscaled python ints, exact
@@ -196,14 +283,18 @@ class SumAgg(AggFunction):
             self._npdt = result_type.np_dtype
 
     def state_fields(self):
-        if self.limbs:
+        if self.limbs == "2":
             return [(self._limb_tag, T.I64), ("sum_hi", T.I64), ("has", T.BOOL)]
+        if self.limbs == "3":
+            return [(self._limb_tag, T.I64), ("sum_l1", T.I64),
+                    ("sum_l2", T.I64), ("has", T.BOOL)]
         return [("sum", self.result_type), ("has", T.BOOL)]
 
     def init_state(self, capacity):
         if self.limbs:
-            return [jnp.zeros(capacity, jnp.int64), jnp.zeros(capacity, jnp.int64),
-                    jnp.zeros(capacity, bool)]
+            nlimb = 2 if self.limbs == "2" else 3
+            return [jnp.zeros(capacity, jnp.int64) for _ in range(nlimb)] \
+                + [jnp.zeros(capacity, bool)]
         if self.host:
             return [np.zeros(capacity, self._npdt), np.zeros(capacity, bool)]
         return [jnp.zeros(capacity, self._npdt), jnp.zeros(capacity, bool)]
@@ -227,6 +318,20 @@ class SumAgg(AggFunction):
         return _arr_np(value, self._npdt)
 
     def update(self, state, slots, value, validity, mask, order=None):
+        if self.limbs == "3":
+            # wide arg arrives as a host decimal128 array (no int64 plane
+            # exists); limb extraction is a buffer view, accumulation runs
+            # on device
+            l0a, l1a, l2a, has = state
+            v0, v1, v2, valid = _wide_value_limbs(value)
+            m = np.asarray(valid & mask)
+            sl = jnp.asarray(np.asarray(slots, np.int64))
+            jm = jnp.asarray(m)
+            l0a = l0a.at[sl].add(jnp.asarray(np.where(m, v0, 0)), mode="drop")
+            l1a = l1a.at[sl].add(jnp.asarray(np.where(m, v1, 0)), mode="drop")
+            l2a = l2a.at[sl].add(jnp.asarray(np.where(m, v2, 0)), mode="drop")
+            has = has.at[sl].max(jm, mode="drop")
+            return list(_limb3_renorm(l0a, l1a, l2a)) + [has]
         if self.limbs:
             lo, hi, has = state
             m = validity & mask
@@ -255,6 +360,21 @@ class SumAgg(AggFunction):
         return [acc, has]
 
     def merge(self, state, slots, partial_cols, mask, n):
+        if self.limbs == "3":
+            l0a, l1a, l2a, has = state
+            p0, p1, p2, phas = partial_cols
+            m = phas.data.astype(bool) & phas.validity & mask
+            for i, (acc, p) in enumerate(((l0a, p0), (l1a, p1), (l2a, p2))):
+                upd = acc.at[slots].add(
+                    jnp.where(m, p.data, jnp.int64(0)), mode="drop")
+                if i == 0:
+                    l0a = upd
+                elif i == 1:
+                    l1a = upd
+                else:
+                    l2a = upd
+            has = has.at[slots].max(m, mode="drop")
+            return list(_limb3_renorm(l0a, l1a, l2a)) + [has]
         if self.limbs:
             lo, hi, has = state
             plo, phi, phas = partial_cols
@@ -285,10 +405,10 @@ class SumAgg(AggFunction):
 
     def state_columns(self, state, num_slots, capacity):
         if self.limbs:
-            lo, hi, has = self.grow(state, capacity)
+            grown = self.grow(state, capacity)
             ones = jnp.ones(capacity, bool)
-            return [DeviceColumn(T.I64, lo, ones), DeviceColumn(T.I64, hi, ones),
-                    DeviceColumn(T.BOOL, has, ones)]
+            return [DeviceColumn(T.I64, g, ones) for g in grown[:-1]] \
+                + [DeviceColumn(T.BOOL, grown[-1], ones)]
         acc, has = self.grow(state, capacity)
         if self.host:
             return [_host_col_out(self.result_type, acc[:num_slots], has[:num_slots]),
@@ -297,6 +417,11 @@ class SumAgg(AggFunction):
                 DeviceColumn(T.BOOL, has, jnp.ones(capacity, bool))]
 
     def final_column(self, state, num_slots, capacity):
+        if self.limbs == "3":
+            l0a, l1a, l2a, has = state
+            totals, has_i = _limb3_totals(l0a, l1a, l2a, num_slots, has)
+            return _host_col_out(self.result_type, totals,
+                                 has_i.astype(bool))
         if self.limbs:
             return _limb_final_column(state, num_slots, self.result_type)
         acc, has = self.grow(state, capacity)
@@ -358,29 +483,37 @@ class AvgAgg(AggFunction):
 
     def __init__(self, agg, arg_type, result_type, limbs=None):
         super().__init__(agg, arg_type, result_type)
-        from blaze_tpu.ir.aggstate import limb_state, limb_tag
+        from blaze_tpu.ir.aggstate import limb3_tag, limb_tag, state_mode
 
         if isinstance(arg_type, T.DecimalType):
             self.sum_type = T.DecimalType(min(arg_type.precision + 10, 38), arg_type.scale)
         else:
             self.sum_type = T.F64
-        self.limbs = limb_state(arg_type, self.sum_type) if limbs is None \
-            else bool(limbs)
+        if limbs is None:
+            self.limbs = state_mode(E.AggFunction.AVG, arg_type,
+                                    self.result_type)
+        else:
+            self.limbs = "2" if limbs is True else (limbs or False)
         self._sum = SumAgg(agg, arg_type, self.sum_type, limbs=False)
         self._cnt = CountAgg(agg, arg_type, T.I64)
         self.host = (not self.limbs) and self._sum.host
-        if self.limbs:
+        if self.limbs == "2":
             self._limb_tag = limb_tag(self.sum_type)
+        elif self.limbs == "3":
+            self._limb_tag = limb3_tag(self.sum_type, arg_type)
 
     def state_fields(self):
-        if self.limbs:
+        if self.limbs == "2":
             return [(self._limb_tag, T.I64), ("sum_hi", T.I64), ("count", T.I64)]
+        if self.limbs == "3":
+            return [(self._limb_tag, T.I64), ("sum_l1", T.I64),
+                    ("sum_l2", T.I64), ("count", T.I64)]
         return [("sum", self.sum_type), ("count", T.I64)]
 
     def init_state(self, capacity):
         if self.limbs:
-            return [jnp.zeros(capacity, jnp.int64), jnp.zeros(capacity, jnp.int64),
-                    jnp.zeros(capacity, jnp.int64)]
+            nlimb = 2 if self.limbs == "2" else 3
+            return [jnp.zeros(capacity, jnp.int64) for _ in range(nlimb + 1)]
         if self.host:
             return [np.zeros(capacity, self._sum._npdt), np.zeros(capacity, np.int64)]
         return [self._sum.init_state(capacity)[0], self._cnt.init_state(capacity)[0]]
@@ -389,6 +522,17 @@ class AvgAgg(AggFunction):
         return [_grow(s, capacity) for s in state]
 
     def update(self, state, slots, value, validity, mask, order=None):
+        if self.limbs == "3":
+            l0a, l1a, l2a, c = state
+            v0, v1, v2, valid = _wide_value_limbs(value)
+            m = np.asarray(valid & mask)
+            sl = jnp.asarray(np.asarray(slots, np.int64))
+            jm = jnp.asarray(m)
+            l0a = l0a.at[sl].add(jnp.asarray(np.where(m, v0, 0)), mode="drop")
+            l1a = l1a.at[sl].add(jnp.asarray(np.where(m, v1, 0)), mode="drop")
+            l2a = l2a.at[sl].add(jnp.asarray(np.where(m, v2, 0)), mode="drop")
+            c = c.at[sl].add(jm.astype(jnp.int64), mode="drop")
+            return list(_limb3_renorm(l0a, l1a, l2a)) + [c]
         if self.limbs:
             lo, hi, c = state
             m = validity & mask
@@ -411,6 +555,19 @@ class AvgAgg(AggFunction):
         return [s, c]
 
     def merge(self, state, slots, partial_cols, mask, n):
+        if self.limbs == "3":
+            l0a, l1a, l2a, c = state
+            p0, p1, p2, pcnt = partial_cols
+            m = pcnt.data.astype(bool) & pcnt.validity & mask
+            l0a = l0a.at[slots].add(jnp.where(m, p0.data, jnp.int64(0)),
+                                    mode="drop")
+            l1a = l1a.at[slots].add(jnp.where(m, p1.data, jnp.int64(0)),
+                                    mode="drop")
+            l2a = l2a.at[slots].add(jnp.where(m, p2.data, jnp.int64(0)),
+                                    mode="drop")
+            c = c.at[slots].add(jnp.where(m, pcnt.data, jnp.int64(0)),
+                                mode="drop")
+            return list(_limb3_renorm(l0a, l1a, l2a)) + [c]
         if self.limbs:
             lo, hi, c = state
             plo, phi, pcnt = partial_cols
@@ -442,10 +599,9 @@ class AvgAgg(AggFunction):
 
     def state_columns(self, state, num_slots, capacity):
         if self.limbs:
-            lo, hi, c = self.grow(state, capacity)
+            grown = self.grow(state, capacity)
             ones = jnp.ones(capacity, bool)
-            return [DeviceColumn(T.I64, lo, ones), DeviceColumn(T.I64, hi, ones),
-                    DeviceColumn(T.I64, c, ones)]
+            return [DeviceColumn(T.I64, g, ones) for g in grown]
         s, c = self.grow(state, capacity)
         if self.host:
             cn = c
@@ -457,23 +613,34 @@ class AvgAgg(AggFunction):
 
     def _decimal_divide(self, totals, counts, num_slots, has):
         """Exact Decimal sum/count with Spark HALF_UP rounding and
-        check_overflow nulling. ``totals`` unscaled object ints."""
+        check_overflow nulling. ``totals`` unscaled object ints. Runs under
+        a widened context: wide-arg sums reach ~10^38 and the default
+        28-significant-digit context raises InvalidOperation on
+        quantize."""
+        import decimal as _d
         from decimal import ROUND_HALF_UP, Decimal
 
         q = Decimal(1).scaleb(-self.result_type.scale)
         bound = Decimal(10) ** (self.result_type.precision - self.result_type.scale)
         out = []
-        for i in range(num_slots):
-            if not has[i]:
-                out.append(None)
-                continue
-            v = (Decimal(int(totals[i])).scaleb(-self.sum_type.scale)
-                 / Decimal(int(counts[i]))).quantize(q, rounding=ROUND_HALF_UP)
-            out.append(v if abs(v) < bound else None)
+        with _d.localcontext() as ctx:
+            ctx.prec = 80
+            for i in range(num_slots):
+                if not has[i]:
+                    out.append(None)
+                    continue
+                v = (Decimal(int(totals[i])).scaleb(-self.sum_type.scale)
+                     / Decimal(int(counts[i]))).quantize(
+                         q, rounding=ROUND_HALF_UP)
+                out.append(v if abs(v) < bound else None)
         return HostColumn(self.result_type,
                           pa.array(out, type=T.to_arrow_type(self.result_type)))
 
     def final_column(self, state, num_slots, capacity):
+        if self.limbs == "3":
+            l0a, l1a, l2a, c = state
+            totals, counts = _limb3_totals(l0a, l1a, l2a, num_slots, c)
+            return self._decimal_divide(totals, counts, num_slots, counts > 0)
         if self.limbs:
             lo, hi, c = state
             packed = np.asarray(jnp.stack(
@@ -500,24 +667,40 @@ class AvgAgg(AggFunction):
 
 
 class MinMaxAgg(AggFunction):
-    def __init__(self, agg, arg_type, result_type, which: str):
+    def __init__(self, agg, arg_type, result_type, which: str, limbs=None):
         super().__init__(agg, arg_type, result_type)
+        from blaze_tpu.ir.aggstate import state_mode, wide_val_tag
+
         self.which = which
-        # numerics stay vectorized (numpy ufunc.at when host); var-width
-        # values and wide decimals use per-slot python objects (exact
-        # Decimal comparisons for p > 18)
+        # numerics stay vectorized (numpy ufunc.at when host); wide
+        # decimals (19..38) as three int64 value limbs compared
+        # lexicographically on DEVICE; other var-width values per-slot
+        # python objects
+        if limbs is None:
+            fn = E.AggFunction.MIN if which == "min" else E.AggFunction.MAX
+            self.limbs = state_mode(fn, arg_type, result_type)
+        else:
+            self.limbs = limbs or False
         if isinstance(arg_type, T.DecimalType):
             self.numeric = arg_type.fits_int64
         else:
             self.numeric = arg_type.np_dtype is not None
-        self.host = not is_device_dtype(arg_type)
+        self.host = (not self.limbs) and not is_device_dtype(arg_type)
         self._npdt = np.dtype(np.int64) if isinstance(arg_type, T.DecimalType) else (
             arg_type.np_dtype if self.numeric else None)
+        if self.limbs == "w":
+            self._limb_tag = wide_val_tag(result_type)
 
     def state_fields(self):
+        if self.limbs == "w":
+            return [(self._limb_tag, T.I64), ("val_l1", T.I64),
+                    ("val_l2", T.I64), ("has", T.BOOL)]
         return [("val", self.result_type), ("has", T.BOOL)]
 
     def init_state(self, capacity):
+        if self.limbs == "w":
+            return [jnp.zeros(capacity, jnp.int64) for _ in range(3)] \
+                + [jnp.zeros(capacity, bool)]
         if self.host and not self.numeric:
             return [dict(), None]
         if self.host:
@@ -528,6 +711,8 @@ class MinMaxAgg(AggFunction):
                 jnp.zeros(capacity, bool)]
 
     def grow(self, state, capacity):
+        if self.limbs == "w":
+            return [_grow(s, capacity) for s in state]
         if self.host and not self.numeric:
             return state
         val, has = state
@@ -537,6 +722,13 @@ class MinMaxAgg(AggFunction):
                 _grow(has, capacity)]
 
     def update(self, state, slots, value, validity, mask, order=None):
+        if self.limbs == "w":
+            v0, v1, v2, valid = _wide_value_limbs(value)
+            m = np.asarray(valid & mask)
+            return _lex_scatter_minmax(
+                state, jnp.asarray(np.asarray(slots, np.int64)),
+                jnp.asarray(v0), jnp.asarray(v1), jnp.asarray(v2),
+                jnp.asarray(m), self.which == "max")
         if self.host and not self.numeric:
             return self._update_obj(state, slots, value.to_pylist(), mask)
         if self.host:
@@ -569,6 +761,11 @@ class MinMaxAgg(AggFunction):
         return [d, None]
 
     def merge(self, state, slots, partial_cols, mask, n):
+        if self.limbs == "w":
+            p0, p1, p2, phas = partial_cols
+            m = phas.data.astype(bool) & phas.validity & mask
+            return _lex_scatter_minmax(state, slots, p0.data, p1.data,
+                                       p2.data, m, self.which == "max")
         pval, phas = partial_cols
         if self.host and not self.numeric:
             return self._update_obj(state, slots, pval.array.to_pylist(), mask)
@@ -591,6 +788,11 @@ class MinMaxAgg(AggFunction):
         return [acc, has]
 
     def state_columns(self, state, num_slots, capacity):
+        if self.limbs == "w":
+            grown = self.grow(state, capacity)
+            ones = jnp.ones(capacity, bool)
+            return [DeviceColumn(T.I64, g, ones) for g in grown[:-1]] \
+                + [DeviceColumn(T.BOOL, grown[-1], ones)]
         if self.host and not self.numeric:
             d = state[0]
             vals = [d.get(i) for i in range(num_slots)]
@@ -607,6 +809,11 @@ class MinMaxAgg(AggFunction):
                 DeviceColumn(T.BOOL, has, jnp.ones(capacity, bool))]
 
     def final_column(self, state, num_slots, capacity):
+        if self.limbs == "w":
+            l0a, l1a, l2a, has = state
+            totals, has_i = _limb3_totals(l0a, l1a, l2a, num_slots, has)
+            return _host_col_out(self.result_type, totals,
+                                 has_i.astype(bool))
         return self.state_columns(state, num_slots, capacity)[0]
 
     def mem_used(self, state):
@@ -942,9 +1149,9 @@ def create_agg_function(agg: E.AggExpr, input_schema: T.Schema,
     if agg.fn == F.AVG:
         return AvgAgg(agg, arg_t, result_t, limbs=limbs)
     if agg.fn == F.MIN:
-        return MinMaxAgg(agg, arg_t, result_t, "min")
+        return MinMaxAgg(agg, arg_t, result_t, "min", limbs=limbs)
     if agg.fn == F.MAX:
-        return MinMaxAgg(agg, arg_t, result_t, "max")
+        return MinMaxAgg(agg, arg_t, result_t, "max", limbs=limbs)
     if agg.fn == F.FIRST:
         return FirstAgg(agg, arg_t, result_t, ignores_null=False)
     if agg.fn == F.FIRST_IGNORES_NULL:

@@ -253,15 +253,16 @@ def test_scale_mismatch_keeps_host_layout_both_sides(tmp_path):
                             for k in sorted(expected)]
 
 
-def test_wide_arg_stays_host(tmp_path):
-    # SUM over a decimal(19,2) column with declared result decimal(28,2):
-    # the ARG does not fit int64, so limbs must not engage (host object path)
+def test_wide_arg_takes_three_limb_device_path(tmp_path):
+    # SUM over a decimal(19,2) column: the ARG does not fit int64 planes,
+    # so the round-4 three-limb layout engages (device accumulation from
+    # decimal128 buffer views) instead of the old host object path
     from blaze_tpu.ops.aggfns import create_agg_function
 
     fn = create_agg_function(
         E.AggExpr(F.SUM, [E.Column("v")], T.DecimalType(28, 2)),
         T.Schema((T.StructField("v", T.DecimalType(19, 2)),)))
-    assert not fn.limbs and fn.host
+    assert fn.limbs == "3" and not fn.host
     unscaled = [9 * 10**18, 8 * 10**18, -10**18]
     tbl = pa.table({
         "k": pa.array([1, 1, 1], type=pa.int64()),
@@ -343,3 +344,155 @@ def test_avg_limb_two_stage_exact(tmp_path):
            for k in sorted(sums)]
     assert out["k"] == sorted(sums)
     assert out["a"] == exp
+
+
+# --- round 4: wide-arg (19..38 digit) aggregates on device limbs ------------
+
+
+def _wide_table(n=3000, seed=11, precision=38, scale=2):
+    rng = np.random.default_rng(seed)
+    # unscaled values far beyond int64, mixed signs
+    hi = rng.integers(10**4, 10**8, n)
+    lo = rng.integers(0, 10**16, n)
+    signs = rng.choice([-1, 1], n)
+    unscaled = [int(s) * (int(h) * 10**16 + int(l))
+                for s, h, l in zip(signs, hi, lo)]
+    ks = rng.integers(1, 9, n)
+    tbl = pa.table({
+        "k": pa.array(ks, type=pa.int64()),
+        "v": pa.array([Decimal(u).scaleb(-scale) for u in unscaled],
+                      type=pa.decimal128(precision, scale)),
+    })
+    groups = {}
+    for k, u in zip(ks, unscaled):
+        g = groups.setdefault(int(k), [])
+        g.append(u)
+    return tbl, groups
+
+
+def test_wide_arg_sum_min_max_two_stage_exact(tmp_path):
+    tbl, groups = _wide_table()
+    scan = _scan(tbl, tmp_path, nparts=2)
+    aggs = lambda mode: [
+        N.AggColumn(E.AggExpr(F.SUM, [E.Column("v")]), mode, "s"),
+        N.AggColumn(E.AggExpr(F.MIN, [E.Column("v")]), mode, "mn"),
+        N.AggColumn(E.AggExpr(F.MAX, [E.Column("v")]), mode, "mx"),
+    ]
+    partial = N.Agg(scan, E.AggExecMode.HASH_AGG, [("k", E.Column("k"))],
+                    aggs(E.AggMode.PARTIAL))
+    # partial wire schema: three-limb sum + wide min/max value limbs, all
+    # device dtypes (I64/BOOL)
+    names = [f.name for f in partial.output_schema.fields]
+    assert any("sum_l0@" in nm for nm in names), names
+    assert any("val_l0@" in nm for nm in names), names
+    from blaze_tpu.utils.device import is_device_dtype
+    assert all(is_device_dtype(f.dtype) for f in partial.output_schema.fields)
+    final = N.Agg(N.ShuffleExchange(partial,
+                                    N.HashPartitioning([E.Column("k")], 3)),
+                  E.AggExecMode.HASH_AGG, [("k", E.Column("k"))],
+                  aggs(E.AggMode.FINAL))
+    plan = N.Sort(N.ShuffleExchange(final, N.SinglePartitioning(1)),
+                  [E.SortOrder(E.Column("k"))])
+    with Session() as s:
+        out = s.execute_to_pydict(plan)
+    assert out["k"] == sorted(groups)
+    for i, k in enumerate(out["k"]):
+        us = groups[k]
+        assert out["s"][i] == Decimal(sum(us)).scaleb(-2), f"sum k={k}"
+        assert out["mn"][i] == Decimal(min(us)).scaleb(-2), f"min k={k}"
+        assert out["mx"][i] == Decimal(max(us)).scaleb(-2), f"max k={k}"
+
+
+def test_wide_arg_avg_exact_half_up(tmp_path):
+    tbl, groups = _wide_table(seed=13, precision=30, scale=3)
+    scan = _scan(tbl, tmp_path, nparts=2)
+    partial = N.Agg(scan, E.AggExecMode.HASH_AGG, [("k", E.Column("k"))], [
+        N.AggColumn(E.AggExpr(F.AVG, [E.Column("v")]),
+                    E.AggMode.PARTIAL, "a")])
+    names = [f.name for f in partial.output_schema.fields]
+    assert any("sum_l0@" in nm for nm in names), names
+    final = N.Agg(N.ShuffleExchange(partial,
+                                    N.HashPartitioning([E.Column("k")], 2)),
+                  E.AggExecMode.HASH_AGG, [("k", E.Column("k"))], [
+        N.AggColumn(E.AggExpr(F.AVG, [E.Column("v")]),
+                    E.AggMode.FINAL, "a")])
+    plan = N.Sort(N.ShuffleExchange(final, N.SinglePartitioning(1)),
+                  [E.SortOrder(E.Column("k"))])
+    with Session() as s:
+        out = s.execute_to_pydict(plan)
+    from decimal import ROUND_HALF_UP
+    # Spark avg over decimal(30,3): result scale min(3+4, ...) — read the
+    # produced scale from the result and check HALF_UP division exactness
+    for i, k in enumerate(out["k"]):
+        us = groups[k]
+        got = out["a"][i]
+        want = (Decimal(sum(us)).scaleb(-3)
+                / Decimal(len(us))).quantize(got.as_tuple() and
+                                             Decimal(1).scaleb(got.as_tuple().exponent),
+                                             rounding=ROUND_HALF_UP)
+        assert got == want, f"avg k={k}: {got} != {want}"
+
+
+def test_wide_minmax_all_negative_and_single_rows(tmp_path):
+    unscaled = [-10**25, -3, -10**30, -10**25 - 1]
+    tbl = pa.table({
+        "k": pa.array([1, 1, 1, 1], type=pa.int64()),
+        "v": pa.array([Decimal(u).scaleb(-2) for u in unscaled],
+                      type=pa.decimal128(31, 2)),
+    })
+    scan = _scan(tbl, tmp_path)
+    agg = N.Agg(scan, E.AggExecMode.HASH_AGG, [("k", E.Column("k"))], [
+        N.AggColumn(E.AggExpr(F.MIN, [E.Column("v")]), E.AggMode.COMPLETE, "mn"),
+        N.AggColumn(E.AggExpr(F.MAX, [E.Column("v")]), E.AggMode.COMPLETE, "mx")])
+    with Session() as s:
+        out = s.execute_to_pydict(agg)
+    assert out["mn"] == [Decimal(-10**30).scaleb(-2)]
+    assert out["mx"] == [Decimal(-3).scaleb(-2)]
+
+
+def test_wide_sum_cancellation_near_extremes(tmp_path):
+    # large positive and negative values whose TOTAL is small: the l2
+    # accumulator wraps mod 2^64 but the reconstruction stays exact
+    big = 10**37
+    unscaled = [big, -big, big, -big, 12345]
+    tbl = pa.table({
+        "k": pa.array([1] * 5, type=pa.int64()),
+        "v": pa.array([Decimal(u).scaleb(-2) for u in unscaled],
+                      type=pa.decimal128(38, 2)),
+    })
+    scan = _scan(tbl, tmp_path)
+    agg = N.Agg(scan, E.AggExecMode.HASH_AGG, [("k", E.Column("k"))], [
+        N.AggColumn(E.AggExpr(F.SUM, [E.Column("v")]), E.AggMode.COMPLETE, "s")])
+    with Session() as s:
+        out = s.execute_to_pydict(agg)
+    assert out["s"] == [Decimal(12345).scaleb(-2)]
+
+
+def test_wide_avg_two_stage_type_matches_complete(tmp_path):
+    """Round-4 review: the three-limb tag must carry the ARG precision —
+    for a decimal(38,2) arg the FINAL stage would otherwise reconstruct a
+    28-digit arg and narrow AVG's result type (and its overflow bound)."""
+    unscaled = [10**30, 10**30 + 4]
+    tbl = pa.table({
+        "k": pa.array([1, 1], type=pa.int64()),
+        "v": pa.array([Decimal(u).scaleb(-2) for u in unscaled],
+                      type=pa.decimal128(38, 2)),
+    })
+    scan = _scan(tbl, tmp_path)
+    def avg(mode):
+        return [N.AggColumn(E.AggExpr(F.AVG, [E.Column("v")]), mode, "a")]
+    complete = N.Agg(scan, E.AggExecMode.HASH_AGG, [("k", E.Column("k"))],
+                     avg(E.AggMode.COMPLETE))
+    partial = N.Agg(scan, E.AggExecMode.HASH_AGG, [("k", E.Column("k"))],
+                    avg(E.AggMode.PARTIAL))
+    final = N.Agg(N.ShuffleExchange(partial, N.SinglePartitioning(1)),
+                  E.AggExecMode.HASH_AGG, [("k", E.Column("k"))],
+                  avg(E.AggMode.FINAL))
+    assert final.output_schema["a"].dtype == complete.output_schema["a"].dtype
+    with Session() as s:
+        got_c = s.execute_to_pydict(complete)
+    with Session() as s:
+        got_f = s.execute_to_pydict(final)
+    # averages of ~10^28-scale values must not be overflow-nulled
+    assert got_c["a"][0] is not None
+    assert got_f["a"] == got_c["a"]
