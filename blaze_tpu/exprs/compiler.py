@@ -272,7 +272,7 @@ class ExprEvaluator:
                 out = self._binary_dict_fast(op, lval, rval, batch)
                 if out is not None:
                     return out
-                return self._binary_host(op, lval, rval, batch)
+                return self._binary_host(op, lval, rval, batch, expr)
         return self._binary_dev(op, expr, lval, rval)
 
     def _binary_dict_fast(self, op: E.BinaryOp, lval, rval,
@@ -450,7 +450,9 @@ class ExprEvaluator:
             return v.data.astype(jnp.float64) / float(10 ** v.dtype.scale)
         return v.data.astype(jnp.float64)
 
-    def _binary_host(self, op: E.BinaryOp, l: Val, r: Val, batch: ColumnarBatch) -> Val:
+    def _binary_host(self, op: E.BinaryOp, l: Val, r: Val,
+                 batch: ColumnarBatch,
+                 expr: Optional[E.BinaryExpr] = None) -> Val:
         B = E.BinaryOp
         la = self._to_host(l, batch).arr
         ra = self._to_host(r, batch).arr
@@ -490,7 +492,64 @@ class ExprEvaluator:
             res_t = T.F64
             return HostVal(res_t, pa.Array.from_pandas(out, mask=~valid,
                                                        type=pa.float64()))
+        if pa.types.is_decimal(la.type) or pa.types.is_decimal(ra.type):
+            return self._decimal_host_arith(op, l, r, la, ra, expr)
         raise ExprError(f"unsupported host binary op {op} on {la.type}")
+
+    def _decimal_host_arith(self, op: E.BinaryOp, l: Val, r: Val,
+                            la: pa.Array, ra: pa.Array,
+                            expr: Optional[E.BinaryExpr] = None) -> HostVal:
+        """Exact python-Decimal arithmetic for WIDE decimal operands (a
+        wide window/agg output dividing a device decimal lands here, e.g.
+        TPC-DS q98's revenue ratio). Result type follows the engine's
+        decimal promotion rules (E.infer_type); division rounds HALF_UP at
+        the result scale and overflow nulls (Spark non-ANSI)."""
+        import decimal as _d
+
+        B = E.BinaryOp
+        if op not in (B.ADD, B.SUB, B.MUL, B.DIV, B.MOD):
+            raise ExprError(f"unsupported host decimal op {op}")
+        # the PLAN's declared result type is authoritative (exact Spark
+        # promotion comes from the converter); inference is the fallback
+        # for hand-built plans — mirroring _binary_dev
+        res_t = (expr.result_type if expr is not None and
+                 expr.result_type is not None else None) or E.infer_type(
+            E.BinaryExpr(op, E.Literal(None, l.dtype), E.Literal(None, r.dtype)),
+            T.Schema(()))
+        if not isinstance(res_t, T.DecimalType):
+            raise ExprError(f"host decimal op {op} inferred {res_t}")
+        lv = la.to_pylist()
+        rv = ra.to_pylist()
+        q = _d.Decimal(1).scaleb(-res_t.scale)
+        bound = _d.Decimal(10) ** (res_t.precision - res_t.scale)
+        out = []
+        with _d.localcontext() as ctx:
+            ctx.prec = 80
+            for x, y in zip(lv, rv):
+                if x is None or y is None:
+                    out.append(None)
+                    continue
+                x, y = _d.Decimal(x), _d.Decimal(y)
+                if op == B.ADD:
+                    v = x + y
+                elif op == B.SUB:
+                    v = x - y
+                elif op == B.MUL:
+                    v = x * y
+                elif op == B.DIV:
+                    if y == 0:
+                        out.append(None)
+                        continue
+                    v = x / y
+                else:  # MOD (Java truncating-division remainder)
+                    if y == 0:
+                        out.append(None)
+                        continue
+                    v = x - (x / y).to_integral_value(
+                        rounding=_d.ROUND_DOWN) * y
+                v = v.quantize(q, rounding=_d.ROUND_HALF_UP)
+                out.append(v if abs(v) < bound else None)
+        return HostVal(res_t, pa.array(out, type=T.to_arrow_type(res_t)))
 
     # -- unary / predicates ---------------------------------------------------
 

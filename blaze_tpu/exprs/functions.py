@@ -183,8 +183,17 @@ def _unary_math(jfn):
 
 
 def _fn_abs(args, ev, batch):
-    from blaze_tpu.exprs.compiler import DevVal
+    from blaze_tpu.exprs.compiler import DevVal, HostVal
+    from blaze_tpu.utils.device import is_device_dtype
 
+    (v,) = args
+    if not is_device_dtype(v.dtype):
+        # wide decimals and other host-resident numerics (e.g. TPC-DS
+        # q89's abs(sum - avg) over a window result): pyarrow abs is exact
+        hv = ev._to_host(v, batch)
+        import pyarrow.compute as pc
+
+        return HostVal(v.dtype, pc.abs_checked(hv.arr))
     (a,) = _dev(args, ev, batch)
     if a.data.dtype == jnp.bool_:
         return a

@@ -843,18 +843,15 @@ def _reduce_aggs(specs, args, seg, nseg_total):
             # l2 the signed high word wrapping mod 2^64 — exact within
             # decimal(38))
             p0, p1, p2 = sa
+            from blaze_tpu.ops.aggfns import _limb3_renorm
+
             s0 = jnp.zeros(nseg_total, jnp.int64).at[seg].add(
                 jnp.where(sv, p0, jnp.int64(0)), mode="drop")
             s1 = jnp.zeros(nseg_total, jnp.int64).at[seg].add(
                 jnp.where(sv, p1, jnp.int64(0)), mode="drop")
             s2 = jnp.zeros(nseg_total, jnp.int64).at[seg].add(
                 jnp.where(sv, p2, jnp.int64(0)), mode="drop")
-            c0 = s0 >> 32
-            s0 = s0 & jnp.int64(0xFFFFFFFF)
-            s1 = s1 + c0
-            c1 = s1 >> 32
-            s1 = s1 & jnp.int64(0xFFFFFFFF)
-            s2 = s2 + c1
+            s0, s1, s2 = _limb3_renorm(s0, s1, s2)
             if kind == "avg3":
                 scnt = jnp.zeros(nseg_total, jnp.int64).at[seg].add(
                     sv.astype(jnp.int64), mode="drop")

@@ -155,3 +155,24 @@ def test_string_functions_still_work_on_dict_columns(tmp_path):
         got = sess.execute_to_table(proj).to_pydict()
     assert got["u"] == ["A", "BC", None, "DEF"]
     assert got["l"] == [1, 2, None, 3]
+
+
+def test_host_decimal_divide_honors_declared_result_type():
+    """Round-4 review: host decimal arithmetic must honor the PLAN's
+    declared result type (Spark's exact promotion), not re-infer — a
+    declared decimal(38,6) division must keep its 6-digit scale."""
+    import decimal
+
+    from blaze_tpu.exprs.compiler import ExprEvaluator
+
+    t = pa.table({
+        "x": pa.array([decimal.Decimal("1.00")], type=pa.decimal128(38, 2)),
+        "y": pa.array([decimal.Decimal("3.00")], type=pa.decimal128(19, 2)),
+    })
+    b = ColumnarBatch.from_arrow(t)
+    expr = E.BinaryExpr(E.BinaryOp.DIV, E.Column("x"), E.Column("y"),
+                        result_type=T.DecimalType(38, 6))
+    ev = ExprEvaluator([expr], b.schema)
+    out = ev.evaluate(b)[0].to_arrow(1)
+    assert out.type == pa.decimal128(38, 6)
+    assert out[0].as_py() == decimal.Decimal("0.333333")

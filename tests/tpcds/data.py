@@ -1,0 +1,246 @@
+"""Tiny-scale TPC-DS star schema for the real-query gate.
+
+Column subsets of the official TPC-DS tables (the columns the checked-in
+queries touch), generated deterministically at roughly sf≈0.002 so the full
+16-query gate runs in CI time while every query still returns non-trivial
+results. FK distributions are skewed like the real generator's (recent
+dates, popular items)."""
+
+from __future__ import annotations
+
+import decimal
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+N_DATES = 731            # two years: 1998-01-01 .. 1999-12-31 (d_date_sk 1..)
+N_ITEMS = 600
+N_STORES = 12
+N_CUSTOMERS = 4000
+N_ADDRS = 3000
+N_CDEMO = 400
+N_HDEMO = 60
+N_PROMOS = 40
+N_SS = 60_000
+N_CS = 30_000
+
+
+def _dec(rng, n, lo, hi, prec=7, scale=2):
+    unscaled = rng.integers(lo, hi, n)
+    return pa.array([decimal.Decimal(int(v)).scaleb(-scale) for v in unscaled],
+                    type=pa.decimal128(prec, scale))
+
+
+def generate(dirpath: str) -> dict:
+    """Write all tables as parquet under ``dirpath``; returns
+    {table: [paths]}."""
+    rng = np.random.default_rng(2026)
+    os.makedirs(dirpath, exist_ok=True)
+    tables = {}
+
+    def write(name, tbl, parts=1):
+        paths = []
+        per = max(1, tbl.num_rows // parts)
+        for p in range(parts):
+            sub = tbl.slice(p * per,
+                            per if p < parts - 1 else tbl.num_rows - p * per)
+            path = os.path.join(dirpath, f"{name}_{p}.parquet")
+            pq.write_table(sub, path)
+            paths.append(path)
+        tables[name] = paths
+        return tbl
+
+    # --- date_dim: d_date_sk 1.. maps to days from 1998-01-01
+    sk = np.arange(1, N_DATES + 1)
+    doy = (sk - 1) % 365
+    year = 1998 + (sk - 1) // 365
+    month_lengths = np.array([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31])
+    month_starts = np.concatenate([[0], np.cumsum(month_lengths)[:-1]])
+    moy = np.searchsorted(month_starts, doy, side="right")
+    dom = doy - month_starts[moy - 1] + 1
+    day_names = np.array(["Sunday", "Monday", "Tuesday", "Wednesday",
+                          "Thursday", "Friday", "Saturday"])
+    write("date_dim", pa.table({
+        "d_date_sk": pa.array(sk, type=pa.int64()),
+        "d_year": pa.array(year, type=pa.int64()),
+        "d_moy": pa.array(moy, type=pa.int64()),
+        "d_dom": pa.array(dom, type=pa.int64()),
+        "d_day_name": pa.array(day_names[(sk - 1) % 7]),
+        "d_month_seq": pa.array((year - 1900) * 12 + moy - 1,
+                                type=pa.int64()),
+        "d_qoy": pa.array((moy - 1) // 3 + 1, type=pa.int64()),
+    }))
+
+    cats = ["Books", "Home", "Electronics", "Music", "Sports",
+            "Shoes", "Women", "Men", "Children", "Jewelry"]
+    classes = ["class%02d" % i for i in range(16)]
+    write("item", pa.table({
+        "i_item_sk": pa.array(np.arange(1, N_ITEMS + 1), type=pa.int64()),
+        "i_item_id": pa.array([f"AAAAAA{v:010d}" for v in range(1, N_ITEMS + 1)]),
+        "i_item_desc": pa.array([f"item description {v}" for v in range(N_ITEMS)]),
+        "i_manufact": pa.array([f"manufact{v % 100}" for v in range(N_ITEMS)]),
+        "i_brand_id": pa.array(rng.integers(1001001, 1001060, N_ITEMS),
+                               type=pa.int64()),
+        "i_brand": pa.array([f"brand#{v}" for v in
+                             rng.integers(1, 60, N_ITEMS)]),
+        "i_class": pa.array([classes[v] for v in
+                             rng.integers(0, len(classes), N_ITEMS)]),
+        "i_category_id": pa.array(rng.integers(1, len(cats) + 1, N_ITEMS),
+                                  type=pa.int64()),
+        "i_category": pa.array([cats[v] for v in
+                                rng.integers(0, len(cats), N_ITEMS)]),
+        "i_manufact_id": pa.array(rng.integers(1, 100, N_ITEMS),
+                                  type=pa.int64()),
+        "i_manager_id": pa.array(rng.integers(1, 40, N_ITEMS),
+                                 type=pa.int64()),
+        "i_current_price": _dec(rng, N_ITEMS, 100, 30000),
+    }))
+
+    write("store", pa.table({
+        "s_store_sk": pa.array(np.arange(1, N_STORES + 1), type=pa.int64()),
+        "s_store_id": pa.array([f"S{v:09d}" for v in range(1, N_STORES + 1)]),
+        "s_store_name": pa.array([f"store {chr(97 + v % 26)}"
+                                  for v in range(N_STORES)]),
+        "s_city": pa.array([["Midway", "Fairview", "Oakland"][v % 3]
+                            for v in range(N_STORES)]),
+        "s_state": pa.array([["TN", "SD", "AL"][v % 3]
+                             for v in range(N_STORES)]),
+        "s_zip": pa.array([f"{24000 + (v * 11) % 70000:05d}"
+                           for v in range(N_STORES)]),
+        "s_company_name": pa.array([["Unknown", "ought", "able"][v % 3]
+                                    for v in range(N_STORES)]),
+        "s_gmt_offset": _dec(rng, N_STORES, -600, -400, prec=5, scale=2),
+    }))
+
+    n_times = 7200
+    write("time_dim", pa.table({
+        "t_time_sk": pa.array(np.arange(1, n_times + 1), type=pa.int64()),
+        "t_hour": pa.array((np.arange(n_times) // 300) % 24,
+                           type=pa.int64()),
+        "t_minute": pa.array((np.arange(n_times) // 5) % 60,
+                             type=pa.int64()),
+    }))
+
+    write("customer", pa.table({
+        "c_customer_sk": pa.array(np.arange(1, N_CUSTOMERS + 1),
+                                  type=pa.int64()),
+        "c_current_addr_sk": pa.array(rng.integers(1, N_ADDRS + 1,
+                                                   N_CUSTOMERS),
+                                      type=pa.int64()),
+        "c_current_cdemo_sk": pa.array(rng.integers(1, N_CDEMO + 1,
+                                                    N_CUSTOMERS),
+                                       type=pa.int64()),
+        "c_current_hdemo_sk": pa.array(rng.integers(1, N_HDEMO + 1,
+                                                    N_CUSTOMERS),
+                                       type=pa.int64()),
+        "c_first_name": pa.array([f"First{v % 97}"
+                                  for v in range(N_CUSTOMERS)]),
+        "c_last_name": pa.array([f"Last{v % 131}"
+                                 for v in range(N_CUSTOMERS)]),
+    }))
+
+    write("customer_address", pa.table({
+        "ca_address_sk": pa.array(np.arange(1, N_ADDRS + 1), type=pa.int64()),
+        "ca_city": pa.array([["Edgewood", "Midway", "Salem", "Concord",
+                              "Clinton"][v % 5] for v in range(N_ADDRS)]),
+        "ca_zip": pa.array([f"{24000 + (v * 7) % 70000:05d}"
+                            for v in range(N_ADDRS)]),
+        "ca_state": pa.array([["CA", "TX", "OH", "GA", "WA"][v % 5]
+                              for v in range(N_ADDRS)]),
+        "ca_country": pa.array(["United States"] * N_ADDRS),
+        "ca_gmt_offset": _dec(rng, N_ADDRS, -600, -400, prec=5, scale=2),
+    }))
+
+    write("customer_demographics", pa.table({
+        "cd_demo_sk": pa.array(np.arange(1, N_CDEMO + 1), type=pa.int64()),
+        "cd_gender": pa.array([["M", "F"][v % 2] for v in range(N_CDEMO)]),
+        "cd_marital_status": pa.array([["M", "S", "D", "W", "U"][v % 5]
+                                       for v in range(N_CDEMO)]),
+        "cd_education_status": pa.array(
+            [["Primary", "Secondary", "College", "2 yr Degree",
+              "4 yr Degree", "Advanced Degree", "Unknown"][v % 7]
+             for v in range(N_CDEMO)]),
+    }))
+
+    write("household_demographics", pa.table({
+        "hd_demo_sk": pa.array(np.arange(1, N_HDEMO + 1), type=pa.int64()),
+        "hd_dep_count": pa.array(np.arange(N_HDEMO) % 10, type=pa.int64()),
+        "hd_vehicle_count": pa.array(np.arange(N_HDEMO) % 5, type=pa.int64()),
+    }))
+
+    write("promotion", pa.table({
+        "p_promo_sk": pa.array(np.arange(1, N_PROMOS + 1), type=pa.int64()),
+        "p_channel_dmail": pa.array([["Y", "N"][v % 2]
+                                     for v in range(N_PROMOS)]),
+        "p_channel_email": pa.array([["N", "Y"][v % 3 == 1]
+                                     for v in range(N_PROMOS)]),
+        "p_channel_tv": pa.array([["N", "Y"][v % 5 == 2]
+                                  for v in range(N_PROMOS)]),
+    }))
+
+    def sales(prefix, n):
+        qty = rng.integers(1, 101, n)
+        list_price = rng.integers(100, 30000, n)
+        sales_price = (list_price * rng.integers(40, 100, n)) // 100
+        return {
+            f"{prefix}_sold_date_sk": pa.array(
+                rng.integers(1, N_DATES + 1, n), type=pa.int64()),
+            f"{prefix}_item_sk": pa.array(
+                rng.integers(1, N_ITEMS + 1, n), type=pa.int64()),
+            f"{prefix}_promo_sk": pa.array(
+                rng.integers(1, N_PROMOS + 1, n), type=pa.int64()),
+            f"{prefix}_quantity": pa.array(qty, type=pa.int64()),
+            f"{prefix}_list_price": pa.array(
+                [decimal.Decimal(int(v)).scaleb(-2) for v in list_price],
+                type=pa.decimal128(7, 2)),
+            f"{prefix}_sales_price": pa.array(
+                [decimal.Decimal(int(v)).scaleb(-2) for v in sales_price],
+                type=pa.decimal128(7, 2)),
+            f"{prefix}_ext_sales_price": pa.array(
+                [decimal.Decimal(int(q * v)).scaleb(-2)
+                 for q, v in zip(qty, sales_price)],
+                type=pa.decimal128(7, 2)),
+            f"{prefix}_coupon_amt": _dec(rng, n, 0, 5000),
+        }
+
+    ss = sales("ss", N_SS)
+    ss.update({
+        "ss_ticket_number": pa.array(rng.integers(1, N_SS // 3, N_SS),
+                                     type=pa.int64()),
+        "ss_sold_time_sk": pa.array(rng.integers(1, 7201, N_SS),
+                                    type=pa.int64()),
+        "ss_customer_sk": pa.array(rng.integers(1, N_CUSTOMERS + 1, N_SS),
+                                   type=pa.int64()),
+        "ss_cdemo_sk": pa.array(rng.integers(1, N_CDEMO + 1, N_SS),
+                                type=pa.int64()),
+        "ss_hdemo_sk": pa.array(rng.integers(1, N_HDEMO + 1, N_SS),
+                                type=pa.int64()),
+        "ss_addr_sk": pa.array(rng.integers(1, N_ADDRS + 1, N_SS),
+                               type=pa.int64()),
+        "ss_store_sk": pa.array(rng.integers(1, N_STORES + 1, N_SS),
+                                type=pa.int64()),
+        "ss_ext_discount_amt": _dec(rng, N_SS, 0, 10000),
+        "ss_ext_wholesale_cost": _dec(rng, N_SS, 100, 20000),
+        "ss_net_profit": _dec(rng, N_SS, -5000, 15000),
+    })
+    write("store_sales", pa.table(ss), parts=2)
+
+    cs = sales("cs", N_CS)
+    cs.update({
+        "cs_bill_customer_sk": pa.array(
+            rng.integers(1, N_CUSTOMERS + 1, N_CS), type=pa.int64()),
+        "cs_bill_cdemo_sk": pa.array(rng.integers(1, N_CDEMO + 1, N_CS),
+                                     type=pa.int64()),
+    })
+    write("catalog_sales", pa.table(cs), parts=2)
+
+    return tables
+
+
+def load_dfs(tables: dict) -> dict:
+    """pandas frames for the oracles."""
+    return {name: pa.concat_tables(
+        [pq.read_table(p) for p in ps]).to_pandas()
+        for name, ps in tables.items()}

@@ -1,0 +1,248 @@
+"""Builder for Spark ``TreeNode.toJSON`` physical-plan fixtures.
+
+Emits the same flattened pre-order node arrays real Spark serializes (the
+wire form ``blaze_tpu.frontend`` consumes — see frontend/treenode.py), so
+the checked-in TPC-DS queries exercise the genuine conversion path:
+AttributeReference exprIds, Alias bindings, AggregateExpression
+Partial/Final modes, BroadcastHashJoinExec build sides, etc.
+
+Every helper returns a FLATTENED LIST of node dicts; plan combinators
+concatenate children in pre-order exactly like Spark's serializer."""
+
+from __future__ import annotations
+
+import itertools
+
+SPARK = "org.apache.spark.sql"
+X = f"{SPARK}.catalyst.expressions"
+P = f"{SPARK}.execution"
+
+_ids = itertools.count(1000)
+
+
+class Attrs:
+    """Per-query attribute registry: stable exprIds keyed by column name
+    (matching how one Spark plan reuses the same AttributeReference)."""
+
+    def __init__(self):
+        self._ids = {}
+        self._types = {}
+
+    def define(self, name: str, dtype: str):
+        if name not in self._ids:
+            self._ids[name] = next(_ids)
+            self._types[name] = dtype
+        return self(name)
+
+    def __call__(self, name: str):
+        return [{
+            "class": f"{X}.AttributeReference", "num-children": 0,
+            "name": name, "dataType": self._types[name], "nullable": True,
+            "metadata": {},
+            "exprId": {"product-class": f"{X}.ExprId",
+                       "id": self._ids[name],
+                       "jvmId": "00000000-0000-0000-0000-000000000000"},
+            "qualifier": []}]
+
+    def new_id(self) -> int:
+        return next(_ids)
+
+    def define_with_id(self, name: str, dtype: str, eid: int):
+        """Bind a name to a KNOWN exprId — how downstream nodes reference
+        an aggregate's result attribute (exprId == the agg's resultId)."""
+        self._ids[name] = eid
+        self._types[name] = dtype
+        return self(name)
+
+
+def lit(value, dtype):
+    return [{"class": f"{X}.Literal", "num-children": 0,
+             "value": value, "dataType": dtype}]
+
+
+def binop(cls, l, r):
+    return [{"class": f"{X}.{cls}", "num-children": 2,
+             "left": 0, "right": 1}] + l + r
+
+
+def eq(l, r):
+    return binop("EqualTo", l, r)
+
+
+def and_(*conds):
+    out = conds[0]
+    for c in conds[1:]:
+        out = binop("And", out, c)
+    return out
+
+
+def or_(*conds):
+    out = conds[0]
+    for c in conds[1:]:
+        out = binop("Or", out, c)
+    return out
+
+
+def isnotnull(c):
+    return [{"class": f"{X}.IsNotNull", "num-children": 1, "child": 0}] + c
+
+
+def in_list(child, values, dtype):
+    lits = [lit(v, dtype) for v in values]
+    node = [{"class": f"{X}.In", "num-children": 1 + len(lits),
+             "value": 0, "list": list(range(1, len(lits) + 1))}]
+    return node + child + [x for li in lits for x in li]
+
+
+def sfn(cls, *children):
+    """Generic scalar function node (Substring, Concat, ...)."""
+    return [{"class": f"{X}.{cls}", "num-children": len(children)}] + \
+        [x for c in children for x in c]
+
+
+def not_(child):
+    return [{"class": f"{X}.Not", "num-children": 1, "child": 0}] + child
+
+
+def cast(child, to):
+    return [{"class": f"{X}.Cast", "num-children": 1, "child": 0,
+             "dataType": to, "timeZoneId": "UTC"}] + child
+
+
+def mul(l, r):
+    return binop("Multiply", l, r)
+
+
+def alias(child, name: str, eid: int):
+    return [{"class": f"{X}.Alias", "num-children": 1, "child": 0,
+             "name": name,
+             "exprId": {"product-class": f"{X}.ExprId", "id": eid,
+                        "jvmId": "00000000-0000-0000-0000-000000000000"},
+             "qualifier": [], "explicitMetadata": {},
+             "nonInheritableMetadataKeys": []}] + child
+
+
+def agg_expr(fn_cls, mode, rid, children, distinct=False):
+    fn = [{"class": f"{X}.aggregate.{fn_cls}",
+           "num-children": len(children)}] + \
+        [c for ch in children for c in ch]
+    return [{"class": f"{X}.aggregate.AggregateExpression", "num-children": 1,
+             "aggregateFunction": 0,
+             "mode": {"object": f"{X}.aggregate.{mode}$"},
+             "isDistinct": bool(distinct),
+             "resultId": {"product-class": f"{X}.ExprId", "id": rid,
+                          "jvmId": "00000000-0000-0000-0000-000000000000"}}] \
+        + fn
+
+
+def sort_order(child, asc=True, nulls_first=None):
+    d = "Ascending$" if asc else "Descending$"
+    nf = asc if nulls_first is None else nulls_first
+    n = "NullsFirst$" if nf else "NullsLast$"
+    return [{"class": f"{X}.SortOrder", "num-children": 1, "child": 0,
+             "direction": {"object": f"{X}.{d}"},
+             "nullOrdering": {"object": f"{X}.{n}"},
+             "sameOrderExpressions": []}] + child
+
+
+# --- plan nodes (flattened pre-order) ---------------------------------------
+
+
+def scan(table: str, attrs, cols):
+    return [{"class": f"{P}.FileSourceScanExec", "num-children": 0,
+             "output": [attrs(c) for c in cols],
+             "requiredSchema": {"type": "struct", "fields": []},
+             "partitionFilters": [], "dataFilters": [],
+             "tableIdentifier": table}]
+
+
+def filt(cond, child):
+    return [{"class": f"{P}.FilterExec", "num-children": 1,
+             "condition": cond, "child": 0}] + child
+
+
+def project(plist, child):
+    return [{"class": f"{P}.ProjectExec", "num-children": 1,
+             "projectList": plist, "child": 0}] + child
+
+
+def hash_agg(groups, aggs, child):
+    return [{"class": f"{P}.aggregate.HashAggregateExec", "num-children": 1,
+             "requiredChildDistributionExpressions": None,
+             "groupingExpressions": groups,
+             "aggregateExpressions": aggs,
+             "aggregateAttributes": [],
+             "initialInputBufferOffset": 0,
+             "resultExpressions": [], "child": 0}] + child
+
+
+def exchange(child, keys=None, nparts=4):
+    if keys is None:
+        part = [{"class": f"{SPARK}.catalyst.plans.physical."
+                          "SinglePartition$", "num-children": 0}]
+    else:
+        part = [{"class": f"{SPARK}.catalyst.plans.physical."
+                          "HashPartitioning",
+                 "num-children": len(keys),
+                 "expressions": list(range(len(keys))),
+                 "numPartitions": nparts}] + \
+            [x for k in keys for x in k]
+    return [{"class": f"{P}.exchange.ShuffleExchangeExec", "num-children": 1,
+             "outputPartitioning": part,
+             "shuffleOrigin": {"object": f"{P}.exchange."
+                                         "ENSURE_REQUIREMENTS$"},
+             "child": 0}] + child
+
+
+def two_stage_agg(groups, agg_fns, child, nparts=4):
+    """partial agg -> hash exchange on the group keys -> final agg, the
+    shape Spark plans for a grouped aggregate. ``agg_fns``: list of
+    (fn_cls, rid, children-builder) — children rebuilt per mode."""
+    partial = hash_agg(groups,
+                       [agg_expr(f, "Partial", rid, ch)
+                        for f, rid, ch in agg_fns], child)
+    ex = exchange(partial, keys=list(groups), nparts=nparts)
+    return hash_agg(groups,
+                    [agg_expr(f, "Final", rid, ch)
+                     for f, rid, ch in agg_fns], ex)
+
+
+def bcast(child):
+    return [{"class": f"{P}.exchange.BroadcastExchangeExec",
+             "num-children": 1, "mode": {}, "child": 0}] + child
+
+
+def bhj(left, right, lkeys, rkeys, jt="Inner", build="BuildRight",
+        condition=None):
+    node = {"class": f"{P}.joins.BroadcastHashJoinExec", "num-children": 2,
+            "leftKeys": lkeys, "rightKeys": rkeys,
+            "joinType": {"object": f"{SPARK}.catalyst.plans.{jt}$"},
+            "buildSide": {"object": f"{P}.joins.{build}$"},
+            "condition": condition, "left": 0, "right": 1}
+    return [node] + left + right
+
+
+def smj(left, right, lkeys, rkeys, jt="Inner", condition=None):
+    node = {"class": f"{P}.joins.SortMergeJoinExec", "num-children": 2,
+            "leftKeys": lkeys, "rightKeys": rkeys,
+            "joinType": {"object": f"{SPARK}.catalyst.plans.{jt}$"},
+            "condition": condition, "isSkewJoin": False,
+            "left": 0, "right": 1}
+    return [node] + left + right
+
+
+def sort(orders, child):
+    return [{"class": f"{P}.SortExec", "num-children": 1,
+             "sortOrder": orders, "global": True, "child": 0}] + child
+
+
+def take_ordered(limit, orders, plist, child):
+    return [{"class": f"{P}.TakeOrderedAndProjectExec", "num-children": 1,
+             "limit": limit, "sortOrder": orders,
+             "projectList": plist, "child": 0}] + child
+
+
+def window(wexprs, part_spec, order_spec, child):
+    return [{"class": f"{P}.window.WindowExec", "num-children": 1,
+             "windowExpression": wexprs, "partitionSpec": part_spec,
+             "orderSpec": order_spec, "child": 0}] + child
