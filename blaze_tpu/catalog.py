@@ -32,6 +32,9 @@ class CatalogTable:
     fmt: str                      # "parquet" | "orc"
     files: List[Tuple[str, tuple]]  # (path, partition value tuple)
     partition_schema: T.Schema
+    # explicit data schema (e.g. from metastore cols): lets an EMPTY table
+    # still resolve a scan schema; None = read it from the first file
+    schema: "T.Schema | None" = None
 
 
 class Catalog:
@@ -135,6 +138,11 @@ class Catalog:
         return N.ParquetScan(conf, predicate)
 
     def _data_schema(self, t: CatalogTable) -> T.Schema:
+        if t.schema is not None:
+            return t.schema
+        if not t.files:
+            raise ValueError(
+                f"table {t.name!r} has no files and no declared schema")
         path = t.files[0][0]
         if t.fmt == "orc":
             from pyarrow import orc

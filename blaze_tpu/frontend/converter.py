@@ -241,8 +241,15 @@ class SparkPlanConverter:
         bare = [a.field("name") for a in out_attrs]
         ppred = and_fold_filters(node.field("partitionFilters"), {})
         dpred = and_fold_filters(node.field("dataFilters"), {})
+        plan = self._catalog_scan_tail(ident, bare, names, ppred, dpred)
+        return plan, self._attr_scope(out_attrs)
+
+    def _catalog_scan_tail(self, ident: str, bare, names, ppred, dpred):
+        """Shared catalog-scan assembly (FileSourceScanExec and
+        HiveTableScanExec): pruning scan + residual filter + narrowing
+        projection + rename to Spark's attribute names."""
         t = self.catalog.tables[ident]
-        nparts = max(1, min(len(t.files), 4))
+        nparts = max(1, min(len(t.files), 4)) if t.files else 1
         plan = self.catalog.scan_node(
             ident, num_partitions=nparts, projection=bare or None,
             predicate=dpred, partition_predicate=ppred)
@@ -255,6 +262,31 @@ class SparkPlanConverter:
             if bare != scan_names:
                 plan = N.Projection(plan, [E.Column(b) for b in bare], bare)
             plan = N.RenameColumns(plan, names)
+        return plan
+
+    def _convert_hive_table_scan_exec(self, node, kids):
+        """HiveTableScanExec -> native scan through the metastore-backed
+        catalog (reference: NativeHiveTableScanBase — the table's files
+        come from its METASTORE partition locations, and partition
+        pruning predicates prune before IO)."""
+        rel = node.field("relation") or {}
+        ident = None
+        if isinstance(rel, dict):
+            meta = rel.get("tableMeta") or {}
+            identifier = meta.get("identifier") or rel.get("identifier") or {}
+            if isinstance(identifier, dict):
+                ident = identifier.get("table")
+        ident = ident or node.field("tableName")
+        if not ident or self.catalog is None or \
+                ident not in getattr(self.catalog, "tables", {}):
+            raise UnsupportedNode(
+                f"hive table {ident!r} not resolvable via the catalog")
+        out_attrs = [decode(x)
+                     for x in node.field("requestedAttributes") or []]
+        names = [FE.attr_name(a) for a in out_attrs]
+        bare = [a.field("name") for a in out_attrs]
+        ppred = and_fold_filters(node.field("partitionPruningPred"), {})
+        plan = self._catalog_scan_tail(ident, bare, names, ppred, None)
         return plan, self._attr_scope(out_attrs)
 
     # ---- row-level ops ------------------------------------------------------

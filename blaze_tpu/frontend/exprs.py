@@ -190,6 +190,22 @@ def convert_expr(node: TreeNode, scope: AttrScope) -> E.Expr:
         c = convert_expr(kids[0], scope)
         zero_t = _guess_type(node)
         return E.BinaryExpr(E.BinaryOp.SUB, E.Literal(0, zero_t or T.I64), c)
+    if name in ("HiveSimpleUDF", "HiveGenericUDF"):
+        # reference: HiveUDFUtil.getFunctionClassName — convert the
+        # builtins the engine implements; unknown classes fall back
+        from blaze_tpu.hive import convert_hive_udf
+
+        fw = node.field("funcWrapper") or {}
+        cls_name = fw.get("functionClassName") if isinstance(fw, dict) \
+            else None
+        if cls_name is None:
+            cls_name = node.field("functionClassName")
+        try:
+            return convert_hive_udf(
+                cls_name, [convert_expr(k, scope) for k in kids],
+                _guess_type(node))
+        except KeyError:
+            raise UnsupportedExpr(f"hive UDF {cls_name}") from None
     if name in _FUNCTIONS:
         return E.ScalarFunction(_FUNCTIONS[name],
                                 [convert_expr(k, scope) for k in kids])

@@ -1,0 +1,300 @@
+"""Hive metastore client seam + Hive UDF translation.
+
+Reference roles (SURVEY.md §2.2 "Hive glue"):
+
+- ``HiveClientHelper.scala`` / ``NativeHiveTableScanBase.scala`` — resolve a
+  Hive table's storage descriptors (location, format, partition list) from
+  the METASTORE (not from directory listing) and build native file scans
+  with Catalyst partition pruning;
+- ``HiveUDFUtil.scala`` — recognize HiveSimpleUDF / HiveGenericUDF
+  expressions by their function class names.
+
+This module supplies the JVM-free equivalents:
+
+- :class:`HiveMetastore` — the Hive Metastore OBJECT MODEL (Database ->
+  Table(storage descriptor, partition keys) -> Partition(values, location))
+  behind a client interface. Backed by a JSON metastore dump (the shape an
+  HMS Thrift ``get_table``/``get_partitions`` round produces) or
+  programmatic registration; a real Thrift transport slots in behind the
+  same three methods. ``as_catalog()`` bridges into ``blaze_tpu.catalog``
+  so the frontend's pruning scan path serves metastore tables unchanged.
+- :data:`HIVE_UDF_CLASSES` — Hive builtin UDF class names -> engine
+  expression builders; the frontend converts ``HiveSimpleUDF`` /
+  ``HiveGenericUDF`` nodes through it and falls back (Spark keeps the
+  subtree) for unknown classes, matching the reference's convert-or-
+  fallback contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from blaze_tpu.catalog import Catalog, CatalogTable
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import types as T
+
+_FMT_BY_INPUT_FORMAT = {
+    "org.apache.hadoop.hive.ql.io.parquet.MapredParquetInputFormat": "parquet",
+    "org.apache.hadoop.hive.ql.io.orc.OrcInputFormat": "orc",
+    "org.apache.hadoop.mapred.TextInputFormat": "text",
+}
+
+_HIVE_TYPES = {
+    "tinyint": T.I8, "smallint": T.I16, "int": T.I32, "bigint": T.I64,
+    "float": T.F32, "double": T.F64, "boolean": T.BOOL, "string": T.STRING,
+    "binary": T.BINARY, "date": T.DATE,
+}
+
+
+def _hive_type(s: str) -> T.DataType:
+    s = s.strip().lower()
+    if s in _HIVE_TYPES:
+        return _HIVE_TYPES[s]
+    if s.startswith("decimal"):
+        inner = s[s.index("(") + 1:s.index(")")] if "(" in s else "10,0"
+        p, _, sc = inner.partition(",")
+        return T.DecimalType(int(p), int(sc or 0))
+    if s.startswith("varchar") or s.startswith("char"):
+        return T.STRING
+    if s.startswith("timestamp"):
+        return T.TimestampType()
+    raise ValueError(f"unsupported hive type {s!r}")
+
+
+@dataclasses.dataclass
+class StorageDescriptor:
+    location: str
+    input_format: str
+    cols: List[Tuple[str, str]]          # (name, hive type string)
+
+
+@dataclasses.dataclass
+class HivePartition:
+    values: List[Optional[str]]
+    sd: StorageDescriptor
+
+
+@dataclasses.dataclass
+class HiveTable:
+    db: str
+    name: str
+    sd: StorageDescriptor
+    partition_keys: List[Tuple[str, str]]
+    partitions: List[HivePartition] = dataclasses.field(default_factory=list)
+
+    @property
+    def fmt(self) -> str:
+        fmt = _FMT_BY_INPUT_FORMAT.get(self.sd.input_format)
+        if fmt is None or fmt == "text":
+            raise ValueError(
+                f"unsupported hive input format {self.sd.input_format}")
+        return fmt
+
+
+class HiveMetastore:
+    """The three HMS client calls the scan path needs. A Thrift client
+    implements the same surface against a live metastore; here tables come
+    from a JSON dump (``from_json``) or registration (``create_table`` /
+    ``add_partition``)."""
+
+    def __init__(self):
+        self._tables: Dict[Tuple[str, str], HiveTable] = {}
+
+    # -- client surface -------------------------------------------------------
+
+    def get_table(self, db: str, name: str) -> HiveTable:
+        try:
+            return self._tables[(db, name)]
+        except KeyError:
+            raise KeyError(f"NoSuchObjectException: {db}.{name}") from None
+
+    def get_all_tables(self, db: str) -> List[str]:
+        return sorted(n for d, n in self._tables if d == db)
+
+    def get_partitions(self, db: str, name: str) -> List[HivePartition]:
+        return list(self.get_table(db, name).partitions)
+
+    # -- population -----------------------------------------------------------
+
+    def create_table(self, db: str, name: str, location: str,
+                     cols: Sequence[Tuple[str, str]],
+                     partition_keys: Sequence[Tuple[str, str]] = (),
+                     input_format: str = "org.apache.hadoop.hive.ql.io."
+                                         "parquet.MapredParquetInputFormat"
+                     ) -> HiveTable:
+        t = HiveTable(db, name,
+                      StorageDescriptor(location, input_format, list(cols)),
+                      list(partition_keys))
+        self._tables[(db, name)] = t
+        return t
+
+    def add_partition(self, db: str, name: str,
+                      values: Sequence[Optional[str]], location: str):
+        t = self.get_table(db, name)
+        assert len(values) == len(t.partition_keys), (
+            values, t.partition_keys)
+        t.partitions.append(HivePartition(
+            list(values),
+            StorageDescriptor(location, t.sd.input_format, t.sd.cols)))
+
+    @classmethod
+    def from_json(cls, path_or_obj) -> "HiveMetastore":
+        """Load an HMS dump: {"databases": {db: {table: {location,
+        inputFormat, cols: [[name, type]...], partitionKeys: [...],
+        partitions: [{values, location}...]}}}} — the JSON shape of
+        ``get_table`` + ``get_partitions`` responses."""
+        obj = path_or_obj
+        if isinstance(path_or_obj, (str, os.PathLike)):
+            with open(path_or_obj) as f:
+                obj = json.load(f)
+        ms = cls()
+        for db, tables in obj.get("databases", {}).items():
+            for name, td in tables.items():
+                ms.create_table(
+                    db, name, td["location"],
+                    [tuple(c) for c in td.get("cols", [])],
+                    [tuple(c) for c in td.get("partitionKeys", [])],
+                    td.get("inputFormat",
+                           "org.apache.hadoop.hive.ql.io.parquet."
+                           "MapredParquetInputFormat"))
+                for p in td.get("partitions", []):
+                    ms.add_partition(db, name, p["values"], p["location"])
+        return ms
+
+    # -- bridge into the engine's catalog -------------------------------------
+
+    def as_catalog(self, db: str = "default") -> Catalog:
+        """Catalog view of one database: file lists come from the
+        partitions' metastore LOCATIONS (the HMS contract — partitions can
+        live anywhere, unlike directory discovery), so the frontend's
+        pruning scan path (`_catalog_scan`) serves metastore tables
+        unchanged."""
+        cat = Catalog()
+        for (d, name), t in self._tables.items():
+            if d != db:
+                continue
+            try:
+                fmt = t.fmt
+            except ValueError as exc:
+                # one unsupported-format table must not make the whole
+                # database unscannable
+                import logging
+
+                logging.getLogger("blaze_tpu.hive").warning(
+                    "skipping table %s.%s: %s", d, name, exc)
+                continue
+            pschema = T.Schema(tuple(
+                T.StructField(k, _hive_type(ht))
+                for k, ht in t.partition_keys))
+            files: List[Tuple[str, tuple]] = []
+            if t.partition_keys:
+                for p in t.partitions:
+                    vals = tuple(
+                        None if v is None or
+                        v == "__HIVE_DEFAULT_PARTITION__" else
+                        _coerce_part(v, pschema[i].dtype)
+                        for i, v in enumerate(p.values))
+                    for f in _list_data_files(p.sd.location):
+                        files.append((f, vals))
+            else:
+                files = [(f, ()) for f in _list_data_files(t.sd.location)]
+            dschema = T.Schema(tuple(
+                T.StructField(c, _hive_type(ht)) for c, ht in t.sd.cols))
+            cat.tables[name] = CatalogTable(name, fmt, files, pschema,
+                                            schema=dschema)
+        return cat
+
+
+def _coerce_part(v: str, dt: T.DataType):
+    if isinstance(dt, (T.Int64Type, T.Int32Type, T.Int16Type, T.Int8Type)):
+        return int(v)
+    if isinstance(dt, (T.Float64Type, T.Float32Type)):
+        return float(v)
+    if isinstance(dt, T.DateType):
+        # Catalyst serializes date literals as epoch DAYS; partition values
+        # arrive as 'YYYY-MM-DD' strings — align the representations or
+        # every pruning predicate silently prunes everything
+        import datetime
+
+        return (datetime.date.fromisoformat(v)
+                - datetime.date(1970, 1, 1)).days
+    if isinstance(dt, T.BooleanType):
+        return v.lower() in ("true", "1")
+    return v
+
+
+def _list_data_files(location: str) -> List[str]:
+    from blaze_tpu.io import fs as FS
+
+    out = []
+    for name in sorted(FS.listdir(location)):
+        if name.startswith((".", "_")):
+            continue
+        out.append(os.path.join(location, name))
+    return out
+
+
+# --------------------------------------------------------------------------
+# Hive UDF translation (HiveUDFUtil role)
+# --------------------------------------------------------------------------
+
+def _fn(name):
+    def build(args, rt=None):
+        return E.ScalarFunction(name, list(args), rt)
+    return build
+
+
+def _binop(op):
+    def build(args, rt=None):
+        assert len(args) == 2
+        return E.BinaryExpr(op, args[0], args[1], result_type=rt)
+    return build
+
+
+# Hive builtin UDF classes -> engine expressions. The common builtins Spark
+# wraps in HiveSimpleUDF/HiveGenericUDF when a HiveSessionCatalog resolves
+# them; unknown classes raise (frontend falls back, Spark keeps the
+# subtree) exactly like the reference's unconvertible-UDF path.
+HIVE_UDF_CLASSES = {
+    "org.apache.hadoop.hive.ql.udf.UDFUpper": _fn("upper"),
+    "org.apache.hadoop.hive.ql.udf.UDFLower": _fn("lower"),
+    "org.apache.hadoop.hive.ql.udf.UDFLength": _fn("length"),
+    "org.apache.hadoop.hive.ql.udf.UDFTrim": _fn("trim"),
+    "org.apache.hadoop.hive.ql.udf.UDFLTrim": _fn("ltrim"),
+    "org.apache.hadoop.hive.ql.udf.UDFRTrim": _fn("rtrim"),
+    "org.apache.hadoop.hive.ql.udf.UDFSubstr": _fn("substring"),
+    "org.apache.hadoop.hive.ql.udf.UDFYear": _fn("year"),
+    "org.apache.hadoop.hive.ql.udf.UDFMonth": _fn("month"),
+    "org.apache.hadoop.hive.ql.udf.UDFDayOfMonth": _fn("day"),
+    "org.apache.hadoop.hive.ql.udf.generic.GenericUDFAbs": _fn("abs"),
+    "org.apache.hadoop.hive.ql.udf.generic.GenericUDFConcat": _fn("concat"),
+    "org.apache.hadoop.hive.ql.udf.generic.GenericUDFCoalesce":
+        _fn("coalesce"),
+    "org.apache.hadoop.hive.ql.udf.generic.GenericUDFNvl": _fn("nvl"),
+    "org.apache.hadoop.hive.ql.udf.generic.GenericUDFLower": _fn("lower"),
+    "org.apache.hadoop.hive.ql.udf.generic.GenericUDFUpper": _fn("upper"),
+    "org.apache.hadoop.hive.ql.udf.generic.GenericUDFOPPlus":
+        _binop(E.BinaryOp.ADD),
+    "org.apache.hadoop.hive.ql.udf.generic.GenericUDFOPMinus":
+        _binop(E.BinaryOp.SUB),
+    "org.apache.hadoop.hive.ql.udf.generic.GenericUDFOPMultiply":
+        _binop(E.BinaryOp.MUL),
+    "org.apache.hadoop.hive.ql.udf.generic.GenericUDFOPDivide":
+        _binop(E.BinaryOp.DIV),
+}
+
+# brickhouse UDAF classes the engine implements natively (ops/aggfns.py)
+HIVE_UDAF_CLASSES = {
+    "brickhouse.udf.collect.CollectUDAF": E.AggFunction.BRICKHOUSE_COLLECT,
+    "brickhouse.udf.collect.CombineUniqueUDAF":
+        E.AggFunction.BRICKHOUSE_COMBINE_UNIQUE,
+}
+
+
+def convert_hive_udf(class_name: str, args, return_type=None) -> E.Expr:
+    """HiveSimpleUDF/HiveGenericUDF -> engine expression, or KeyError for
+    an unknown class (callers translate that into a fallback)."""
+    return HIVE_UDF_CLASSES[class_name](args, return_type)

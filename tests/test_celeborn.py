@@ -1,0 +1,126 @@
+"""Celeborn PushData wire framing (io/celeborn.py): golden byte-level
+fixtures pinning the transport layout, round-trips, the merge heuristic of
+CelebornPartitionWriter, and the framed path through the native RSS server
+(round-2 verdict item 8; reference: CelebornPartitionWriter.scala:27-74 +
+Celeborn's network protocol)."""
+
+import struct
+
+import pytest
+
+from blaze_tpu.io import celeborn as cb
+
+
+def test_push_data_golden_bytes():
+    frame = cb.encode_push_data(7, "app1-3", "5-0", b"DATA")
+    # layout: len(8) type(1) reqId(8) mode(1) key(4+6) puid(4+3) body(4)
+    assert frame == (
+        struct.pack(">q", 8 + 1 + 8 + 1 + 10 + 7 + 4)
+        + b"\x0b"                                  # PUSH_DATA = 11
+        + struct.pack(">q", 7)                     # requestId
+        + b"\x00"                                  # MODE_PRIMARY
+        + struct.pack(">i", 6) + b"app1-3"         # shuffleKey
+        + struct.pack(">i", 3) + b"5-0"            # partitionUniqueId
+        + b"DATA")
+    assert len(frame) == struct.unpack(">q", frame[:8])[0]
+
+
+def test_push_merged_data_golden_bytes():
+    frame = cb.encode_push_merged_data(
+        9, "a-0", ["1-0", "2-0"], [b"xx", b"yyy"])
+    want = (
+        b"\x0c"                                    # PUSH_MERGED_DATA = 12
+        + struct.pack(">q", 9) + b"\x00"
+        + struct.pack(">i", 3) + b"a-0"
+        + struct.pack(">i", 2)                     # partition count
+        + struct.pack(">i", 3) + b"1-0"
+        + struct.pack(">i", 3) + b"2-0"
+        + struct.pack(">i", 2)                     # offsets count
+        + struct.pack(">i", 0) + struct.pack(">i", 2)
+        + b"xxyyy")
+    assert frame == struct.pack(">q", 8 + len(want)) + want
+
+
+def test_round_trip_both_frames():
+    f1 = cb.decode_frame(cb.encode_push_data(
+        42, "myapp-12", "99-1", b"\x00\x01payload", mode=cb.MODE_REPLICA))
+    assert isinstance(f1, cb.PushDataFrame)
+    assert (f1.request_id, f1.mode) == (42, cb.MODE_REPLICA)
+    assert cb.parse_shuffle_key(f1.shuffle_key) == ("myapp", 12)
+    assert cb.parse_partition_unique_id(f1.partition_unique_id) == (99, 1)
+    assert f1.body == b"\x00\x01payload"
+
+    f2 = cb.decode_frame(cb.encode_push_merged_data(
+        1, "a-0", ["3-0", "7-0", "3-1"], [b"", b"abc", b"defg"]))
+    assert isinstance(f2, cb.PushMergedDataFrame)
+    assert f2.bodies == [b"", b"abc", b"defg"]
+    assert [cb.parse_partition_unique_id(p)[0]
+            for p in f2.partition_unique_ids] == [3, 7, 3]
+
+
+def test_decode_rejects_bad_frames():
+    good = cb.encode_push_data(1, "a-0", "0-0", b"x")
+    with pytest.raises(ValueError):
+        cb.decode_frame(good[:-1])  # truncated
+    bad_type = bytearray(good)
+    bad_type[8] = 99
+    with pytest.raises(ValueError):
+        cb.decode_frame(bytes(bad_type))
+
+
+def test_partition_writer_merges_small_pushes():
+    frames = []
+    w = cb.CelebornPartitionWriter(frames.append, "app", 5, map_id=2)
+    w.write(0, b"a" * 10)      # small: buffered
+    w.write(1, b"b" * 20)      # small: buffered
+    w.write(2, b"c" * (64 * 1024))  # large: immediate PushData
+    w.close(success=True)      # flush buffers the two small ones merged
+    assert len(frames) == 2
+    big = cb.decode_frame(frames[0])
+    assert isinstance(big, cb.PushDataFrame)
+    assert cb.parse_partition_unique_id(big.partition_unique_id)[0] == 2
+    merged = cb.decode_frame(frames[1])
+    assert isinstance(merged, cb.PushMergedDataFrame)
+    assert merged.bodies == [b"a" * 10, b"b" * 20]
+    assert w.get_partition_length_map() == {0: 10, 1: 20, 2: 64 * 1024}
+
+
+def test_framed_push_through_rss_server():
+    from blaze_tpu.runtime.rss import CelebornMapWriter, RssClient, RssServer
+
+    server = RssServer()
+    try:
+        client = RssClient(server.sock_path, app="appX", shuffle_id=4)
+        w = CelebornMapWriter(client, map_id=0)
+        w.write(0, b"p0-block")
+        w.write(1, b"small1")
+        w.write(1, b"small2")
+        w.flush()
+        # a second attempt of the same map must be deduped at commit
+        w2 = CelebornMapWriter(client, map_id=0)
+        w2.write(0, b"dup-block")
+        w2.flush()
+        assert client.fetch(0) == [b"p0-block"]
+        assert client.fetch(1) == [b"small1", b"small2"]
+    finally:
+        server.close()
+
+
+def test_malformed_frame_gets_error_reply_not_dead_socket():
+    from blaze_tpu.runtime.rss import RssClient, RssServer
+
+    server = RssServer()
+    try:
+        client = RssClient(server.sock_path, app="a", shuffle_id=0)
+        with pytest.raises(RuntimeError, match="bad frame"):
+            client._call({"op": "push_framed", "payload": b"garbage",
+                          "map_id": 0, "attempt": "x"})
+        # the connection survives: a well-formed push on the same client
+        w = __import__("blaze_tpu.runtime.rss",
+                       fromlist=["CelebornMapWriter"]).CelebornMapWriter(
+            client, map_id=0)
+        w.write(0, b"ok-block")
+        w.flush()
+        assert client.fetch(0) == [b"ok-block"]
+    finally:
+        server.close()
