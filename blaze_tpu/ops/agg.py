@@ -123,8 +123,17 @@ class AggExec(Operator):
             return None, None
         probe_schema = source.children[source._probe_child()].schema
         build_schema = source.children[source._build_child()].schema
-        if not all(is_device_dtype(f.dtype)
-                   for f in probe_schema.fields + build_schema.fields):
+        from blaze_tpu.ops.agg_device import _is_wide_dec, _touches_wide
+
+        # build side must be fully device; probe side may carry wide
+        # decimals (they flatten as limb planes) as long as the KEY never
+        # touches one (by name or bound index)
+        if not all(is_device_dtype(f.dtype) for f in build_schema.fields):
+            return None, None
+        if not all(is_device_dtype(f.dtype) or _is_wide_dec(f.dtype)
+                   for f in probe_schema.fields):
+            return None, None
+        if _touches_wide(key_exprs[0], probe_schema):
             return None, None
         bmap = source._load_build_map(partition, ctx, src_metrics)
         if not FusedJoinSpec.runtime_eligible(bmap):
@@ -163,16 +172,28 @@ class AggExec(Operator):
             fuse_conf = ctx.conf.fused_filter_agg
             fuse_ok = fuse_conf if fuse_conf is not None \
                 else placement.backend_is_cpu_hint()
-            # wide-decimal limb aggregates extract their arg planes from
-            # HOST decimal128 arrays (eager pyarrow work a jit trace cannot
-            # perform), so any wide ARG TYPE — even one computed from
-            # all-device columns, e.g. CAST(i64 AS DECIMAL(20,2)) — keeps
-            # the agg on the eager path
+            # non-device agg args keep the agg on the eager path UNLESS
+            # they are bare wide-decimal columns, which the fused kernels
+            # consume directly as limb-plane jit inputs. Any OTHER traced
+            # access to a wide column — a device-typed expression over it
+            # (CAST(w AS DOUBLE)) or a grouping touching it — also blocks
+            # fusion: the trace would crash on the _WideLimbCol.
+            from blaze_tpu.ops.agg_device import (_is_wide_dec,
+                                                  _touches_wide)
             from blaze_tpu.utils.device import is_device_dtype as _isdev
 
-            if any(a.agg.args and not _isdev(
-                    E.infer_type(a.agg.args[0], child_schema))
-                   for a in self.aggs):
+            for a in self.aggs:
+                if not a.agg.args:
+                    continue
+                arg = a.agg.args[0]
+                at = E.infer_type(arg, child_schema)
+                if _is_wide_dec(at) and isinstance(arg, E.Column):
+                    continue  # bare wide column: the limb-plane path
+                if not _isdev(at) or _touches_wide(arg, child_schema):
+                    fuse_ok = False
+                    break
+            if fuse_ok and any(_touches_wide(ge, child_schema)
+                               for _, ge in self.groupings):
                 fuse_ok = False
             src_metrics = metrics.child(0)
             if fuse_ok and isinstance(child_op, FilterExec) \
@@ -181,27 +202,34 @@ class AggExec(Operator):
                 source = child_op.children[0]
                 fused_preds = child_op.predicates
                 src_metrics = src_metrics.child(0)
-            # a unique-single-key inner BroadcastJoin directly under the
-            # (possibly peeled) filter fuses too: the agg kernel probes the
-            # dim table inline and never materializes the joined rows
-            fused_join, loaded_bmap = self._try_fuse_join(
-                source, partition, ctx, src_metrics) if fuse_ok \
-                else (None, None)
+            # unique-single-key inner BroadcastJoins directly under the
+            # (possibly peeled) filter fuse too — CHAINED: a star query's
+            # stacked dim joins all trace into the one agg kernel, probing
+            # dim tables inline without materializing any joined rows
+            fused_joins = []
             join_src = None
-            if fused_join is not None:
+            while fuse_ok:
+                spec, loaded_bmap = self._try_fuse_join(
+                    source, partition, ctx, src_metrics)
+                if spec is None:
+                    if loaded_bmap is not None:
+                        # statically eligible but runtime-declined: drive
+                        # the unfused probe with the ALREADY-LOADED map
+                        # rather than letting the join build it again
+                        join_src = source._probe_with_map(
+                            loaded_bmap, partition, ctx, src_metrics)
+                    break
+                fused_joins.append(spec)
                 probe_idx = source._probe_child()
                 source = source.children[probe_idx]
                 src_metrics = src_metrics.child(probe_idx)
-                metrics.add("fused_join_stages", 1)
-            elif loaded_bmap is not None:
-                # statically eligible but runtime-declined: drive the
-                # unfused probe with the ALREADY-LOADED map rather than
-                # letting the join operator build it a second time
-                join_src = source._probe_with_map(loaded_bmap, partition,
-                                                  ctx, src_metrics)
-            agger = DevicePartialAgger(self, child_schema,
-                                       fused_predicates=fused_preds,
-                                       conf=ctx.conf, fused_join=fused_join)
+            if fused_joins:
+                metrics.add("fused_join_stages", len(fused_joins))
+            agger = DevicePartialAgger(
+                self, child_schema, fused_predicates=fused_preds,
+                conf=ctx.conf,
+                # peeled outer-first; the kernel chains inner-first
+                fused_join=list(reversed(fused_joins)))
             if join_src is not None:
                 src_iter = join_src
             else:

@@ -48,6 +48,101 @@ _DEFER_PLAN = object()
 _WIDE_KINDS = ("sum3", "avg3", "minw", "maxw")
 
 
+def _column_refs(e: E.Expr, out=None):
+    if out is None:
+        out = set()
+    if isinstance(e, E.Column):
+        out.add(e.name)
+    for c in e.children():
+        _column_refs(c, out)
+    return out
+
+
+def _touches_wide(e: E.Expr, schema: T.Schema) -> bool:
+    """Does the expression reference a wide-decimal column of ``schema`` —
+    by NAME (E.Column) or by INDEX (E.BoundReference, the proto wire
+    form)? Gates the fused/jitted paths: only bare wide agg args may read
+    wide columns (as limb planes); any other traced access would crash on
+    the _WideLimbCol placeholder."""
+    if isinstance(e, E.Column):
+        try:
+            if _is_wide_dec(schema[schema.index_of(e.name)].dtype):
+                return True
+        except (KeyError, ValueError):
+            pass
+    if isinstance(e, E.BoundReference):
+        if 0 <= e.index < len(schema) and \
+                _is_wide_dec(schema[e.index].dtype):
+            return True
+    return any(_touches_wide(c, schema) for c in e.children())
+
+
+def _is_wide_dec(dt: T.DataType) -> bool:
+    return (isinstance(dt, T.DecimalType) and not dt.fits_int64
+            and dt.precision <= 38)
+
+
+class _WideLimbCol:
+    """Wide-decimal column inside a TRACED batch: three int64 limb planes
+    + validity (the jit-flattenable representation of a host decimal128
+    column). Only the wide-agg arg path reads it; expressions never touch
+    it (fusion eligibility gates that)."""
+
+    __slots__ = ("dtype", "l0", "l1", "l2", "validity")
+
+    def __init__(self, dtype, l0, l1, l2, validity):
+        self.dtype = dtype
+        self.l0, self.l1, self.l2 = l0, l1, l2
+        self.validity = validity
+
+
+def _host_wide_planes(col, capacity: int):
+    """HostColumn(decimal>18) -> (l0, l1, l2, validity) jnp planes padded
+    to capacity (buffer views + two masks — no per-value python work)."""
+    from blaze_tpu.ops.aggfns import _wide_value_limbs
+
+    v0, v1, v2, valid = _wide_value_limbs(col.array)
+    pad = capacity - len(v0)
+    if pad:
+        z = np.zeros(pad, np.int64)
+        v0 = np.concatenate([v0, z])
+        v1 = np.concatenate([v1, z])
+        v2 = np.concatenate([v2, z])
+        valid = np.concatenate([valid, np.zeros(pad, bool)])
+    return (jnp.asarray(v0), jnp.asarray(v1), jnp.asarray(v2),
+            jnp.asarray(valid))
+
+
+def _flatten_cols(batch: ColumnarBatch):
+    """jit-argument planes for a batch: 2 per device column, 4 (limbs +
+    validity) per wide-decimal host column. The schema determines the
+    layout, so kernels cache correctly on (schema, capacity) keys."""
+    flat = []
+    for c, f in zip(batch.columns, batch.schema.fields):
+        if isinstance(c, DeviceColumn):
+            flat += [c.data, c.validity]
+        elif _is_wide_dec(f.dtype):
+            flat += list(_host_wide_planes(c, batch.capacity))
+        else:
+            raise TypeError(
+                f"column {f.name} ({f.dtype}) is not jit-flattenable")
+    return flat
+
+
+def _rebuild_cols(schema: T.Schema, flat, pos: int = 0):
+    """Inverse of _flatten_cols inside a trace: (columns, next_pos)."""
+    cols = []
+    for f in schema.fields:
+        if _is_wide_dec(f.dtype):
+            cols.append(_WideLimbCol(f.dtype, flat[pos], flat[pos + 1],
+                                     flat[pos + 2], flat[pos + 3]))
+            pos += 4
+        else:
+            cols.append(DeviceColumn(f.dtype, flat[pos], flat[pos + 1]))
+            pos += 2
+    return cols, pos
+
+
 class FusedJoinSpec:
     """Unique-single-key inner BroadcastJoin traced INTO the partial-agg
     kernel (the TPC-DS star-join shape: fact scan -> dim lookup -> group-by
@@ -71,6 +166,10 @@ class FusedJoinSpec:
         bb = bmap.batch
         self.cap_b = bb.capacity
         self.n_build_cols = len(bb.columns)
+        fields = (tuple(probe_schema.fields) + tuple(build_schema.fields)
+                  if probe_on_left else
+                  tuple(build_schema.fields) + tuple(probe_schema.fields))
+        self.joined_schema = T.Schema(fields)
         if bmap._dev_cell[0] is None:
             bmap._dev_cell[0] = jnp.asarray(
                 bmap.sorted_keys if self.nk else np.zeros(1, np.int64))
@@ -98,7 +197,9 @@ class FusedJoinSpec:
             isinstance(c, DeviceColumn) for c in bmap.batch.columns)
 
     def batch_eligible(self, batch: ColumnarBatch) -> bool:
-        return all(isinstance(c, DeviceColumn) for c in batch.columns)
+        # wide-decimal host columns are fine: they flatten as limb planes
+        return all(isinstance(c, DeviceColumn) or _is_wide_dec(f.dtype)
+                   for c, f in zip(batch.columns, batch.schema.fields))
 
     def structural_key(self) -> str:
         from blaze_tpu.ir.serde import expr_to_json
@@ -126,14 +227,18 @@ class FusedJoinSpec:
     def n_build_planes(self) -> int:
         return 1 + 2 * self.n_build_cols
 
-    def trace_join(self, joined_schema, num_rows, jflat, pflat):
-        """Traced: probe planes -> (joined tracer batch, hit mask). jflat =
-        [uniq, build planes...]; pflat = probe planes."""
+    def trace_join(self, num_rows, jflat, probe):
+        """Traced: (build jflat = [uniq, build planes...], probe = flat
+        plane list OR the PREVIOUS join's virtual batch in a chained
+        star-join fusion) -> (joined tracer batch, hit mask). Probe-side
+        columns — including wide-decimal limb columns — pass through
+        untouched; only the hit mask filters them."""
         uniq = jflat[0]
-        pfields = self.probe_schema.fields
-        pcols = [DeviceColumn(f.dtype, pflat[2 * i], pflat[2 * i + 1])
-                 for i, f in enumerate(pfields)]
-        ptb = ColumnarBatch(self.probe_schema, pcols, num_rows)
+        if isinstance(probe, ColumnarBatch):
+            ptb = probe
+        else:
+            pcols, _ = _rebuild_cols(self.probe_schema, probe)
+            ptb = ColumnarBatch(self.probe_schema, pcols, num_rows)
         kev = ExprEvaluator([self.key_expr], self.probe_schema)
         kev._reset_cse(ptb)
         kd, kv = _broadcast(kev._to_dev(kev._eval(self.key_expr, ptb), ptb),
@@ -150,8 +255,9 @@ class FusedJoinSpec:
         for i, f in enumerate(self.build_schema.fields):
             bd, bv = jflat[1 + 2 * i], jflat[2 + 2 * i]
             bcols.append(DeviceColumn(f.dtype, bd[cidx], bv[cidx] & hit))
+        pcols = list(ptb.columns)
         cols = pcols + bcols if self.probe_on_left else bcols + pcols
-        return ColumnarBatch(joined_schema, cols, num_rows), hit
+        return ColumnarBatch(self.joined_schema, cols, num_rows), hit
 
     def materialize(self, batch: ColumnarBatch, metrics):
         """Non-device fallback for a single probe batch: run the join for
@@ -201,13 +307,18 @@ def supports_device_partial(op, child_schema: T.Schema) -> bool:
 
 def supports_fused_filter(filter_op, grandchild_schema: T.Schema) -> bool:
     """Can the filter's predicate run inside the agg's jitted kernel? All
-    columns must be device-resident (the tracer batch is rebuilt from jit
-    inputs) and the predicate must be stateless jax-traceable."""
+    columns must be jit-flattenable — device-resident, or wide decimals
+    (which flatten as limb planes but which no PREDICATE may touch) — and
+    the predicate must be stateless jax-traceable."""
     from blaze_tpu.exprs.compiler import _contains_stateful
 
     if getattr(filter_op, "projection", None) is not None:
         return False
-    if not all(is_device_dtype(f.dtype) for f in grandchild_schema.fields):
+    if not all(is_device_dtype(f.dtype) or _is_wide_dec(f.dtype)
+               for f in grandchild_schema.fields):
+        return False
+    if any(_touches_wide(p, grandchild_schema)
+           for p in filter_op.predicates):
         return False
     return not any(_contains_stateful(p) for p in filter_op.predicates)
 
@@ -222,13 +333,21 @@ class DevicePartialAgger:
     of a compaction round trip plus the kernel."""
 
     def __init__(self, op, child_schema: T.Schema, fused_predicates=None,
-                 conf=None, fused_join: Optional[FusedJoinSpec] = None):
+                 conf=None, fused_join=None):
         from blaze_tpu.config import get_config
 
         self.op = op
         self.child_schema = child_schema
         self.fused_predicates = fused_predicates
-        self.fused_join = fused_join
+        # one OR SEVERAL chained unique-key joins traced into the kernel
+        # (a star query's stacked dim BHJs); stored inner-first so the
+        # probe batch flows join-by-join in plan order
+        if fused_join is None:
+            self.fused_joins = []
+        elif isinstance(fused_join, FusedJoinSpec):
+            self.fused_joins = [fused_join]
+        else:
+            self.fused_joins = list(fused_join)
         self.conf = conf or get_config()
         self._fused_cache = {}
         # dense-bucket path state: None = eligibility undecided; False =
@@ -322,8 +441,27 @@ class DevicePartialAgger:
             if ev is None:
                 args.append((jnp.zeros(batch.capacity, jnp.int64), exists))
             elif kind in _WIDE_KINDS:
-                planes, valid = self._wide_arg_planes(
-                    ev._eval(a.agg.args[0], batch), batch)
+                arg = a.agg.args[0]
+                planes = valid = None
+                if isinstance(arg, E.Column):
+                    # bare-column wide args read the batch's limb planes
+                    # directly — works in BOTH eager and traced contexts
+                    # (_WideLimbCol in a virtual batch, HostColumn eagerly)
+                    try:
+                        idx = batch.schema.index_of(arg.name)
+                    except (KeyError, ValueError):
+                        idx = None
+                    if idx is not None:
+                        col = batch.columns[idx]
+                        if isinstance(col, _WideLimbCol):
+                            planes = (col.l0, col.l1, col.l2)
+                            valid = col.validity
+                        elif not isinstance(col, DeviceColumn):
+                            p4 = _host_wide_planes(col, batch.capacity)
+                            planes, valid = p4[:3], p4[3]
+                if planes is None:
+                    planes, valid = self._wide_arg_planes(
+                        ev._eval(arg, batch), batch)
                 args.append((planes, valid & exists))
             else:
                 dv = ev._to_dev(ev._eval(a.agg.args[0], batch), batch)
@@ -333,7 +471,6 @@ class DevicePartialAgger:
 
     def _wide_arg_planes(self, val, batch: ColumnarBatch):
         from blaze_tpu.exprs.compiler import HostVal
-        from blaze_tpu.ops.aggfns import _wide_value_limbs
 
         assert isinstance(val, HostVal), "wide decimal args are host-resident"
         arr = val.arr
@@ -342,31 +479,35 @@ class DevicePartialAgger:
 
             arr = pa.concat_arrays([arr] * batch.num_rows) \
                 if batch.num_rows else arr.slice(0, 0)
-        v0, v1, v2, valid = _wide_value_limbs(arr)
-        pad = batch.capacity - len(v0)
-        if pad:
-            z = np.zeros(pad, np.int64)
-            v0 = np.concatenate([v0, z])
-            v1 = np.concatenate([v1, z])
-            v2 = np.concatenate([v2, z])
-            valid = np.concatenate([valid, np.zeros(pad, bool)])
-        return ((jnp.asarray(v0), jnp.asarray(v1), jnp.asarray(v2)),
-                jnp.asarray(valid))
+
+        class _ArrCol:
+            array = arr
+
+        p4 = _host_wide_planes(_ArrCol, batch.capacity)
+        return p4[:3], p4[3]
 
     def _trace_tb_mask(self, num_rows, flat):
         """Traced: jit inputs -> (tracer batch over the agg's child schema,
         row keep-mask). With ``fused_join`` the batch is the PROBE side and
         the joined tracer batch + hit mask come from the join spec; the
         optional fused predicates then evaluate over the joined schema."""
-        spec = self.fused_join
-        if spec is not None:
-            nb = spec.n_build_planes()
-            tb, mask = spec.trace_join(self.child_schema, num_rows,
-                                       flat[:nb], flat[nb:])
+        if self.fused_joins:
+            pos = 0
+            jflats = []
+            for spec in self.fused_joins:
+                nb = spec.n_build_planes()
+                jflats.append(flat[pos:pos + nb])
+                pos += nb
+            tb = None
+            mask = None
+            pflat = flat[pos:]
+            for spec, jf in zip(self.fused_joins, jflats):
+                tb, hit = spec.trace_join(num_rows, jf,
+                                          pflat if tb is None else tb)
+                mask = hit if mask is None else (mask & hit)
         else:
             schema = self.child_schema
-            cols = [DeviceColumn(f.dtype, flat[2 * i], flat[2 * i + 1])
-                    for i, f in enumerate(schema.fields)]
+            cols, _ = _rebuild_cols(schema, flat)
             tb = ColumnarBatch(schema, cols, num_rows)
             # inline, NOT tb.row_exists_mask(): that helper caches in a
             # module lru_cache a traced call would poison
@@ -380,9 +521,10 @@ class DevicePartialAgger:
         return tb, mask
 
     def _jit_flat(self, batch: ColumnarBatch):
-        if self.fused_join is not None:
-            return self.fused_join.jit_args(batch) + self._flat(batch)
-        return self._flat(batch)
+        flat = []
+        for spec in self.fused_joins:
+            flat += spec.jit_args(batch)
+        return flat + self._flat(batch)
 
     def _trace_clone(self) -> "DevicePartialAgger":
         """The agger instance jit closures may capture: identical structural
@@ -391,15 +533,14 @@ class DevicePartialAgger:
         import copy
 
         clone = copy.copy(self)
-        if self.fused_join is not None:
-            clone.fused_join = self.fused_join.trace_view()
+        clone.fused_joins = [s.trace_view() for s in self.fused_joins]
         clone._fused_cache = {}
         return clone
 
     def _cap_key(self, batch: ColumnarBatch):
         return (batch.capacity,
                 tuple((f.name, str(f.dtype)) for f in batch.schema.fields),
-                self.fused_join.shape_key() if self.fused_join else None)
+                tuple(s.shape_key() for s in self.fused_joins))
 
     def _fused_fn(self, batch: ColumnarBatch):
         """Jitted (join + predicate + flow), cached at MODULE level by
@@ -428,8 +569,7 @@ class DevicePartialAgger:
             from blaze_tpu.ir.serde import expr_to_json
 
             parts = [expr_to_json(p) for p in (self.fused_predicates or ())]
-            if self.fused_join is not None:
-                parts.append(self.fused_join.structural_key())
+            parts += [s.structural_key() for s in self.fused_joins]
             parts += [f"{n}:{expr_to_json(e)}" for n, e in self.op.groupings]
             parts += [f"{a.name}:{a.mode.value}:{expr_to_json(a.agg)}"
                       for a in self.op.aggs]
@@ -439,10 +579,7 @@ class DevicePartialAgger:
     # -- dense-bucket fast path ------------------------------------------------
 
     def _flat(self, batch: ColumnarBatch):
-        flat = []
-        for c in batch.columns:
-            flat += [c.data, c.validity]
-        return flat
+        return _flatten_cols(batch)
 
     def _dense_enabled(self) -> bool:
         """Integer-keyed partial aggs may use the dense-bucket kernel; auto
@@ -555,7 +692,7 @@ class DevicePartialAgger:
 
     def _dense_call(self, batch: ColumnarBatch, bases, sizes, out_cap):
         bases_arr = jnp.asarray(np.asarray(bases, np.int64))
-        if self.fused_predicates is not None or self.fused_join is not None:
+        if self.fused_predicates is not None or self.fused_joins:
             cap_key = self._cap_key(batch)
             key = ("dense", self._structural_key(), cap_key, sizes, out_cap)
             fn = _FUSED_KERNELS.get(key)
@@ -609,8 +746,7 @@ class DevicePartialAgger:
         prev = None
         for _ in range(2):
             if st is None:
-                if self.fused_predicates is not None or \
-                        self.fused_join is not None:
+                if self.fused_predicates is not None or self.fused_joins:
                     pr = np.asarray(self._probe_fn(batch)(
                         jnp.int64(batch.num_rows), *self._jit_flat(batch)))
                 else:
@@ -644,13 +780,15 @@ class DevicePartialAgger:
         n = batch.num_rows
         if n == 0:
             return None
-        if self.fused_join is not None and \
-                not self.fused_join.batch_eligible(batch):
-            # host-column probe batch: run the join for real, then the
-            # eager (unfused) agg flow over the joined batch
-            jb = self.fused_join.materialize(batch, self.fused_join.metrics)
-            if jb is None or jb.num_rows == 0:
-                return None
+        if self.fused_joins and \
+                not all(s.batch_eligible(batch) for s in self.fused_joins):
+            # non-flattenable probe batch: run the joins for real
+            # (inner-first), then the eager (unfused) agg flow
+            jb = batch
+            for spec in self.fused_joins:
+                jb = spec.materialize(jb, spec.metrics)
+                if jb is None or jb.num_rows == 0:
+                    return None
             t0 = _time.perf_counter()
             exists = jb.row_exists_mask()
             if self.fused_predicates:
@@ -668,8 +806,7 @@ class DevicePartialAgger:
         if dense is not None:
             outs, num_groups = dense
         else:
-            if self.fused_predicates is not None or \
-                    self.fused_join is not None:
+            if self.fused_predicates is not None or self.fused_joins:
                 outs = self._fused_fn(batch)(jnp.int64(n),
                                              *self._jit_flat(batch))
             else:
