@@ -115,6 +115,37 @@ class RssServer:
                     self._store[(app, sid, pid)].append(
                         (map_id, attempt, body))
             return {"ok": True, "frames": len(items)}
+        if op == "push_uniffle":
+            # Uniffle-protocol push: the payload is a SendShuffleDataRequest
+            # protobuf (io/uniffle.py). Blocks are crc-verified like the
+            # real shuffle server, then stored under the same ENVELOPE-level
+            # attempt-dedup contract as every other push op (the blockIds'
+            # embedded task_attempt_id is carried but not consulted here)
+            from blaze_tpu.io import uniffle as un
+
+            try:
+                req = un.SendShuffleDataRequest.decode(msg["payload"])
+                for sd in req.shuffle_data:
+                    for b in sd.blocks:
+                        if un.crc32(b.data) != b.crc:
+                            raise ValueError(
+                                f"crc mismatch on block {b.block_id}")
+            except (ValueError, IndexError, UnicodeDecodeError,
+                    TypeError, AttributeError) as exc:
+                # wire-type confusion surfaces as Type/AttributeError from
+                # the decoder; all malformed requests get an error REPLY
+                return {"ok": False, "error": f"bad uniffle request: {exc}"}
+            map_id = int(msg.get("map_id", 0))
+            attempt = str(msg.get("attempt", ""))
+            with self._mu:
+                for sd in req.shuffle_data:
+                    for b in sd.blocks:
+                        self._store[(req.app_id, req.shuffle_id,
+                                     sd.partition_id)].append(
+                            (map_id, attempt, b.data))
+            return {"ok": True,
+                    "blocks": sum(len(sd.blocks)
+                                  for sd in req.shuffle_data)}
         if op == "stats":
             with self._mu:
                 return {"ok": True,
@@ -236,26 +267,27 @@ class RssMapWriter:
                            "map_id": self.map_id, "attempt": self.attempt})
 
 
-class CelebornMapWriter:
-    """RssMapWriter twin that puts PROTOCOL-FRAMED bytes on the wire: each
-    push is a Celeborn PushData/PushMergedData frame (io/celeborn.py), the
-    byte layout ``ShuffleClientImpl.pushOrMergeData`` produces (reference:
-    ``CelebornPartitionWriter.scala:27-74``). Same attempt-commit dedup as
-    the plain writer."""
+class _ProtocolMapWriter:
+    """Shared shape of the protocol-framed map writers: a per-attempt
+    partition writer pushes encoded payloads through one server op, and
+    flush() commits the attempt (the dedup handshake shared with
+    RssMapWriter)."""
+
+    _OP: str = ""
 
     def __init__(self, client: RssClient, map_id: int):
         import uuid
 
-        from blaze_tpu.io.celeborn import CelebornPartitionWriter
-
         self.client = client
         self.map_id = map_id
         self.attempt = uuid.uuid4().hex
-        self._writer = CelebornPartitionWriter(
-            self._send, client.app, client.shuffle_id, map_id)
+        self._writer = self._make_writer()
 
-    def _send(self, frame: bytes):
-        self.client._call({"op": "push_framed", "payload": frame,
+    def _make_writer(self):
+        raise NotImplementedError
+
+    def _send(self, payload: bytes):
+        self.client._call({"op": self._OP, "payload": payload,
                            "map_id": self.map_id, "attempt": self.attempt})
 
     def write(self, pid: int, payload: bytes):
@@ -266,3 +298,34 @@ class CelebornMapWriter:
         self.client._call({"op": "commit_map", "app": self.client.app,
                            "shuffle_id": self.client.shuffle_id,
                            "map_id": self.map_id, "attempt": self.attempt})
+
+
+class CelebornMapWriter(_ProtocolMapWriter):
+    """RssMapWriter twin that puts PROTOCOL-FRAMED bytes on the wire: each
+    push is a Celeborn PushData/PushMergedData frame (io/celeborn.py), the
+    byte layout ``ShuffleClientImpl.pushOrMergeData`` produces (reference:
+    ``CelebornPartitionWriter.scala:27-74``)."""
+
+    _OP = "push_framed"
+
+    def _make_writer(self):
+        from blaze_tpu.io.celeborn import CelebornPartitionWriter
+
+        return CelebornPartitionWriter(
+            self._send, self.client.app, self.client.shuffle_id,
+            self.map_id)
+
+
+class UniffleMapWriter(_ProtocolMapWriter):
+    """RssMapWriter twin over the Uniffle block protocol: pushes
+    SendShuffleDataRequest protobufs (io/uniffle.py) with crc'd,
+    sequence-numbered blocks."""
+
+    _OP = "push_uniffle"
+
+    def _make_writer(self):
+        from blaze_tpu.io.uniffle import UnifflePartitionWriter
+
+        return UnifflePartitionWriter(
+            self._send, self.client.app, self.client.shuffle_id,
+            task_attempt_id=self.map_id)

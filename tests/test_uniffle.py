@@ -1,0 +1,122 @@
+"""Uniffle shuffle-block protocol (io/uniffle.py): blockId bit layout,
+protobuf golden bytes + round trips, the WriteBufferManager block cutting,
+crc verification at the server, and the framed path through the native RSS
+server (SURVEY §2.6; reference: UnifflePartitionWriter.scala + Uniffle
+rss.proto)."""
+
+import pytest
+
+from blaze_tpu.io import uniffle as un
+
+
+def test_block_id_bit_layout():
+    bid = un.pack_block_id(3, 5, 9)
+    # [seq:18 | partition:24 | task:21]
+    assert bid == (3 << 45) | (5 << 21) | 9
+    assert un.unpack_block_id(bid) == (3, 5, 9)
+    hi = un.pack_block_id(2**18 - 1, 2**24 - 1, 2**21 - 1)
+    assert hi == 2**63 - 1
+    with pytest.raises(AssertionError):
+        un.pack_block_id(2**18, 0, 0)
+
+
+def test_shuffle_block_golden_bytes():
+    b = un.ShuffleBlock(block_id=1, length=3, uncompress_length=3,
+                        crc=un.crc32(b"abc"), data=b"abc",
+                        task_attempt_id=7)
+    enc = b.encode()
+    # field 1 varint 1; field 2 varint 3; field 3 varint 3; field 4 crc;
+    # field 5 bytes "abc"; field 6 varint 7
+    crc = un.crc32(b"abc")
+    want = (b"\x08\x01" + b"\x10\x03" + b"\x18\x03"
+            + b"\x20" + un._varint(crc)[0:1] + un._varint(crc)[1:]
+            + b"\x2a\x03abc" + b"\x30\x07")
+    assert enc == want
+    assert un.ShuffleBlock.decode(enc) == b
+
+
+def test_send_shuffle_data_request_round_trip():
+    blocks = [un.ShuffleBlock(un.pack_block_id(i, 2, 4), 4, 4,
+                              un.crc32(b"dat" + bytes([i])),
+                              b"dat" + bytes([i]), 4) for i in range(3)]
+    req = un.SendShuffleDataRequest("app-1", 9, 77,
+                                    [un.ShuffleData(2, blocks)], 123456)
+    dec = un.SendShuffleDataRequest.decode(req.encode())
+    assert dec == req
+
+
+def test_buffer_manager_cuts_blocks_with_sequence_ids():
+    m = un.UniffleWriteBufferManager(task_attempt_id=5, spill_size=10)
+    assert m.add_partition_data(1, b"aaaa") == []
+    (blk,) = m.add_partition_data(1, b"bbbbbbb")   # 11 bytes: cut
+    assert blk.data == b"aaaabbbbbbb"
+    assert un.unpack_block_id(blk.block_id) == (0, 1, 5)
+    assert blk.crc == un.crc32(blk.data)
+    m.add_partition_data(1, b"cc")
+    m.add_partition_data(2, b"dd")
+    rest = m.clear()
+    assert [un.unpack_block_id(b.block_id) for b in rest] == \
+        [(1, 1, 5), (0, 2, 5)]
+
+
+def test_uniffle_push_through_rss_server():
+    from blaze_tpu.runtime.rss import RssClient, RssServer, UniffleMapWriter
+
+    server = RssServer()
+    try:
+        client = RssClient(server.sock_path, app="appU", shuffle_id=2)
+        w = UniffleMapWriter(client, map_id=1)
+        w.write(0, b"block-zero")
+        w.write(1, b"x" * 70_000)   # beyond spill: immediate block push
+        w.flush()
+        # losing attempt is deduped at commit
+        w2 = UniffleMapWriter(client, map_id=1)
+        w2.write(0, b"dup")
+        w2.flush()
+        assert client.fetch(0) == [b"block-zero"]
+        assert client.fetch(1) == [b"x" * 70_000]
+    finally:
+        server.close()
+
+
+def test_corrupt_crc_rejected():
+    from blaze_tpu.runtime.rss import RssClient, RssServer
+
+    server = RssServer()
+    try:
+        client = RssClient(server.sock_path, app="a", shuffle_id=0)
+        blk = un.ShuffleBlock(un.pack_block_id(0, 0, 1), 3, 3,
+                              un.crc32(b"abc") ^ 1, b"abc", 1)
+        req = un.SendShuffleDataRequest(
+            "a", 0, 1, [un.ShuffleData(0, [blk])])
+        with pytest.raises(RuntimeError, match="crc mismatch"):
+            client._call({"op": "push_uniffle", "payload": req.encode(),
+                          "map_id": 0, "attempt": "x"})
+    finally:
+        server.close()
+
+
+def test_malformed_uniffle_payloads_get_error_replies():
+    """Wire-type confusion and truncation must produce error REPLIES (the
+    connection survives), never a dead socket or silent truncation."""
+    from blaze_tpu.runtime.rss import RssClient, RssServer
+
+    with pytest.raises(ValueError, match="truncated"):
+        un.SendShuffleDataRequest.decode(b"\x0a\x05ab")  # declares 5, has 2
+    server = RssServer()
+    try:
+        client = RssClient(server.sock_path, app="a", shuffle_id=0)
+        for bad in (b"\x08\x01",          # app_id as varint (type confusion)
+                    b"\x0a\x05ab"):       # truncated length-delimited
+            with pytest.raises(RuntimeError, match="bad uniffle request"):
+                client._call({"op": "push_uniffle", "payload": bad,
+                              "map_id": 0, "attempt": "x"})
+        # connection still serves well-formed requests
+        from blaze_tpu.runtime.rss import UniffleMapWriter
+
+        w = UniffleMapWriter(client, map_id=0)
+        w.write(0, b"fine")
+        w.flush()
+        assert client.fetch(0) == [b"fine"]
+    finally:
+        server.close()
