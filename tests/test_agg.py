@@ -591,3 +591,110 @@ def test_partial_consolidation_single_output_batch():
     assert out["k"] == [int(k) for k in g.index.tolist()]
     assert out["s"] == [int(x) for x in g["sum"].tolist()]
     assert out["a"] == pytest.approx(g["mean"].tolist())
+
+
+def test_string_group_keys_intern_via_dictionary_codes():
+    """Var-width group keys intern as vectorized dictionary-code gathers
+    (SURVEY §7.4.3): correctness across batches with DIFFERENT
+    dictionaries, null keys, and mixed dict/plain encodings."""
+    import numpy as np
+    import pyarrow as pa
+
+    from blaze_tpu.core.batch import ColumnarBatch
+    from blaze_tpu.ir import exprs as E
+    from blaze_tpu.ir import nodes as N
+    from blaze_tpu.runtime.session import Session
+    from tests.util import mem_scan
+
+    b1 = {"k": pa.array(["a", "b", None, "a"]).dictionary_encode(),
+          "v": pa.array([1, 2, 3, 4], type=pa.int64())}
+    # different dictionary (order + values) and a PLAIN (non-dict) batch
+    b2 = {"k": pa.array(["c", "a", "b", None]).dictionary_encode(),
+          "v": pa.array([10, 20, 30, 40], type=pa.int64())}
+    b3 = {"k": pa.array(["b", "d", "a", "d"]),
+          "v": pa.array([100, 200, 300, 400], type=pa.int64())}
+    batches = [ColumnarBatch.from_arrow(pa.table(b)) for b in (b1, b2, b3)]
+
+    from blaze_tpu.ops.agg import AggExec, AggTable
+    from blaze_tpu.runtime.metrics import MetricNode
+
+    scan = mem_scan({"k": pa.array(["a"]), "v": pa.array([0])})
+    op = AggExec(scan, E.AggExecMode.HASH_AGG,
+                 [("k", E.Column("k"))],
+                 [N.AggColumn(E.AggExpr(E.AggFunction.SUM, [E.Column("v")]),
+                              E.AggMode.COMPLETE, "s")])
+    table = AggTable(op, op.children[0].schema, None, MetricNode("t"))
+    for b in batches:
+        table.process_batch(b)
+    # slot count: a, b, c, d, NULL = 5 distinct keys
+    assert table.num_slots == 5
+    sums = np.asarray(table.states[0][0][:table.num_slots])
+    got = {table.key_values[0][i]: int(sums[i])
+           for i in range(table.num_slots)}
+    # a: 1+4+20+300, b: 2+30+100, c: 10, d: 200+400, NULL: 3+40
+    assert got == {"a": 325, "b": 132, "c": 10, "d": 600, None: 43}
+
+
+def test_agg_spill_with_string_keys_stays_exact():
+    """Round-4 review: slot key BYTES must be a pure function of the key
+    VALUE — gid-based bytes would desynchronize spill-run merging across
+    table epochs and emit duplicate groups for string keys."""
+    rng = np.random.default_rng(4)
+    n = 30_000
+    keys = [f"key{v:05d}" for v in rng.integers(0, 4000, size=n)]
+    vals = rng.integers(0, 100, size=n)
+    scan = mem_scan({"k": keys, "v": vals.tolist()}, num_batches=12)
+    MemManager.reset()
+    with config_override(memory_total=100_000, memory_fraction=1.0):
+        op = AggExec(scan, HASH, [("k", col("k"))], [
+            agg_col(F.SUM, [col("v")], M.COMPLETE, "s")])
+        from blaze_tpu.ops.base import ExecContext
+        from blaze_tpu.runtime.metrics import MetricNode
+
+        ctx = ExecContext()
+        m = MetricNode("root")
+        batches = []
+        for p in range(op.num_partitions()):
+            batches.extend(b.to_arrow() for b in op.execute(p, ctx, m)
+                           if b.num_rows)
+        import pyarrow as _pa
+
+        tbl = _pa.Table.from_batches(batches).to_pydict()
+        assert m.total("spill_count") >= 1, "spill must actually fire"
+    MemManager.reset()
+    import collections
+
+    expected = collections.defaultdict(int)
+    for k, v in zip(keys, vals.tolist()):
+        expected[k] += v
+    assert len(tbl["k"]) == len(expected), "duplicate groups after spill"
+    got = dict(zip(tbl["k"], tbl["s"]))
+    assert got == dict(expected)
+
+
+def test_null_in_dictionary_values_folds_into_null_group():
+    """A DictionaryArray with None stored in its VALUES (non-null indices)
+    must land in the same NULL group as index-level nulls."""
+    import pyarrow as pa
+
+    from blaze_tpu.core.batch import ColumnarBatch
+    from blaze_tpu.ops.agg import AggExec, AggTable
+    from blaze_tpu.runtime.metrics import MetricNode
+
+    arr1 = pa.DictionaryArray.from_arrays(
+        pa.array([0, 1, 0], type=pa.int32()), pa.array(["a", None]))
+    b1 = ColumnarBatch.from_arrow(pa.table(
+        {"k": arr1, "v": pa.array([1, 2, 4], type=pa.int64())}))
+    b2 = ColumnarBatch.from_arrow(pa.table(
+        {"k": pa.array(["a", None]).dictionary_encode(),
+         "v": pa.array([10, 20], type=pa.int64())}))
+    scan = mem_scan({"k": ["a"], "v": [0]})
+    op = AggExec(scan, HASH, [("k", col("k"))],
+                 [agg_col(F.SUM, [col("v")], M.COMPLETE, "s")])
+    table = AggTable(op, op.children[0].schema, None, MetricNode("t"))
+    table.process_batch(b1)
+    table.process_batch(b2)
+    assert table.num_slots == 2  # "a" and ONE null group
+    sums = np.asarray(table.states[0][0][:2])
+    got = {table.key_values[0][i]: int(sums[i]) for i in range(2)}
+    assert got == {"a": 15, None: 22}
