@@ -41,6 +41,7 @@ from blaze_tpu.ir import types as T
 
 ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
 PARTS = int(os.environ.get("BENCH_PARTITIONS", 4))
+ARROW_THREADS = int(os.environ.get("BENCH_ARROW_THREADS", 8))
 N_ITEMS = 2000
 N_STORES = 400
 N_CUSTOMERS = 100_000
@@ -385,6 +386,32 @@ SHAPES = [
 ]
 
 
+def roofline_model(name: str) -> dict:
+    """Rough per-shape traffic/arithmetic model (round-4 verdict item 9) so
+    an MFU / roofline estimate is computable from the bench record:
+    ``model_bytes`` is the column data the query must move through the
+    compute (decoded device-resident columns actually read by the plan, one
+    pass), ``model_flops`` counts per-row kernel work (compares, hashes,
+    gathers, scatter-adds). Both are analytic — derived from the generator
+    shapes above, not measured — and deliberately conservative; divide by
+    ``kernel_time_s`` for effective GB/s / GFLOP/s, or by the chip's peak
+    for MFU."""
+    r = ROWS
+    per_row = {
+        # q01: 2 int64-plane cols scanned (store_sk + return_amt; the plan
+        # prunes sr_customer_sk); 1 cmp + hash(5) + 2 scatter-adds
+        "q01": (2 * 8, 8),
+        # q06: 3 fact cols + dim probe; hash-join probe ~10 + 2-sum agg ~8
+        "q06": (3 * 8, 18),
+        # q17: 3 narrow cols + 3-limb wide decimal (24B); 2 probes + limb agg
+        "q17": (3 * 8 + 24, 32),
+        # q47: 2 pruned fact cols; probe + agg + rank over tiny agg output
+        "q47": (2 * 8, 20),
+    }[name]
+    return {"model_bytes": per_row[0] * r, "model_flops": per_row[1] * r,
+            "flops_per_byte": round(per_row[1] / per_row[0], 3)}
+
+
 # --------------------------------------------------------------------------
 # runners
 # --------------------------------------------------------------------------
@@ -416,6 +443,13 @@ def run_baseline(paths):
 
 
 def run_arrow_baseline(paths):
+    """pyarrow Acero on the same files. The thread pool is PINNED (default
+    8, env BENCH_ARROW_THREADS) — Acero wall-clock otherwise swings >3x
+    with the machine's core count, making vs_arrow incomparable across
+    boxes (round-4 verdict weak #2); the pinned count is recorded in the
+    bench output."""
+    pa.set_cpu_count(ARROW_THREADS)
+    pa.set_io_thread_count(ARROW_THREADS)
     per_shape = {}
     total = 0.0
     for name, _p, _o, acero_fn, _c, tables_used in SHAPES:
@@ -481,8 +515,10 @@ def main():
         if tunnel_up and _placement_says_host(paths):
             _pin_cpu()
             device = "host_placed"
-        from blaze_tpu.utils.device import DEVICE_STATS
+        from blaze_tpu.utils.device import DEVICE_STATS, effective_platform
 
+        backend = effective_platform()
+        on_accel = backend != "cpu"
         baseline_s, oracles = run_baseline(paths)
         shapes = {}
         total = 0.0
@@ -492,12 +528,24 @@ def main():
             engine_s, out = run_engine(paths, plan_fn)
             dev = DEVICE_STATS.snapshot()
             check_fn(out, oracles[name])  # correctness gate before numbers
+            rl = roofline_model(name)
+            if dev["kernel_time_s"]:
+                rl["effective_gbps"] = round(
+                    rl["model_bytes"] / dev["kernel_time_s"] / 1e9, 2)
+                rl["effective_gflops"] = round(
+                    rl["model_flops"] / dev["kernel_time_s"] / 1e9, 2)
             shapes[name] = {"value": round(engine_s, 3), "unit": "s",
-                            "device_stats": dev,
-                            # round-1 verdict item 9: device residency share
+                            "backend": backend,
+                            "kernel_stats": dev,
+                            "roofline": rl,
+                            # round-1 verdict item 9: device residency share.
+                            # 0.0 on a cpu fallback: those kernels ran on the
+                            # host, there IS no device residency (round-4
+                            # verdict weak #1 — fallback runs must not report
+                            # device_time_fraction 1.0)
                             "device_time_fraction": round(
                                 min(dev["kernel_time_s"] / engine_s, 1.0), 3)
-                            if engine_s else 0.0}
+                            if engine_s and on_accel else 0.0}
             total += engine_s
         arrow_total, arrow_shapes = run_arrow_baseline(paths)
         for name, _p, _o, _a, _c, _t in SHAPES:
@@ -511,6 +559,7 @@ def main():
             # denominator family; BASELINE.md has the full table)
             "vs_baseline": round(baseline_s / total, 3),
             "vs_arrow": round(arrow_total / total, 3),
+            "arrow_threads": ARROW_THREADS,
             "shapes": shapes,
         }
         if device == "cpu_fallback":

@@ -52,7 +52,24 @@ def setup_compile_cache():
     platforms = jax.config.jax_platforms or "auto"
     rc = "rc1" if _os.environ.get(
         "PALLAS_AXON_REMOTE_COMPILE") == "1" else "rc0"
-    cc_dir = _os.path.join(cc_dir, f"{platforms.replace(',', '_')}-{rc}")
+    # also partition by the HOST's cpu feature set: XLA:CPU AOT artifacts
+    # record the compile machine's features, and loading another machine's
+    # (a shared/home cache moved between boxes) fails the feature check on
+    # every kernel ("cpu_aot_loader: ... could lead to SIGILL"), forcing
+    # recompiles while spamming stderr — a per-host subdir sidesteps both
+    host = "generic"
+    try:
+        import hashlib as _hashlib
+        import re as _re
+
+        with open("/proc/cpuinfo") as _f:
+            m = _re.search(r"^flags\s*:\s*(.*)$", _f.read(), _re.M)
+        if m:
+            host = _hashlib.md5(m.group(1).encode()).hexdigest()[:8]
+    except OSError:
+        pass
+    cc_dir = _os.path.join(cc_dir,
+                           f"{platforms.replace(',', '_')}-{rc}-{host}")
     try:
         _os.makedirs(cc_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cc_dir)

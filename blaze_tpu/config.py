@@ -115,6 +115,13 @@ class Config:
         default_factory=lambda: os.environ.get("BLAZE_TPU_SPILL_DIR", "/tmp/blaze_tpu_spill")
     )
 
+    # Remote-shuffle protocol when a Session runs with rss_sock_path:
+    # "native" = the plain push/fetch ops; "celeborn" = the full Celeborn
+    # protocol loop (registerShuffle -> framed pushes -> mapperEnd ->
+    # commitFiles -> openStream/chunk-fetch), every control + data message
+    # wire-framed (reference: AuronCelebornShuffleManager).
+    rss_protocol: str = "native"
+
     # Number of host worker threads for IO/decode and task overlap
     # (reference: tokio worker threads conf). On the tunneled-TPU backend
     # threads mostly overlap device round trips, not CPU.

@@ -67,7 +67,16 @@ def table_ident(node: TreeNode) -> Optional[str]:
     """tableIdentifier field -> dotted name (shared with providers)."""
     ident = node.field("tableIdentifier")
     if isinstance(ident, dict):
-        ident = ".".join(str(v) for v in ident.values() if v)
+        # real wire form: a TableIdentifier PRODUCT ({"product-class":
+        # "...TableIdentifier", "table": ..., "database": ...}); dotted
+        # name is database.table, never the class tag
+        tbl = ident.get("table")
+        if tbl:
+            db = ident.get("database")
+            ident = f"{db}.{tbl}" if db else str(tbl)
+        else:
+            ident = ".".join(str(v) for k, v in ident.items()
+                             if v and k not in ("product-class", "jvmId"))
     return str(ident) if ident else None
 
 
@@ -486,23 +495,57 @@ class SparkPlanConverter:
             cond = convert_expr(ctrees[0], scope)
         return left, right, list(zip(lkeys, rkeys)), _JOIN_TYPES[jt], cond, scope
 
+    def _finish_join(self, plan: N.PlanNode, node: TreeNode, scope: AttrScope
+                     ) -> Tuple[N.PlanNode, AttrScope]:
+        """ExistenceJoin(exprId#n) output: the engine's EXISTENCE join always
+        appends a column named "exists#0"; rename it to the exprId Spark's
+        downstream filter references (exists#1 OR exists#2 in q10/q35-class
+        plans) and bind that exprId — also what keeps STACKED existence
+        joins from colliding on the fixed name."""
+        if plan.join_type != N.JoinType.EXISTENCE:
+            return plan, scope
+        jt_field = node.field("joinType")
+        eid = None
+        if isinstance(jt_field, dict):
+            ex = jt_field.get("exists") or jt_field.get("exprId")
+            if isinstance(ex, list):
+                # real toJSON serializes the exists Attribute as a nested
+                # tree array: [[{AttributeReference..., exprId: {...}}]]
+                try:
+                    attr = decode_field_trees(ex)[0]
+                    eid = (attr.field("exprId") or {}).get("id")
+                except (ValueError, IndexError, NotImplementedError):
+                    eid = None
+            elif isinstance(ex, dict):
+                eid = ex.get("id")
+        if eid is None:
+            return plan, scope
+        names = [f.name for f in plan.output_schema.fields]
+        names[-1] = f"exists#{eid}"
+        scope = dict(scope)
+        scope[eid] = names[-1]
+        return N.RenameColumns(plan, names), scope
+
     def _convert_sort_merge_join_exec(self, node, kids):
         left, right, on, jt, cond, scope = self._join_common(node, kids)
-        return N.SortMergeJoin(left, right, on, jt, condition=cond), scope
+        return self._finish_join(
+            N.SortMergeJoin(left, right, on, jt, condition=cond), node, scope)
 
     def _convert_broadcast_hash_join_exec(self, node, kids):
         left, right, on, jt, cond, scope = self._join_common(node, kids)
         side = FE._obj_str(node.field("buildSide")) or "BuildRight"
         bside = N.JoinSide.LEFT if "Left" in side else N.JoinSide.RIGHT
-        return N.BroadcastJoin(left, right, on, jt, broadcast_side=bside,
-                               condition=cond), scope
+        return self._finish_join(
+            N.BroadcastJoin(left, right, on, jt, broadcast_side=bside,
+                            condition=cond), node, scope)
 
     def _convert_shuffled_hash_join_exec(self, node, kids):
         left, right, on, jt, cond, scope = self._join_common(node, kids)
         side = FE._obj_str(node.field("buildSide")) or "BuildRight"
         bside = N.JoinSide.LEFT if "Left" in side else N.JoinSide.RIGHT
-        return N.HashJoin(left, right, on, jt, build_side=bside,
-                          condition=cond), scope
+        return self._finish_join(
+            N.HashJoin(left, right, on, jt, build_side=bside,
+                       condition=cond), node, scope)
 
     # ---- misc ---------------------------------------------------------------
 
@@ -621,6 +664,13 @@ def _parse_frame(spec: TreeNode):
     (ops/window.py: prefix sums / sliding windows / value-searchsorted).
     Unparseable bounds (interval offsets etc.) fall back."""
     frame = spec.field("frameSpecification")
+    if isinstance(frame, int):
+        # real TreeNode.toJSON: WindowSpecDefinition's children are
+        # partitionSpec ++ orderSpec ++ [frameSpecification]; the field
+        # holds the child ORDINAL (tests/fixtures/spark35)
+        if 0 <= frame < len(spec.children):
+            return _parse_frame_tree(spec.children[frame])
+        raise UnsupportedNode(f"frameSpecification ordinal {frame}")
     if frame in (None, {}, []):
         return None
     if isinstance(frame, dict) and not frame.get("class") and \
@@ -643,6 +693,40 @@ def _parse_frame(spec: TreeNode):
         hi = _frame_bound(frame.get("upper"))
         return ("rows", lo, hi)
     raise UnsupportedNode(f"unrecognized window frame: {text[:120]}")
+
+
+def _parse_frame_tree(node: TreeNode):
+    """SpecifiedWindowFrame/UnspecifiedFrame as decoded TREES (the wire
+    form a real Spark session emits) -> the same ("rows"|"range", lo, hi)
+    contract as the dict path."""
+    if node.name == "UnspecifiedFrame":
+        return None
+    if node.name != "SpecifiedWindowFrame":
+        raise UnsupportedNode(f"window frame {node.name}")
+    ftype = FE._obj_str(node.field("frameType")) or ""
+    lo = _frame_bound_tree(node.children[0]) if node.children else None
+    hi = _frame_bound_tree(node.children[1]) if len(node.children) > 1 \
+        else None
+    if "RowFrame" in ftype:
+        return ("rows", lo, hi)
+    if (lo, hi) == (None, 0):
+        return None  # RANGE UNBOUNDED PRECEDING .. CURRENT ROW == default
+    return ("range", lo, hi)
+
+
+def _frame_bound_tree(node: TreeNode):
+    if node.name in ("UnboundedPreceding", "UnboundedFollowing"):
+        return None
+    if node.name == "CurrentRow":
+        return 0
+    if node.name == "Literal":
+        try:
+            return int(node.field("value"))
+        except (TypeError, ValueError) as exc:
+            raise UnsupportedNode(
+                f"non-integer window frame bound "
+                f"{node.field('value')!r}") from exc
+    raise UnsupportedNode(f"window frame bound {node.name}")
 
 
 def _frame_bound(b):

@@ -65,7 +65,7 @@ _FUNCTIONS = {
     "StringLPad": "lpad", "StringRPad": "rpad", "StringReplace": "replace",
     "Year": "year", "Month": "month", "DayOfMonth": "day",
     "Quarter": "quarter", "DateDiff": "datediff",
-    "Abs": "abs", "Coalesce": "coalesce", "Sha2": "sha2",
+    "Abs": "abs", "Coalesce": "coalesce", "Sha2": "sha2", "Round": "round",
     "GetJsonObject": "get_json_object",
     "Murmur3Hash": "hash", "XxHash64": "xxhash64",
     "NormalizeNaNAndZero": "normalize_nan_and_zero",

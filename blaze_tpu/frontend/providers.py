@@ -88,7 +88,13 @@ class LakeTableScanProvider(ConvertProvider):
         part_pred = and_fold_filters(node.field("partitionFilters"), {})
         data_pred = and_fold_filters(node.field("dataFilters"), {})
         num_partitions = int(node.field("numPartitions") or 1)
-        plan = LakeTable(str(root)).scan_node(
+        from blaze_tpu.io.paimon import PaimonTable
+
+        # real Paimon directory layout (snapshot/LATEST) takes the Paimon
+        # metadata reader; anything else is the engine's own lake format
+        table = PaimonTable(str(root)) if PaimonTable.is_paimon_dir(
+            str(root)) else LakeTable(str(root))
+        plan = table.scan_node(
             num_partitions=num_partitions,
             predicate=data_pred,
             partition_predicate=part_pred)

@@ -1,0 +1,300 @@
+"""Minimal Avro Object Container File codec (reader + writer).
+
+Paimon's table metadata (manifest lists and manifests) is stored as Avro
+OCF streams; this image ships no avro library, so the Paimon client
+(io/paimon.py) carries its own spec implementation. Scope: the subset of
+the Avro 1.11 spec those files use — records, unions with null, the
+primitive types, arrays/maps/fixed/enum, and the ``null``/``deflate``
+codecs. Reference role: the Paimon integration's metadata reads
+(``thirdparty/auron-paimon`` delegates them to the Paimon Java client;
+standalone we read the format directly).
+
+Layout (spec 'Object Container Files'): magic ``Obj\\x01``, file metadata
+map (``avro.schema`` JSON, ``avro.codec``), 16-byte sync marker, then
+blocks of ``<count:long> <size:long> <data> <sync>``.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import zlib
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+MAGIC = b"Obj\x01"
+
+Schema = Union[str, dict, list]
+
+
+# --------------------------------------------------------------------------
+# binary primitives
+# --------------------------------------------------------------------------
+
+
+def _zigzag_encode(n: int) -> int:
+    return (n << 1) ^ (n >> 63)
+
+
+def _zigzag_decode(n: int) -> int:
+    return (n >> 1) ^ -(n & 1)
+
+
+def write_long(buf: io.BytesIO, n: int):
+    z = _zigzag_encode(n) & ((1 << 64) - 1)
+    while True:
+        b = z & 0x7F
+        z >>= 7
+        if z:
+            buf.write(bytes([b | 0x80]))
+        else:
+            buf.write(bytes([b]))
+            return
+
+
+def read_long(buf) -> int:
+    shift = 0
+    acc = 0
+    while True:
+        byte = buf.read(1)
+        if not byte:
+            raise EOFError("truncated avro varint")
+        b = byte[0]
+        acc |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return _zigzag_decode(acc)
+        shift += 7
+
+
+def write_bytes(buf: io.BytesIO, data: bytes):
+    write_long(buf, len(data))
+    buf.write(data)
+
+
+def read_bytes(buf) -> bytes:
+    n = read_long(buf)
+    data = buf.read(n)
+    if len(data) != n:
+        raise EOFError("truncated avro bytes")
+    return data
+
+
+# --------------------------------------------------------------------------
+# schema-driven encode/decode
+# --------------------------------------------------------------------------
+
+
+def _type_name(schema: Schema) -> str:
+    if isinstance(schema, str):
+        return schema
+    if isinstance(schema, list):
+        return "union"
+    return schema["type"]
+
+
+def encode(buf: io.BytesIO, schema: Schema, value: Any,
+           named: Optional[Dict[str, Schema]] = None):
+    named = named if named is not None else {}
+    t = _type_name(schema)
+    if isinstance(schema, dict) and t in ("record", "fixed", "enum"):
+        named[schema.get("name", "")] = schema
+    if isinstance(schema, str) and schema in named:
+        schema = named[schema]
+        t = _type_name(schema)
+    if t == "null":
+        return
+    if t == "boolean":
+        buf.write(b"\x01" if value else b"\x00")
+    elif t in ("int", "long"):
+        write_long(buf, int(value))
+    elif t == "float":
+        buf.write(struct.pack("<f", float(value)))
+    elif t == "double":
+        buf.write(struct.pack("<d", float(value)))
+    elif t == "bytes":
+        write_bytes(buf, bytes(value))
+    elif t == "string":
+        write_bytes(buf, value.encode("utf-8"))
+    elif t == "fixed":
+        assert len(value) == schema["size"]
+        buf.write(bytes(value))
+    elif t == "enum":
+        write_long(buf, schema["symbols"].index(value))
+    elif t == "union":
+        for i, branch in enumerate(schema):
+            bn = _type_name(branch)
+            if value is None and bn == "null":
+                write_long(buf, i)
+                return
+            if value is not None and bn != "null":
+                write_long(buf, i)
+                encode(buf, branch, value, named)
+                return
+        raise ValueError(f"no union branch for {value!r} in {schema}")
+    elif t == "array":
+        if value:
+            write_long(buf, len(value))
+            for item in value:
+                encode(buf, schema["items"], item, named)
+        write_long(buf, 0)
+    elif t == "map":
+        if value:
+            write_long(buf, len(value))
+            for k, v in value.items():
+                write_bytes(buf, k.encode("utf-8"))
+                encode(buf, schema["values"], v, named)
+        write_long(buf, 0)
+    elif t == "record":
+        for f in schema["fields"]:
+            encode(buf, f["type"], value[f["name"]], named)
+    else:
+        raise NotImplementedError(f"avro type {t}")
+
+
+def decode(buf, schema: Schema,
+           named: Optional[Dict[str, Schema]] = None) -> Any:
+    named = named if named is not None else {}
+    t = _type_name(schema)
+    if isinstance(schema, dict) and t in ("record", "fixed", "enum"):
+        named[schema.get("name", "")] = schema
+    if isinstance(schema, str) and schema in named:
+        schema = named[schema]
+        t = _type_name(schema)
+    if t == "null":
+        return None
+    if t == "boolean":
+        return buf.read(1) == b"\x01"
+    if t in ("int", "long"):
+        return read_long(buf)
+    if t == "float":
+        return struct.unpack("<f", buf.read(4))[0]
+    if t == "double":
+        return struct.unpack("<d", buf.read(8))[0]
+    if t == "bytes":
+        return read_bytes(buf)
+    if t == "string":
+        return read_bytes(buf).decode("utf-8")
+    if t == "fixed":
+        return buf.read(schema["size"])
+    if t == "enum":
+        return schema["symbols"][read_long(buf)]
+    if t == "union":
+        return decode(buf, schema[read_long(buf)], named)
+    if t == "array":
+        out = []
+        while True:
+            n = read_long(buf)
+            if n == 0:
+                return out
+            if n < 0:
+                n = -n
+                read_long(buf)  # block byte size, unused
+            for _ in range(n):
+                out.append(decode(buf, schema["items"], named))
+    if t == "map":
+        out = {}
+        while True:
+            n = read_long(buf)
+            if n == 0:
+                return out
+            if n < 0:
+                n = -n
+                read_long(buf)
+            for _ in range(n):
+                k = read_bytes(buf).decode("utf-8")
+                out[k] = decode(buf, schema["values"], named)
+    if t == "record":
+        return {f["name"]: decode(buf, f["type"], named)
+                for f in schema["fields"]}
+    raise NotImplementedError(f"avro type {t}")
+
+
+# --------------------------------------------------------------------------
+# object container files
+# --------------------------------------------------------------------------
+
+
+def write_ocf(fobj, schema: Schema, records: List[dict],
+              codec: str = "deflate", sync: Optional[bytes] = None,
+              block_records: int = 1000):
+    """Serialize ``records`` as one Avro OCF stream."""
+    sync = sync or os.urandom(16)
+    head = io.BytesIO()
+    head.write(MAGIC)
+    meta = {"avro.schema": json.dumps(schema).encode(),
+            "avro.codec": codec.encode()}
+    write_long(head, len(meta))
+    for k, v in meta.items():
+        write_bytes(head, k.encode())
+        write_bytes(head, v)
+    write_long(head, 0)
+    head.write(sync)
+    fobj.write(head.getvalue())
+    for off in range(0, len(records), block_records):
+        chunk = records[off:off + block_records]
+        body = io.BytesIO()
+        for rec in chunk:
+            encode(body, schema, rec)
+        data = body.getvalue()
+        if codec == "deflate":
+            data = zlib.compress(data)[2:-4]  # raw deflate, per spec
+        elif codec != "null":
+            raise NotImplementedError(f"avro codec {codec}")
+        blk = io.BytesIO()
+        write_long(blk, len(chunk))
+        write_long(blk, len(data))
+        fobj.write(blk.getvalue())
+        fobj.write(data)
+        fobj.write(sync)
+
+
+def read_ocf(fobj) -> Iterator[dict]:
+    """Iterate records from one Avro OCF stream."""
+    if fobj.read(4) != MAGIC:
+        raise ValueError("not an avro object container file")
+    meta: Dict[str, bytes] = {}
+    while True:
+        n = read_long(fobj)
+        if n == 0:
+            break
+        if n < 0:
+            n = -n
+            read_long(fobj)
+        for _ in range(n):
+            k = read_bytes(fobj).decode()
+            meta[k] = read_bytes(fobj)
+    schema = json.loads(meta["avro.schema"])
+    codec = meta.get("avro.codec", b"null").decode()
+    sync = fobj.read(16)
+    while True:
+        first = fobj.read(1)
+        if not first:
+            return
+        rest = io.BytesIO(first)
+        count = read_long(_Chain(rest, fobj))
+        size = read_long(fobj)
+        data = fobj.read(size)
+        if codec == "deflate":
+            data = zlib.decompress(data, -15)
+        elif codec != "null":
+            raise NotImplementedError(f"avro codec {codec}")
+        if fobj.read(16) != sync:
+            raise ValueError("avro block sync mismatch")
+        body = io.BytesIO(data)
+        for _ in range(count):
+            yield decode(body, schema)
+
+
+class _Chain:
+    """Read from ``a`` until exhausted, then ``b`` (used to peek the first
+    byte of a possibly-absent block)."""
+
+    def __init__(self, a, b):
+        self.a, self.b = a, b
+
+    def read(self, n: int) -> bytes:
+        out = self.a.read(n)
+        if len(out) < n:
+            out += self.b.read(n - len(out))
+        return out

@@ -221,3 +221,421 @@ class CelebornPartitionWriter:
 
     def get_partition_length_map(self):
         return dict(self.partition_lengths)
+
+
+# --------------------------------------------------------------------------
+# Control plane + read path (round-4 verdict item 6)
+#
+# Celeborn's control RPCs ride the same transport framing as the pushes:
+# an RpcRequest/RpcResponse message whose body is a protobuf
+# ``PbTransportMessage {int32 messageTypeValue = 1; bytes payload = 2}``
+# wrapping one control message (Celeborn 0.5
+# ``common/src/main/proto/TransportMessages.proto`` — field layouts below
+# model its PbRegisterShuffle / PbMapperEnd / PbCommitFiles / PbOpenStream /
+# PbStreamHandler shapes). The fetch path is OPEN_STREAM over RPC followed
+# by CHUNK_FETCH_REQUEST frames addressed by (streamId, chunkIndex) — the
+# protocol ``CelebornShuffleReader``'s WorkerPartitionReader drives.
+# --------------------------------------------------------------------------
+
+from blaze_tpu.io.pbwire import (int_field as _pb_int,  # noqa: E402
+                                 len_delim as _pb_len,
+                                 read_fields as _pb_fields,
+                                 str_field as _pb_str)
+
+RPC_REQUEST = 0
+RPC_RESPONSE = 1
+RPC_FAILURE = 2
+CHUNK_FETCH_REQUEST = 3
+CHUNK_FETCH_SUCCESS = 4
+CHUNK_FETCH_FAILURE = 5
+
+# PbTransportMessage.messageTypeValue (TransportMessages.proto MessageType)
+MSG_REGISTER_SHUFFLE = 1
+MSG_REGISTER_SHUFFLE_RESPONSE = 2
+MSG_MAPPER_END = 23
+MSG_MAPPER_END_RESPONSE = 24
+MSG_COMMIT_FILES = 33
+MSG_COMMIT_FILES_RESPONSE = 34
+MSG_UNREGISTER_SHUFFLE = 17
+MSG_UNREGISTER_SHUFFLE_RESPONSE = 18
+MSG_OPEN_STREAM = 63
+MSG_STREAM_HANDLER = 64
+
+STATUS_SUCCESS = 0
+STATUS_SHUFFLE_NOT_REGISTERED = 5
+
+
+def encode_rpc_request(request_id: int, body: bytes) -> bytes:
+    frame_len = 8 + 1 + 8 + len(body)
+    return (struct.pack(">q", frame_len) + struct.pack(">b", RPC_REQUEST)
+            + struct.pack(">q", request_id) + body)
+
+
+def encode_rpc_response(request_id: int, body: bytes) -> bytes:
+    frame_len = 8 + 1 + 8 + len(body)
+    return (struct.pack(">q", frame_len) + struct.pack(">b", RPC_RESPONSE)
+            + struct.pack(">q", request_id) + body)
+
+
+@dataclasses.dataclass
+class RpcFrame:
+    msg_type: int
+    request_id: int
+    body: bytes
+
+
+def decode_rpc_frame(data: bytes) -> RpcFrame:
+    buf = memoryview(data)
+    (frame_len,) = struct.unpack_from(">q", buf, 0)
+    if frame_len != len(data):
+        raise ValueError(f"frame length {frame_len} != buffer {len(data)}")
+    (mtype,) = struct.unpack_from(">b", buf, 8)
+    if mtype not in (RPC_REQUEST, RPC_RESPONSE, RPC_FAILURE):
+        raise ValueError(f"not an rpc frame: type {mtype}")
+    (request_id,) = struct.unpack_from(">q", buf, 9)
+    return RpcFrame(mtype, request_id, bytes(buf[17:]))
+
+
+def encode_transport_message(msg_type: int, payload: bytes) -> bytes:
+    return _pb_int(1, msg_type) + _pb_len(2, payload)
+
+
+def decode_transport_message(body: bytes) -> Tuple[int, bytes]:
+    msg_type, payload = 0, b""
+    for f, v in _pb_fields(memoryview(body)):
+        if f == 1:
+            msg_type = v
+        elif f == 2:
+            payload = v
+    return msg_type, payload
+
+
+def _pb_decode(payload: bytes, spec: dict) -> dict:
+    """Decode per ``spec``: {field: (name, kind)} with kind in
+    int|str|bytes|repeated_int|repeated_str|repeated_bytes."""
+    out = {}
+    for field, (name, kind) in spec.items():
+        if kind.startswith("repeated"):
+            out[name] = []
+        elif kind == "int":
+            out[name] = 0
+        elif kind == "str":
+            out[name] = ""
+        else:
+            out[name] = b""
+    for f, v in _pb_fields(memoryview(payload)):
+        if f not in spec:
+            continue
+        name, kind = spec[f]
+        if kind == "int":
+            out[name] = v
+        elif kind == "str":
+            out[name] = v.decode("utf-8")
+        elif kind == "bytes":
+            out[name] = v
+        elif kind == "repeated_int":
+            out[name].append(v)
+        elif kind == "repeated_str":
+            out[name].append(v.decode("utf-8"))
+        elif kind == "repeated_bytes":
+            out[name].append(v)
+    return out
+
+
+@dataclasses.dataclass
+class RegisterShuffle:
+    """PbRegisterShuffle: announce a shuffle to the lifecycle manager and
+    obtain partition locations."""
+
+    app_id: str
+    shuffle_id: int
+    num_mappers: int
+    num_partitions: int
+
+    def encode(self) -> bytes:
+        return (_pb_str(1, self.app_id) + _pb_int(2, self.shuffle_id)
+                + _pb_int(3, self.num_mappers)
+                + _pb_int(4, self.num_partitions))
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "RegisterShuffle":
+        d = _pb_decode(payload, {1: ("app_id", "str"),
+                                 2: ("shuffle_id", "int"),
+                                 3: ("num_mappers", "int"),
+                                 4: ("num_partitions", "int")})
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class PartitionLocation:
+    """PbPartitionLocation (the subset the standalone worker uses)."""
+
+    id: int
+    epoch: int
+    host: str
+    push_port: int
+    fetch_port: int
+    mode: int = MODE_PRIMARY
+
+    def encode(self) -> bytes:
+        return (_pb_int(1, self.id) + _pb_int(2, self.epoch)
+                + _pb_str(3, self.host) + _pb_int(4, self.push_port)
+                + _pb_int(5, self.fetch_port) + _pb_int(6, self.mode))
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "PartitionLocation":
+        d = _pb_decode(payload, {1: ("id", "int"), 2: ("epoch", "int"),
+                                 3: ("host", "str"), 4: ("push_port", "int"),
+                                 5: ("fetch_port", "int"),
+                                 6: ("mode", "int")})
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class RegisterShuffleResponse:
+    status: int
+    partition_locations: List[PartitionLocation]
+
+    def encode(self) -> bytes:
+        return _pb_int(1, self.status) + b"".join(
+            _pb_len(2, p.encode()) for p in self.partition_locations)
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "RegisterShuffleResponse":
+        d = _pb_decode(payload, {1: ("status", "int"),
+                                 2: ("locs", "repeated_bytes")})
+        return cls(d["status"],
+                   [PartitionLocation.decode(b) for b in d["locs"]])
+
+
+@dataclasses.dataclass
+class MapperEnd:
+    """PbMapperEnd: a map task finished pushing; first attempt to report
+    per (shuffle, map) wins — later attempts' data is dropped at commit."""
+
+    app_id: str
+    shuffle_id: int
+    map_id: int
+    attempt_id: int
+    num_mappers: int
+
+    def encode(self) -> bytes:
+        return (_pb_str(1, self.app_id) + _pb_int(2, self.shuffle_id)
+                + _pb_int(3, self.map_id) + _pb_int(4, self.attempt_id)
+                + _pb_int(5, self.num_mappers))
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "MapperEnd":
+        d = _pb_decode(payload, {1: ("app_id", "str"),
+                                 2: ("shuffle_id", "int"),
+                                 3: ("map_id", "int"),
+                                 4: ("attempt_id", "int"),
+                                 5: ("num_mappers", "int")})
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class MapperEndResponse:
+    status: int
+
+    def encode(self) -> bytes:
+        return _pb_int(1, self.status)
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "MapperEndResponse":
+        return cls(_pb_decode(payload, {1: ("status", "int")})["status"])
+
+
+@dataclasses.dataclass
+class CommitFiles:
+    """PbCommitFiles: the stage-end handshake — the worker seals the
+    shuffle's partition files; only sealed data serves fetches."""
+
+    app_id: str
+    shuffle_id: int
+    primary_ids: List[str]
+    map_attempts: List[int]
+
+    def encode(self) -> bytes:
+        return (_pb_str(1, self.app_id) + _pb_int(2, self.shuffle_id)
+                + b"".join(_pb_len(3, p.encode("utf-8"))
+                           for p in self.primary_ids)
+                + b"".join(_pb_int(4, a + 1) for a in self.map_attempts))
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "CommitFiles":
+        d = _pb_decode(payload, {1: ("app_id", "str"),
+                                 2: ("shuffle_id", "int"),
+                                 3: ("primary_ids", "repeated_str"),
+                                 4: ("attempts", "repeated_int")})
+        return cls(d["app_id"], d["shuffle_id"], d["primary_ids"],
+                   [a - 1 for a in d["attempts"]])
+
+
+@dataclasses.dataclass
+class CommitFilesResponse:
+    status: int
+    committed_primary_ids: List[str]
+
+    def encode(self) -> bytes:
+        return _pb_int(1, self.status) + b"".join(
+            _pb_len(2, p.encode("utf-8"))
+            for p in self.committed_primary_ids)
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "CommitFilesResponse":
+        d = _pb_decode(payload, {1: ("status", "int"),
+                                 2: ("ids", "repeated_str")})
+        return cls(d["status"], d["ids"])
+
+
+@dataclasses.dataclass
+class OpenStream:
+    """PbOpenStream: reducer opens a partition's chunk stream."""
+
+    shuffle_key: str
+    file_name: str          # "partitionId-epoch" for reduce files
+    start_index: int = 0
+    end_index: int = 2 ** 31 - 1
+
+    def encode(self) -> bytes:
+        return (_pb_str(1, self.shuffle_key) + _pb_str(2, self.file_name)
+                + _pb_int(3, self.start_index) + _pb_int(4, self.end_index))
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "OpenStream":
+        d = _pb_decode(payload, {1: ("shuffle_key", "str"),
+                                 2: ("file_name", "str"),
+                                 3: ("start_index", "int"),
+                                 4: ("end_index", "int")})
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class StreamHandler:
+    stream_id: int
+    num_chunks: int
+
+    def encode(self) -> bytes:
+        return _pb_int(1, self.stream_id) + _pb_int(2, self.num_chunks)
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "StreamHandler":
+        d = _pb_decode(payload, {1: ("stream_id", "int"),
+                                 2: ("num_chunks", "int")})
+        return cls(**d)
+
+
+@dataclasses.dataclass
+class UnregisterShuffle:
+    app_id: str
+    shuffle_id: int
+
+    def encode(self) -> bytes:
+        return _pb_str(1, self.app_id) + _pb_int(2, self.shuffle_id)
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "UnregisterShuffle":
+        d = _pb_decode(payload, {1: ("app_id", "str"),
+                                 2: ("shuffle_id", "int")})
+        return cls(**d)
+
+
+_CONTROL_CODECS = {
+    MSG_REGISTER_SHUFFLE: RegisterShuffle,
+    MSG_REGISTER_SHUFFLE_RESPONSE: RegisterShuffleResponse,
+    MSG_MAPPER_END: MapperEnd,
+    MSG_MAPPER_END_RESPONSE: MapperEndResponse,
+    MSG_COMMIT_FILES: CommitFiles,
+    MSG_COMMIT_FILES_RESPONSE: CommitFilesResponse,
+    MSG_OPEN_STREAM: OpenStream,
+    MSG_STREAM_HANDLER: StreamHandler,
+    MSG_UNREGISTER_SHUFFLE: UnregisterShuffle,
+}
+
+
+def encode_control_rpc(request_id: int, msg) -> bytes:
+    """Control message object -> full RpcRequest frame."""
+    for mtype, cls in _CONTROL_CODECS.items():
+        if isinstance(msg, cls):
+            return encode_rpc_request(
+                request_id, encode_transport_message(mtype, msg.encode()))
+    raise TypeError(f"not a control message: {type(msg).__name__}")
+
+
+def encode_control_response(request_id: int, msg) -> bytes:
+    for mtype, cls in _CONTROL_CODECS.items():
+        if isinstance(msg, cls):
+            return encode_rpc_response(
+                request_id, encode_transport_message(mtype, msg.encode()))
+    raise TypeError(f"not a control message: {type(msg).__name__}")
+
+
+def decode_control_rpc(data: bytes) -> Tuple[int, object]:
+    """Full RPC frame -> (request_id, decoded control message)."""
+    frame = decode_rpc_frame(data)
+    mtype, payload = decode_transport_message(frame.body)
+    cls = _CONTROL_CODECS.get(mtype)
+    if cls is None:
+        raise ValueError(f"unknown transport message type {mtype}")
+    return frame.request_id, cls.decode(payload)
+
+
+# -- chunk fetch frames ------------------------------------------------------
+
+
+@dataclasses.dataclass
+class StreamChunkSlice:
+    stream_id: int
+    chunk_index: int
+    offset: int = 0
+    len: int = 2 ** 31 - 1
+
+    def encode(self) -> bytes:
+        return struct.pack(">qiii", self.stream_id, self.chunk_index,
+                           self.offset, self.len)
+
+    @classmethod
+    def decode_from(cls, buf: memoryview, off: int):
+        sid, ci, o, ln = struct.unpack_from(">qiii", buf, off)
+        return cls(sid, ci, o, ln), off + 20
+
+
+def encode_chunk_fetch_request(slice_: StreamChunkSlice) -> bytes:
+    body = slice_.encode()
+    frame_len = 8 + 1 + len(body)
+    return (struct.pack(">q", frame_len)
+            + struct.pack(">b", CHUNK_FETCH_REQUEST) + body)
+
+
+def encode_chunk_fetch_success(slice_: StreamChunkSlice,
+                               body: bytes) -> bytes:
+    head = slice_.encode()
+    frame_len = 8 + 1 + len(head) + len(body)
+    return (struct.pack(">q", frame_len)
+            + struct.pack(">b", CHUNK_FETCH_SUCCESS) + head + body)
+
+
+@dataclasses.dataclass
+class ChunkFetchRequestFrame:
+    slice: StreamChunkSlice
+
+
+@dataclasses.dataclass
+class ChunkFetchSuccessFrame:
+    slice: StreamChunkSlice
+    body: bytes
+
+
+def decode_chunk_frame(data: bytes):
+    buf = memoryview(data)
+    (frame_len,) = struct.unpack_from(">q", buf, 0)
+    if frame_len != len(data):
+        raise ValueError(f"frame length {frame_len} != buffer {len(data)}")
+    (mtype,) = struct.unpack_from(">b", buf, 8)
+    slice_, off = StreamChunkSlice.decode_from(buf, 9)
+    if mtype == CHUNK_FETCH_REQUEST:
+        return ChunkFetchRequestFrame(slice_)
+    if mtype == CHUNK_FETCH_SUCCESS:
+        return ChunkFetchSuccessFrame(slice_, bytes(buf[off:]))
+    raise ValueError(f"not a chunk frame: type {mtype}")

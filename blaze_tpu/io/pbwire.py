@@ -1,0 +1,75 @@
+"""Hand-rolled protobuf wire helpers shared by the RSS protocol modules.
+
+The Celeborn and Uniffle integrations speak protobuf-encoded control
+messages; no codegen dependency is needed for the handful of message
+shapes involved, so these primitives implement the wire format directly
+(varints, tags, length-delimited fields — protobuf encoding spec)."""
+
+from __future__ import annotations
+
+from typing import Iterator, Tuple
+
+
+def varint(v: int) -> bytes:
+    out = bytearray()
+    v &= (1 << 64) - 1
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def tag(field: int, wire: int) -> bytes:
+    return varint((field << 3) | wire)
+
+
+def len_delim(field: int, payload: bytes) -> bytes:
+    return tag(field, 2) + varint(len(payload)) + payload
+
+
+def str_field(field: int, s: str) -> bytes:
+    return len_delim(field, s.encode("utf-8")) if s else b""
+
+
+def int_field(field: int, v: int) -> bytes:
+    if v == 0:
+        return b""  # proto3 default elision
+    return tag(field, 0) + varint(v)
+
+
+def read_varint(buf: memoryview, off: int) -> Tuple[int, int]:
+    shift = 0
+    v = 0
+    while True:
+        b = buf[off]
+        off += 1
+        v |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return v, off
+        shift += 7
+
+
+def read_fields(buf: memoryview) -> Iterator[Tuple[int, object]]:
+    """Yield (field_number, value) pairs: varint fields as int,
+    length-delimited as bytes. Fixed32/64 unsupported (unused here)."""
+    off = 0
+    while off < len(buf):
+        key, off = read_varint(buf, off)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, off = read_varint(buf, off)
+            yield field, v
+        elif wire == 2:
+            n, off = read_varint(buf, off)
+            if off + n > len(buf):
+                raise ValueError(
+                    f"truncated length-delimited field {field}: "
+                    f"declared {n} bytes, {len(buf) - off} available")
+            yield field, bytes(buf[off:off + n])
+            off += n
+        else:
+            raise ValueError(f"unsupported wire type {wire}")

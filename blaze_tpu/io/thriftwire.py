@@ -1,0 +1,170 @@
+"""Thrift BINARY protocol + framed transport (the Hive metastore wire).
+
+The reference's Hive glue talks to HMS through the Java Thrift client;
+standalone we implement the protocol directly: the strict binary message
+envelope (``0x8001`` version word, method name, seqid), struct/field
+encoding, and the 4-byte framed transport. Scope: the types the HMS calls
+in ``blaze_tpu/hive.py`` use (bool/i16/i32/i64/string/struct/map/list).
+
+Spec: thrift-binary-protocol.md (apache/thrift), TBinaryProtocol strict
+encoding; goldens in tests/test_hive_thrift.py pin the byte layout."""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Tuple
+
+VERSION_1 = 0x80010000
+MSG_CALL = 1
+MSG_REPLY = 2
+MSG_EXCEPTION = 3
+
+T_STOP = 0
+T_BOOL = 2
+T_BYTE = 3
+T_DOUBLE = 4
+T_I16 = 6
+T_I32 = 8
+T_I64 = 10
+T_STRING = 11
+T_STRUCT = 12
+T_MAP = 13
+T_SET = 14
+T_LIST = 15
+
+
+# --- encode -----------------------------------------------------------------
+
+
+def enc_string(s) -> bytes:
+    b = s.encode("utf-8") if isinstance(s, str) else bytes(s)
+    return struct.pack(">i", len(b)) + b
+
+
+def enc_value(ttype: int, v) -> bytes:
+    if ttype == T_BOOL:
+        return b"\x01" if v else b"\x00"
+    if ttype == T_BYTE:
+        return struct.pack(">b", v)
+    if ttype == T_DOUBLE:
+        return struct.pack(">d", v)
+    if ttype == T_I16:
+        return struct.pack(">h", v)
+    if ttype == T_I32:
+        return struct.pack(">i", v)
+    if ttype == T_I64:
+        return struct.pack(">q", v)
+    if ttype == T_STRING:
+        return enc_string(v)
+    if ttype == T_STRUCT:
+        # v: list of (field_id, ttype, value)
+        return enc_struct(v)
+    if ttype == T_LIST or ttype == T_SET:
+        elem_t, items = v
+        return struct.pack(">bi", elem_t, len(items)) + b"".join(
+            enc_value(elem_t, it) for it in items)
+    if ttype == T_MAP:
+        kt, vt, pairs = v
+        return struct.pack(">bbi", kt, vt, len(pairs)) + b"".join(
+            enc_value(kt, k) + enc_value(vt, val) for k, val in pairs)
+    raise NotImplementedError(f"thrift type {ttype}")
+
+
+def enc_struct(fields: List[Tuple[int, int, Any]]) -> bytes:
+    out = b""
+    for fid, ttype, v in fields:
+        out += struct.pack(">bh", ttype, fid) + enc_value(ttype, v)
+    return out + bytes([T_STOP])
+
+
+def enc_message(name: str, msg_type: int, seqid: int, body: bytes) -> bytes:
+    return (struct.pack(">I", VERSION_1 | msg_type) + enc_string(name)
+            + struct.pack(">i", seqid) + body)
+
+
+def frame(data: bytes) -> bytes:
+    return struct.pack(">i", len(data)) + data
+
+
+# --- decode -----------------------------------------------------------------
+
+
+class Reader:
+    def __init__(self, data: bytes):
+        self.buf = memoryview(data)
+        self.off = 0
+
+    def take(self, n: int) -> bytes:
+        out = bytes(self.buf[self.off:self.off + n])
+        if len(out) != n:
+            raise ValueError("truncated thrift payload")
+        self.off += n
+        return out
+
+    def i8(self) -> int:
+        return struct.unpack(">b", self.take(1))[0]
+
+    def i16(self) -> int:
+        return struct.unpack(">h", self.take(2))[0]
+
+    def i32(self) -> int:
+        return struct.unpack(">i", self.take(4))[0]
+
+    def i64(self) -> int:
+        return struct.unpack(">q", self.take(8))[0]
+
+    def string(self) -> str:
+        return self.take(self.i32()).decode("utf-8")
+
+    def value(self, ttype: int):
+        if ttype == T_BOOL:
+            return self.take(1) == b"\x01"
+        if ttype == T_BYTE:
+            return self.i8()
+        if ttype == T_DOUBLE:
+            return struct.unpack(">d", self.take(8))[0]
+        if ttype == T_I16:
+            return self.i16()
+        if ttype == T_I32:
+            return self.i32()
+        if ttype == T_I64:
+            return self.i64()
+        if ttype == T_STRING:
+            return self.string()
+        if ttype == T_STRUCT:
+            return self.struct()
+        if ttype in (T_LIST, T_SET):
+            elem_t = self.i8()
+            n = self.i32()
+            return [self.value(elem_t) for _ in range(n)]
+        if ttype == T_MAP:
+            kt = self.i8()
+            vt = self.i8()
+            n = self.i32()
+            return {self.value(kt): self.value(vt) for _ in range(n)}
+        raise NotImplementedError(f"thrift type {ttype}")
+
+    def struct(self) -> Dict[int, Any]:
+        out: Dict[int, Any] = {}
+        while True:
+            ttype = self.i8()
+            if ttype == T_STOP:
+                return out
+            fid = self.i16()
+            out[fid] = self.value(ttype)
+
+    def message(self) -> Tuple[str, int, int]:
+        word = struct.unpack(">I", self.take(4))[0]
+        if word & 0xFFFF0000 != VERSION_1:
+            raise ValueError(f"bad thrift version word {word:#x}")
+        msg_type = word & 0xFF
+        name = self.string()
+        seqid = self.i32()
+        return name, msg_type, seqid
+
+
+def unframe(data: bytes) -> bytes:
+    (n,) = struct.unpack(">i", data[:4])
+    if n != len(data) - 4:
+        raise ValueError(f"frame length {n} != payload {len(data) - 4}")
+    return data[4:]

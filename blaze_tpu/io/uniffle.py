@@ -60,66 +60,13 @@ def crc32(data: bytes) -> int:
     return zlib.crc32(data) & 0xFFFFFFFF
 
 
-# --- minimal protobuf wire helpers -----------------------------------------
+# --- minimal protobuf wire helpers (shared: io/pbwire.py) ------------------
 
-
-def _varint(v: int) -> bytes:
-    out = bytearray()
-    v &= (1 << 64) - 1
-    while True:
-        b = v & 0x7F
-        v >>= 7
-        if v:
-            out.append(b | 0x80)
-        else:
-            out.append(b)
-            return bytes(out)
-
-
-def _tag(field: int, wire: int) -> bytes:
-    return _varint((field << 3) | wire)
-
-
-def _len_delim(field: int, payload: bytes) -> bytes:
-    return _tag(field, 2) + _varint(len(payload)) + payload
-
-
-def _int_field(field: int, v: int) -> bytes:
-    if v == 0:
-        return b""  # proto3 default elision
-    return _tag(field, 0) + _varint(v)
-
-
-def _read_varint(buf: memoryview, off: int) -> Tuple[int, int]:
-    shift = 0
-    v = 0
-    while True:
-        b = buf[off]
-        off += 1
-        v |= (b & 0x7F) << shift
-        if not b & 0x80:
-            return v, off
-        shift += 7
-
-
-def _read_fields(buf: memoryview):
-    off = 0
-    while off < len(buf):
-        key, off = _read_varint(buf, off)
-        field, wire = key >> 3, key & 7
-        if wire == 0:
-            v, off = _read_varint(buf, off)
-            yield field, v
-        elif wire == 2:
-            n, off = _read_varint(buf, off)
-            if off + n > len(buf):
-                raise ValueError(
-                    f"truncated length-delimited field {field}: "
-                    f"declared {n} bytes, {len(buf) - off} available")
-            yield field, bytes(buf[off:off + n])
-            off += n
-        else:
-            raise ValueError(f"unsupported wire type {wire}")
+from blaze_tpu.io.pbwire import (len_delim as _len_delim,  # noqa: E402
+                                 int_field as _int_field,
+                                 read_fields as _read_fields,
+                                 read_varint as _read_varint,
+                                 tag as _tag, varint as _varint)
 
 
 # --- messages ---------------------------------------------------------------
@@ -284,3 +231,334 @@ class UnifflePartitionWriter:
 
     def get_partition_length_map(self):
         return dict(self.partition_lengths)
+
+
+# --------------------------------------------------------------------------
+# Control plane + read path (round-4 verdict item 6)
+#
+# Uniffle's client drives the shuffle server over gRPC (proto/rss.proto):
+# requireBuffer before each send, reportShuffleResult after a task's last
+# push, getShuffleResult for the committed blockId bitmap, and the data
+# fetch. The message payloads below are those protobufs (hand-rolled like
+# the writer path); the blockId sets travel as genuine
+# Roaring64NavigableMap bytes (_roaring64_serialize — the wire format
+# RssUtils.serializeBitMap produces).
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RequireBufferRequest:
+    require_size: int
+    app_id: str
+    shuffle_id: int
+    partition_ids: List[int]
+
+    def encode(self) -> bytes:
+        out = _int_field(1, self.require_size)
+        out += _len_delim(2, self.app_id.encode("utf-8"))
+        out += _int_field(3, self.shuffle_id)
+        for p in self.partition_ids:
+            out += _tag(4, 0) + _varint(p)
+        return out
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "RequireBufferRequest":
+        size = sid = 0
+        app = ""
+        pids: List[int] = []
+        for f, v in _read_fields(memoryview(payload)):
+            if f == 1:
+                size = v
+            elif f == 2:
+                app = v.decode("utf-8")
+            elif f == 3:
+                sid = v
+            elif f == 4:
+                pids.append(v)
+        return cls(size, app, sid, pids)
+
+
+@dataclasses.dataclass
+class RequireBufferResponse:
+    require_buffer_id: int
+    status: int = 0
+    ret_msg: str = ""
+
+    def encode(self) -> bytes:
+        return (_int_field(1, self.require_buffer_id)
+                + _int_field(2, self.status)
+                + _len_delim(3, self.ret_msg.encode("utf-8"))
+                if self.ret_msg else
+                _int_field(1, self.require_buffer_id)
+                + _int_field(2, self.status))
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "RequireBufferResponse":
+        rid = status = 0
+        msg = ""
+        for f, v in _read_fields(memoryview(payload)):
+            if f == 1:
+                rid = v
+            elif f == 2:
+                status = v
+            elif f == 3:
+                msg = v.decode("utf-8")
+        return cls(rid, status, msg)
+
+
+@dataclasses.dataclass
+class PartitionToBlockIds:
+    partition_id: int
+    block_ids: List[int]
+
+    def encode(self) -> bytes:
+        out = _int_field(1, self.partition_id)
+        for b in self.block_ids:
+            out += _tag(2, 0) + _varint(b)
+        return out
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "PartitionToBlockIds":
+        pid = 0
+        ids: List[int] = []
+        for f, v in _read_fields(memoryview(payload)):
+            if f == 1:
+                pid = v
+            elif f == 2:
+                ids.append(v)
+        return cls(pid, ids)
+
+
+@dataclasses.dataclass
+class ReportShuffleResultRequest:
+    app_id: str
+    shuffle_id: int
+    task_attempt_id: int
+    bitmap_num: int
+    partition_to_block_ids: List[PartitionToBlockIds]
+
+    def encode(self) -> bytes:
+        out = _len_delim(1, self.app_id.encode("utf-8"))
+        out += _int_field(2, self.shuffle_id)
+        out += _int_field(3, self.task_attempt_id)
+        out += _int_field(4, self.bitmap_num)
+        for p in self.partition_to_block_ids:
+            out += _len_delim(5, p.encode())
+        return out
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "ReportShuffleResultRequest":
+        app = ""
+        sid = task = bn = 0
+        parts = []
+        for f, v in _read_fields(memoryview(payload)):
+            if f == 1:
+                app = v.decode("utf-8")
+            elif f == 2:
+                sid = v
+            elif f == 3:
+                task = v
+            elif f == 4:
+                bn = v
+            elif f == 5:
+                parts.append(PartitionToBlockIds.decode(v))
+        return cls(app, sid, task, bn, parts)
+
+
+@dataclasses.dataclass
+class GetShuffleResultRequest:
+    app_id: str
+    shuffle_id: int
+    partition_id: int
+
+    def encode(self) -> bytes:
+        return (_len_delim(1, self.app_id.encode("utf-8"))
+                + _int_field(2, self.shuffle_id)
+                + _int_field(3, self.partition_id))
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "GetShuffleResultRequest":
+        app = ""
+        sid = pid = 0
+        for f, v in _read_fields(memoryview(payload)):
+            if f == 1:
+                app = v.decode("utf-8")
+            elif f == 2:
+                sid = v
+            elif f == 3:
+                pid = v
+        return cls(app, sid, pid)
+
+
+@dataclasses.dataclass
+class GetShuffleResultResponse:
+    status: int
+    serialized_bitmap: bytes
+
+    def encode(self) -> bytes:
+        return (_int_field(1, self.status)
+                + _len_delim(2, self.serialized_bitmap))
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "GetShuffleResultResponse":
+        status = 0
+        bm = b""
+        for f, v in _read_fields(memoryview(payload)):
+            if f == 1:
+                status = v
+            elif f == 2:
+                bm = v
+        return cls(status, bm)
+
+
+@dataclasses.dataclass
+class BlockSegment:
+    block_id: int
+    offset: int
+    length: int
+    uncompress_length: int
+    crc: int
+    task_attempt_id: int
+
+    def encode(self) -> bytes:
+        return (_int_field(1, self.block_id) + _int_field(2, self.offset)
+                + _int_field(3, self.length)
+                + _int_field(4, self.uncompress_length)
+                + _int_field(5, self.crc)
+                + _int_field(6, self.task_attempt_id))
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "BlockSegment":
+        vals = {i: 0 for i in range(1, 7)}
+        for f, v in _read_fields(memoryview(payload)):
+            vals[f] = v
+        return cls(vals[1], vals[2], vals[3], vals[4], vals[5], vals[6])
+
+
+@dataclasses.dataclass
+class GetMemoryShuffleDataRequest:
+    app_id: str
+    shuffle_id: int
+    partition_id: int
+    last_block_id: int = 0
+    read_buffer_size: int = 1 << 20
+
+    def encode(self) -> bytes:
+        return (_len_delim(1, self.app_id.encode("utf-8"))
+                + _int_field(2, self.shuffle_id)
+                + _int_field(3, self.partition_id)
+                + _int_field(4, self.last_block_id)
+                + _int_field(5, self.read_buffer_size))
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "GetMemoryShuffleDataRequest":
+        app = ""
+        vals = {2: 0, 3: 0, 4: 0, 5: 1 << 20}
+        for f, v in _read_fields(memoryview(payload)):
+            if f == 1:
+                app = v.decode("utf-8")
+            else:
+                vals[f] = v
+        return cls(app, vals[2], vals[3], vals[4], vals[5])
+
+
+@dataclasses.dataclass
+class GetMemoryShuffleDataResponse:
+    status: int
+    segments: List[BlockSegment]
+    data: bytes
+
+    def encode(self) -> bytes:
+        out = _int_field(1, self.status)
+        for s in self.segments:
+            out += _len_delim(2, s.encode())
+        out += _len_delim(3, self.data)
+        return out
+
+    @classmethod
+    def decode(cls, payload: bytes) -> "GetMemoryShuffleDataResponse":
+        status = 0
+        segs = []
+        data = b""
+        for f, v in _read_fields(memoryview(payload)):
+            if f == 1:
+                status = v
+            elif f == 2:
+                segs.append(BlockSegment.decode(v))
+            elif f == 3:
+                data = v
+        return cls(status, segs, data)
+
+
+# --- Roaring64NavigableMap serialization ------------------------------------
+#
+# RssUtils.serializeBitMap: Roaring64NavigableMap.serialize writes
+#   boolean signedLongs (1 byte, 0) + int32 BE highCount, then per high:
+#   int32 BE high + a standard 32-bit RoaringBitmap (RoaringFormatSpec).
+# The 32-bit bitmaps here use the no-run cookie with array containers —
+# valid per the spec for the cardinalities block ids produce.
+
+_SERIAL_COOKIE_NO_RUN = 12346
+
+
+def _roaring32_serialize(values: List[int]) -> bytes:
+    by_key: Dict[int, List[int]] = {}
+    for v in sorted(set(values)):
+        by_key.setdefault(v >> 16, []).append(v & 0xFFFF)
+    out = struct.pack("<ii", _SERIAL_COOKIE_NO_RUN, len(by_key))
+    for key in sorted(by_key):
+        out += struct.pack("<HH", key, len(by_key[key]) - 1)
+    # offsets section (always present for the no-run cookie). Spec layout:
+    # cookie(4) + size(4) + descriptive header 4B/container + offsets
+    # 4B/container, containers follow
+    off = 8 + 4 * len(by_key) + 4 * len(by_key)
+    for key in sorted(by_key):
+        out += struct.pack("<I", off)
+        off += 2 * len(by_key[key])
+    for key in sorted(by_key):
+        out += b"".join(struct.pack("<H", lo) for lo in by_key[key])
+    return out
+
+
+def _roaring32_deserialize(buf: memoryview, off: int
+                           ) -> Tuple[List[int], int]:
+    cookie, size = struct.unpack_from("<ii", buf, off)
+    if cookie != _SERIAL_COOKIE_NO_RUN:
+        raise ValueError(f"unsupported roaring cookie {cookie}")
+    off += 8
+    keys = []
+    for _ in range(size):
+        key, card_m1 = struct.unpack_from("<HH", buf, off)
+        off += 4
+        keys.append((key, card_m1 + 1))
+    off += 4 * size  # offsets (containers follow contiguously anyway)
+    values = []
+    for key, card in keys:
+        for _ in range(card):
+            (lo,) = struct.unpack_from("<H", buf, off)
+            off += 2
+            values.append((key << 16) | lo)
+    return values, off
+
+
+def roaring64_serialize(values: List[int]) -> bytes:
+    by_high: Dict[int, List[int]] = {}
+    for v in sorted(set(values)):
+        by_high.setdefault(v >> 32, []).append(v & 0xFFFFFFFF)
+    out = b"\x00" + struct.pack(">i", len(by_high))
+    for high in sorted(by_high):
+        out += struct.pack(">i", high) + _roaring32_serialize(by_high[high])
+    return out
+
+
+def roaring64_deserialize(data: bytes) -> List[int]:
+    buf = memoryview(data)
+    (n_high,) = struct.unpack_from(">i", buf, 1)
+    off = 5
+    values: List[int] = []
+    for _ in range(n_high):
+        (high,) = struct.unpack_from(">i", buf, off)
+        off += 4
+        lows, off = _roaring32_deserialize(buf, off)
+        values.extend((high << 32) | lo for lo in lows)
+    return values

@@ -500,7 +500,10 @@ class Union(PlanNode):
     carries num_partitions + per-input partition offsets)."""
 
     inputs: List[PlanNode]
-    num_partitions: int
+    # None = resolved at build time to the stacked count of the inputs'
+    # partitions (what the frontend emits for UnionExec: Spark unions
+    # concatenate child partitions)
+    num_partitions: Optional[int] = None
     # (input index, input partition) for each output partition; empty = stack
     # inputs' partitions in order
     in_partitions: List[Tuple[int, int]] = dataclasses.field(default_factory=list)

@@ -628,7 +628,8 @@ def plan_to_proto(node: N.PlanNode) -> pb.PlanNode:
     elif isinstance(node, N.Union):
         for c in node.inputs:
             m.union.inputs.append(plan_to_proto(c))
-        m.union.num_partitions = node.num_partitions
+        # 0 encodes "resolve at build time" (stack the inputs' partitions)
+        m.union.num_partitions = node.num_partitions or 0
         for i, p in node.in_partitions:
             im = m.union.in_partitions.add()
             im.input = i
@@ -772,7 +773,7 @@ def plan_from_proto(m: pb.PlanNode) -> N.PlanNode:
             [expr_from_proto(e) for e in m.broadcast_join_build_hash_map.keys])
     if which == "union":
         return N.Union([plan_from_proto(c) for c in m.union.inputs],
-                       m.union.num_partitions,
+                       m.union.num_partitions or None,
                        [(im.input, im.partition) for im in m.union.in_partitions])
     if which == "shuffle_writer":
         return N.ShuffleWriter(plan_from_proto(m.shuffle_writer.child),

@@ -172,15 +172,18 @@ class RenameColumnsExec(Operator):
 class UnionExec(Operator):
     """Union with partition mapping (reference: union_exec.rs)."""
 
-    def __init__(self, inputs: List[Operator], num_partitions: int,
+    def __init__(self, inputs: List[Operator],
+                 num_partitions: Optional[int] = None,
                  in_partitions: Optional[List[Tuple[int, int]]] = None):
-        self._num_partitions = num_partitions
         if not in_partitions:
             in_partitions = []
             for i, op in enumerate(inputs):
                 for p in range(op.num_partitions()):
                     in_partitions.append((i, p))
         self.in_partitions = in_partitions
+        # None: stack every input partition (Spark UnionExec semantics)
+        self._num_partitions = len(in_partitions) \
+            if num_partitions is None else num_partitions
         super().__init__(inputs[0].schema, inputs)
 
     def num_partitions(self):

@@ -605,12 +605,31 @@ class Session:
         child_op = build_operator(node.child)
         num_maps = child_op.num_partitions()
         num_reducers = node.partitioning.num_partitions
-        from blaze_tpu.runtime.rss import RssWriterFactory
+        from blaze_tpu.runtime.rss import (CelebornShuffleClient,
+                                           CelebornWriterFactory,
+                                           RssWriterFactory,
+                                           UniffleShuffleClient,
+                                           UniffleWriterFactory)
 
         client = RssClient(self.rss_sock_path, app=self.work_dir,
                            shuffle_id=stage)
         wid = f"rss_writer_{stage}"
-        self.resources[wid] = RssWriterFactory(client)
+        shuffle_client = None
+        if self.conf.rss_protocol == "celeborn":
+            # full protocol loop: registerShuffle precedes the maps; every
+            # push/control message crosses as a Celeborn transport frame
+            shuffle_client = CelebornShuffleClient(client, num_maps,
+                                                   num_reducers)
+            shuffle_client.register()
+            self.resources[wid] = CelebornWriterFactory(shuffle_client)
+        elif self.conf.rss_protocol == "uniffle":
+            # requireBuffer-gated sends + reportShuffleResult commits; the
+            # reader follows the blockId bitmap (no stage-end seal RPC in
+            # uniffle's model)
+            shuffle_client = UniffleShuffleClient(client)
+            self.resources[wid] = UniffleWriterFactory(shuffle_client)
+        else:
+            self.resources[wid] = RssWriterFactory(client)
 
         shipped = None
         if self.pool is not None:
@@ -637,7 +656,16 @@ class Session:
             self._run_tasks(run_map, range(num_maps))
 
         rid = f"rss_shuffle_{stage}"
-        self.resources[rid] = client  # provider form: client(pid) -> blocks
+        if shuffle_client is not None:
+            # stage end: celeborn seals via commitFiles; uniffle has no
+            # seal RPC — its readers follow the reported blockId bitmap.
+            # Reducers then read through the protocol client (openStream +
+            # chunk-fetch frames / bitmap + getMemoryShuffleData)
+            if hasattr(shuffle_client, "commit_files"):
+                shuffle_client.commit_files()
+            self.resources[rid] = shuffle_client
+        else:
+            self.resources[rid] = client  # provider: client(pid) -> blocks
         return N.CoalesceBatches(
             N.IpcReader(schema=node.child.output_schema, resource_id=rid,
                         num_partitions=num_reducers),

@@ -1,0 +1,509 @@
+"""Generate the vendored Spark-3.5 wire-form fixtures
+(tests/fixtures/spark35/*.json).
+
+No JVM exists in this environment, so these dumps cannot be captured from a
+live session; they are RECONSTRUCTIONS of ``df.queryExecution.executedPlan
+.toJSON`` output, written field-for-field to Spark 3.5's TreeNode
+serializer conventions — including the parts the test-suite's plan builder
+(tests/tpcds/plans.py) simplifies:
+
+- every physical node carries its full constructor field set
+  (``isStreaming``/``numShufflePartitions`` on HashAggregateExec, ``offset``
+  on TakeOrderedAndProjectExec, ``relation``/``optionalBucketSet``/
+  ``disableBucketedScan`` on FileSourceScanExec, ...);
+- ``tableIdentifier`` is a TableIdentifier PRODUCT with database+table;
+- WindowExpression serializes with TWO children — the function and a
+  WindowSpecDefinition whose children are partitionSpec ++ orderSpec ++
+  frameSpecification (SpecifiedWindowFrame with bound trees);
+- AggregateExpression carries ``filter: null``; aggregate functions carry
+  their child-ordinal fields.
+
+tests/test_spark_wire_fixtures.py asserts these convert to the SAME engine
+plans/results as the builder-synthesized forms — the round-4 verdict's
+wire-fidelity gate (item 3), as far as it can be closed without a JVM."""
+
+import itertools
+import json
+import os
+
+SPARK = "org.apache.spark.sql"
+X = f"{SPARK}.catalyst.expressions"
+P = f"{SPARK}.execution"
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))), "tests", "fixtures", "spark35")
+
+_ids = itertools.count(200)
+
+
+class A:
+    """Attribute registry emitting the full AttributeReference field set."""
+
+    def __init__(self):
+        self.ids = {}
+        self.types = {}
+
+    def d(self, name, dtype):
+        if name not in self.ids:
+            self.ids[name] = next(_ids)
+            self.types[name] = dtype
+
+    def __call__(self, name):
+        return [{
+            "class": f"{X}.AttributeReference", "num-children": 0,
+            "name": name, "dataType": self.types[name], "nullable": True,
+            "metadata": {},
+            "exprId": {"product-class": f"{X}.ExprId",
+                       "id": self.ids[name],
+                       "jvmId": "b0a2cfbf-16d1-4b6e-8e5c-27f1d1e0f8a1"},
+            "qualifier": ["spark_catalog", "default",
+                          name.split("_")[0] + "_tbl"]}]
+
+    def new(self):
+        return next(_ids)
+
+
+def lit(value, dtype):
+    return [{"class": f"{X}.Literal", "num-children": 0,
+             "value": value, "dataType": dtype}]
+
+
+def binop(cls, left, right, **extra):
+    return [{"class": f"{X}.{cls}", "num-children": 2,
+             "left": 0, "right": 1, **extra}] + left + right
+
+
+def and_(a, b):
+    return binop("And", a, b)
+
+
+def sort_order(child, asc=True):
+    d = "Ascending$" if asc else "Descending$"
+    nf = "NullsFirst$" if asc else "NullsLast$"
+    return [{"class": f"{X}.SortOrder", "num-children": 1, "child": 0,
+             "direction": {"object": f"{X}.{d}"},
+             "nullOrdering": {"object": f"{X}.{nf}"},
+             "sameOrderExpressions": []}] + child
+
+
+def alias(child, name, eid):
+    return [{"class": f"{X}.Alias", "num-children": 1, "child": 0,
+             "name": name,
+             "exprId": {"product-class": f"{X}.ExprId", "id": eid,
+                        "jvmId": "b0a2cfbf-16d1-4b6e-8e5c-27f1d1e0f8a1"},
+             "qualifier": [], "explicitMetadata": {},
+             "nonInheritableMetadataKeys": ["__dataset_id", "__col_position"]
+             }] + child
+
+
+def agg_expr(fn_cls, mode, rid, children, child_fields=None):
+    fn = [{"class": f"{X}.aggregate.{fn_cls}",
+           "num-children": len(children),
+           **(child_fields or {})}] + \
+        [c for ch in children for c in ch]
+    return [{"class": f"{X}.aggregate.AggregateExpression", "num-children": 1,
+             "aggregateFunction": 0,
+             "mode": {"object": f"{X}.aggregate.{mode}$"},
+             "isDistinct": False,
+             "filter": None,
+             "resultId": {"product-class": f"{X}.ExprId", "id": rid,
+                          "jvmId": "b0a2cfbf-16d1-4b6e-8e5c-27f1d1e0f8a1"}}]\
+        + fn
+
+
+def scan(table, a, cols):
+    struct_fields = [{"name": c, "type": a.types[c], "nullable": True,
+                      "metadata": {}} for c in cols]
+    return [{"class": f"{P}.FileSourceScanExec", "num-children": 0,
+             "relation": None,
+             "output": [a(c) for c in cols],
+             "requiredSchema": {"type": "struct", "fields": struct_fields},
+             "partitionFilters": [],
+             "optionalBucketSet": None,
+             "optionalNumCoalescedBuckets": None,
+             "dataFilters": [],
+             "tableIdentifier": {
+                 "product-class": f"{SPARK}.catalyst.TableIdentifier",
+                 "table": table, "database": "default"},
+             "disableBucketedScan": False}]
+
+
+def filt(cond, child):
+    return [{"class": f"{P}.FilterExec", "num-children": 1,
+             "condition": cond, "child": 0}] + child
+
+
+def hash_agg(groups, aggs, child, required_dist=None):
+    return [{"class": f"{P}.aggregate.HashAggregateExec", "num-children": 1,
+             "requiredChildDistributionExpressions": required_dist,
+             "isStreaming": False,
+             "numShufflePartitions": None,
+             "groupingExpressions": groups,
+             "aggregateExpressions": aggs,
+             "aggregateAttributes": [],
+             "initialInputBufferOffset": 0,
+             "resultExpressions": [],
+             "child": 0}] + child
+
+
+def range_exchange(child, orders, nparts=4):
+    """What Spark plans under a global SortExec: RangePartitioning."""
+    part = [{"class": f"{SPARK}.catalyst.plans.physical.RangePartitioning",
+             "num-children": len(orders),
+             "ordering": list(range(len(orders))),
+             "numPartitions": nparts}] + \
+        [x for o in orders for x in o]
+    return [{"class": f"{P}.exchange.ShuffleExchangeExec", "num-children": 1,
+             "outputPartitioning": part,
+             "shuffleOrigin": {"object": f"{P}.exchange."
+                                         "ENSURE_REQUIREMENTS$"},
+             "advisoryPartitionSize": None,
+             "child": 0}] + child
+
+
+def exchange(child, keys=None, nparts=4):
+    if keys is None:
+        part = [{"class": f"{SPARK}.catalyst.plans.physical."
+                          "SinglePartition$", "num-children": 0}]
+    else:
+        part = [{"class": f"{SPARK}.catalyst.plans.physical."
+                          "HashPartitioning",
+                 "num-children": len(keys),
+                 "expressions": list(range(len(keys))),
+                 "numPartitions": nparts}] + \
+            [x for k in keys for x in k]
+    return [{"class": f"{P}.exchange.ShuffleExchangeExec", "num-children": 1,
+             "outputPartitioning": part,
+             "shuffleOrigin": {"object": f"{P}.exchange."
+                                         "ENSURE_REQUIREMENTS$"},
+             "advisoryPartitionSize": None,
+             "child": 0}] + child
+
+
+def bcast(child):
+    return [{"class": f"{P}.exchange.BroadcastExchangeExec",
+             "num-children": 1,
+             "mode": {"product-class":
+                      f"{P}.joins.HashedRelationBroadcastMode",
+                      "key": [], "isNullAware": False},
+             "child": 0}] + child
+
+
+def bhj(left, right, lkeys, rkeys, jt="Inner", build="BuildRight"):
+    return [{"class": f"{P}.joins.BroadcastHashJoinExec", "num-children": 2,
+             "leftKeys": lkeys, "rightKeys": rkeys,
+             "joinType": {"object": f"{SPARK}.catalyst.plans.{jt}$"},
+             "buildSide": {"object": f"{P}.joins.{build}$"},
+             "condition": None, "left": 0, "right": 1,
+             "isNullAwareAntiJoin": False}] + left + right
+
+
+def smj(left, right, lkeys, rkeys, jt):
+    return [{"class": f"{P}.joins.SortMergeJoinExec", "num-children": 2,
+             "leftKeys": lkeys, "rightKeys": rkeys,
+             "joinType": jt,
+             "condition": None, "isSkewJoin": False,
+             "left": 0, "right": 1}] + left + right
+
+
+def sort_node(orders, child, global_=False):
+    return [{"class": f"{P}.SortExec", "num-children": 1,
+             "sortOrder": orders, "global": global_,
+             "child": 0}] + child
+
+
+def take_ordered(limit, orders, plist, child):
+    return [{"class": f"{P}.TakeOrderedAndProjectExec", "num-children": 1,
+             "limit": limit, "sortOrder": orders,
+             "projectList": plist, "offset": 0, "child": 0}] + child
+
+
+def project(plist, child):
+    return [{"class": f"{P}.ProjectExec", "num-children": 1,
+             "projectList": plist, "child": 0}] + child
+
+
+def window_spec(part_exprs, order_exprs, frame_nodes):
+    """WindowSpecDefinition as a real TreeNode: children are partition
+    exprs ++ order SortOrders ++ the frame tree; fields hold ordinals."""
+    n_part, n_order = len(part_exprs), len(order_exprs)
+    node = {"class": f"{X}.WindowSpecDefinition",
+            "num-children": n_part + n_order + 1,
+            "partitionSpec": list(range(n_part)),
+            "orderSpec": list(range(n_part, n_part + n_order)),
+            "frameSpecification": n_part + n_order}
+    out = [node]
+    for e in part_exprs:
+        out += e
+    for e in order_exprs:
+        out += e
+    out += frame_nodes
+    return out
+
+
+def specified_frame(frame_type, lower_nodes, upper_nodes):
+    return [{"class": f"{X}.SpecifiedWindowFrame", "num-children": 2,
+             "frameType": {"object": f"{X}.{frame_type}$"},
+             "lower": 0, "upper": 1}] + lower_nodes + upper_nodes
+
+
+UNBOUNDED_PRECEDING = [{"class": f"{X}.UnboundedPreceding$",
+                        "num-children": 0}]
+CURRENT_ROW = [{"class": f"{X}.CurrentRow$", "num-children": 0}]
+
+
+def window_exec(wexprs, part_spec, order_spec, child):
+    return [{"class": f"{P}.window.WindowExec", "num-children": 1,
+             "windowExpression": wexprs, "partitionSpec": part_spec,
+             "orderSpec": order_spec, "child": 0}] + child
+
+
+# --------------------------------------------------------------------------
+# fixture q55: brand revenue (scan -> filter -> 2 BHJ -> 2-stage agg ->
+# TakeOrderedAndProject)
+# --------------------------------------------------------------------------
+
+
+def fixture_q55():
+    a = A()
+    for c, t in [("ss_sold_date_sk", "long"), ("ss_item_sk", "long"),
+                 ("ss_ext_sales_price", "decimal(7,2)"),
+                 ("d_date_sk", "long"), ("d_year", "long"), ("d_moy", "long"),
+                 ("i_item_sk", "long"), ("i_brand_id", "long"),
+                 ("i_brand", "string"), ("i_manager_id", "long")]:
+        a.d(c, t)
+    ss = scan("store_sales", a,
+              ["ss_sold_date_sk", "ss_item_sk", "ss_ext_sales_price"])
+    dt = filt(and_(binop("EqualTo", a("d_moy"), lit(11, "long")),
+                   binop("EqualTo", a("d_year"), lit(1999, "long"))),
+              scan("date_dim", a, ["d_date_sk", "d_year", "d_moy"]))
+    it = filt(binop("EqualTo", a("i_manager_id"), lit(13, "long")),
+              scan("item", a, ["i_item_sk", "i_brand_id", "i_brand",
+                               "i_manager_id"]))
+    j1 = bhj(ss, bcast(dt), [a("ss_sold_date_sk")], [a("d_date_sk")])
+    j2 = bhj(j1, bcast(it), [a("ss_item_sk")], [a("i_item_sk")])
+    rid = a.new()
+    sum_fields = {"child": 0}
+    partial = hash_agg(
+        [a("i_brand_id"), a("i_brand")],
+        [agg_expr("Sum", "Partial", rid, [a("ss_ext_sales_price")],
+                  sum_fields)], j2)
+    ex = exchange(partial, keys=[a("i_brand_id"), a("i_brand")])
+    final = hash_agg(
+        [a("i_brand_id"), a("i_brand")],
+        [agg_expr("Sum", "Final", rid, [a("ss_ext_sales_price")],
+                  sum_fields)], ex,
+        required_dist=[0, 1])
+    a.ids["ext_price"] = rid
+    a.types["ext_price"] = "decimal(17,2)"
+    return take_ordered(100, [sort_order(a("ext_price"), asc=False),
+                              sort_order(a("i_brand_id"))], [], final)
+
+
+# --------------------------------------------------------------------------
+# fixture q96: count(*) over 3 BHJs
+# --------------------------------------------------------------------------
+
+
+def fixture_q96():
+    a = A()
+    for c, t in [("ss_sold_time_sk", "long"), ("ss_hdemo_sk", "long"),
+                 ("ss_store_sk", "long"),
+                 ("t_time_sk", "long"), ("t_hour", "long"),
+                 ("t_minute", "long"),
+                 ("hd_demo_sk", "long"), ("hd_dep_count", "long"),
+                 ("s_store_sk", "long"), ("s_store_name", "string")]:
+        a.d(c, t)
+    ss = scan("store_sales", a,
+              ["ss_sold_time_sk", "ss_hdemo_sk", "ss_store_sk"])
+    td = filt(and_(binop("EqualTo", a("t_hour"), lit(20, "long")),
+                   binop("GreaterThanOrEqual", a("t_minute"),
+                         lit(30, "long"))),
+              scan("time_dim", a, ["t_time_sk", "t_hour", "t_minute"]))
+    hd = filt(binop("EqualTo", a("hd_dep_count"), lit(3, "long")),
+              scan("household_demographics", a,
+                   ["hd_demo_sk", "hd_dep_count"]))
+    st = filt(binop("EqualTo", a("s_store_name"), lit("store a", "string")),
+              scan("store", a, ["s_store_sk", "s_store_name"]))
+    j1 = bhj(ss, bcast(td), [a("ss_sold_time_sk")], [a("t_time_sk")])
+    j2 = bhj(j1, bcast(hd), [a("ss_hdemo_sk")], [a("hd_demo_sk")])
+    j3 = bhj(j2, bcast(st), [a("ss_store_sk")], [a("s_store_sk")])
+    rid = a.new()
+    partial = hash_agg([], [agg_expr("Count", "Partial", rid,
+                                     [lit(1, "integer")])], j3)
+    ex = exchange(partial, keys=None)
+    return hash_agg([], [agg_expr("Count", "Final", rid,
+                                  [lit(1, "integer")])], ex,
+                    required_dist=[])
+
+
+# --------------------------------------------------------------------------
+# fixture q98-window: sum-over-partition with a REAL WindowSpecDefinition
+# child (RANGE UNBOUNDED PRECEDING .. CURRENT ROW — Spark's default frame,
+# serialized explicitly the way the JVM emits it)
+# --------------------------------------------------------------------------
+
+
+def fixture_q98_window():
+    a = A()
+    for c, t in [("ss_item_sk", "long"), ("ss_sold_date_sk", "long"),
+                 ("ss_ext_sales_price", "decimal(7,2)"),
+                 ("i_item_sk", "long"), ("i_item_id", "string"),
+                 ("i_item_desc", "string"), ("i_category", "string"),
+                 ("i_class", "string"), ("i_current_price", "decimal(7,2)"),
+                 ("d_date_sk", "long"), ("d_year", "long"),
+                 ("d_moy", "long")]:
+        a.d(c, t)
+    in_cat = [{"class": f"{X}.In", "num-children": 4,
+               "value": 0, "list": [1, 2, 3]}] + a("i_category") + \
+        lit("Sports", "string") + lit("Books", "string") + \
+        lit("Home", "string")
+    ss = scan("store_sales", a,
+              ["ss_item_sk", "ss_sold_date_sk", "ss_ext_sales_price"])
+    it = filt(in_cat,
+              scan("item", a, ["i_item_sk", "i_item_id", "i_item_desc",
+                               "i_category", "i_class", "i_current_price"]))
+    dt = filt(and_(binop("EqualTo", a("d_year"), lit(1999, "long")),
+                   binop("EqualTo", a("d_moy"), lit(2, "long"))),
+              scan("date_dim", a, ["d_date_sk", "d_year", "d_moy"]))
+    j = bhj(ss, bcast(it), [a("ss_item_sk")], [a("i_item_sk")])
+    j = bhj(j, bcast(dt), [a("ss_sold_date_sk")], [a("d_date_sk")])
+    rid = a.new()
+    groups = ["i_item_id", "i_item_desc", "i_category", "i_class",
+              "i_current_price"]
+    partial = hash_agg([a(c) for c in groups],
+                       [agg_expr("Sum", "Partial", rid,
+                                 [a("ss_ext_sales_price")],
+                                 {"child": 0})], j)
+    ex = exchange(partial, keys=[a(c) for c in groups])
+    final = hash_agg([a(c) for c in groups],
+                     [agg_expr("Sum", "Final", rid,
+                               [a("ss_ext_sales_price")],
+                               {"child": 0})], ex, required_dist=[0])
+    a.ids["itemrevenue"] = rid
+    a.types["itemrevenue"] = "decimal(17,2)"
+    wex = exchange(final, keys=[a("i_class")])
+    wsort = sort_node([sort_order(a("i_class"))], wex)
+    wid = a.new()
+    spec = window_spec(
+        [a("i_class")], [],
+        specified_frame("RangeFrame", UNBOUNDED_PRECEDING, CURRENT_ROW))
+    wagg = agg_expr("Sum", "Complete", a.new(), [a("itemrevenue")],
+                    {"child": 0})
+    wexpr_inner = [{"class": f"{X}.WindowExpression", "num-children": 2,
+                    "windowFunction": 0, "windowSpec": 1}] + wagg + spec
+    win = window_exec([alias(wexpr_inner, "_we0", wid)],
+                      [a("i_class")], [], wsort)
+    a.ids["_we0"] = wid
+    a.types["_we0"] = "decimal(27,2)"
+    ratio_id = a.new()
+    ratio = alias(
+        binop("Divide",
+              binop("Multiply", a("itemrevenue"),
+                    lit("100", "decimal(3,0)")),
+              a("_we0")),
+        "revenueratio", ratio_id)
+    proj = project([a(c) for c in groups] + [a("itemrevenue")] + [ratio],
+                   win)
+    a.ids["revenueratio"] = ratio_id
+    a.types["revenueratio"] = "decimal(38,11)"
+
+    def orders():
+        return [sort_order(a("i_category")), sort_order(a("i_class")),
+                sort_order(a("i_item_id")), sort_order(a("i_item_desc")),
+                sort_order(a("revenueratio"))]
+
+    return sort_node(orders(), range_exchange(proj, orders()),
+                     global_=True)
+
+
+# --------------------------------------------------------------------------
+# fixture q10-core: LeftSemi + ExistenceJoin over SMJ with the exists
+# attribute serialized as a nested tree array inside the joinType product
+# --------------------------------------------------------------------------
+
+
+def fixture_q10_core():
+    a = A()
+    for c, t in [("c_customer_sk", "long"), ("c_current_cdemo_sk", "long"),
+                 ("ss_customer_sk", "long"), ("ss_sold_date_sk", "long"),
+                 ("ws_bill_customer_sk", "long"),
+                 ("ws_sold_date_sk", "long"),
+                 ("cs_bill_customer_sk", "long"),
+                 ("cs_sold_date_sk", "long"),
+                 ("d_date_sk", "long"), ("d_year", "long"),
+                 ("d_moy", "long")]:
+        a.d(c, t)
+
+    def activity(table, cust, date):
+        dta = A()
+        dta.d("d_date_sk", "long")
+        dta.d("d_year", "long")
+        dta.d("d_moy", "long")
+        s = scan(table, a, [cust, date])
+        dt = filt(and_(binop("EqualTo", dta("d_year"), lit(1999, "long")),
+                       and_(binop("GreaterThanOrEqual", dta("d_moy"),
+                                  lit(1, "long")),
+                            binop("LessThanOrEqual", dta("d_moy"),
+                                  lit(4, "long")))),
+                  scan("date_dim", dta, ["d_date_sk", "d_year", "d_moy"]))
+        j = bhj(s, bcast(dt), [a(date)], [dta("d_date_sk")])
+        return project([a(cust)], j)
+
+    def sorted_ex(child, key):
+        return sort_node([sort_order(key)], exchange(child, keys=[key]))
+
+    cu = scan("customer", a, ["c_customer_sk", "c_current_cdemo_sk"])
+    ss = activity("store_sales", "ss_customer_sk", "ss_sold_date_sk")
+    ws = activity("web_sales", "ws_bill_customer_sk", "ws_sold_date_sk")
+    cs = activity("catalog_sales", "cs_bill_customer_sk", "cs_sold_date_sk")
+    j = smj(sorted_ex(cu, a("c_customer_sk")),
+            sorted_ex(ss, a("ss_customer_sk")),
+            [a("c_customer_sk")], [a("ss_customer_sk")],
+            {"object": f"{SPARK}.catalyst.plans.LeftSemi$"})
+    e1, e2 = a.new(), a.new()
+
+    def exists_attr(eid, n):
+        return [[{"class": f"{X}.AttributeReference", "num-children": 0,
+                  "name": "exists", "dataType": "boolean",
+                  "nullable": False, "metadata": {},
+                  "exprId": {"product-class": f"{X}.ExprId", "id": eid,
+                             "jvmId":
+                                 "b0a2cfbf-16d1-4b6e-8e5c-27f1d1e0f8a1"},
+                  "qualifier": []}]]
+
+    j = smj(sorted_ex(j, a("c_customer_sk")),
+            sorted_ex(ws, a("ws_bill_customer_sk")),
+            [a("c_customer_sk")], [a("ws_bill_customer_sk")],
+            {"product-class": f"{SPARK}.catalyst.plans.ExistenceJoin",
+             "exists": exists_attr(e1, 1)})
+    j = smj(sorted_ex(j, a("c_customer_sk")),
+            sorted_ex(cs, a("cs_bill_customer_sk")),
+            [a("c_customer_sk")], [a("cs_bill_customer_sk")],
+            {"product-class": f"{SPARK}.catalyst.plans.ExistenceJoin",
+             "exists": exists_attr(e2, 2)})
+    a.ids["exists1"], a.types["exists1"] = e1, "boolean"
+    a.ids["exists2"], a.types["exists2"] = e2, "boolean"
+    ex1 = [dict(a("exists1")[0], name="exists")]
+    ex2 = [dict(a("exists2")[0], name="exists")]
+    f = filt(binop("Or", ex1, ex2), j)
+    rid = a.new()
+    partial = hash_agg([], [agg_expr("Count", "Partial", rid,
+                                     [lit(1, "integer")])], f)
+    return hash_agg([], [agg_expr("Count", "Final", rid,
+                                  [lit(1, "integer")])],
+                    exchange(partial, keys=None), required_dist=[])
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    for name, fn in (("q55", fixture_q55), ("q96", fixture_q96),
+                     ("q98_window", fixture_q98_window),
+                     ("q10_core", fixture_q10_core)):
+        path = os.path.join(OUT, f"{name}.json")
+        with open(path, "w") as f:
+            json.dump(fn(), f, indent=1)
+        print("wrote", path)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,142 @@
+"""Scale soak (round-4 verdict item 4): ~1 GB of fact data through the
+four bench shapes + five real TPC-DS queries under a CONSTRAINED memory
+budget, so spill/merge/window-stream paths genuinely engage at volume.
+
+Defaults: 10M bench fact rows over 32 partitions (~0.95 GB parquet across
+the star tables) with a 512 MB engine budget, plus the real-query gate's
+dataset scaled ~40x (2.4M store_sales). Records wall-clock, spill
+count/bytes, window-stream counts, and peak RSS — the numbers BASELINE.md
+cites. Reference analogue: the 1 GB TPC-DS dataset gate
+(``tpcds-reusable.yml:168-260``).
+
+Run: python scripts/scale_soak.py   (CPU; ~15-30 min)
+Env: SOAK_ROWS (10_000_000), SOAK_PARTS (32), SOAK_BUDGET_MB (512),
+SOAK_TPCDS_SCALE (40).
+"""
+
+import json
+import os
+import resource
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+ROWS = int(os.environ.get("SOAK_ROWS", 10_000_000))
+PARTS = int(os.environ.get("SOAK_PARTS", 32))
+BUDGET_MB = int(os.environ.get("SOAK_BUDGET_MB", 512))
+TPCDS_SCALE = int(os.environ.get("SOAK_TPCDS_SCALE", 40))
+
+os.environ["BENCH_ROWS"] = str(ROWS)
+os.environ["BENCH_PARTITIONS"] = str(PARTS)
+os.environ["BLAZE_BENCH_TUNNEL_WAIT_S"] = "5"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def peak_rss_mb() -> int:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
+
+
+def main():
+    import bench  # repo-root bench.py (shapes, generators, oracles)
+    from blaze_tpu.config import Config, set_config
+    from blaze_tpu.runtime.session import Session
+    from blaze_tpu.runtime.memmgr import MemManager
+
+    set_config(Config(memory_total=BUDGET_MB << 20, memory_fraction=1.0,
+                      mem_wait_timeout_s=5.0))
+    out = {"rows": ROWS, "partitions": PARTS, "budget_mb": BUDGET_MB,
+           "shapes": {}, "tpcds": {}}
+    with tempfile.TemporaryDirectory(prefix="blaze_soak_") as tmpdir:
+        t0 = time.perf_counter()
+        paths = bench.make_data(tmpdir)
+        out["datagen_s"] = round(time.perf_counter() - t0, 1)
+        out["data_bytes"] = sum(os.path.getsize(p)
+                                for ps in paths.values() for p in ps)
+        _, oracles = bench.run_baseline(paths)
+        for name, plan_fn, _o, _a, check_fn, _t in bench.SHAPES:
+            MemManager.reset()
+            t0 = time.perf_counter()
+            conf = Config(memory_total=BUDGET_MB << 20, memory_fraction=1.0,
+                          mem_wait_timeout_s=5.0)
+            with Session(conf=conf) as sess:
+                table = sess.execute_to_table(plan_fn(paths))
+                spills = sess.metrics.total("spill_count")
+                spill_bytes = sess.metrics.total("spilled_bytes")
+                streamed = sess.metrics.total("streamed_partitions")
+            wall = time.perf_counter() - t0
+            check_fn(table, oracles[name])  # correctness AT SCALE
+            out["shapes"][name] = {
+                "wall_s": round(wall, 1), "spill_count": int(spills),
+                "spilled_bytes": int(spill_bytes),
+                "streamed_window_partitions": int(streamed),
+                "peak_rss_mb": peak_rss_mb(),
+            }
+            print(json.dumps({name: out["shapes"][name]}), flush=True)
+
+    # real-query gate at ~40x its CI size
+    import tests.tpcds.data as D
+
+    D.N_SS *= TPCDS_SCALE
+    D.N_CS *= TPCDS_SCALE
+    D.N_WS *= TPCDS_SCALE
+    D.N_INV *= TPCDS_SCALE
+    D.N_CUSTOMERS *= 4
+    D.N_ADDRS *= 4
+    from tests.tpcds.queries import QUERIES
+    from tests.test_tpcds_queries import (_rows_equal, _sorted_if_tied)
+
+    with tempfile.TemporaryDirectory(prefix="blaze_soak_tpcds_") as td:
+        t0 = time.perf_counter()
+        tables = D.generate(td)
+        dfs = D.load_dfs(tables)
+        out["tpcds"]["datagen_s"] = round(time.perf_counter() - t0, 1)
+        out["tpcds"]["data_bytes"] = sum(os.path.getsize(p)
+                                         for ps in tables.values()
+                                         for p in ps)
+        from blaze_tpu.frontend.converter import SparkPlanConverter
+
+        for name in ("q3", "q7", "q53", "q67", "q96"):
+            plan_json, oracle, extract, flags = QUERIES[name]()
+            conv = SparkPlanConverter(tables=tables)
+            res = conv.convert(json.dumps(plan_json))
+            assert not [t for t in res.tags if "fallback" in t[1]]
+            MemManager.reset()
+            t0 = time.perf_counter()
+            conf = Config(memory_total=BUDGET_MB << 20, memory_fraction=1.0,
+                          mem_wait_timeout_s=5.0)
+            with Session(conf=conf) as sess:
+                table = sess.execute_to_table(res.plan)
+                spills = sess.metrics.total("spill_count")
+                spill_bytes = sess.metrics.total("spilled_bytes")
+            wall = time.perf_counter() - t0
+            if extract is None:
+                d = table.to_pydict()
+                rows = list(zip(*d.values())) if d else []
+            else:
+                rows = extract(table)
+            got = _sorted_if_tied(rows, flags)
+            want = _sorted_if_tied(oracle(dfs), flags)
+            assert _rows_equal(got, want, flags), f"{name} wrong at scale"
+            out["tpcds"][name] = {
+                "wall_s": round(wall, 1), "rows_out": len(got),
+                "spill_count": int(spills),
+                "spilled_bytes": int(spill_bytes),
+                "peak_rss_mb": peak_rss_mb(),
+            }
+            print(json.dumps({name: out["tpcds"][name]}), flush=True)
+    out["peak_rss_mb"] = peak_rss_mb()
+    print(json.dumps(out))
+    with open(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "SOAK_r05.json"), "w") as f:
+        json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,187 @@
+"""Standing TPU tunnel watcher (round-4 verdict, next-round item #1).
+
+The axon tunnel to the one real TPU chip has been down for rounds 2-4; every
+bench shipped CPU-fallback numbers. This daemon probes the tunnel every few
+minutes for the whole round and, the moment a probe answers with a real TPU
+platform, fires the full on-hardware evidence capture:
+
+  1. scripts/tpu_smoke.py      -> scripts/tpu_smoke_r05.log
+  2. bench.py                  -> BENCH_r05_tpu.json  (the on-silicon number)
+  3. scripts/placement_check.py-> PLACEMENT_r05.json  (auto vs forced)
+
+Every probe attempt is appended to TUNNEL_PROBES.jsonl (timestamp, outcome,
+elapsed) — if the tunnel never answers, that log IS the round's deliverable
+for item #1. After a successful capture the watcher keeps probing (cheaply)
+and re-captures at most twice more, >= 1h apart, to show stability.
+
+Run detached:  nohup python scripts/tunnel_watcher.py >/dev/null 2>&1 &
+Env: WATCH_INTERVAL_S (180), WATCH_MAX_HOURS (12), WATCH_PROBE_TIMEOUT (120).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROBE_LOG = os.path.join(REPO, "TUNNEL_PROBES.jsonl")
+STATE = os.path.join(REPO, "scripts", ".tunnel_watcher_state.json")
+INTERVAL = float(os.environ.get("WATCH_INTERVAL_S", 180))
+MAX_HOURS = float(os.environ.get("WATCH_MAX_HOURS", 12))
+PROBE_TIMEOUT = float(os.environ.get("WATCH_PROBE_TIMEOUT", 120))
+MAX_CAPTURES = 3
+RECAPTURE_GAP_S = 3600.0
+
+PROBE_SNIPPET = (
+    "import jax, jax.numpy as jnp; d = jax.devices();"
+    "assert d and d[0].platform != 'cpu', f'cpu-only: {d}';"
+    "x = float(jnp.arange(128.0).sum()); assert x == 8128.0;"
+    "print(d[0].platform)"
+)
+
+
+def _log(rec: dict):
+    rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(PROBE_LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def _load_state() -> dict:
+    try:
+        with open(STATE) as f:
+            return json.load(f)
+    except Exception:
+        return {"captures": 0, "last_capture_ts": 0.0}
+
+
+def _save_state(st: dict):
+    with open(STATE, "w") as f:
+        json.dump(st, f)
+
+
+def probe() -> tuple:
+    """(ok, platform_or_error, elapsed_s). Runs in a subprocess: a wedged
+    tunnel hangs un-cancellably inside backend init, so only a process
+    boundary gives us a deadline."""
+    t0 = time.monotonic()
+    try:
+        r = subprocess.run([sys.executable, "-c", PROBE_SNIPPET],
+                           capture_output=True, text=True,
+                           timeout=PROBE_TIMEOUT, cwd=REPO)
+        el = time.monotonic() - t0
+        if r.returncode == 0:
+            return True, r.stdout.strip(), el
+        return False, (r.stderr or r.stdout).strip()[-300:], el
+    except subprocess.TimeoutExpired:
+        return False, f"timeout>{PROBE_TIMEOUT:.0f}s", time.monotonic() - t0
+    except Exception as e:  # pragma: no cover - defensive
+        return False, repr(e)[:300], time.monotonic() - t0
+
+
+def _run_step(name: str, argv, log_path: str, timeout_s: float,
+              env_extra=None) -> dict:
+    """stdout goes to ``log_path``, stderr to ``log_path + '.err'`` —
+    kept apart so JSON records can be parsed off stdout (jax backends
+    always chatter on stderr)."""
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    t0 = time.monotonic()
+    try:
+        r = subprocess.run(argv, capture_output=True, text=True,
+                           timeout=timeout_s, cwd=REPO, env=env)
+        with open(log_path, "w") as f:
+            f.write(r.stdout)
+        if r.stderr:
+            with open(log_path + ".err", "w") as f:
+                f.write(r.stderr)
+        return {"step": name, "rc": r.returncode,
+                "elapsed_s": round(time.monotonic() - t0, 1),
+                "tail": r.stdout.strip()[-400:]}
+    except subprocess.TimeoutExpired:
+        return {"step": name, "rc": -1, "timeout": timeout_s,
+                "elapsed_s": round(time.monotonic() - t0, 1)}
+
+
+def _last_json_line(path: str):
+    """Last stdout line that parses as a JSON object (probes/benches print
+    exactly one such record)."""
+    try:
+        with open(path) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    except OSError:
+        return None
+    for ln in reversed(lines):
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            return rec
+    return None
+
+
+def capture(platform: str):
+    """The tunnel answered: grab every on-hardware artifact in order of
+    value-per-minute (smoke first — it's the cheapest proof the chip works;
+    bench second — the headline; placement last — it runs q01 nine times)."""
+    _log({"event": "capture_start", "platform": platform})
+    results = []
+    results.append(_run_step(
+        "tpu_smoke", [sys.executable, "scripts/tpu_smoke.py"],
+        os.path.join(REPO, "scripts", "tpu_smoke_r05.log"), 1800))
+    bench_log = os.path.join(REPO, "scripts", "bench_r05_tpu.log")
+    res = _run_step(
+        "bench", [sys.executable, "bench.py"], bench_log, 3600,
+        {"BLAZE_BENCH_TUNNEL_WAIT_S": "120"})
+    results.append(res)
+    if res.get("rc") == 0:
+        rec = _last_json_line(bench_log)
+        if rec is not None:
+            rec["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                               time.gmtime())
+            rec["platform"] = platform
+            with open(os.path.join(REPO, "BENCH_r05_tpu.json"), "w") as f:
+                json.dump(rec, f, indent=1)
+        else:
+            results.append({"step": "bench_parse",
+                            "error": "no JSON record in bench stdout"})
+    pl_log = os.path.join(REPO, "scripts", "placement_r05.log")
+    res_p = _run_step(
+        "placement", [sys.executable, "scripts/placement_check.py"],
+        pl_log, 3600)
+    results.append(res_p)
+    if res_p.get("rc") == 0:
+        rec = _last_json_line(pl_log)
+        if rec is not None:
+            with open(os.path.join(REPO, "PLACEMENT_r05.json"), "w") as f:
+                json.dump(rec, f, indent=1)
+    _log({"event": "capture_done", "results": results})
+
+
+def main():
+    deadline = time.monotonic() + MAX_HOURS * 3600
+    st = _load_state()
+    _log({"event": "watcher_start", "interval_s": INTERVAL,
+          "max_hours": MAX_HOURS, "pid": os.getpid()})
+    while time.monotonic() < deadline:
+        ok, info, el = probe()
+        _log({"ok": ok, "info": info, "elapsed_s": round(el, 1)})
+        # wall-clock (NOT monotonic: the state file outlives this process)
+        # gap applies only between captures — never blocks the first one
+        if ok and st["captures"] < MAX_CAPTURES and (
+                st["captures"] == 0 or
+                time.time() - st["last_capture_ts"] > RECAPTURE_GAP_S):
+            try:
+                capture(info)
+            except Exception as e:  # pragma: no cover - defensive
+                _log({"event": "capture_error", "error": repr(e)[:300]})
+            st["captures"] += 1
+            st["last_capture_ts"] = time.time()
+            _save_state(st)
+        time.sleep(INTERVAL)
+    _log({"event": "watcher_exit", "captures": st["captures"]})
+
+
+if __name__ == "__main__":
+    main()

@@ -165,3 +165,85 @@ def test_window_buffer_spills_under_pressure():
             assert rns == expect
     finally:
         MemManager.reset()
+
+
+def test_window_streams_oversized_partition():
+    """ONE window partition far larger than the memory budget: the spilled
+    buffer must stream (never concatenated into a bigger-than-memory batch)
+    and every supported function class must stay exact across the spill
+    boundary (round-4 verdict item 7)."""
+    from decimal import Decimal
+
+    from blaze_tpu.ir.nodes import WindowExpr
+    from blaze_tpu.ir import types as T
+    from blaze_tpu.ops.base import ExecContext
+    from blaze_tpu.ops.window import WindowExec
+    from blaze_tpu.runtime.metrics import MetricNode
+
+    n = 60_000
+    rng = np.random.default_rng(11)
+    # single partition (constant key), order key with ties -> rank/dense
+    # diverge from row_number; decimal argument exercises object cumsums
+    okeys = np.sort(rng.integers(0, n // 7, n))
+    vals = rng.integers(1, 1000, n)
+    data = {
+        "g": pa.array(np.zeros(n, dtype=np.int64), type=pa.int64()),
+        "o": pa.array(okeys, type=pa.int64()),
+        "v": pa.array([Decimal(int(v)).scaleb(-2) for v in vals],
+                      type=pa.decimal128(7, 2)),
+    }
+    sum_agg = E.AggExpr(E.AggFunction.SUM, [E.Column("v")],
+                        T.DecimalType(17, 2))
+    avg_all = E.AggExpr(E.AggFunction.AVG, [E.Column("v")],
+                        T.DecimalType(17, 6))
+    MemManager.reset()
+    try:
+        with config_override(memory_total=400_000, memory_fraction=1.0,
+                             mem_wait_timeout_s=0.2):
+            scan = mem_scan(data, num_batches=24)
+            op = WindowExec(
+                scan,
+                [WindowExpr("row_number", "rn"), WindowExpr("rank", "rk"),
+                 WindowExpr("dense_rank", "dr"),
+                 WindowExpr("agg", "rsum", agg=sum_agg)],
+                [E.Column("g")], [E.SortOrder(E.Column("o"))])
+            ctx = ExecContext()
+            m = MetricNode("root")
+            got = {"rn": [], "rk": [], "dr": [], "rsum": []}
+            for b in op.execute(0, ctx, m):
+                d = b.to_pydict()
+                for k in got:
+                    got[k].extend(d[k])
+            assert m.total("spill_count") >= 1, "partition must spill"
+            assert m.total("streamed_partitions") >= 1, \
+                "spilled partition must take the streaming path"
+            # oracle: numpy over the sorted single partition
+            new_peer = np.concatenate([[True], okeys[1:] != okeys[:-1]])
+            rn = np.arange(1, n + 1)
+            rank = np.maximum.accumulate(np.where(new_peer, rn, 0))
+            dense = np.cumsum(new_peer)
+            csum = np.cumsum(vals)
+            grp = dense - 1
+            last_of_grp = np.concatenate(
+                [np.nonzero(new_peer)[0][1:] - 1, [n - 1]])
+            rsum = csum[last_of_grp[grp]]
+            assert got["rn"] == rn.tolist()
+            assert got["rk"] == rank.tolist()
+            assert got["dr"] == dense.tolist()
+            assert got["rsum"] == [Decimal(int(s)).scaleb(-2)
+                                   for s in rsum.tolist()]
+
+            # whole-partition frame (no ORDER BY): avg is one constant
+            op2 = WindowExec(mem_scan(data, num_batches=24),
+                             [WindowExpr("agg", "av", agg=avg_all)],
+                             [E.Column("g")], [])
+            m2 = MetricNode("root")
+            av = []
+            for b in op2.execute(0, ctx, m2):
+                av.extend(b.to_pydict()["av"])
+            assert m2.total("spill_count") >= 1
+            expect = (Decimal(int(vals.sum())).scaleb(-2)
+                      / n).quantize(Decimal("0.000001"))
+            assert len(av) == n and set(av) == {expect}
+    finally:
+        MemManager.reset()

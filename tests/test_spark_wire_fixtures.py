@@ -1,0 +1,130 @@
+"""Wire-form fidelity gate (round-4 verdict item 3): the vendored Spark-3.5
+``TreeNode.toJSON`` fixtures (tests/fixtures/spark35/*.json — reconstructed
+field-for-field to the JVM serializer's conventions; no JVM exists in this
+environment to capture live dumps, see scripts/make_spark_fixtures.py) must
+convert to the SAME engine plans and results as the builder-synthesized
+forms in tests/tpcds/plans.py.
+
+What the fixtures carry that the builder simplifies: full physical-node
+field sets, TableIdentifier products with database qualifiers, attribute
+qualifiers, WindowSpecDefinition serialized as a child tree with an
+explicit SpecifiedWindowFrame, AggregateExpression ``filter`` fields, and
+the ExistenceJoin exists-attribute as a nested tree array. A systematic
+misreading of any of those would diverge here."""
+
+import json
+import os
+
+import pytest
+
+from blaze_tpu.frontend.converter import SparkPlanConverter
+from blaze_tpu.runtime.session import Session
+from tests.tpcds import data as tpcds_data
+from tests.tpcds.queries import QUERIES
+
+FIXTURE_DIR = os.path.join(os.path.dirname(__file__), "fixtures", "spark35")
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tpcds_wire_fixtures")
+    tables = tpcds_data.generate(str(d))
+    # fixtures address tables through TableIdentifier(database="default")
+    tables.update({f"default.{k}": v for k, v in list(tables.items())})
+    return tables
+
+
+def _load(name: str) -> str:
+    with open(os.path.join(FIXTURE_DIR, f"{name}.json")) as f:
+        return f.read()
+
+
+def _node_types(plan) -> list:
+    out = []
+
+    def walk(n):
+        out.append(type(n).__name__)
+        for c in n.children():
+            walk(c)
+
+    walk(plan)
+    return out
+
+
+def _run(tables, plan) -> list:
+    with Session() as s:
+        d = s.execute_to_table(plan).to_pydict()
+    return sorted(zip(*d.values()), key=repr) if d else []
+
+
+def _convert(tables, plan_json: str):
+    res = SparkPlanConverter(tables=tables).convert(plan_json)
+    fallbacks = [t for t in res.tags if "fallback" in t[1]]
+    assert not fallbacks, fallbacks
+    return res.plan
+
+
+@pytest.mark.parametrize("fixture,builder", [("q55", "q55"),
+                                             ("q96", "q96"),
+                                             ("q98_window", "q98")])
+def test_fixture_matches_builder(fixture, builder, dataset):
+    """Spark-wire fixture and builder-synthesized plan convert to the same
+    engine operator tree and produce identical rows."""
+    fplan = _convert(dataset, _load(fixture))
+    bjson, _oracle, _extract, _flags = QUERIES[builder]()
+    bplan = _convert(dataset, json.dumps(bjson))
+    assert _node_types(fplan) == _node_types(bplan)
+    assert _run(dataset, fplan) == _run(dataset, bplan)
+
+
+def test_existence_fixture_matches_builder(dataset):
+    """LeftSemi + stacked ExistenceJoins with the exists attribute in its
+    real nested-tree serialization."""
+    from tests.tpcds.plans import (Attrs, agg_expr, exchange, hash_agg, lit)
+    from tests.tpcds.queries_r5 import _exists_customer_base
+
+    fplan = _convert(dataset, _load("q10_core"))
+
+    a = Attrs()
+    for c, t in [("ss_customer_sk", "long"), ("ss_sold_date_sk", "long"),
+                 ("ws_bill_customer_sk", "long"), ("ws_sold_date_sk", "long"),
+                 ("cs_bill_customer_sk", "long"),
+                 ("cs_sold_date_sk", "long")]:
+        a.define(c, t)
+    base, _e1, _e2 = _exists_customer_base(a, 1, 4)
+    rid = a.new_id()
+    partial = hash_agg([], [agg_expr("Count", "Partial", rid,
+                                     [lit(1, "integer")])], base)
+    bjson = hash_agg([], [agg_expr("Count", "Final", rid,
+                                   [lit(1, "integer")])],
+                     exchange(partial, keys=None))
+    bplan = _convert(dataset, json.dumps(bjson))
+    # builder base scans one extra customer column (c_current_addr_sk for
+    # the downstream joins q10 proper does) — compare the COUNT, which
+    # pins semi/existence semantics, plus both zero-fallback conversions
+    got = _run(dataset, fplan)
+    want = _run(dataset, bplan)
+    assert got == want and len(got) == 1
+
+
+def test_fixture_files_are_vendored():
+    """The fixtures are static vendored artifacts, not runtime-generated:
+    regenerating must be a no-op (scripts/make_spark_fixtures.py)."""
+    for name in ("q55", "q96", "q98_window", "q10_core"):
+        raw = json.loads(_load(name))
+        assert isinstance(raw, list) and raw, name
+        assert all("class" in n for n in raw), name
+        # every node's child count is consistent with the flat array
+        total = len(raw)
+        consumed = [0]
+
+        def walk(i):
+            n = raw[i]
+            consumed[0] += 1
+            j = i + 1
+            for _ in range(int(n.get("num-children", 0))):
+                j = walk(j)
+            return j
+
+        end = walk(0)
+        assert end == total == consumed[0], name

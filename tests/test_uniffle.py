@@ -120,3 +120,140 @@ def test_malformed_uniffle_payloads_get_error_replies():
         assert client.fetch(0) == [b"fine"]
     finally:
         server.close()
+
+
+# --- control plane + read path (round-4 verdict item 6) --------------------
+
+
+def test_roaring64_golden_bytes():
+    """RssUtils.serializeBitMap layout: signedLongs byte + BE high count,
+    then per high: BE high + 32-bit RoaringBitmap (no-run cookie 12346)."""
+    import struct
+
+    from blaze_tpu.io.uniffle import roaring64_serialize
+
+    data = roaring64_serialize([1, 2, 0x10001])
+    # one high word (0), lows {1, 2, 0x10001}
+    assert data[0] == 0                       # signedLongs = false
+    assert struct.unpack_from(">i", data, 1)[0] == 1   # one high
+    assert struct.unpack_from(">i", data, 5)[0] == 0   # high = 0
+    cookie, size = struct.unpack_from("<ii", data, 9)
+    assert cookie == 12346 and size == 2      # keys 0x0000 and 0x0001
+
+
+def test_roaring64_roundtrip_large():
+    from blaze_tpu.io.uniffle import (pack_block_id, roaring64_deserialize,
+                                      roaring64_serialize)
+
+    ids = [pack_block_id(s, p, t)
+           for s in range(0, 200, 7) for p in (0, 5, 4000) for t in (0, 3)]
+    assert sorted(roaring64_deserialize(roaring64_serialize(ids))) == \
+        sorted(set(ids))
+
+
+def test_control_messages_roundtrip():
+    from blaze_tpu.io import uniffle as un
+
+    for msg in (
+        un.RequireBufferRequest(4096, "app", 3, [0, 1, 2]),
+        un.RequireBufferResponse(77, 0, ""),
+        un.ReportShuffleResultRequest("app", 3, 9, 1, [
+            un.PartitionToBlockIds(0, [un.pack_block_id(0, 0, 9)]),
+            un.PartitionToBlockIds(1, [un.pack_block_id(0, 1, 9),
+                                       un.pack_block_id(1, 1, 9)])]),
+        un.GetShuffleResultRequest("app", 3, 1),
+        un.GetShuffleResultResponse(0, b"\x00\x00\x00\x00\x00"),
+        un.GetMemoryShuffleDataRequest("app", 3, 1, 0, 1 << 20),
+        un.GetMemoryShuffleDataResponse(0, [
+            un.BlockSegment(5, 0, 3, 3, 123, 9)], b"abc"),
+    ):
+        assert type(msg).decode(msg.encode()) == msg
+
+
+def test_full_protocol_loop_require_send_report_fetch():
+    """requireBuffer -> sendShuffleData -> reportShuffleResult ->
+    getShuffleResult bitmap -> getMemoryShuffleData segments; unreported
+    blocks are invisible to the reader."""
+    from blaze_tpu.runtime.rss import (RssClient, RssServer,
+                                       UniffleShuffleClient)
+
+    server = RssServer()
+    try:
+        client = RssClient(server.sock_path, app="uloop", shuffle_id=2)
+        sc = UniffleShuffleClient(client)
+        for m in range(2):
+            w = sc.writer_for_map(m)
+            w.write(0, f"m{m}p0".encode())
+            w.write(1, f"m{m}p1".encode())
+            w.flush()
+        # an unreported (failed) task's blocks must not be served
+        w_fail = sc.writer_for_map(7)
+        w_fail.write(0, b"failed-task-block")
+        w_fail._writer.close(success=True)  # pushed but never reported
+        assert sorted(sc.fetch(0)) == [b"m0p0", b"m1p0"]
+        assert sorted(sc.fetch(1)) == [b"m0p1", b"m1p1"]
+    finally:
+        server.close()
+
+
+def test_send_without_require_buffer_rejected():
+    from blaze_tpu.io import uniffle as un
+    from blaze_tpu.runtime.rss import RssClient, RssServer
+
+    server = RssServer()
+    try:
+        client = RssClient(server.sock_path, app="nobuf", shuffle_id=1)
+        blk = un.ShuffleBlock(un.pack_block_id(0, 0, 1), 4, 4,
+                              un.crc32(b"data"), b"data", 1)
+        req = un.SendShuffleDataRequest("nobuf", 1, 999,
+                                        [un.ShuffleData(0, [blk])])
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError, match="not granted"):
+            client._call({"op": "uniffle_rpc", "method": "sendShuffleData",
+                          "payload": req.encode()})
+    finally:
+        server.close()
+
+
+def test_session_shuffle_over_uniffle_protocol(tmp_path):
+    """A real plan's exchange rides the uniffle protocol loop and matches
+    the file-shuffle result."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from blaze_tpu.config import Config
+    from blaze_tpu.ir import exprs as E
+    from blaze_tpu.ir import nodes as N
+    from blaze_tpu.ops.parquet import scan_node_for_files
+    from blaze_tpu.runtime.rss import RssServer
+    from blaze_tpu.runtime.session import Session
+
+    rng = np.random.default_rng(6)
+    tbl = pa.table({
+        "k": pa.array(rng.integers(0, 40, 4000), type=pa.int64()),
+        "v": pa.array(rng.integers(0, 1000, 4000), type=pa.int64()),
+    })
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(tbl, path)
+    scan = scan_node_for_files([path], num_partitions=2)
+    partial = N.Agg(scan, E.AggExecMode.HASH_AGG, [("k", E.Column("k"))],
+                    [N.AggColumn(E.AggExpr(E.AggFunction.SUM, [E.Column("v")]),
+                                 E.AggMode.PARTIAL, "s")])
+    ex = N.ShuffleExchange(partial, N.HashPartitioning([E.Column("k")], 3))
+    final = N.Agg(ex, E.AggExecMode.HASH_AGG, [("k", E.Column("k"))],
+                  [N.AggColumn(E.AggExpr(E.AggFunction.SUM, [E.Column("v")]),
+                               E.AggMode.FINAL, "s")])
+    plan = N.Sort(N.ShuffleExchange(final, N.SinglePartitioning(1)),
+                  [E.SortOrder(E.Column("k"))])
+    with Session() as s_file:
+        want = s_file.execute_to_table(plan).to_pydict()
+    server = RssServer()
+    try:
+        with Session(conf=Config(rss_protocol="uniffle"),
+                     rss_sock_path=server.sock_path) as s:
+            got = s.execute_to_table(plan).to_pydict()
+        assert got == want
+    finally:
+        server.close()

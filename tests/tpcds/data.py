@@ -25,6 +25,9 @@ N_HDEMO = 60
 N_PROMOS = 40
 N_SS = 60_000
 N_CS = 30_000
+N_WS = 20_000
+N_INV = 24_000
+N_CALL_CENTERS = 6
 
 
 def _dec(rng, n, lo, hi, prec=7, scale=2):
@@ -71,6 +74,7 @@ def generate(dirpath: str) -> dict:
         "d_month_seq": pa.array((year - 1900) * 12 + moy - 1,
                                 type=pa.int64()),
         "d_qoy": pa.array((moy - 1) // 3 + 1, type=pa.int64()),
+        "d_week_seq": pa.array((sk - 1) // 7 + 5270, type=pa.int64()),
     }))
 
     cats = ["Books", "Home", "Electronics", "Music", "Sports",
@@ -96,6 +100,10 @@ def generate(dirpath: str) -> dict:
         "i_manager_id": pa.array(rng.integers(1, 40, N_ITEMS),
                                  type=pa.int64()),
         "i_current_price": _dec(rng, N_ITEMS, 100, 30000),
+        # deterministic (no rng draw: keeps every pre-existing column's
+        # draw sequence byte-identical to earlier rounds)
+        "i_product_name": pa.array([f"product{v % 250}"
+                                    for v in range(N_ITEMS)]),
     }))
 
     write("store", pa.table({
@@ -139,6 +147,10 @@ def generate(dirpath: str) -> dict:
                                   for v in range(N_CUSTOMERS)]),
         "c_last_name": pa.array([f"Last{v % 131}"
                                  for v in range(N_CUSTOMERS)]),
+        "c_birth_month": pa.array(np.arange(N_CUSTOMERS) % 12 + 1,
+                                  type=pa.int64()),
+        "c_birth_year": pa.array(1930 + (np.arange(N_CUSTOMERS) * 7) % 70,
+                                 type=pa.int64()),
     }))
 
     write("customer_address", pa.table({
@@ -151,6 +163,7 @@ def generate(dirpath: str) -> dict:
                               for v in range(N_ADDRS)]),
         "ca_country": pa.array(["United States"] * N_ADDRS),
         "ca_gmt_offset": _dec(rng, N_ADDRS, -600, -400, prec=5, scale=2),
+        "ca_county": pa.array([f"county{v % 40}" for v in range(N_ADDRS)]),
     }))
 
     write("customer_demographics", pa.table({
@@ -162,6 +175,16 @@ def generate(dirpath: str) -> dict:
             [["Primary", "Secondary", "College", "2 yr Degree",
               "4 yr Degree", "Advanced Degree", "Unknown"][v % 7]
              for v in range(N_CDEMO)]),
+        "cd_purchase_estimate": pa.array((np.arange(N_CDEMO) % 10 + 1) * 500,
+                                         type=pa.int64()),
+        "cd_credit_rating": pa.array(
+            [["Low Risk", "High Risk", "Good", "Unknown"][v % 4]
+             for v in range(N_CDEMO)]),
+        "cd_dep_count": pa.array(np.arange(N_CDEMO) % 7, type=pa.int64()),
+        "cd_dep_employed_count": pa.array(np.arange(N_CDEMO) % 5,
+                                          type=pa.int64()),
+        "cd_dep_college_count": pa.array(np.arange(N_CDEMO) % 3,
+                                         type=pa.int64()),
     }))
 
     write("household_demographics", pa.table({
@@ -234,7 +257,50 @@ def generate(dirpath: str) -> dict:
         "cs_bill_cdemo_sk": pa.array(rng.integers(1, N_CDEMO + 1, N_CS),
                                      type=pa.int64()),
     })
+    # round-5 additions draw from a SEPARATE stream so every pre-existing
+    # column keeps the exact values earlier rounds generated (narrow query
+    # filters stay selective-but-nonempty)
+    rng5 = np.random.default_rng(777)
+    cs.update({
+        "cs_bill_addr_sk": pa.array(rng5.integers(1, N_ADDRS + 1, N_CS),
+                                    type=pa.int64()),
+        "cs_call_center_sk": pa.array(
+            rng5.integers(1, N_CALL_CENTERS + 1, N_CS), type=pa.int64()),
+    })
     write("catalog_sales", pa.table(cs), parts=2)
+
+    # --- round-5 tables: web channel, inventory, call centers -------------
+    ws_qty = rng5.integers(1, 101, N_WS)
+    ws_price = rng5.integers(100, 30000, N_WS)
+    write("web_sales", pa.table({
+        "ws_sold_date_sk": pa.array(rng5.integers(1, N_DATES + 1, N_WS),
+                                    type=pa.int64()),
+        "ws_item_sk": pa.array(rng5.integers(1, N_ITEMS + 1, N_WS),
+                               type=pa.int64()),
+        "ws_bill_customer_sk": pa.array(
+            rng5.integers(1, N_CUSTOMERS + 1, N_WS), type=pa.int64()),
+        "ws_bill_addr_sk": pa.array(rng5.integers(1, N_ADDRS + 1, N_WS),
+                                    type=pa.int64()),
+        "ws_ext_sales_price": pa.array(
+            [decimal.Decimal(int(q * v)).scaleb(-2)
+             for q, v in zip(ws_qty, ws_price)], type=pa.decimal128(7, 2)),
+    }), parts=2)
+
+    write("inventory", pa.table({
+        "inv_date_sk": pa.array(rng5.integers(1, N_DATES + 1, N_INV),
+                                type=pa.int64()),
+        "inv_item_sk": pa.array(rng5.integers(1, N_ITEMS + 1, N_INV),
+                                type=pa.int64()),
+        "inv_quantity_on_hand": pa.array(rng5.integers(0, 1000, N_INV),
+                                         type=pa.int64()),
+    }), parts=2)
+
+    write("call_center", pa.table({
+        "cc_call_center_sk": pa.array(np.arange(1, N_CALL_CENTERS + 1),
+                                      type=pa.int64()),
+        "cc_name": pa.array([f"call center {v}"
+                             for v in range(1, N_CALL_CENTERS + 1)]),
+    }))
 
     return tables
 

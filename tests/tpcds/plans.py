@@ -212,11 +212,24 @@ def bcast(child):
              "num-children": 1, "mode": {}, "child": 0}] + child
 
 
+def existence_join(eid: int) -> dict:
+    """ExistenceJoin(exists#eid) — a case CLASS (carries the exprId), not a
+    case object like Inner$/LeftSemi$."""
+    return {"product-class": f"{SPARK}.catalyst.plans.ExistenceJoin",
+            "exists": {"product-class": f"{X}.ExprId", "id": eid,
+                       "jvmId": "00000000-0000-0000-0000-000000000000"}}
+
+
+def _join_type(jt) -> dict:
+    return jt if isinstance(jt, dict) else \
+        {"object": f"{SPARK}.catalyst.plans.{jt}$"}
+
+
 def bhj(left, right, lkeys, rkeys, jt="Inner", build="BuildRight",
         condition=None):
     node = {"class": f"{P}.joins.BroadcastHashJoinExec", "num-children": 2,
             "leftKeys": lkeys, "rightKeys": rkeys,
-            "joinType": {"object": f"{SPARK}.catalyst.plans.{jt}$"},
+            "joinType": _join_type(jt),
             "buildSide": {"object": f"{P}.joins.{build}$"},
             "condition": condition, "left": 0, "right": 1}
     return [node] + left + right
@@ -225,7 +238,7 @@ def bhj(left, right, lkeys, rkeys, jt="Inner", build="BuildRight",
 def smj(left, right, lkeys, rkeys, jt="Inner", condition=None):
     node = {"class": f"{P}.joins.SortMergeJoinExec", "num-children": 2,
             "leftKeys": lkeys, "rightKeys": rkeys,
-            "joinType": {"object": f"{SPARK}.catalyst.plans.{jt}$"},
+            "joinType": _join_type(jt),
             "condition": condition, "isSkewJoin": False,
             "left": 0, "right": 1}
     return [node] + left + right
@@ -246,3 +259,57 @@ def window(wexprs, part_spec, order_spec, child):
     return [{"class": f"{P}.window.WindowExec", "num-children": 1,
              "windowExpression": wexprs, "partitionSpec": part_spec,
              "orderSpec": order_spec, "child": 0}] + child
+
+
+def window_rank(a, name: str, order_children, wid: int, dense=False):
+    """Alias(WindowExpression(Rank(order...))) — how Spark serializes
+    rank()/dense_rank() OVER a window (the rank's children repeat the
+    window order expressions)."""
+    fn = "DenseRank" if dense else "Rank"
+    rank = [{"class": f"{X}.{fn}", "num-children": len(order_children),
+             "children": list(range(len(order_children)))}] + \
+        [x for c in order_children for x in c]
+    wexpr = [{"class": f"{X}.WindowExpression", "num-children": 1,
+              "windowFunction": 0, "windowSpec": {}}] + rank
+    return alias(wexpr, name, wid)
+
+
+def union_all(*children):
+    return [{"class": f"{P}.UnionExec",
+             "num-children": len(children),
+             "children": list(range(len(children)))}] + \
+        [x for c in children for x in c]
+
+
+def expand(projections, output_attrs, child):
+    """ExpandExec: ``projections`` is a Seq[Seq[Expression]] (one inner list
+    per generated row set — rollup null-extensions + spark_grouping_id),
+    ``output`` carries the fresh output attributes."""
+    return [{"class": f"{P}.ExpandExec", "num-children": 1,
+             "projections": projections, "output": output_attrs,
+             "child": 0}] + child
+
+
+def range_exchange(child, orders, nparts=4):
+    """ShuffleExchangeExec with RangePartitioning — what Spark plans under
+    a GLOBAL SortExec (ORDER BY without LIMIT): range-partitioned rows,
+    then per-partition sorts yield total order across partitions."""
+    part = [{"class": f"{SPARK}.catalyst.plans.physical.RangePartitioning",
+             "num-children": len(orders),
+             "ordering": list(range(len(orders))),
+             "numPartitions": nparts}] + \
+        [x for o in orders for x in o]
+    return [{"class": f"{P}.exchange.ShuffleExchangeExec", "num-children": 1,
+             "outputPartitioning": part,
+             "shuffleOrigin": {"object": f"{P}.exchange."
+                                         "ENSURE_REQUIREMENTS$"},
+             "child": 0}] + child
+
+
+def sorted_exchange(child, keys, orders=None, nparts=4):
+    """exchange-by-hash + sort: what Spark plans under each SMJ side."""
+    ex = exchange(child, keys=list(keys), nparts=nparts)
+    if orders is None:
+        orders = [sort_order(k) for k in keys]
+    return [{"class": f"{P}.SortExec", "num-children": 1,
+             "sortOrder": orders, "global": False, "child": 0}] + ex

@@ -1167,9 +1167,16 @@ def q98():
     proj = project(groups + [srev] + [ratio], win)
     ratio_attr = a.define_with_id("revenueratio", "decimal(38,11)",
                                   rid_ratio)
-    plan = sort([sort_order(a("i_category")), sort_order(a("i_class")),
-                 sort_order(a("i_item_id")), sort_order(a("i_item_desc")),
-                 sort_order(ratio_attr)], proj)
+    # global ORDER BY = RangePartitioning exchange + sort, as Spark plans it
+    from tests.tpcds.plans import range_exchange
+
+    q98_orders = [sort_order(a("i_category")), sort_order(a("i_class")),
+                  sort_order(a("i_item_id")), sort_order(a("i_item_desc")),
+                  sort_order(ratio_attr)]
+    plan = sort(q98_orders, range_exchange(proj, [
+        sort_order(a("i_category")), sort_order(a("i_class")),
+        sort_order(a("i_item_id")), sort_order(a("i_item_desc")),
+        sort_order(ratio_attr)]))
 
     def oracle(dfs):
         dd = dfs["date_dim"]
@@ -1305,4 +1312,9 @@ def q89():
                  r.avg_monthly_sales) for r in g.itertuples(index=False)]
 
     return plan, oracle, None, ("approx", "ties")
+
+
+# round-5 additions (window/rank, rollup, existence joins, SMJ, union)
+# register into the same QUERIES dict
+from tests.tpcds import queries_r5  # noqa: E402,F401
 

@@ -1,0 +1,1444 @@
+"""Round-5 TPC-DS gate queries: window/rank, rollup (Expand), existence
+joins (semi/anti/ExistenceJoin), SMJ, and UNION — the operator classes the
+round-4 verdict flagged as implemented-but-never-exercised-by-a-real-query.
+
+Same contract as tests/tpcds/queries.py: each entry carries the genuine
+TPC-DS query text (template parameters bound to values the tiny dataset
+makes selective), a Spark-wire ``toJSON`` physical plan, a pandas oracle,
+an optional extractor, and compare flags. Registered into the same QUERIES
+dict. Reference: the all-99-query buckets in ``tpcds-reusable.yml:57-71``."""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from tests.tpcds.plans import (Attrs, X, agg_expr, alias, and_, bcast, bhj,
+                               binop, cast, eq, exchange, existence_join,
+                               expand, filt, hash_agg, in_list, isnotnull,
+                               lit, mul, not_, or_, project, scan, sfn, smj,
+                               sort, sort_order, sorted_exchange,
+                               take_ordered, two_stage_agg, union_all,
+                               window, window_rank)
+from tests.tpcds.queries import QUERIES, query
+
+
+def _window_agg(a, fn_cls, arg, name, wid):
+    """Alias(WindowExpression(AggregateExpression(fn))) — aggregate-over-
+    window, as Spark serializes avg(...) OVER (PARTITION BY ...)."""
+    agg = agg_expr(fn_cls, "Complete", a.new_id(), [arg])
+    wexpr = [{"class": f"{X}.WindowExpression", "num-children": 1,
+              "windowFunction": 0, "windowSpec": {}}] + agg
+    return alias(wexpr, name, wid)
+
+
+def _case_ratio_filter(ssum, wavg, a, threshold="0.1"):
+    """CASE WHEN avg > 0 THEN abs(sum-avg)/avg ELSE null END > threshold —
+    the q47/q53/q57/q63/q89 deviation predicate."""
+    cond = binop("GreaterThan", wavg, lit("0.000000", "decimal(21,6)"))
+    ratio = binop("Divide", sfn("Abs", binop("Subtract", ssum, wavg)), wavg)
+    case = [{"class": f"{X}.CaseWhen", "num-children": 3,
+             "branches": None, "elseValue": None}] + \
+        cond + ratio + lit(None, "decimal(38,16)")
+    return binop("GreaterThan", case, lit(threshold, "decimal(2,1)"))
+
+
+def _manufact_window_query(group_col, second_group_col,
+                           group_first_order=False):
+    """Shared shape of q53 (i_manufact_id) and q63 (i_manager_id):
+    quarterly/monthly sums per item group + avg-over-group window + the
+    deviation filter. ``group_first_order``: q63 sorts the group column
+    FIRST (ORDER BY i_manager_id, avg_monthly_sales, sum_sales) while q53
+    sorts it last."""
+    a = Attrs()
+    for c, t in [("ss_item_sk", "long"), ("ss_sold_date_sk", "long"),
+                 ("ss_store_sk", "long"), ("ss_sales_price", "decimal(7,2)"),
+                 ("i_item_sk", "long"), (group_col, "long"),
+                 ("i_category", "string"), ("i_class", "string"),
+                 ("i_brand", "string"),
+                 ("d_date_sk", "long"), ("d_month_seq", "long"),
+                 (second_group_col, "long"),
+                 ("s_store_sk", "long")]:
+        a.define(c, t)
+    ss = scan("store_sales", a, ["ss_item_sk", "ss_sold_date_sk",
+                                 "ss_store_sk", "ss_sales_price"])
+    it = filt(
+        or_(and_(in_list(a("i_category"),
+                         ["Books", "Children", "Electronics"], "string"),
+                 in_list(a("i_class"),
+                         ["class01", "class02", "class03"], "string"),
+                 in_list(a("i_brand"),
+                         ["brand#1", "brand#2", "brand#3", "brand#4",
+                          "brand#5", "brand#6", "brand#7"], "string")),
+            and_(in_list(a("i_category"),
+                         ["Women", "Music", "Men"], "string"),
+                 in_list(a("i_class"),
+                         ["class04", "class05", "class06"], "string"),
+                 in_list(a("i_brand"),
+                         ["brand#8", "brand#9", "brand#10", "brand#11",
+                          "brand#12", "brand#13", "brand#14"], "string"))),
+        scan("item", a, ["i_item_sk", group_col, "i_category", "i_class",
+                         "i_brand"]))
+    dt = filt(in_list(a("d_month_seq"), list(range(1176, 1188)), "long"),
+              scan("date_dim", a,
+                   ["d_date_sk", "d_month_seq", second_group_col]))
+    st = scan("store", a, ["s_store_sk"])
+    j = bhj(ss, bcast(it), [a("ss_item_sk")], [a("i_item_sk")])
+    j = bhj(j, bcast(dt), [a("ss_sold_date_sk")], [a("d_date_sk")])
+    j = bhj(j, bcast(st), [a("ss_store_sk")], [a("s_store_sk")])
+    rid = a.new_id()
+    agg = two_stage_agg([a(group_col), a(second_group_col)],
+                        [("Sum", rid, [a("ss_sales_price")])], j)
+    ssum = a.define_with_id("sum_sales", "decimal(17,2)", rid)
+    wid = a.new_id()
+    wchild = sort([sort_order(a(group_col))],
+                  exchange(agg, keys=[a(group_col)]))
+    win = window([_window_agg(a, "Average", ssum, "avg_group_sales", wid)],
+                 [a(group_col)], [], wchild)
+    wavg = a.define_with_id("avg_group_sales", "decimal(21,6)", wid)
+    f = filt(_case_ratio_filter(ssum, wavg, a), win)
+    orders = [sort_order(a(group_col)), sort_order(wavg), sort_order(ssum)] \
+        if group_first_order else \
+        [sort_order(wavg), sort_order(ssum), sort_order(a(group_col))]
+    plan = take_ordered(100, orders, [a(group_col), ssum, wavg], f)
+
+    def oracle(dfs):
+        it = dfs["item"]
+        dd = dfs["date_dim"]
+        keep = ((it.i_category.isin(["Books", "Children", "Electronics"])
+                 & it.i_class.isin(["class01", "class02", "class03"])
+                 & it.i_brand.isin([f"brand#{v}" for v in range(1, 8)]))
+                | (it.i_category.isin(["Women", "Music", "Men"])
+                   & it.i_class.isin(["class04", "class05", "class06"])
+                   & it.i_brand.isin([f"brand#{v}" for v in range(8, 15)])))
+        m = dfs["store_sales"].merge(it[keep], left_on="ss_item_sk",
+                                     right_on="i_item_sk")
+        m = m.merge(dd[(dd.d_month_seq >= 1176) & (dd.d_month_seq <= 1187)],
+                    left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(dfs["store"], left_on="ss_store_sk",
+                    right_on="s_store_sk")
+        g = m.groupby([group_col, second_group_col],
+                      as_index=False).ss_sales_price.sum()
+        g["sum_sales"] = g.ss_sales_price.astype(float)
+        g["avg_g"] = g.groupby(group_col).sum_sales.transform("mean")
+        g = g[(g.avg_g > 0)
+              & ((g.sum_sales - g.avg_g).abs() / g.avg_g > 0.1)]
+        sort_cols = [group_col, "avg_g", "sum_sales"] if group_first_order \
+            else ["avg_g", "sum_sales", group_col]
+        g = g.sort_values(sort_cols, kind="stable").head(100)
+        return [(getattr(r, group_col), r.sum_sales, r.avg_g)
+                for r in g.itertuples(index=False)]
+
+    def extract(out):
+        d = out.to_pydict()
+        cols = list(d.values())
+        return [(int(k), float(s), float(v))
+                for k, s, v in zip(*cols)]
+
+    return plan, oracle, extract, ("approx",)
+
+
+@query("q53")
+def q53():
+    """SELECT * FROM (SELECT i_manufact_id, sum(ss_sales_price) sum_sales,
+              avg(sum(ss_sales_price)) OVER (PARTITION BY i_manufact_id)
+                  avg_quarterly_sales
+       FROM item, store_sales, date_dim, store
+       WHERE ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+         AND ss_store_sk = s_store_sk AND d_month_seq IN (1176..1187)
+         AND ((i_category IN ('Books','Children','Electronics')
+               AND i_class IN (...) AND i_brand IN (...))
+           OR (i_category IN ('Women','Music','Men')
+               AND i_class IN (...) AND i_brand IN (...)))
+       GROUP BY i_manufact_id, d_qoy) tmp1
+       WHERE CASE WHEN avg_quarterly_sales > 0
+                  THEN abs(sum_sales - avg_quarterly_sales)
+                       / avg_quarterly_sales ELSE null END > 0.1
+       ORDER BY avg_quarterly_sales, sum_sales, i_manufact_id LIMIT 100"""
+    return _manufact_window_query("i_manufact_id", "d_qoy")
+
+
+@query("q63")
+def q63():
+    """SELECT * FROM (SELECT i_manager_id, sum(ss_sales_price) sum_sales,
+              avg(sum(ss_sales_price)) OVER (PARTITION BY i_manager_id)
+                  avg_monthly_sales
+       FROM item, store_sales, date_dim, store
+       WHERE ... d_month_seq IN (1176..1187) AND (category/class/brand
+         disjuncts as q53) GROUP BY i_manager_id, d_moy) tmp1
+       WHERE CASE WHEN avg_monthly_sales > 0
+                  THEN abs(sum_sales - avg_monthly_sales)
+                       / avg_monthly_sales ELSE null END > 0.1
+       ORDER BY i_manager_id, avg_monthly_sales, sum_sales LIMIT 100"""
+    return _manufact_window_query("i_manager_id", "d_moy",
+                                  group_first_order=True)
+
+
+# --------------------------------------------------------------------------
+# rollup / Expand class
+# --------------------------------------------------------------------------
+
+
+def _rollup_expand(a, g, key_cols, child, gid_name="spark_grouping_id"):
+    """ExpandExec for GROUP BY ROLLUP(key_cols): level i nulls out the last
+    i keys; spark_grouping_id gets one bit per nulled key (Spark's
+    ResolveGroupingAnalytics rewrite). ``g`` is the POST-expand attribute
+    registry (fresh exprIds, same names — exactly how Spark emits it)."""
+    projections = []
+    n = len(key_cols)
+    for lvl in range(n + 1):
+        keep = n - lvl
+        row = []
+        for i, (name, dtype) in enumerate(key_cols):
+            row.append(a(name) if i < keep else lit(None, dtype))
+        gid = (1 << lvl) - 1
+        row.append(lit(gid, "long"))
+        projections.append(row)
+    out_attrs = [g.define(name, dtype) for name, dtype in key_cols]
+    out_attrs.append(g.define(gid_name, "long"))
+    return expand(projections, out_attrs, child)
+
+
+@query("q67")
+def q67():
+    """SELECT * FROM (SELECT i_category, i_class, i_brand, i_product_name,
+              d_year, d_qoy, d_moy, s_store_id, sumsales,
+              rank() OVER (PARTITION BY i_category
+                           ORDER BY sumsales DESC) rk
+       FROM (SELECT i_category, i_class, i_brand, i_product_name, d_year,
+                    d_qoy, d_moy, s_store_id,
+                    sum(coalesce(ss_sales_price*ss_quantity,0)) sumsales
+             FROM store_sales, date_dim, store, item
+             WHERE ss_sold_date_sk = d_date_sk AND ss_item_sk = i_item_sk
+               AND ss_store_sk = s_store_sk
+               AND d_month_seq BETWEEN 1176 AND 1187
+             GROUP BY ROLLUP(i_category, i_class, i_brand, i_product_name,
+                             d_year, d_qoy, d_moy, s_store_id)) dw1) dw2
+       WHERE rk <= 100
+       ORDER BY i_category, i_class, i_brand, i_product_name, d_year,
+                d_qoy, d_moy, s_store_id, sumsales, rk LIMIT 100"""
+    a = Attrs()
+    for c, t in [("ss_sold_date_sk", "long"), ("ss_item_sk", "long"),
+                 ("ss_store_sk", "long"), ("ss_quantity", "long"),
+                 ("ss_sales_price", "decimal(7,2)"),
+                 ("d_date_sk", "long"), ("d_month_seq", "long"),
+                 ("d_year", "long"), ("d_qoy", "long"), ("d_moy", "long"),
+                 ("s_store_sk", "long"), ("s_store_id", "string"),
+                 ("i_item_sk", "long"), ("i_category", "string"),
+                 ("i_class", "string"), ("i_brand", "string"),
+                 ("i_product_name", "string")]:
+        a.define(c, t)
+    ss = scan("store_sales", a, ["ss_sold_date_sk", "ss_item_sk",
+                                 "ss_store_sk", "ss_quantity",
+                                 "ss_sales_price"])
+    dt = filt(and_(binop("GreaterThanOrEqual", a("d_month_seq"),
+                         lit(1176, "long")),
+                   binop("LessThanOrEqual", a("d_month_seq"),
+                         lit(1187, "long"))),
+              scan("date_dim", a, ["d_date_sk", "d_month_seq", "d_year",
+                                   "d_qoy", "d_moy"]))
+    st = scan("store", a, ["s_store_sk", "s_store_id"])
+    it = scan("item", a, ["i_item_sk", "i_category", "i_class", "i_brand",
+                          "i_product_name"])
+    j = bhj(ss, bcast(dt), [a("ss_sold_date_sk")], [a("d_date_sk")])
+    j = bhj(j, bcast(st), [a("ss_store_sk")], [a("s_store_sk")])
+    j = bhj(j, bcast(it), [a("ss_item_sk")], [a("i_item_sk")])
+    # sum argument: coalesce(ss_sales_price * ss_quantity, 0) — Spark casts
+    # the int factor and wraps the product in CheckOverflow
+    sales_amt = sfn(
+        "Coalesce",
+        mul(a("ss_sales_price"), cast(a("ss_quantity"), "decimal(10,0)")),
+        lit("0.00", "decimal(18,2)"))
+    # project the pre-agg inputs Expand consumes (Spark plans Project
+    # below Expand carrying group cols + the agg argument)
+    amt_id = a.new_id()
+    proj = project([a(c) for c in ("i_category", "i_class", "i_brand",
+                                   "i_product_name", "d_year", "d_qoy",
+                                   "d_moy", "s_store_id")] +
+                   [alias(sales_amt, "sales_amt", amt_id)], j)
+    amt = a.define_with_id("sales_amt", "decimal(18,2)", amt_id)
+    key_cols = [("i_category", "string"), ("i_class", "string"),
+                ("i_brand", "string"), ("i_product_name", "string"),
+                ("d_year", "long"), ("d_qoy", "long"), ("d_moy", "long"),
+                ("s_store_id", "string")]
+    g = Attrs()
+    ex = _rollup_expand(a, g, key_cols, proj)
+    # Expand's output also forwards the agg argument
+    ex[0]["output"].append(a("sales_amt"))
+    for row in ex[0]["projections"]:
+        row.append(amt)
+    rid = a.new_id()
+    groups = [g(name) for name, _ in key_cols] + [g("spark_grouping_id")]
+    agg = two_stage_agg(groups, [("Sum", rid, [amt])], ex)
+    ssum = a.define_with_id("sumsales", "decimal(28,2)", rid)
+    rkid = a.new_id()
+    wchild = sort([sort_order(g("i_category")),
+                   sort_order(ssum, asc=False)],
+                  exchange(agg, keys=[g("i_category")]))
+    win = window([window_rank(g, "rk", [sort_order(ssum, asc=False)], rkid)],
+                 [g("i_category")], [sort_order(ssum, asc=False)], wchild)
+    rk = g.define_with_id("rk", "integer", rkid)
+    f = filt(binop("LessThanOrEqual", rk, lit(100, "integer")), win)
+    out_cols = [g(name) for name, _ in key_cols] + [ssum, rk]
+    plan = take_ordered(
+        100,
+        [sort_order(g(name)) for name, _ in key_cols] +
+        [sort_order(ssum), sort_order(rk)],
+        out_cols, f)
+
+    def oracle(dfs):
+        dd = dfs["date_dim"]
+        m = dfs["store_sales"].merge(
+            dd[(dd.d_month_seq >= 1176) & (dd.d_month_seq <= 1187)],
+            left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(dfs["store"], left_on="ss_store_sk",
+                    right_on="s_store_sk")
+        m = m.merge(dfs["item"], left_on="ss_item_sk", right_on="i_item_sk")
+        # decimal cents * int is exact in float for these magnitudes
+        m["sales_amt"] = m.ss_sales_price.astype(float) * m.ss_quantity
+        cols = ["i_category", "i_class", "i_brand", "i_product_name",
+                "d_year", "d_qoy", "d_moy", "s_store_id"]
+        frames = []
+        for lvl in range(len(cols) + 1):
+            keep = cols[:len(cols) - lvl]
+            if keep:
+                gdf = m.groupby(keep, as_index=False).sales_amt.sum()
+            else:
+                gdf = pd.DataFrame({"sales_amt": [m.sales_amt.sum()]})
+            for c in cols[len(cols) - lvl:]:
+                gdf[c] = None
+            frames.append(gdf[cols + ["sales_amt"]])
+        allg = pd.concat(frames, ignore_index=True)
+        allg["sumsales"] = allg.sales_amt.round(2)
+        allg["rk"] = allg.groupby("i_category", dropna=False).sumsales.rank(
+            method="min", ascending=False).astype(int)
+        allg = allg[allg.rk <= 100]
+        allg = allg.sort_values(cols + ["sumsales", "rk"], kind="stable",
+                                na_position="first").head(100)
+
+        def norm(v):
+            if v is None or (isinstance(v, float) and np.isnan(v)):
+                return None
+            if isinstance(v, (np.integer, float)) and not isinstance(v, str):
+                return int(v) if float(v).is_integer() and not isinstance(
+                    v, np.floating) or isinstance(v, np.integer) else v
+            return v
+
+        out = []
+        for r in allg.itertuples(index=False):
+            row = []
+            for c in cols:
+                v = getattr(r, c)
+                if v is None or (isinstance(v, float) and np.isnan(v)):
+                    row.append(None)
+                elif c in ("d_year", "d_qoy", "d_moy"):
+                    row.append(int(v))
+                else:
+                    row.append(v)
+            row.append(round(float(r.sumsales), 2))
+            row.append(int(r.rk))
+            out.append(tuple(row))
+        return out
+
+    def extract(out):
+        d = out.to_pydict()
+        names = list(d)
+        rows = []
+        for vals in zip(*d.values()):
+            row = []
+            for n, v in zip(names, vals):
+                if v is None:
+                    row.append(None)
+                elif "sumsales" in n or "sum#" in n:
+                    row.append(round(float(v), 2))
+                elif isinstance(v, int):
+                    row.append(v)
+                else:
+                    row.append(v)
+            rows.append(tuple(row))
+        return rows
+
+    return plan, oracle, extract, ("approx",)
+
+
+@query("q18")
+def q18():
+    """SELECT i_item_id, ca_country, ca_state, ca_county,
+              avg(cast(cs_quantity as decimal(12,2))) agg1,
+              avg(cast(cs_list_price as decimal(12,2))) agg2,
+              avg(cast(cs_coupon_amt as decimal(12,2))) agg3,
+              avg(cast(cs_sales_price as decimal(12,2))) agg4,
+              avg(cast(c_birth_year as decimal(12,2))) agg5,
+              avg(cast(cd1.cd_dep_count as decimal(12,2))) agg6
+       FROM catalog_sales, customer_demographics cd1,
+            customer_demographics cd2, customer, customer_address, date_dim,
+            item
+       WHERE cs_sold_date_sk = d_date_sk AND cs_item_sk = i_item_sk
+         AND cs_bill_cdemo_sk = cd1.cd_demo_sk
+         AND cs_bill_customer_sk = c_customer_sk
+         AND cd1.cd_gender = 'F' AND cd1.cd_education_status = 'Unknown'
+         AND c_current_cdemo_sk = cd2.cd_demo_sk
+         AND c_current_addr_sk = ca_address_sk AND c_birth_month IN (1,6,8,9)
+         AND d_year = 1998 AND ca_state IN ('CA','TX','OH','GA','WA')
+       GROUP BY ROLLUP (i_item_id, ca_country, ca_state, ca_county)
+       ORDER BY ca_country, ca_state, ca_county, i_item_id LIMIT 100"""
+    a = Attrs()
+    for c, t in [("cs_sold_date_sk", "long"), ("cs_item_sk", "long"),
+                 ("cs_bill_cdemo_sk", "long"),
+                 ("cs_bill_customer_sk", "long"),
+                 ("cs_quantity", "long"), ("cs_list_price", "decimal(7,2)"),
+                 ("cs_coupon_amt", "decimal(7,2)"),
+                 ("cs_sales_price", "decimal(7,2)"),
+                 ("cd_demo_sk", "long"), ("cd_gender", "string"),
+                 ("cd_education_status", "string"), ("cd_dep_count", "long"),
+                 ("c_customer_sk", "long"), ("c_current_cdemo_sk", "long"),
+                 ("c_current_addr_sk", "long"), ("c_birth_month", "long"),
+                 ("c_birth_year", "long"),
+                 ("ca_address_sk", "long"), ("ca_country", "string"),
+                 ("ca_state", "string"), ("ca_county", "string"),
+                 ("d_date_sk", "long"), ("d_year", "long"),
+                 ("i_item_sk", "long"), ("i_item_id", "string")]:
+        a.define(c, t)
+    cs = scan("catalog_sales", a,
+              ["cs_sold_date_sk", "cs_item_sk", "cs_bill_cdemo_sk",
+               "cs_bill_customer_sk", "cs_quantity", "cs_list_price",
+               "cs_coupon_amt", "cs_sales_price"])
+    cd1 = filt(and_(eq(a("cd_gender"), lit("F", "string")),
+                    eq(a("cd_education_status"), lit("Unknown", "string"))),
+               scan("customer_demographics", a,
+                    ["cd_demo_sk", "cd_gender", "cd_education_status",
+                     "cd_dep_count"]))
+    # second customer_demographics instance: same names, fresh exprIds
+    b = Attrs()
+    b.define("cd_demo_sk", "long")
+    cd2 = scan("customer_demographics", b, ["cd_demo_sk"])
+    cu = filt(in_list(a("c_birth_month"), [1, 6, 8, 9], "long"),
+              scan("customer", a,
+                   ["c_customer_sk", "c_current_cdemo_sk",
+                    "c_current_addr_sk", "c_birth_month", "c_birth_year"]))
+    ca = filt(in_list(a("ca_state"), ["CA", "TX", "OH", "GA", "WA"],
+                      "string"),
+              scan("customer_address", a,
+                   ["ca_address_sk", "ca_country", "ca_state", "ca_county"]))
+    dt = filt(eq(a("d_year"), lit(1998, "long")),
+              scan("date_dim", a, ["d_date_sk", "d_year"]))
+    it = scan("item", a, ["i_item_sk", "i_item_id"])
+    j = bhj(cs, bcast(cd1), [a("cs_bill_cdemo_sk")], [a("cd_demo_sk")])
+    j = bhj(j, bcast(cu), [a("cs_bill_customer_sk")], [a("c_customer_sk")])
+    j = bhj(j, bcast(cd2), [a("c_current_cdemo_sk")], [b("cd_demo_sk")])
+    j = bhj(j, bcast(ca), [a("c_current_addr_sk")], [a("ca_address_sk")])
+    j = bhj(j, bcast(dt), [a("cs_sold_date_sk")], [a("d_date_sk")])
+    j = bhj(j, bcast(it), [a("cs_item_sk")], [a("i_item_sk")])
+    # pre-agg projection: group cols + the six cast agg arguments
+    arg_cols = ["cs_quantity", "cs_list_price", "cs_coupon_amt",
+                "cs_sales_price", "c_birth_year", "cd_dep_count"]
+    arg_ids = [a.new_id() for _ in arg_cols]
+    proj = project(
+        [a(c) for c in ("i_item_id", "ca_country", "ca_state", "ca_county")]
+        + [alias(cast(a(c), "decimal(12,2)"), f"arg{i}", aid)
+           for i, (c, aid) in enumerate(zip(arg_cols, arg_ids))], j)
+    args = [a.define_with_id(f"arg{i}", "decimal(12,2)", aid)
+            for i, aid in enumerate(arg_ids)]
+    key_cols = [("i_item_id", "string"), ("ca_country", "string"),
+                ("ca_state", "string"), ("ca_county", "string")]
+    g = Attrs()
+    ex = _rollup_expand(a, g, key_cols, proj)
+    for arg in args:
+        ex[0]["output"].append(arg)
+    for row in ex[0]["projections"]:
+        for arg in args:
+            row.append(arg)
+    rids = [a.new_id() for _ in range(6)]
+    groups = [g(name) for name, _ in key_cols] + [g("spark_grouping_id")]
+    agg = two_stage_agg(groups,
+                        [("Average", rid, [arg])
+                         for rid, arg in zip(rids, args)], ex)
+    plan = take_ordered(
+        100,
+        [sort_order(g("ca_country")), sort_order(g("ca_state")),
+         sort_order(g("ca_county")), sort_order(g("i_item_id"))],
+        [g("i_item_id"), g("ca_country"), g("ca_state"), g("ca_county")] +
+        [a.define_with_id(f"agg{i + 1}", "decimal(16,6)", rid)
+         for i, rid in enumerate(rids)], agg)
+
+    def oracle(dfs):
+        cd = dfs["customer_demographics"]
+        cu = dfs["customer"]
+        ca = dfs["customer_address"]
+        dd = dfs["date_dim"]
+        m = dfs["catalog_sales"].merge(
+            cd[(cd.cd_gender == "F")
+               & (cd.cd_education_status == "Unknown")],
+            left_on="cs_bill_cdemo_sk", right_on="cd_demo_sk")
+        m = m.merge(cu[cu.c_birth_month.isin([1, 6, 8, 9])],
+                    left_on="cs_bill_customer_sk", right_on="c_customer_sk")
+        m = m.merge(cd[["cd_demo_sk"]].rename(
+            columns={"cd_demo_sk": "cd2_sk"}),
+            left_on="c_current_cdemo_sk", right_on="cd2_sk")
+        m = m.merge(ca[ca.ca_state.isin(["CA", "TX", "OH", "GA", "WA"])],
+                    left_on="c_current_addr_sk", right_on="ca_address_sk")
+        m = m.merge(dd[dd.d_year == 1998], left_on="cs_sold_date_sk",
+                    right_on="d_date_sk")
+        m = m.merge(dfs["item"], left_on="cs_item_sk", right_on="i_item_sk")
+        for c in ("cs_list_price", "cs_coupon_amt", "cs_sales_price"):
+            m[c] = m[c].astype(float)
+        cols = ["i_item_id", "ca_country", "ca_state", "ca_county"]
+        frames = []
+        for lvl in range(len(cols) + 1):
+            keep = cols[:len(cols) - lvl]
+            spec = dict(a1=("cs_quantity", "mean"),
+                        a2=("cs_list_price", "mean"),
+                        a3=("cs_coupon_amt", "mean"),
+                        a4=("cs_sales_price", "mean"),
+                        a5=("c_birth_year", "mean"),
+                        a6=("cd_dep_count", "mean"))
+            if keep:
+                gdf = m.groupby(keep, as_index=False).agg(**spec)
+            else:
+                gdf = pd.DataFrame({k: [getattr(m[c], f)()]
+                                    for k, (c, f) in spec.items()})
+            for c in cols[len(cols) - lvl:]:
+                gdf[c] = None
+            frames.append(gdf[cols + list(spec)])
+        allg = pd.concat(frames, ignore_index=True)
+        allg = allg.sort_values(
+            ["ca_country", "ca_state", "ca_county", "i_item_id"],
+            kind="stable", na_position="first").head(100)
+        out = []
+        for r in allg.itertuples(index=False):
+            row = [None if not isinstance(v, str) else v
+                   for v in (r.i_item_id, r.ca_country, r.ca_state,
+                             r.ca_county)]
+            row += [round(float(v), 4)
+                    for v in (r.a1, r.a2, r.a3, r.a4, r.a5, r.a6)]
+            out.append(tuple(row))
+        return out
+
+    def extract(out):
+        d = out.to_pydict()
+        rows = []
+        for vals in zip(*d.values()):
+            rows.append(tuple(
+                v if isinstance(v, str) or v is None
+                else round(float(v), 4) for v in vals))
+        return rows
+
+    return plan, oracle, extract, ("approx", "ties")
+
+
+@query("q22")
+def q22():
+    """SELECT i_product_name, i_brand, i_class, i_category,
+              avg(inv_quantity_on_hand) qoh
+       FROM inventory, date_dim, item
+       WHERE inv_date_sk = d_date_sk AND inv_item_sk = i_item_sk
+         AND d_month_seq BETWEEN 1176 AND 1187
+       GROUP BY ROLLUP(i_product_name, i_brand, i_class, i_category)
+       ORDER BY qoh, i_product_name, i_brand, i_class, i_category
+       LIMIT 100"""
+    a = Attrs()
+    for c, t in [("inv_date_sk", "long"), ("inv_item_sk", "long"),
+                 ("inv_quantity_on_hand", "long"),
+                 ("d_date_sk", "long"), ("d_month_seq", "long"),
+                 ("i_item_sk", "long"), ("i_product_name", "string"),
+                 ("i_brand", "string"), ("i_class", "string"),
+                 ("i_category", "string")]:
+        a.define(c, t)
+    inv = scan("inventory", a,
+               ["inv_date_sk", "inv_item_sk", "inv_quantity_on_hand"])
+    dt = filt(and_(binop("GreaterThanOrEqual", a("d_month_seq"),
+                         lit(1176, "long")),
+                   binop("LessThanOrEqual", a("d_month_seq"),
+                         lit(1187, "long"))),
+              scan("date_dim", a, ["d_date_sk", "d_month_seq"]))
+    it = scan("item", a, ["i_item_sk", "i_product_name", "i_brand",
+                          "i_class", "i_category"])
+    j = bhj(inv, bcast(dt), [a("inv_date_sk")], [a("d_date_sk")])
+    j = bhj(j, bcast(it), [a("inv_item_sk")], [a("i_item_sk")])
+    key_cols = [("i_product_name", "string"), ("i_brand", "string"),
+                ("i_class", "string"), ("i_category", "string")]
+    g = Attrs()
+    ex = _rollup_expand(a, g, key_cols, j)
+    ex[0]["output"].append(a("inv_quantity_on_hand"))
+    for row in ex[0]["projections"]:
+        row.append(a("inv_quantity_on_hand"))
+    rid = a.new_id()
+    groups = [g(name) for name, _ in key_cols] + [g("spark_grouping_id")]
+    agg = two_stage_agg(groups,
+                        [("Average", rid, [a("inv_quantity_on_hand")])], ex)
+    qoh = a.define_with_id("qoh", "double", rid)
+    plan = take_ordered(
+        100,
+        [sort_order(qoh)] + [sort_order(g(name)) for name, _ in key_cols],
+        [g(name) for name, _ in key_cols] + [qoh], agg)
+
+    def oracle(dfs):
+        dd = dfs["date_dim"]
+        m = dfs["inventory"].merge(
+            dd[(dd.d_month_seq >= 1176) & (dd.d_month_seq <= 1187)],
+            left_on="inv_date_sk", right_on="d_date_sk")
+        m = m.merge(dfs["item"], left_on="inv_item_sk", right_on="i_item_sk")
+        cols = ["i_product_name", "i_brand", "i_class", "i_category"]
+        frames = []
+        for lvl in range(len(cols) + 1):
+            keep = cols[:len(cols) - lvl]
+            if keep:
+                gdf = m.groupby(keep, as_index=False).agg(
+                    qoh=("inv_quantity_on_hand", "mean"))
+            else:
+                gdf = pd.DataFrame(
+                    {"qoh": [m.inv_quantity_on_hand.mean()]})
+            for c in cols[len(cols) - lvl:]:
+                gdf[c] = None
+            frames.append(gdf[cols + ["qoh"]])
+        allg = pd.concat(frames, ignore_index=True)
+        allg = allg.sort_values(["qoh"] + cols, kind="stable",
+                                na_position="first").head(100)
+        return [tuple([None if not isinstance(getattr(r, c), str)
+                       else getattr(r, c) for c in cols]
+                      + [round(float(r.qoh), 4)])
+                for r in allg.itertuples(index=False)]
+
+    def extract(out):
+        d = out.to_pydict()
+        rows = []
+        for vals in zip(*d.values()):
+            *keys, qoh_v = vals
+            rows.append(tuple(list(keys) + [round(float(qoh_v), 4)]))
+        return rows
+
+    return plan, oracle, extract, ("approx", "ties")
+
+
+# --------------------------------------------------------------------------
+# existence-join class (EXISTS / NOT EXISTS / OR-of-EXISTS), SMJ-planned
+# --------------------------------------------------------------------------
+
+
+def _sales_in_window(a, table, cust_col, date_col, moy_lo, moy_hi,
+                     year=1999):
+    """Subquery plan for EXISTS(SELECT * FROM <sales>, date_dim WHERE
+    c_customer_sk = <cust> AND <date> = d_date_sk AND d_year = <y> AND
+    d_moy BETWEEN lo AND hi) — projected to the correlation key, the shape
+    Spark plans under the rewritten semi/anti/existence join."""
+    dta = Attrs()
+    dta.define("d_date_sk", "long")
+    dta.define("d_year", "long")
+    dta.define("d_moy", "long")
+    s = scan(table, a, [cust_col, date_col])
+    dt = filt(and_(eq(dta("d_year"), lit(year, "long")),
+                   binop("GreaterThanOrEqual", dta("d_moy"),
+                         lit(moy_lo, "long")),
+                   binop("LessThanOrEqual", dta("d_moy"),
+                         lit(moy_hi, "long"))),
+              scan("date_dim", dta, ["d_date_sk", "d_year", "d_moy"]))
+    j = bhj(s, bcast(dt), [a(date_col)], [dta("d_date_sk")])
+    return project([a(cust_col)], j)
+
+
+def _exists_customer_base(a, moy_lo, moy_hi, anti=False):
+    """customer semi-joined to store_sales activity, then web/catalog
+    activity as ExistenceJoins (q10/q35) or anti-joins (q69), all planned
+    as SortMergeJoins over hash exchanges — Spark's plan for these
+    large-to-large correlations."""
+    for c in ("c_customer_sk", "c_current_cdemo_sk", "c_current_addr_sk"):
+        a.define(c, "long")
+    cu = scan("customer", a,
+              ["c_customer_sk", "c_current_cdemo_sk", "c_current_addr_sk"])
+    ss = _sales_in_window(a, "store_sales", "ss_customer_sk",
+                          "ss_sold_date_sk", moy_lo, moy_hi)
+    left = sorted_exchange(cu, [a("c_customer_sk")])
+    right = sorted_exchange(ss, [a("ss_customer_sk")])
+    j = smj(left, right, [a("c_customer_sk")], [a("ss_customer_sk")],
+            jt="LeftSemi")
+    ws = _sales_in_window(a, "web_sales", "ws_bill_customer_sk",
+                          "ws_sold_date_sk", moy_lo, moy_hi)
+    cs = _sales_in_window(a, "catalog_sales", "cs_bill_customer_sk",
+                          "cs_sold_date_sk", moy_lo, moy_hi)
+    if anti:
+        j = smj(sorted_exchange(j, [a("c_customer_sk")]),
+                sorted_exchange(ws, [a("ws_bill_customer_sk")]),
+                [a("c_customer_sk")], [a("ws_bill_customer_sk")],
+                jt="LeftAnti")
+        j = smj(sorted_exchange(j, [a("c_customer_sk")]),
+                sorted_exchange(cs, [a("cs_bill_customer_sk")]),
+                [a("c_customer_sk")], [a("cs_bill_customer_sk")],
+                jt="LeftAnti")
+        return j, None, None
+    e1, e2 = a.new_id(), a.new_id()
+    j = smj(sorted_exchange(j, [a("c_customer_sk")]),
+            sorted_exchange(ws, [a("ws_bill_customer_sk")]),
+            [a("c_customer_sk")], [a("ws_bill_customer_sk")],
+            jt=existence_join(e1))
+    j = smj(sorted_exchange(j, [a("c_customer_sk")]),
+            sorted_exchange(cs, [a("cs_bill_customer_sk")]),
+            [a("c_customer_sk")], [a("cs_bill_customer_sk")],
+            jt=existence_join(e2))
+    ex1 = a.define_with_id("exists1", "boolean", e1)
+    ex2 = a.define_with_id("exists2", "boolean", e2)
+    return filt(or_(ex1, ex2), j), ex1, ex2
+
+
+def _active_customers_oracle(dfs, moy_lo, moy_hi, anti=False):
+    dd = dfs["date_dim"]
+    dates = set(dd[(dd.d_year == 1999) & (dd.d_moy >= moy_lo)
+                   & (dd.d_moy <= moy_hi)].d_date_sk)
+    ss = dfs["store_sales"]
+    ws = dfs["web_sales"]
+    cs = dfs["catalog_sales"]
+    in_ss = set(ss[ss.ss_sold_date_sk.isin(dates)].ss_customer_sk)
+    in_ws = set(ws[ws.ws_sold_date_sk.isin(dates)].ws_bill_customer_sk)
+    in_cs = set(cs[cs.cs_sold_date_sk.isin(dates)].cs_bill_customer_sk)
+    cu = dfs["customer"]
+    keep = cu.c_customer_sk.isin(in_ss)
+    if anti:
+        keep &= ~cu.c_customer_sk.isin(in_ws) & ~cu.c_customer_sk.isin(in_cs)
+    else:
+        keep &= cu.c_customer_sk.isin(in_ws) | cu.c_customer_sk.isin(in_cs)
+    return cu[keep]
+
+
+@query("q10")
+def q10():
+    """SELECT cd_gender, cd_marital_status, cd_education_status, count(*)
+              cnt1, cd_purchase_estimate, count(*) cnt2, cd_credit_rating,
+              count(*) cnt3, cd_dep_count, count(*) cnt4,
+              cd_dep_employed_count, count(*) cnt5, cd_dep_college_count,
+              count(*) cnt6
+       FROM customer c, customer_address ca, customer_demographics
+       WHERE c.c_current_addr_sk = ca.ca_address_sk
+         AND ca_county IN ('county1','county2','county3','county4','county5')
+         AND cd_demo_sk = c.c_current_cdemo_sk
+         AND EXISTS (SELECT * FROM store_sales, date_dim
+                     WHERE c.c_customer_sk = ss_customer_sk
+                       AND ss_sold_date_sk = d_date_sk AND d_year = 1999
+                       AND d_moy BETWEEN 1 AND 4)
+         AND (EXISTS (SELECT * FROM web_sales, date_dim
+                      WHERE c.c_customer_sk = ws_bill_customer_sk
+                        AND ws_sold_date_sk = d_date_sk AND d_year = 1999
+                        AND d_moy BETWEEN 1 AND 4)
+           OR EXISTS (SELECT * FROM catalog_sales, date_dim
+                      WHERE c.c_customer_sk = cs_bill_customer_sk
+                        AND cs_sold_date_sk = d_date_sk AND d_year = 1999
+                        AND d_moy BETWEEN 1 AND 4))
+       GROUP BY cd_gender, cd_marital_status, cd_education_status,
+                cd_purchase_estimate, cd_credit_rating, cd_dep_count,
+                cd_dep_employed_count, cd_dep_college_count
+       ORDER BY (the grouping columns) LIMIT 100"""
+    a = Attrs()
+    for c, t in [("ss_customer_sk", "long"), ("ss_sold_date_sk", "long"),
+                 ("ws_bill_customer_sk", "long"), ("ws_sold_date_sk", "long"),
+                 ("cs_bill_customer_sk", "long"), ("cs_sold_date_sk", "long"),
+                 ("ca_address_sk", "long"), ("ca_county", "string"),
+                 ("cd_demo_sk", "long"), ("cd_gender", "string"),
+                 ("cd_marital_status", "string"),
+                 ("cd_education_status", "string"),
+                 ("cd_purchase_estimate", "long"),
+                 ("cd_credit_rating", "string"), ("cd_dep_count", "long"),
+                 ("cd_dep_employed_count", "long"),
+                 ("cd_dep_college_count", "long")]:
+        a.define(c, t)
+    counties = ["county1", "county2", "county3", "county4", "county5"]
+    base, _e1, _e2 = _exists_customer_base(a, 1, 4)
+    ca = filt(in_list(a("ca_county"), counties, "string"),
+              scan("customer_address", a, ["ca_address_sk", "ca_county"]))
+    cd = scan("customer_demographics", a,
+              ["cd_demo_sk", "cd_gender", "cd_marital_status",
+               "cd_education_status", "cd_purchase_estimate",
+               "cd_credit_rating", "cd_dep_count", "cd_dep_employed_count",
+               "cd_dep_college_count"])
+    j = bhj(base, bcast(ca), [a("c_current_addr_sk")], [a("ca_address_sk")])
+    j = bhj(j, bcast(cd), [a("c_current_cdemo_sk")], [a("cd_demo_sk")])
+    groups = [a(c) for c in
+              ("cd_gender", "cd_marital_status", "cd_education_status",
+               "cd_purchase_estimate", "cd_credit_rating", "cd_dep_count",
+               "cd_dep_employed_count", "cd_dep_college_count")]
+    rids = [a.new_id() for _ in range(6)]
+    agg = two_stage_agg([g for g in groups],
+                        [("Count", rid, [lit(1, "integer")])
+                         for rid in rids], j)
+    cnts = [a.define_with_id(f"cnt{i + 1}", "long", rid)
+            for i, rid in enumerate(rids)]
+    plan = take_ordered(
+        100, [sort_order(g) for g in groups],
+        [groups[0], groups[1], groups[2], cnts[0], groups[3], cnts[1],
+         groups[4], cnts[2], groups[5], cnts[3], groups[6], cnts[4],
+         groups[7], cnts[5]], agg)
+
+    def oracle(dfs):
+        cu = _active_customers_oracle(dfs, 1, 4)
+        ca = dfs["customer_address"]
+        m = cu.merge(ca[ca.ca_county.isin(counties)],
+                     left_on="c_current_addr_sk", right_on="ca_address_sk")
+        m = m.merge(dfs["customer_demographics"],
+                    left_on="c_current_cdemo_sk", right_on="cd_demo_sk")
+        gcols = ["cd_gender", "cd_marital_status", "cd_education_status",
+                 "cd_purchase_estimate", "cd_credit_rating", "cd_dep_count",
+                 "cd_dep_employed_count", "cd_dep_college_count"]
+        g = m.groupby(gcols, as_index=False).size()
+        g = g.sort_values(gcols, kind="stable").head(100)
+        return [(r.cd_gender, r.cd_marital_status, r.cd_education_status,
+                 r.size, r.cd_purchase_estimate, r.size, r.cd_credit_rating,
+                 r.size, r.cd_dep_count, r.size, r.cd_dep_employed_count,
+                 r.size, r.cd_dep_college_count, r.size)
+                for r in g.itertuples(index=False)]
+
+    return plan, oracle, None, ()
+
+
+@query("q69")
+def q69():
+    """SELECT cd_gender, cd_marital_status, cd_education_status, count(*)
+              cnt1, cd_purchase_estimate, count(*) cnt2, cd_credit_rating,
+              count(*) cnt3
+       FROM customer c, customer_address ca, customer_demographics
+       WHERE c.c_current_addr_sk = ca.ca_address_sk
+         AND ca_state IN ('CA','TX','OH')
+         AND cd_demo_sk = c.c_current_cdemo_sk
+         AND EXISTS (SELECT * FROM store_sales, date_dim
+                     WHERE c.c_customer_sk = ss_customer_sk
+                       AND ss_sold_date_sk = d_date_sk AND d_year = 1999
+                       AND d_moy BETWEEN 1 AND 3)
+         AND NOT EXISTS (SELECT * FROM web_sales, date_dim
+                         WHERE c.c_customer_sk = ws_bill_customer_sk
+                           AND ws_sold_date_sk = d_date_sk AND d_year = 1999
+                           AND d_moy BETWEEN 1 AND 3)
+         AND NOT EXISTS (SELECT * FROM catalog_sales, date_dim
+                         WHERE c.c_customer_sk = cs_bill_customer_sk
+                           AND cs_sold_date_sk = d_date_sk AND d_year = 1999
+                           AND d_moy BETWEEN 1 AND 3)
+       GROUP BY cd_gender, cd_marital_status, cd_education_status,
+                cd_purchase_estimate, cd_credit_rating
+       ORDER BY (the grouping columns) LIMIT 100"""
+    a = Attrs()
+    for c, t in [("ss_customer_sk", "long"), ("ss_sold_date_sk", "long"),
+                 ("ws_bill_customer_sk", "long"), ("ws_sold_date_sk", "long"),
+                 ("cs_bill_customer_sk", "long"), ("cs_sold_date_sk", "long"),
+                 ("ca_address_sk", "long"), ("ca_state", "string"),
+                 ("cd_demo_sk", "long"), ("cd_gender", "string"),
+                 ("cd_marital_status", "string"),
+                 ("cd_education_status", "string"),
+                 ("cd_purchase_estimate", "long"),
+                 ("cd_credit_rating", "string")]:
+        a.define(c, t)
+    base, _, _ = _exists_customer_base(a, 1, 3, anti=True)
+    ca = filt(in_list(a("ca_state"), ["CA", "TX", "OH"], "string"),
+              scan("customer_address", a, ["ca_address_sk", "ca_state"]))
+    cd = scan("customer_demographics", a,
+              ["cd_demo_sk", "cd_gender", "cd_marital_status",
+               "cd_education_status", "cd_purchase_estimate",
+               "cd_credit_rating"])
+    j = bhj(base, bcast(ca), [a("c_current_addr_sk")], [a("ca_address_sk")])
+    j = bhj(j, bcast(cd), [a("c_current_cdemo_sk")], [a("cd_demo_sk")])
+    groups = [a(c) for c in
+              ("cd_gender", "cd_marital_status", "cd_education_status",
+               "cd_purchase_estimate", "cd_credit_rating")]
+    rids = [a.new_id() for _ in range(3)]
+    agg = two_stage_agg([g for g in groups],
+                        [("Count", rid, [lit(1, "integer")])
+                         for rid in rids], j)
+    cnts = [a.define_with_id(f"cnt{i + 1}", "long", rid)
+            for i, rid in enumerate(rids)]
+    plan = take_ordered(
+        100, [sort_order(g) for g in groups],
+        [groups[0], groups[1], groups[2], cnts[0], groups[3], cnts[1],
+         groups[4], cnts[2]], agg)
+
+    def oracle(dfs):
+        cu = _active_customers_oracle(dfs, 1, 3, anti=True)
+        ca = dfs["customer_address"]
+        m = cu.merge(ca[ca.ca_state.isin(["CA", "TX", "OH"])],
+                     left_on="c_current_addr_sk", right_on="ca_address_sk")
+        m = m.merge(dfs["customer_demographics"],
+                    left_on="c_current_cdemo_sk", right_on="cd_demo_sk")
+        gcols = ["cd_gender", "cd_marital_status", "cd_education_status",
+                 "cd_purchase_estimate", "cd_credit_rating"]
+        g = m.groupby(gcols, as_index=False).size()
+        g = g.sort_values(gcols, kind="stable").head(100)
+        return [(r.cd_gender, r.cd_marital_status, r.cd_education_status,
+                 r.size, r.cd_purchase_estimate, r.size, r.cd_credit_rating,
+                 r.size) for r in g.itertuples(index=False)]
+
+    return plan, oracle, None, ()
+
+
+@query("q35")
+def q35():
+    """SELECT ca_state, cd_gender, cd_marital_status, cd_dep_count,
+              count(*) cnt1, avg(cd_dep_count), max(cd_dep_count),
+              sum(cd_dep_count), cd_dep_employed_count, count(*) cnt2,
+              avg(cd_dep_employed_count), max(cd_dep_employed_count),
+              sum(cd_dep_employed_count), cd_dep_college_count, count(*)
+              cnt3, avg(cd_dep_college_count), max(cd_dep_college_count),
+              sum(cd_dep_college_count)
+       FROM customer c, customer_address ca, customer_demographics
+       WHERE c.c_current_addr_sk = ca.ca_address_sk
+         AND cd_demo_sk = c.c_current_cdemo_sk
+         AND EXISTS (store_sales activity, 1999 Q1)
+         AND (EXISTS (web_sales activity) OR EXISTS (catalog_sales
+              activity))
+       GROUP BY ca_state, cd_gender, cd_marital_status, cd_dep_count,
+                cd_dep_employed_count, cd_dep_college_count
+       ORDER BY (the grouping columns) LIMIT 100"""
+    a = Attrs()
+    for c, t in [("ss_customer_sk", "long"), ("ss_sold_date_sk", "long"),
+                 ("ws_bill_customer_sk", "long"), ("ws_sold_date_sk", "long"),
+                 ("cs_bill_customer_sk", "long"), ("cs_sold_date_sk", "long"),
+                 ("ca_address_sk", "long"), ("ca_state", "string"),
+                 ("cd_demo_sk", "long"), ("cd_gender", "string"),
+                 ("cd_marital_status", "string"), ("cd_dep_count", "long"),
+                 ("cd_dep_employed_count", "long"),
+                 ("cd_dep_college_count", "long")]:
+        a.define(c, t)
+    base, _, _ = _exists_customer_base(a, 1, 3)
+    ca = scan("customer_address", a, ["ca_address_sk", "ca_state"])
+    cd = scan("customer_demographics", a,
+              ["cd_demo_sk", "cd_gender", "cd_marital_status",
+               "cd_dep_count", "cd_dep_employed_count",
+               "cd_dep_college_count"])
+    j = bhj(base, bcast(ca), [a("c_current_addr_sk")], [a("ca_address_sk")])
+    j = bhj(j, bcast(cd), [a("c_current_cdemo_sk")], [a("cd_demo_sk")])
+    groups = [a(c) for c in
+              ("ca_state", "cd_gender", "cd_marital_status", "cd_dep_count",
+               "cd_dep_employed_count", "cd_dep_college_count")]
+    dep_cols = ["cd_dep_count", "cd_dep_employed_count",
+                "cd_dep_college_count"]
+    agg_fns = []
+    rid_map = {}
+    for dc in dep_cols:
+        for fn in ("Count", "Average", "Max", "Sum"):
+            rid = a.new_id()
+            rid_map[(dc, fn)] = rid
+            args = [lit(1, "integer")] if fn == "Count" else [a(dc)]
+            agg_fns.append((fn, rid, args))
+    agg = two_stage_agg([g for g in groups], agg_fns, j)
+    outs = []
+    for i, dc in enumerate(dep_cols):
+        outs.append(groups[3 + i])
+        for fn, typ in (("Count", "long"), ("Average", "double"),
+                        ("Max", "long"), ("Sum", "long")):
+            outs.append(a.define_with_id(
+                f"{fn.lower()}_{dc}", typ, rid_map[(dc, fn)]))
+    plan = take_ordered(
+        100, [sort_order(g) for g in groups],
+        [groups[0], groups[1], groups[2]] + outs, agg)
+
+    def oracle(dfs):
+        cu = _active_customers_oracle(dfs, 1, 3)
+        m = cu.merge(dfs["customer_address"], left_on="c_current_addr_sk",
+                     right_on="ca_address_sk")
+        m = m.merge(dfs["customer_demographics"],
+                    left_on="c_current_cdemo_sk", right_on="cd_demo_sk")
+        gcols = ["ca_state", "cd_gender", "cd_marital_status",
+                 "cd_dep_count", "cd_dep_employed_count",
+                 "cd_dep_college_count"]
+        g = m.groupby(gcols, as_index=False).size()
+        g = g.sort_values(gcols, kind="stable").head(100)
+        out = []
+        for r in g.itertuples(index=False):
+            row = [r.ca_state, r.cd_gender, r.cd_marital_status]
+            for dc in ("cd_dep_count", "cd_dep_employed_count",
+                       "cd_dep_college_count"):
+                v = getattr(r, dc)
+                row += [v, r.size, float(v), v, v * r.size]
+            out.append(tuple(row))
+        return out
+
+    def extract(out):
+        d = out.to_pydict()
+        rows = []
+        for vals in zip(*d.values()):
+            rows.append(tuple(float(v) if isinstance(v, float) else v
+                              for v in vals))
+        return rows
+
+    return plan, oracle, extract, ("approx",)
+
+
+# --------------------------------------------------------------------------
+# rank + lag/lead self-join class (q47 store / q57 catalog), SMJ-planned
+# --------------------------------------------------------------------------
+
+
+def _v1_monthly(channel: str):
+    """The q47/q57 "v1" CTE: monthly sums per (item, seller) with
+    avg-over-year and rank-over-time windows. Returns (plan, attrs,
+    part_col_names) — built fresh per reference so the three self-join
+    copies carry distinct exprIds, exactly like Spark's inlined CTE."""
+    a = Attrs()
+    if channel == "store":
+        fact, item_k, date_k, price = ("store_sales", "ss_item_sk",
+                                       "ss_sold_date_sk", "ss_sales_price")
+        seller_k, seller_sk = "ss_store_sk", "s_store_sk"
+        seller_cols = ["s_store_name", "s_company_name"]
+        seller_tbl = "store"
+    else:
+        fact, item_k, date_k, price = ("catalog_sales", "cs_item_sk",
+                                       "cs_sold_date_sk", "cs_sales_price")
+        seller_k, seller_sk = "cs_call_center_sk", "cc_call_center_sk"
+        seller_cols = ["cc_name"]
+        seller_tbl = "call_center"
+    for c, t in [(item_k, "long"), (date_k, "long"), (seller_k, "long"),
+                 (price, "decimal(7,2)"),
+                 ("i_item_sk", "long"), ("i_category", "string"),
+                 ("i_brand", "string"),
+                 ("d_date_sk", "long"), ("d_year", "long"),
+                 ("d_moy", "long"), (seller_sk, "long")]:
+        a.define(c, t)
+    for c in seller_cols:
+        a.define(c, "string")
+    fs = scan(fact, a, [item_k, date_k, seller_k, price])
+    it = scan("item", a, ["i_item_sk", "i_category", "i_brand"])
+    dt = filt(or_(eq(a("d_year"), lit(1999, "long")),
+                  and_(eq(a("d_year"), lit(1998, "long")),
+                       eq(a("d_moy"), lit(12, "long")))),
+              scan("date_dim", a, ["d_date_sk", "d_year", "d_moy"]))
+    sl = scan(seller_tbl, a, [seller_sk] + seller_cols)
+    j = bhj(fs, bcast(it), [a(item_k)], [a("i_item_sk")])
+    j = bhj(j, bcast(dt), [a(date_k)], [a("d_date_sk")])
+    j = bhj(j, bcast(sl), [a(seller_k)], [a(seller_sk)])
+    pcols = ["i_category", "i_brand"] + seller_cols
+    rid = a.new_id()
+    agg = two_stage_agg([a(c) for c in pcols + ["d_year", "d_moy"]],
+                        [("Sum", rid, [a(price)])], j)
+    ssum = a.define_with_id("sum_sales", "decimal(17,2)", rid)
+    # window 1: avg over (partition cols, d_year); window 2: rank over
+    # (partition cols) ordered by (d_year, d_moy). One hash exchange on the
+    # partition cols satisfies both clustered distributions (Spark plans
+    # exactly this: exchange + sort + Window + sort + Window)
+    wid, rkid = a.new_id(), a.new_id()
+    ch = exchange(agg, keys=[a(c) for c in pcols])
+    ch = sort([sort_order(a(c)) for c in pcols + ["d_year"]], ch)
+    win1 = window([_window_agg(a, "Average", ssum, "avg_monthly_sales",
+                               wid)],
+                  [a(c) for c in pcols + ["d_year"]], [], ch)
+    wavg = a.define_with_id("avg_monthly_sales", "decimal(21,6)", wid)
+    ch2 = sort([sort_order(a(c)) for c in pcols + ["d_year", "d_moy"]],
+               win1)
+    win2 = window([window_rank(a, "rn",
+                               [sort_order(a("d_year")),
+                                sort_order(a("d_moy"))], rkid)],
+                  [a(c) for c in pcols],
+                  [sort_order(a("d_year")), sort_order(a("d_moy"))], ch2)
+    a.define_with_id("rn", "integer", rkid)
+    return win2, a, pcols
+
+
+def _deviation_self_join(channel):
+    """q47/q57 body: v1 filtered to the deviating 1999 rows, self-joined
+    with its rank-shifted lag and lead copies."""
+    v1, a, pcols = _v1_monthly(channel)
+    ssum, wavg, rn = a("sum_sales"), a("avg_monthly_sales"), a("rn")
+    f1 = filt(and_(eq(a("d_year"), lit(1999, "long")),
+                   _case_ratio_filter(ssum, wavg, a)), v1)
+    lag, b, _ = _v1_monthly(channel)
+    lead, c, _ = _v1_monthly(channel)
+    lag_p = project([b(col) for col in pcols] + [b("rn"), b("sum_sales")],
+                    lag)
+    lead_p = project([c(col) for col in pcols] + [c("rn"), c("sum_sales")],
+                     lead)
+    lag_keys = [b(col) for col in pcols] + \
+        [binop("Add", b("rn"), lit(1, "integer"))]
+    lead_keys = [c(col) for col in pcols] + \
+        [binop("Subtract", c("rn"), lit(1, "integer"))]
+    main_keys = [a(col) for col in pcols] + [rn]
+    j = smj(sorted_exchange(f1, main_keys),
+            sorted_exchange(lag_p, lag_keys,
+                            orders=[sort_order(k) for k in lag_keys]),
+            main_keys, lag_keys)
+    j = smj(sorted_exchange(j, main_keys),
+            sorted_exchange(lead_p, lead_keys,
+                            orders=[sort_order(k) for k in lead_keys]),
+            main_keys, lead_keys)
+    psum_id, nsum_id = a.new_id(), a.new_id()
+    proj = project(
+        [a(col) for col in pcols] + [a("d_year"), a("d_moy"), wavg, ssum] +
+        [alias(b("sum_sales"), "psum", psum_id),
+         alias(c("sum_sales"), "nsum", nsum_id)], j)
+    a.define_with_id("psum", "decimal(17,2)", psum_id)
+    a.define_with_id("nsum", "decimal(17,2)", nsum_id)
+    order_col = pcols[2]  # s_store_name (q47) / cc_name (q57)
+    plan = take_ordered(
+        100,
+        [sort_order(binop("Subtract", ssum, wavg)),
+         sort_order(a(order_col))], [], proj)
+    return plan, a, pcols
+
+
+def _deviation_oracle(dfs, channel):
+    dd = dfs["date_dim"]
+    dd = dd[(dd.d_year == 1999) | ((dd.d_year == 1998) & (dd.d_moy == 12))]
+    if channel == "store":
+        m = dfs["store_sales"].merge(dfs["item"], left_on="ss_item_sk",
+                                     right_on="i_item_sk")
+        m = m.merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(dfs["store"], left_on="ss_store_sk",
+                    right_on="s_store_sk")
+        pcols = ["i_category", "i_brand", "s_store_name", "s_company_name"]
+        price = "ss_sales_price"
+    else:
+        m = dfs["catalog_sales"].merge(dfs["item"], left_on="cs_item_sk",
+                                       right_on="i_item_sk")
+        m = m.merge(dd, left_on="cs_sold_date_sk", right_on="d_date_sk")
+        m = m.merge(dfs["call_center"], left_on="cs_call_center_sk",
+                    right_on="cc_call_center_sk")
+        pcols = ["i_category", "i_brand", "cc_name"]
+        price = "cs_sales_price"
+    g = m.groupby(pcols + ["d_year", "d_moy"], as_index=False)[price].sum()
+    g["sum_sales"] = g[price].astype(float)
+    g["avg_monthly_sales"] = g.groupby(
+        pcols + ["d_year"]).sum_sales.transform("mean")
+    g = g.sort_values(pcols + ["d_year", "d_moy"], kind="stable")
+    g["rn"] = g.groupby(pcols).cumcount() + 1
+    lag = g[pcols + ["rn", "sum_sales"]].copy()
+    lag["rn"] = lag.rn + 1
+    lag = lag.rename(columns={"sum_sales": "psum"})
+    lead = g[pcols + ["rn", "sum_sales"]].copy()
+    lead["rn"] = lead.rn - 1
+    lead = lead.rename(columns={"sum_sales": "nsum"})
+    v = g[(g.d_year == 1999) & (g.avg_monthly_sales > 0)
+          & ((g.sum_sales - g.avg_monthly_sales).abs()
+             / g.avg_monthly_sales > 0.1)]
+    v = v.merge(lag, on=pcols + ["rn"]).merge(lead, on=pcols + ["rn"])
+    v["delta"] = v.sum_sales - v.avg_monthly_sales
+    v = v.sort_values(["delta", pcols[2]], kind="stable").head(100)
+    return [tuple(list(r[c] for c in pcols) +
+                  [int(r["d_year"]), int(r["d_moy"]),
+                   round(float(r["avg_monthly_sales"]), 4),
+                   round(float(r["sum_sales"]), 2),
+                   round(float(r["psum"]), 2), round(float(r["nsum"]), 2)])
+            for _, r in v.iterrows()]
+
+
+def _deviation_extract(out):
+    d = out.to_pydict()
+    names = list(d)
+    rows = []
+    for vals in zip(*d.values()):
+        row = list(vals)
+        # (pcols..., d_year, d_moy, avg, sum, psum, nsum)
+        k = len(row) - 6
+        fixed = row[:k] + [int(row[k]), int(row[k + 1]),
+                           round(float(row[k + 2]), 4),
+                           round(float(row[k + 3]), 2),
+                           round(float(row[k + 4]), 2),
+                           round(float(row[k + 5]), 2)]
+        rows.append(tuple(fixed))
+    return rows
+
+
+@query("q47")
+def q47():
+    """WITH v1 AS (SELECT i_category, i_brand, s_store_name, s_company_name,
+              d_year, d_moy, sum(ss_sales_price) sum_sales,
+              avg(sum(ss_sales_price)) OVER (PARTITION BY i_category,
+                  i_brand, s_store_name, s_company_name, d_year)
+                  avg_monthly_sales,
+              rank() OVER (PARTITION BY i_category, i_brand, s_store_name,
+                  s_company_name ORDER BY d_year, d_moy) rn
+       FROM item, store_sales, date_dim, store
+       WHERE ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+         AND ss_store_sk = s_store_sk
+         AND (d_year = 1999 OR (d_year = 1998 AND d_moy = 12))
+       GROUP BY i_category, i_brand, s_store_name, s_company_name, d_year,
+                d_moy),
+       v2 AS (SELECT v1.i_category, v1.i_brand, v1.s_store_name,
+              v1.s_company_name, v1.d_year, v1.d_moy, v1.avg_monthly_sales,
+              v1.sum_sales, v1_lag.sum_sales psum, v1_lead.sum_sales nsum
+       FROM v1, v1 v1_lag, v1 v1_lead
+       WHERE v1.i_category = v1_lag.i_category AND ... (4 cols each)
+         AND v1.rn = v1_lag.rn + 1 AND v1.rn = v1_lead.rn - 1)
+       SELECT * FROM v2 WHERE d_year = 1999 AND avg_monthly_sales > 0
+         AND CASE WHEN avg_monthly_sales > 0 THEN abs(sum_sales -
+             avg_monthly_sales) / avg_monthly_sales ELSE null END > 0.1
+       ORDER BY sum_sales - avg_monthly_sales, s_store_name LIMIT 100"""
+    plan, _a, _p = _deviation_self_join("store")
+    return (plan, lambda dfs: _deviation_oracle(dfs, "store"),
+            _deviation_extract, ("approx", "ties"))
+
+
+@query("q57")
+def q57():
+    """The catalog-channel twin of q47: v1 over (i_category, i_brand,
+       cc_name) from catalog_sales x call_center, same avg/rank windows,
+       same lag/lead self-join, ORDER BY sum_sales - avg_monthly_sales,
+       cc_name LIMIT 100."""
+    plan, _a, _p = _deviation_self_join("catalog")
+    return (plan, lambda dfs: _deviation_oracle(dfs, "catalog"),
+            _deviation_extract, ("approx", "ties"))
+
+
+# --------------------------------------------------------------------------
+# UNION class
+# --------------------------------------------------------------------------
+
+
+@query("q33")
+def q33():
+    """WITH ss AS (SELECT i_manufact_id, sum(ss_ext_sales_price) total_sales
+       FROM store_sales, date_dim, customer_address, item
+       WHERE i_manufact_id IN (SELECT i_manufact_id FROM item
+                               WHERE i_category IN ('Electronics'))
+         AND ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+         AND d_year = 1998 AND d_moy = 5 AND ss_addr_sk = ca_address_sk
+         AND ca_gmt_offset = -5.00 GROUP BY i_manufact_id),
+       cs AS (... catalog_sales / cs_bill_addr_sk ...),
+       ws AS (... web_sales / ws_bill_addr_sk ...)
+       SELECT i_manufact_id, sum(total_sales) total_sales
+       FROM (SELECT * FROM ss UNION ALL SELECT * FROM cs
+             UNION ALL SELECT * FROM ws) tmp1
+       GROUP BY i_manufact_id ORDER BY total_sales LIMIT 100"""
+    def channel(fact, item_k, date_k, addr_k, price):
+        a = Attrs()
+        for col, t in [(item_k, "long"), (date_k, "long"), (addr_k, "long"),
+                       (price, "decimal(7,2)"),
+                       ("i_item_sk", "long"), ("i_manufact_id", "long"),
+                       ("d_date_sk", "long"), ("d_year", "long"),
+                       ("d_moy", "long"),
+                       ("ca_address_sk", "long"),
+                       ("ca_gmt_offset", "decimal(5,2)")]:
+            a.define(col, t)
+        fs = scan(fact, a, [item_k, date_k, addr_k, price])
+        it = scan("item", a, ["i_item_sk", "i_manufact_id"])
+        # IN (SELECT i_manufact_id FROM item WHERE i_category IN
+        # ('Electronics')): LeftSemi BHJ against the filtered item copy
+        b = Attrs()
+        b.define("i_manufact_id", "long")
+        b.define("i_category", "string")
+        sub = project([b("i_manufact_id")],
+                      filt(in_list(b("i_category"), ["Electronics"],
+                                   "string"),
+                           scan("item", b, ["i_manufact_id", "i_category"])))
+        dt = filt(and_(eq(a("d_year"), lit(1998, "long")),
+                       eq(a("d_moy"), lit(5, "long"))),
+                  scan("date_dim", a, ["d_date_sk", "d_year", "d_moy"]))
+        ca = filt(eq(a("ca_gmt_offset"), lit("-5.00", "decimal(5,2)")),
+                  scan("customer_address", a,
+                       ["ca_address_sk", "ca_gmt_offset"]))
+        j = bhj(fs, bcast(it), [a(item_k)], [a("i_item_sk")])
+        j = bhj(j, bcast(sub), [a("i_manufact_id")], [b("i_manufact_id")],
+                jt="LeftSemi")
+        j = bhj(j, bcast(dt), [a(date_k)], [a("d_date_sk")])
+        j = bhj(j, bcast(ca), [a(addr_k)], [a("ca_address_sk")])
+        rid = a.new_id()
+        agg = two_stage_agg([a("i_manufact_id")],
+                            [("Sum", rid, [a(price)])], j)
+        return agg, a, rid
+
+    ss_agg, a1, rid1 = channel("store_sales", "ss_item_sk",
+                               "ss_sold_date_sk", "ss_addr_sk",
+                               "ss_ext_sales_price")
+    cs_agg, _a2, _r2 = channel("catalog_sales", "cs_item_sk",
+                               "cs_sold_date_sk", "cs_bill_addr_sk",
+                               "cs_ext_sales_price")
+    ws_agg, _a3, _r3 = channel("web_sales", "ws_item_sk",
+                               "ws_sold_date_sk", "ws_bill_addr_sk",
+                               "ws_ext_sales_price")
+    u = union_all(ss_agg, cs_agg, ws_agg)
+    total1 = a1.define_with_id("total_sales", "decimal(17,2)", rid1)
+    rid = a1.new_id()
+    agg = two_stage_agg([a1("i_manufact_id")],
+                        [("Sum", rid, [total1])], u)
+    total = a1.define_with_id("total_sales_final", "decimal(27,2)", rid)
+    plan = take_ordered(100, [sort_order(total)],
+                        [a1("i_manufact_id"), total], agg)
+
+    def oracle(dfs):
+        import decimal as _dc
+
+        dd = dfs["date_dim"]
+        dd = dd[(dd.d_year == 1998) & (dd.d_moy == 5)]
+        ca = dfs["customer_address"]
+        ca = ca[ca.ca_gmt_offset == _dc.Decimal("-5.00")]
+        it = dfs["item"]
+        manu = set(it[it.i_category == "Electronics"].i_manufact_id)
+        frames = []
+        for fact, item_k, date_k, addr_k, price in (
+                ("store_sales", "ss_item_sk", "ss_sold_date_sk",
+                 "ss_addr_sk", "ss_ext_sales_price"),
+                ("catalog_sales", "cs_item_sk", "cs_sold_date_sk",
+                 "cs_bill_addr_sk", "cs_ext_sales_price"),
+                ("web_sales", "ws_item_sk", "ws_sold_date_sk",
+                 "ws_bill_addr_sk", "ws_ext_sales_price")):
+            m = dfs[fact].merge(it, left_on=item_k, right_on="i_item_sk")
+            m = m[m.i_manufact_id.isin(manu)]
+            m = m.merge(dd, left_on=date_k, right_on="d_date_sk")
+            m = m.merge(ca, left_on=addr_k, right_on="ca_address_sk")
+            g = m.groupby("i_manufact_id", as_index=False)[price].sum()
+            g = g.rename(columns={price: "total_sales"})
+            frames.append(g)
+        allg = pd.concat(frames, ignore_index=True)
+        allg = allg.groupby("i_manufact_id", as_index=False).agg(
+            total=("total_sales", "sum"))
+        allg["total"] = allg.total.astype(float)
+        allg = allg.sort_values(["total", "i_manufact_id"],
+                                kind="stable").head(100)
+        return [(int(r.i_manufact_id), round(r.total, 2))
+                for r in allg.itertuples(index=False)]
+
+    def extract(out):
+        d = out.to_pydict()
+        return [(int(k), round(float(v), 2))
+                for k, v in zip(*list(d.values()))]
+
+    return plan, oracle, extract, ("approx", "ties")
+
+
+_DAYS = ["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday", "Friday",
+         "Saturday"]
+
+
+def _wswscs(tag: str):
+    """The q2 "wswscs" CTE: (web UNION ALL catalog) joined to date_dim,
+    weekly sums pivoted into 7 day-name CASE columns. Fresh exprIds per
+    copy (Spark inlines the CTE twice)."""
+    from tests.tpcds.plans import X
+
+    a = Attrs()
+    for c, t in [("ws_sold_date_sk", "long"),
+                 ("ws_ext_sales_price", "decimal(7,2)"),
+                 ("cs_sold_date_sk", "long"),
+                 ("cs_ext_sales_price", "decimal(7,2)"),
+                 ("d_date_sk", "long"), ("d_week_seq", "long"),
+                 ("d_day_name", "string")]:
+        a.define(c, t)
+    sd1, sp1 = a.new_id(), a.new_id()
+    ws = project([alias(a("ws_sold_date_sk"), "sold_date_sk", sd1),
+                  alias(a("ws_ext_sales_price"), "sales_price", sp1)],
+                 scan("web_sales", a,
+                      ["ws_sold_date_sk", "ws_ext_sales_price"]))
+    sd2, sp2 = a.new_id(), a.new_id()
+    cs = project([alias(a("cs_sold_date_sk"), "sold_date_sk", sd2),
+                  alias(a("cs_ext_sales_price"), "sales_price", sp2)],
+                 scan("catalog_sales", a,
+                      ["cs_sold_date_sk", "cs_ext_sales_price"]))
+    u = union_all(ws, cs)
+    sold_date = a.define_with_id("sold_date_sk", "long", sd1)
+    sales_price = a.define_with_id("sales_price", "decimal(7,2)", sp1)
+    dt = scan("date_dim", a, ["d_date_sk", "d_week_seq", "d_day_name"])
+    j = bhj(u, bcast(dt), [sold_date], [a("d_date_sk")])
+
+    def case_day(day):
+        return [{"class": f"{X}.CaseWhen", "num-children": 3,
+                 "branches": None, "elseValue": None}] + \
+            eq(a("d_day_name"), lit(day, "string")) + \
+            sales_price + lit(None, "decimal(7,2)")
+
+    rids = [a.new_id() for _ in _DAYS]
+    agg = two_stage_agg([a("d_week_seq")],
+                        [("Sum", rid, [case_day(day)])
+                         for rid, day in zip(rids, _DAYS)], j)
+    sums = [a.define_with_id(f"{tag}_{d.lower()[:3]}", "decimal(17,2)", rid)
+            for rid, d in zip(rids, _DAYS)]
+    return agg, a, sums
+
+
+@query("q2")
+def q2():
+    """WITH wscs AS (SELECT ws_sold_date_sk sold_date_sk,
+              ws_ext_sales_price sales_price FROM web_sales
+            UNION ALL SELECT cs_sold_date_sk, cs_ext_sales_price
+            FROM catalog_sales),
+       wswscs AS (SELECT d_week_seq,
+              sum(CASE WHEN d_day_name = 'Sunday' THEN sales_price END)
+                  sun_sales, ... (Monday..Saturday alike)
+       FROM wscs, date_dim WHERE d_date_sk = sold_date_sk
+       GROUP BY d_week_seq)
+       SELECT d_week_seq1, round(sun_sales1/sun_sales2, 2), ... (7 ratios)
+       FROM (SELECT wswscs.d_week_seq d_week_seq1, sun_sales sun_sales1,
+             ... FROM wswscs, date_dim
+             WHERE date_dim.d_week_seq = wswscs.d_week_seq
+               AND d_year = 1998) y,
+            (SELECT wswscs.d_week_seq d_week_seq2, ... d_year = 1999) z
+       WHERE d_week_seq1 = d_week_seq2 - 53
+       ORDER BY d_week_seq1
+       -- (the year qualification is planned as a LeftSemi on d_week_seq:
+       --  the literal inner join against day-level date_dim emits 7
+       --  byte-identical copies of every weekly row)"""
+    y_agg, ya, ysums = _wswscs("y")
+    z_agg, za, zsums = _wswscs("z")
+
+    def year_filter(agg_frag, a, year):
+        b = Attrs()
+        b.define("d_date_sk", "long")
+        b.define("d_week_seq", "long")
+        b.define("d_year", "long")
+        dt = filt(eq(b("d_year"), lit(year, "long")),
+                  scan("date_dim", b, ["d_date_sk", "d_week_seq",
+                                       "d_year"]))
+        # wswscs rows qualified to weeks of the year: semi join on week_seq
+        return bhj(agg_frag, bcast(project([b("d_week_seq")], dt)),
+                   [a("d_week_seq")], [b("d_week_seq")], jt="LeftSemi")
+
+    y = year_filter(y_agg, ya, 1998)
+    z = year_filter(z_agg, za, 1999)
+    j = smj(sorted_exchange(y, [ya("d_week_seq")]),
+            sorted_exchange(z, [binop("Subtract", za("d_week_seq"),
+                                      lit(53, "long"))],
+                            orders=[sort_order(
+                                binop("Subtract", za("d_week_seq"),
+                                      lit(53, "long")))]),
+            [ya("d_week_seq")],
+            [binop("Subtract", za("d_week_seq"), lit(53, "long"))])
+    ratios = []
+    for i, d in enumerate(_DAYS):
+        rid = ya.new_id()
+        ratios.append(alias(
+            sfn("Round", binop("Divide", ysums[i], zsums[i]),
+                lit(2, "integer")),
+            f"r_{d.lower()[:3]}", rid))
+    proj = project([ya("d_week_seq")] + ratios, j)
+    # global ORDER BY: range-partitioned exchange + sort (what Spark plans
+    # for a SortExec with global=true; without it the 4 hash partitions
+    # only sort locally)
+    from tests.tpcds.plans import range_exchange
+
+    plan = sort([sort_order(ya("d_week_seq"))],
+                range_exchange(proj, [sort_order(ya("d_week_seq"))]))
+
+    def oracle(dfs):
+        dd = dfs["date_dim"]
+        ws = dfs["web_sales"][["ws_sold_date_sk", "ws_ext_sales_price"]]
+        cs = dfs["catalog_sales"][["cs_sold_date_sk",
+                                   "cs_ext_sales_price"]]
+        ws.columns = cs.columns = ["sold_date_sk", "sales_price"]
+        u = pd.concat([ws, cs], ignore_index=True)
+        m = u.merge(dd, left_on="sold_date_sk", right_on="d_date_sk")
+        m["sales_price"] = m.sales_price.astype(float)
+        piv = {}
+        for d in _DAYS:
+            piv[d] = m[m.d_day_name == d].groupby(
+                "d_week_seq").sales_price.sum()
+        import pandas as _pd
+
+        wk = _pd.DataFrame(piv)
+        y_weeks = set(dd[dd.d_year == 1998].d_week_seq)
+        z_weeks = set(dd[dd.d_year == 1999].d_week_seq)
+        out = []
+        for w1 in sorted(set(wk.index) & y_weeks):
+            w2 = w1 + 53
+            if w2 not in wk.index or w2 not in z_weeks:
+                continue
+            row = [int(w1)]
+            for d in _DAYS:
+                a_v = wk.loc[w1, d] if d in wk.columns else None
+                b_v = wk.loc[w2, d] if d in wk.columns else None
+                if a_v is None or b_v is None or _pd.isna(a_v) \
+                        or _pd.isna(b_v) or b_v == 0:
+                    row.append(None)
+                else:
+                    row.append(round(a_v / b_v, 2))
+            out.append(tuple(row))
+        return out
+
+    def extract(out):
+        d = out.to_pydict()
+        rows = []
+        for vals in zip(*d.values()):
+            row = [int(vals[0])]
+            for v in vals[1:]:
+                row.append(None if v is None else round(float(v), 2))
+            rows.append(tuple(row))
+        return rows
+
+    return plan, oracle, extract, ("approx",)
