@@ -605,7 +605,10 @@ class SparkPlanConverter:
                (w.frame[1] is not None or w.frame[2] is not None)
                for w in wexprs):
             # the executor resolves RANGE value offsets by searchsorted over
-            # ONE numeric/date/timestamp order key
+            # ONE numeric/date/timestamp order key — the same restriction
+            # Spark's analyzer enforces (a RANGE frame with value offsets
+            # over multiple ORDER BY expressions is an AnalysisException),
+            # so this fallback only fires on wire forms Spark cannot emit
             if len(otrees) != 1:
                 raise UnsupportedNode("RANGE offset frame needs 1 order key")
             key_t = _order_key_type(otrees[0])

@@ -199,8 +199,13 @@ class UnifflePartitionWriter:
     handed to the transport; close() flushes the remainder."""
 
     def __init__(self, transport, app_id: str, shuffle_id: int,
-                 task_attempt_id: int, spill_size: int = 64 * 1024):
+                 task_attempt_id: int, spill_size: int = 64 * 1024,
+                 object_transport=None):
         self.transport = transport  # callable(bytes) -> None
+        # callable(SendShuffleDataRequest) -> None: callers that must
+        # inject fields (a granted require_buffer_id) take the OBJECT and
+        # encode once, instead of decoding + re-encoding every block
+        self.object_transport = object_transport
         self.app_id = app_id
         self.shuffle_id = shuffle_id
         self.manager = UniffleWriteBufferManager(task_attempt_id, spill_size)
@@ -218,7 +223,10 @@ class UnifflePartitionWriter:
         req = SendShuffleDataRequest(
             self.app_id, self.shuffle_id, self._req,
             [ShuffleData(p, bs) for p, bs in sorted(by_pid.items())])
-        self.transport(req.encode())
+        if self.object_transport is not None:
+            self.object_transport(req)
+        else:
+            self.transport(req.encode())
 
     def write(self, partition_id: int, payload: bytes):
         self.partition_lengths[partition_id] = \

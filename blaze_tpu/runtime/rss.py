@@ -590,7 +590,11 @@ class CelebornShuffleClient:
         return reply.partition_locations
 
     def writer_for_map(self, map_id: int,
-                       attempt_id: int = 0) -> CelebornMapWriter:
+                       attempt_id: Optional[int] = None
+                       ) -> CelebornMapWriter:
+        # None lets the writer draw a random attempt id — the retry-dedup
+        # contract (a pinned default of 0 here would tag a failed attempt
+        # and its retry identically, serving both attempts' blocks)
         return CelebornMapWriter(self.client, map_id, attempt_id)
 
     def commit_files(self):
@@ -681,18 +685,19 @@ class UniffleProtoMapWriter:
         self.map_id = map_id
         self.block_ids: Dict[int, List[int]] = defaultdict(list)
         self._writer = UnifflePartitionWriter(
-            self._send, client.app, client.shuffle_id,
-            task_attempt_id=map_id)
+            None, client.app, client.shuffle_id,
+            task_attempt_id=map_id, object_transport=self._send)
 
     def _rpc(self, method: str, payload: bytes) -> bytes:
         reply = self.client._call({"op": "uniffle_rpc", "method": method,
                                    "payload": payload})
         return reply.get("payload", b"")
 
-    def _send(self, encoded_request: bytes):
+    def _send(self, req):
+        """Takes the request OBJECT: the granted buffer id is injected
+        before the single encode (no decode/re-encode of block bytes)."""
         from blaze_tpu.io import uniffle as un
 
-        req = un.SendShuffleDataRequest.decode(encoded_request)
         grant = un.RequireBufferResponse.decode(self._rpc(
             "requireBuffer", un.RequireBufferRequest(
                 sum(b.length for sd in req.shuffle_data

@@ -429,6 +429,15 @@ class Session:
         """Execute the map side (one ShuffleWriter task per child partition)
         — on the process pool when configured, else on driver threads — then
         expose the per-reducer file segments as an IpcReader resource."""
+        if isinstance(node.partitioning, N.SinglePartitioning) and \
+                self.pool is None and node.partitioning.num_partitions == 1:
+            # a single-reducer exchange is a COLLECT: route the child's
+            # batches through in-memory IPC chunks like the broadcast path
+            # instead of shuffle data+index files — every top-k/order-by
+            # query ends with one of these over a few hundred rows, and the
+            # file round trip was pure overhead (Spark's AQE local shuffle
+            # reader makes the same cut)
+            return self._run_single_collect(node)
         num_reducers = node.partitioning.num_partitions
         stage, indexes = self._exec_map_stage(node)
         rid = f"shuffle_{stage}"
@@ -808,34 +817,40 @@ class Session:
                                       *paths_for(m)))
         return [paths_for(m) for m in range(num_maps)] if ok else None
 
-    def _run_broadcast_collect(self, node: N.BroadcastExchange) -> N.PlanNode:
-        """Collect the child via IpcWriter into in-memory chunks and expose
-        them as a single-partition IpcReader readable by every task
-        (reference: NativeBroadcastExchangeBase.relationFuture + Spark
-        TorrentBroadcast of the IPC byte arrays)."""
-        stage = next(self._stage_ids)
-        child_op = build_operator(node.child)
+    def _collect_child_chunks(self, child, stage: int,
+                              prefix: str) -> List[bytes]:
+        """Stream every child partition through IpcWriter into in-memory
+        chunks. RETRY-SAFE: each task attempt writes into its OWN bucket
+        and only a SUCCESSFUL attempt's bucket is committed, so a task
+        that died mid-stream and was retried contributes exactly one
+        attempt's chunks (the file-shuffle path gets the same guarantee
+        from its atomic tmp-file rename)."""
+        child_op = build_operator(child)
         num_maps = child_op.num_partitions()
         chunks: List[bytes] = []
         lock = threading.Lock()
+        where = self._decide_placement(child, f"stage_{stage}")
 
-        class _Consumer:
+        class _Bucket:
+            def __init__(self):
+                self.parts: List[bytes] = []
+
             def write(self, b: bytes):
-                with lock:
-                    chunks.append(b)
-
-        cid = f"broadcast_consumer_{stage}"
-        self.resources[cid] = _Consumer()
-        where = self._decide_placement(node.child, f"stage_{stage}")
+                self.parts.append(b)
 
         def run_map(m: int):
             from blaze_tpu.ops.shuffle.reader import IpcWriterExec
             from blaze_tpu.runtime import placement
-            from blaze_tpu.utils.logutil import clear_task_context, set_task_context
+            from blaze_tpu.utils.logutil import (clear_task_context,
+                                                 set_task_context)
 
+            bucket = _Bucket()
+            cid = f"{prefix}_consumer_{stage}_{m}"
+            self.resources[cid] = bucket  # fresh bucket per ATTEMPT
             writer = IpcWriterExec(child_op, cid)
             ctx = self._make_ctx(m, stage)
-            task_metrics = self.metrics.named_child(f"stage_{stage}").named_child(f"map_{m}")
+            task_metrics = self.metrics.named_child(
+                f"stage_{stage}").named_child(f"map_{m}")
             set_task_context(stage, m)
             try:
                 with placement.placed(where):
@@ -843,8 +858,32 @@ class Session:
                         pass
             finally:
                 clear_task_context()
+            with lock:  # commit: only reached when the attempt succeeded
+                chunks.extend(bucket.parts)
 
         self._run_tasks(run_map, range(num_maps))
+        return chunks
+
+    def _run_single_collect(self, node: N.ShuffleExchange) -> N.PlanNode:
+        """SinglePartitioning exchange without a worker pool: the child's
+        partitions stream through IpcWriter into in-memory chunks served to
+        the one reducer — no files, no index, same batch bytes."""
+        stage = next(self._stage_ids)
+        chunks = self._collect_child_chunks(node.child, stage, "single")
+        rid = f"single_{stage}"
+        self.resources[rid] = BytesBlockProvider(chunks)
+        return N.CoalesceBatches(
+            N.IpcReader(schema=node.child.output_schema, resource_id=rid,
+                        num_partitions=1),
+            batch_size=0)
+
+    def _run_broadcast_collect(self, node: N.BroadcastExchange) -> N.PlanNode:
+        """Collect the child via IpcWriter into in-memory chunks and expose
+        them as a single-partition IpcReader readable by every task
+        (reference: NativeBroadcastExchangeBase.relationFuture + Spark
+        TorrentBroadcast of the IPC byte arrays)."""
+        stage = next(self._stage_ids)
+        chunks = self._collect_child_chunks(node.child, stage, "broadcast")
         rid = f"broadcast_{stage}"
         self.resources[rid] = BytesBlockProvider(chunks)
         return N.IpcReader(schema=node.child.output_schema, resource_id=rid,

@@ -296,7 +296,10 @@ def test_retry_without_explicit_attempt_is_deduped():
         sc = CelebornShuffleClient(client, num_mappers=1, num_partitions=1)
         sc.register()
         w1 = sc.writer_for_map(0)
-        w1.write(0, b"partial-then-died")   # no flush: task failed mid-push
+        # LARGE payload: crosses the merge threshold so it goes on the
+        # wire immediately (a small buffered write never reaches the
+        # server and would mask the dedup check)
+        w1.write(0, b"X" * (64 * 1024))     # pushed, then the task died
         w2 = sc.writer_for_map(0)           # retry, fresh writer
         w2.write(0, b"retry-block")
         w2.flush()

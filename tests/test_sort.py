@@ -93,3 +93,23 @@ def test_external_sort_strings_with_spill():
             SortExec(mem_scan({"s": vals}, num_batches=8), [so("s")]))
     MemManager.reset()
     assert out["s"] == sorted(vals)
+
+
+def test_external_sort_multikey_desc_nulls_with_spill():
+    """Vectorized spilled-run merge (device-key path): multi-column keys,
+    mixed directions, and NULL ordering must match the in-memory sort."""
+    rng = np.random.default_rng(7)
+    n = 30_000
+    a = rng.integers(0, 50, n).astype(object)
+    a[rng.random(n) < 0.05] = None
+    b = rng.integers(-(10**6), 10**6, n).tolist()
+    data = {"a": a.tolist(), "b": b}
+    orders = [so("a", asc=False), so("b")]
+    out_mem = collect_pydict(
+        SortExec(mem_scan(data, num_batches=12), orders))
+    MemManager.reset()
+    with config_override(memory_total=1_500_000, memory_fraction=1.0):
+        out_spill = collect_pydict(
+            SortExec(mem_scan(data, num_batches=12), orders))
+    MemManager.reset()
+    assert out_spill == out_mem
