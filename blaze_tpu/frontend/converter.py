@@ -449,9 +449,17 @@ class SparkPlanConverter:
         return N.Sort(child, self._sort_orders(node, scope)), scope
 
     def _convert_take_ordered_and_project_exec(self, node, kids):
+        """TakeOrderedAndProject is GLOBAL top-k: Spark takes each
+        partition's top-k and merges on the driver. Lower it as local
+        top-k -> single-partition exchange -> final top-k (queries whose
+        full result fits under the limit never exposed the difference;
+        q47/q57-class outputs with > limit qualifying rows do)."""
         child, scope = kids[0]
         limit = int(node.field("limit"))
-        plan = N.Sort(child, self._sort_orders(node, scope), fetch_limit=limit)
+        orders = self._sort_orders(node, scope)
+        plan: N.PlanNode = N.Sort(child, orders, fetch_limit=limit)
+        plan = N.ShuffleExchange(plan, N.SinglePartitioning(1))
+        plan = N.Sort(plan, orders, fetch_limit=limit)
         ptrees = decode_field_trees(node.field("projectList"))
         if ptrees:
             exprs = [convert_expr(t.children[0] if t.name == "Alias" else t,

@@ -21,7 +21,7 @@ import os
 import tempfile
 import threading
 from concurrent.futures import ThreadPoolExecutor
-from typing import Iterator, List, Optional
+from typing import Dict, Iterator, List, Optional
 
 import pyarrow as pa
 
@@ -827,7 +827,7 @@ class Session:
         from its atomic tmp-file rename)."""
         child_op = build_operator(child)
         num_maps = child_op.num_partitions()
-        chunks: List[bytes] = []
+        committed: Dict[int, List[bytes]] = {}
         lock = threading.Lock()
         where = self._decide_placement(child, f"stage_{stage}")
 
@@ -859,10 +859,14 @@ class Session:
             finally:
                 clear_task_context()
             with lock:  # commit: only reached when the attempt succeeded
-                chunks.extend(bucket.parts)
+                committed[m] = bucket.parts
 
         self._run_tasks(run_map, range(num_maps))
-        return chunks
+        # assemble in MAP order, not completion order: downstream top-k
+        # sorts resolve ties positionally, and the file-shuffle path reads
+        # maps in index order — the collect path must be just as
+        # deterministic run to run
+        return [p for m in sorted(committed) for p in committed[m]]
 
     def _run_single_collect(self, node: N.ShuffleExchange) -> N.PlanNode:
         """SinglePartitioning exchange without a worker pool: the child's

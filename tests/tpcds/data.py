@@ -119,6 +119,7 @@ def generate(dirpath: str) -> dict:
                            for v in range(N_STORES)]),
         "s_company_name": pa.array([["Unknown", "ought", "able"][v % 3]
                                     for v in range(N_STORES)]),
+        "s_county": pa.array([f"county{v % 8}" for v in range(N_STORES)]),
         "s_gmt_offset": _dec(rng, N_STORES, -600, -400, prec=5, scale=2),
     }))
 
@@ -151,6 +152,10 @@ def generate(dirpath: str) -> dict:
                                   type=pa.int64()),
         "c_birth_year": pa.array(1930 + (np.arange(N_CUSTOMERS) * 7) % 70,
                                  type=pa.int64()),
+        "c_salutation": pa.array([["Mr.", "Mrs.", "Ms.", "Dr.", "Sir"][v % 5]
+                                  for v in range(N_CUSTOMERS)]),
+        "c_preferred_cust_flag": pa.array([["Y", "N"][v % 2]
+                                           for v in range(N_CUSTOMERS)]),
     }))
 
     write("customer_address", pa.table({
@@ -191,6 +196,9 @@ def generate(dirpath: str) -> dict:
         "hd_demo_sk": pa.array(np.arange(1, N_HDEMO + 1), type=pa.int64()),
         "hd_dep_count": pa.array(np.arange(N_HDEMO) % 10, type=pa.int64()),
         "hd_vehicle_count": pa.array(np.arange(N_HDEMO) % 5, type=pa.int64()),
+        "hd_buy_potential": pa.array(
+            [[">10000", "Unknown", "1001-5000", "501-1000"][v % 4]
+             for v in range(N_HDEMO)]),
     }))
 
     write("promotion", pa.table({

@@ -1442,3 +1442,145 @@ def q2():
         return rows
 
     return plan, oracle, extract, ("approx",)
+
+
+# --------------------------------------------------------------------------
+# HAVING-over-count class (q34/q73): a filter ABOVE the aggregate and a
+# join ABOVE the aggregate — the "dn" derived-table pattern
+# --------------------------------------------------------------------------
+
+
+def _ticket_count_query(dom_ranges, cnt_lo, cnt_hi, vehicle_ratio):
+    """Shared q34/q73 shape: per-ticket counts for qualifying household
+    demographics and days, HAVING cnt BETWEEN lo AND hi, joined to
+    customer above the aggregate."""
+    a = Attrs()
+    for c, t in [("ss_sold_date_sk", "long"), ("ss_store_sk", "long"),
+                 ("ss_hdemo_sk", "long"), ("ss_customer_sk", "long"),
+                 ("ss_ticket_number", "long"),
+                 ("d_date_sk", "long"), ("d_year", "long"), ("d_dom", "long"),
+                 ("s_store_sk", "long"), ("s_county", "string"),
+                 ("hd_demo_sk", "long"), ("hd_buy_potential", "string"),
+                 ("hd_dep_count", "long"), ("hd_vehicle_count", "long"),
+                 ("c_customer_sk", "long"), ("c_salutation", "string"),
+                 ("c_first_name", "string"), ("c_last_name", "string"),
+                 ("c_preferred_cust_flag", "string")]:
+        a.define(c, t)
+    ss = scan("store_sales", a,
+              ["ss_sold_date_sk", "ss_store_sk", "ss_hdemo_sk",
+               "ss_customer_sk", "ss_ticket_number"])
+    # or_ returns its sole argument unchanged for a single range
+    dom_cond = or_(*[and_(binop("GreaterThanOrEqual", a("d_dom"),
+                               lit(lo, "long")),
+                          binop("LessThanOrEqual", a("d_dom"),
+                                lit(hi, "long")))
+                     for lo, hi in dom_ranges])
+    dt = filt(and_(dom_cond,
+                   in_list(a("d_year"), [1998, 1999], "long")),
+              scan("date_dim", a, ["d_date_sk", "d_year", "d_dom"]))
+    st = filt(in_list(a("s_county"),
+                      ["county0", "county1", "county2", "county3"],
+                      "string"),
+              scan("store", a, ["s_store_sk", "s_county"]))
+    # (hd_buy_potential = '>10000' OR 'Unknown') AND vehicle_count > 0 AND
+    # dep/vehicle ratio > threshold — Spark casts the int division to double
+    ratio = binop("Divide",
+                  cast(a("hd_dep_count"), "double"),
+                  cast(a("hd_vehicle_count"), "double"))
+    hd = filt(and_(or_(eq(a("hd_buy_potential"), lit(">10000", "string")),
+                       eq(a("hd_buy_potential"), lit("Unknown", "string"))),
+                   and_(binop("GreaterThan", a("hd_vehicle_count"),
+                              lit(0, "long")),
+                        binop("GreaterThan", ratio,
+                              lit(vehicle_ratio, "double")))),
+              scan("household_demographics", a,
+                   ["hd_demo_sk", "hd_buy_potential", "hd_dep_count",
+                    "hd_vehicle_count"]))
+    j = bhj(ss, bcast(dt), [a("ss_sold_date_sk")], [a("d_date_sk")])
+    j = bhj(j, bcast(st), [a("ss_store_sk")], [a("s_store_sk")])
+    j = bhj(j, bcast(hd), [a("ss_hdemo_sk")], [a("hd_demo_sk")])
+    rid = a.new_id()
+    agg = two_stage_agg([a("ss_ticket_number"), a("ss_customer_sk")],
+                        [("Count", rid, [lit(1, "integer")])], j)
+    cnt = a.define_with_id("cnt", "long", rid)
+    # HAVING: filter above the aggregate
+    having = filt(and_(binop("GreaterThanOrEqual", cnt,
+                             lit(cnt_lo, "long")),
+                       binop("LessThanOrEqual", cnt,
+                             lit(cnt_hi, "long"))), agg)
+    cu = scan("customer", a,
+              ["c_customer_sk", "c_salutation", "c_first_name",
+               "c_last_name", "c_preferred_cust_flag"])
+    j2 = bhj(having, bcast(cu), [a("ss_customer_sk")], [a("c_customer_sk")])
+    plan = take_ordered(
+        100,
+        [sort_order(a("c_last_name")), sort_order(a("c_first_name")),
+         sort_order(a("c_salutation")),
+         sort_order(a("c_preferred_cust_flag"), asc=False),
+         sort_order(a("ss_ticket_number"))],
+        [a("c_last_name"), a("c_first_name"), a("c_salutation"),
+         a("c_preferred_cust_flag"), a("ss_ticket_number"), cnt], j2)
+
+    def oracle(dfs):
+        dd = dfs["date_dim"]
+        keep_dom = None
+        for lo, hi in dom_ranges:
+            m = (dd.d_dom >= lo) & (dd.d_dom <= hi)
+            keep_dom = m if keep_dom is None else (keep_dom | m)
+        dd = dd[keep_dom & dd.d_year.isin([1998, 1999])]
+        st = dfs["store"]
+        hd = dfs["household_demographics"]
+        hd = hd[((hd.hd_buy_potential == ">10000")
+                 | (hd.hd_buy_potential == "Unknown"))
+                & (hd.hd_vehicle_count > 0)]
+        hd = hd[hd.hd_dep_count / hd.hd_vehicle_count > vehicle_ratio]
+        m = dfs["store_sales"].merge(dd, left_on="ss_sold_date_sk",
+                                     right_on="d_date_sk")
+        m = m.merge(st[st.s_county.isin(
+            ["county0", "county1", "county2", "county3"])],
+            left_on="ss_store_sk", right_on="s_store_sk")
+        m = m.merge(hd, left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+        g = m.groupby(["ss_ticket_number", "ss_customer_sk"],
+                      as_index=False).size()
+        g = g[(g["size"] >= cnt_lo) & (g["size"] <= cnt_hi)]
+        g = g.merge(dfs["customer"], left_on="ss_customer_sk",
+                    right_on="c_customer_sk")
+        g = g.sort_values(
+            ["c_last_name", "c_first_name", "c_salutation",
+             "c_preferred_cust_flag", "ss_ticket_number"],
+            ascending=[True, True, True, False, True],
+            kind="stable").head(100)
+        return [(r.c_last_name, r.c_first_name, r.c_salutation,
+                 r.c_preferred_cust_flag, r.ss_ticket_number, r["size"])
+                for _, r in g.iterrows()]
+
+    return plan, oracle, None, ("ties",)
+
+
+@query("q34")
+def q34():
+    """SELECT c_last_name, c_first_name, c_salutation,
+              c_preferred_cust_flag, ss_ticket_number, cnt
+       FROM (SELECT ss_ticket_number, ss_customer_sk, count(*) cnt
+             FROM store_sales, date_dim, store, household_demographics
+             WHERE ss_sold_date_sk = d_date_sk AND ss_store_sk = s_store_sk
+               AND ss_hdemo_sk = hd_demo_sk
+               AND (d_dom BETWEEN 1 AND 3 OR d_dom BETWEEN 25 AND 28)
+               AND (hd_buy_potential = '>10000' OR
+                    hd_buy_potential = 'Unknown')
+               AND hd_vehicle_count > 0
+               AND hd_dep_count / hd_vehicle_count > 1.2
+               AND d_year IN (1998, 1999) AND s_county IN (...)
+             GROUP BY ss_ticket_number, ss_customer_sk) dn, customer
+       WHERE ss_customer_sk = c_customer_sk AND cnt BETWEEN 2 AND 6
+       ORDER BY c_last_name, c_first_name, c_salutation,
+                c_preferred_cust_flag DESC, ss_ticket_number"""
+    return _ticket_count_query([(1, 3), (25, 28)], 2, 6, 1.2)
+
+
+@query("q73")
+def q73():
+    """The q34 twin over a single day-of-month window:
+       d_dom BETWEEN 1 AND 2, ratio > 1.0, cnt BETWEEN 1 AND 5
+       (reference q73 binds 1..2 / 1..5 with its own county list)."""
+    return _ticket_count_query([(1, 2)], 1, 5, 1.0)
