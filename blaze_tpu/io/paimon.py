@@ -431,6 +431,53 @@ class PaimonTable:
         schema_json = self.table_schema(int(snap["schemaId"]))
         return self._commit_append(table, schema_json, base_snapshot=snap)
 
+    def add_column(self, name: str, dtype: T.DataType) -> int:
+        """Schema evolution, Paimon-style: a NEW schema-<id> file plus a
+        snapshot whose commitKind records the change; old data files keep
+        their schemaId and readers null-fill the added column (the scan
+        groups by _FILE._SCHEMA_ID)."""
+        snap = self.snapshot()
+        old = self.table_schema(int(snap["schemaId"]))
+        if any(f["name"] == name for f in old["fields"]):
+            raise ValueError(f"column {name!r} already exists")
+        new_id = int(old["id"]) + 1
+        fields = list(old["fields"]) + [{
+            "id": int(old["highestFieldId"]) + 1, "name": name,
+            "type": type_to_paimon(dtype, nullable=True)}]
+        schema_json = {**old, "id": new_id, "fields": fields,
+                       "highestFieldId": int(old["highestFieldId"]) + 1,
+                       "timeMillis": int(time.time() * 1000)}
+        with FS.open_output(_join(self.root, "schema",
+                                  f"schema-{new_id}")) as f:
+            f.write(json.dumps(schema_json).encode())
+        sid = int(snap["id"]) + 1
+        # a no-data commit: fold the previous base+delta manifests into the
+        # new BASE list and reference an EMPTY delta — deltaRecordCount: 0
+        # must match an empty delta or incremental readers double-count the
+        # previous commit's files
+        base_metas: List[dict] = []
+        for key in ("baseManifestList", "deltaManifestList"):
+            ml = snap.get(key)
+            if not ml:
+                continue
+            with FS.open_input(_join(self.root, "manifest", ml)) as f:
+                base_metas.extend(avro.read_ocf(io.BytesIO(f.read())))
+        base_name = f"manifest-list-{uuid.uuid4().hex}-0.avro"
+        delta_name = f"manifest-list-{uuid.uuid4().hex}-1.avro"
+        for name, metas in ((base_name, base_metas), (delta_name, [])):
+            b = io.BytesIO()
+            avro.write_ocf(b, MANIFEST_LIST_SCHEMA, metas)
+            with FS.open_output(_join(self.root, "manifest", name)) as f:
+                f.write(b.getvalue())
+        new_snap = {**snap, "id": sid, "schemaId": new_id,
+                    "baseManifestList": base_name,
+                    "deltaManifestList": delta_name,
+                    "commitKind": "APPEND", "commitIdentifier": sid,
+                    "deltaRecordCount": 0,
+                    "timeMillis": int(time.time() * 1000)}
+        self._commit_snapshot(sid, new_snap)
+        return sid
+
     def _commit_append(self, table: pa.Table, schema_json: dict,
                        base_snapshot: Optional[dict]) -> int:
         from blaze_tpu.io.laketable import _split_partitions
@@ -508,14 +555,24 @@ class PaimonTable:
             "totalRecordCount": prev_total + delta_rows,
             "deltaRecordCount": delta_rows, "changelogRecordCount": 0,
         }
+        self._commit_snapshot(sid, snap)
+        if base_snapshot is None:
+            with FS.open_output(_join(self.root, "snapshot",
+                                      "EARLIEST")) as f:
+                f.write(str(sid).encode())
+        return sid
+
+    def _commit_snapshot(self, sid: int, snap: dict):
+        """Shared commit tail for EVERY snapshot (appends and schema
+        changes): O_EXCL snapshot create so concurrent committers of the
+        same id conflict instead of silently overwriting each other
+        (Paimon's rename-based commit has the same loser-retries
+        contract), then the LATEST pointer flipped atomically."""
         snap_path = _join(self.root, "snapshot", f"snapshot-{sid}")
         fs, ppath = FS.get_fs(snap_path)
         if fs is None:
             import os
 
-            # O_EXCL create: concurrent committers of the same snapshot id
-            # conflict instead of silently overwriting (Paimon's rename-
-            # based snapshot commit has the same loser-retries contract)
             fd = os.open(ppath, os.O_WRONLY | os.O_CREAT | os.O_EXCL)
             with os.fdopen(fd, "wb") as f:
                 f.write(json.dumps(snap).encode())
@@ -537,8 +594,3 @@ class PaimonTable:
         else:
             with FS.open_output(latest) as f:
                 f.write(str(sid).encode())
-        if base_snapshot is None:
-            with FS.open_output(_join(self.root, "snapshot",
-                                      "EARLIEST")) as f:
-                f.write(str(sid).encode())
-        return sid

@@ -187,3 +187,21 @@ def test_manifest_delete_entries(orders, tmp_path):
     rows = _sorted_rows(out)
     assert (3, 30, "us") in rows and (4, 40, "us") in rows
     assert len(rows) == 2 or all(r[2] != "eu" for r in rows)
+
+
+def test_add_column_schema_evolution(orders):
+    """Paimon-style evolution: new schema-<id> + snapshot; old files keep
+    their schemaId and null-fill the added column on read."""
+    orders.add_column("discount", T.I64)
+    orders.append(pa.table({
+        "id": pa.array([9], type=pa.int64()),
+        "amt": pa.array([90], type=pa.int64()),
+        "region": pa.array(["eu"]),
+        "discount": pa.array([7], type=pa.int64()),
+    }))
+    snap = orders.snapshot()
+    assert snap["schemaId"] == 1 and snap["id"] == 3
+    with Session() as s:
+        out = s.execute_to_pydict(orders.scan_node())
+    rows = sorted(zip(out["id"], out["discount"]), key=lambda r: r[0])
+    assert rows == [(1, None), (2, None), (3, None), (4, None), (9, 7)]
