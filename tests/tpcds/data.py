@@ -75,6 +75,9 @@ def generate(dirpath: str) -> dict:
                                 type=pa.int64()),
         "d_qoy": pa.array((moy - 1) // 3 + 1, type=pa.int64()),
         "d_week_seq": pa.array((sk - 1) // 7 + 5270, type=pa.int64()),
+        "d_date": pa.array([f"{y}-{m:02d}-{d:02d}"
+                            for y, m, d in zip(year.tolist(), moy.tolist(),
+                                               dom.tolist())]),
     }))
 
     cats = ["Books", "Home", "Electronics", "Music", "Sports",

@@ -1584,3 +1584,106 @@ def q73():
        d_dom BETWEEN 1 AND 2, ratio > 1.0, cnt BETWEEN 1 AND 5
        (reference q73 binds 1..2 / 1..5 with its own county list)."""
     return _ticket_count_query([(1, 2)], 1, 5, 1.0)
+
+
+# --------------------------------------------------------------------------
+# INTERSECT class (q38): DISTINCT aggregates + LeftSemi joins
+# --------------------------------------------------------------------------
+
+
+def _distinct_channel_customers(fact, cust_k, date_k):
+    """One q38 leg: SELECT DISTINCT c_last_name, c_first_name, d_date —
+    Spark plans the DISTINCT as a two-stage HashAggregate with NO
+    aggregate expressions. Fresh exprIds per leg (each leg is its own
+    subtree in the executed plan)."""
+    a = Attrs()
+    for c, t in [(cust_k, "long"), (date_k, "long"),
+                 ("d_date_sk", "long"), ("d_month_seq", "long"),
+                 ("d_date", "string"),
+                 ("c_customer_sk", "long"), ("c_first_name", "string"),
+                 ("c_last_name", "string")]:
+        a.define(c, t)
+    fs = scan(fact, a, [cust_k, date_k])
+    dt = filt(and_(binop("GreaterThanOrEqual", a("d_month_seq"),
+                         lit(1176, "long")),
+                   binop("LessThanOrEqual", a("d_month_seq"),
+                         lit(1187, "long"))),
+              scan("date_dim", a, ["d_date_sk", "d_month_seq", "d_date"]))
+    cu = scan("customer", a,
+              ["c_customer_sk", "c_first_name", "c_last_name"])
+    j = bhj(fs, bcast(dt), [a(date_k)], [a("d_date_sk")])
+    j = bhj(j, bcast(cu), [a(cust_k)], [a("c_customer_sk")])
+    groups = [a("c_last_name"), a("c_first_name"), a("d_date")]
+    # DISTINCT = two-stage aggregate with no aggregate expressions
+    return two_stage_agg(groups, [], j), a
+
+
+def _set_op_query(jt: str, reduce_sets):
+    """Shared q38/q87 body: three per-channel DISTINCT legs chained by
+    set-operation joins (INTERSECT -> LeftSemi, EXCEPT -> LeftAnti), then
+    a global count."""
+    ss_leg, a1 = _distinct_channel_customers(
+        "store_sales", "ss_customer_sk", "ss_sold_date_sk")
+    cs_leg, a2 = _distinct_channel_customers(
+        "catalog_sales", "cs_bill_customer_sk", "cs_sold_date_sk")
+    ws_leg, a3 = _distinct_channel_customers(
+        "web_sales", "ws_bill_customer_sk", "ws_sold_date_sk")
+    cols = ("c_last_name", "c_first_name", "d_date")
+    j = smj(sorted_exchange(ss_leg, [a1(c) for c in cols]),
+            sorted_exchange(cs_leg, [a2(c) for c in cols]),
+            [a1(c) for c in cols], [a2(c) for c in cols], jt=jt)
+    j = smj(sorted_exchange(j, [a1(c) for c in cols]),
+            sorted_exchange(ws_leg, [a3(c) for c in cols]),
+            [a1(c) for c in cols], [a3(c) for c in cols], jt=jt)
+    rid = a1.new_id()
+    partial = hash_agg([], [agg_expr("Count", "Partial", rid,
+                                     [lit(1, "integer")])], j)
+    plan = hash_agg([], [agg_expr("Count", "Final", rid,
+                                  [lit(1, "integer")])],
+                    exchange(partial, keys=None))
+
+    def oracle(dfs):
+        dd = dfs["date_dim"]
+        dd = dd[(dd.d_month_seq >= 1176) & (dd.d_month_seq <= 1187)]
+        cu = dfs["customer"]
+
+        def leg(fact, cust_k, date_k):
+            m = dfs[fact].merge(dd, left_on=date_k, right_on="d_date_sk")
+            m = m.merge(cu, left_on=cust_k, right_on="c_customer_sk")
+            return set(zip(m.c_last_name, m.c_first_name, m.d_date))
+
+        ss = leg("store_sales", "ss_customer_sk", "ss_sold_date_sk")
+        cs = leg("catalog_sales", "cs_bill_customer_sk", "cs_sold_date_sk")
+        ws = leg("web_sales", "ws_bill_customer_sk", "ws_sold_date_sk")
+        return [(len(reduce_sets(ss, cs, ws)),)]
+
+    return plan, oracle, None, ()
+
+
+@query("q38")
+def q38():
+    """SELECT count(*) FROM (
+         SELECT DISTINCT c_last_name, c_first_name, d_date
+         FROM store_sales, date_dim, customer
+         WHERE ss_sold_date_sk = d_date_sk
+           AND ss_customer_sk = c_customer_sk
+           AND d_month_seq BETWEEN 1176 AND 1187
+       INTERSECT
+         SELECT DISTINCT ... FROM catalog_sales ...
+       INTERSECT
+         SELECT DISTINCT ... FROM web_sales ...) hot_cust
+       LIMIT 100
+       -- Spark plans each INTERSECT as a LeftSemi join on the three
+       -- distinct columns over the legs' HashAggregates"""
+    return _set_op_query("LeftSemi", lambda ss, cs, ws: ss & cs & ws)
+
+
+@query("q87")
+def q87():
+    """The q38 EXCEPT twin: store-channel distinct customers minus those
+    in catalog, minus those in web — Spark plans each EXCEPT as a
+    LeftAnti join over the legs' DISTINCT HashAggregates.
+       SELECT count(*) FROM ((SELECT DISTINCT c_last_name, c_first_name,
+       d_date FROM store_sales, date_dim, customer WHERE ...)
+       EXCEPT (... catalog_sales ...) EXCEPT (... web_sales ...)) cool_cust"""
+    return _set_op_query("LeftAnti", lambda ss, cs, ws: ss - cs - ws)
