@@ -29,6 +29,10 @@ from blaze_tpu.config import get_config
 from blaze_tpu.ir import types as T
 
 
+# max operands per concat dispatch (see ColumnarBatch.concat)
+_CONCAT_FANIN = 64
+
+
 @functools.lru_cache(maxsize=128)
 def _iota_on(capacity: int, device) -> jax.Array:
     return jnp.arange(capacity)
@@ -561,6 +565,16 @@ class ColumnarBatch:
         if len(batches) == 1:
             return batches[0]
         schema = schema or batches[0].schema
+        # bound the jit fan-in: concatenating thousands of tiny batches in one
+        # traced call unrolls into an HLO whose compile time is quadratic-ish
+        # in the operand count (minutes at ~6k inputs). A two-level tree keeps
+        # every dispatch at <= _CONCAT_FANIN operands, so signatures repeat
+        # and compile once per (fan-in, capacities) shape.
+        while len(batches) > _CONCAT_FANIN:
+            batches = [
+                ColumnarBatch.concat(batches[i:i + _CONCAT_FANIN], schema)
+                for i in range(0, len(batches), _CONCAT_FANIN)
+            ]
         total = sum(b.num_rows for b in batches)
         cap = get_config().capacity_for(total)
         slots = batches[0]._device_slots()

@@ -108,6 +108,104 @@ def slice_planes(datas: Sequence[jax.Array], valids: Sequence[jax.Array],
                      jnp.int64(offset), jnp.int64(length), out_cap=out_cap)
 
 
+def _key_ops_traced(datas, valids, exists, spec):
+    """Traced body shared by the sort-operand and range-partition kernels.
+
+    Emits [rank0, val0, rank1, val1, ...] where rank is a u8 total-order
+    class and val is the native-dtype payload, already direction-adjusted.
+    NaNs are FOLDED into the rank (value zeroed) so plain IEEE compares —
+    not just lax.sort's total-order comparator — see the same ordering:
+      0 = null (nulls first)        1 = NaN under descending
+      2 = valid                     3 = NaN under ascending
+      4 = null (nulls last)         6 = padding row (always last)
+    """
+    ops = []
+    for (ascending, nulls_first), data, validity in zip(spec, datas, valids):
+        validity = validity & exists
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            nan = jnp.isnan(data)
+            val = jnp.where(nan | ~validity, jnp.zeros((), data.dtype), data)
+            if not ascending:
+                val = -val
+            rank = jnp.where(nan, 3 if ascending else 1, 2)
+        elif data.dtype == jnp.bool_:
+            val = data.astype(jnp.uint8)
+            if not ascending:
+                val = jnp.uint8(1) - val
+            val = jnp.where(validity, val, jnp.zeros((), jnp.uint8))
+            rank = 2
+        else:
+            val = data if ascending else ~data
+            val = jnp.where(validity, val, jnp.zeros((), val.dtype))
+            rank = 2
+        rank = jnp.where(validity, rank, 0 if nulls_first else 4)
+        rank = jnp.where(exists, rank, 6).astype(jnp.uint8)
+        ops.append(rank)
+        ops.append(val)
+    return tuple(ops)
+
+
+@functools.partial(jax.jit, static_argnames=("spec",))
+def _key_ops(datas, valids, exists, spec):
+    return _key_ops_traced(datas, valids, exists, spec)
+
+
+def sort_key_operands(datas, valids, exists, spec):
+    """All sort keys of a batch normalized in ONE jitted dispatch (replaces
+    the former per-key eager jnp chain in ops/sort_keys.key_operands). The
+    jit cache is keyed by (pytree structure, shapes, dtypes, spec) — spec is
+    the static per-key (ascending, nulls_first) tuple."""
+    return list(_dispatch(_key_ops, tuple(datas), tuple(valids), exists, spec))
+
+
+def _lex_le_count(ops, bound_ops):
+    """(rows,) count of bounds whose key tuple is <= the row's key tuple —
+    bisect_right over B bounds via a broadcast lt/eq cascade."""
+    nb = bound_ops[0].shape[0]
+    rows = ops[0].shape[0]
+    lt = jnp.zeros((rows, nb), dtype=jnp.bool_)
+    eq = jnp.ones((rows, nb), dtype=jnp.bool_)
+    for o, b in zip(ops, bound_ops):
+        bb = b[None, :]
+        oo = o[:, None]
+        lt |= eq & (bb < oo)
+        eq &= bb == oo
+    return jnp.sum(lt | eq, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("spec",))
+def _range_pids(datas, valids, exists, bound_ops, spec):
+    ops = _key_ops_traced(datas, valids, exists, spec)
+    pid = _lex_le_count(ops, bound_ops).astype(jnp.int32)
+    # padding rows park past the last real partition so a pid-sorted batch
+    # keeps them out of every partition slice
+    return jnp.where(exists, pid, jnp.int32(bound_ops[0].shape[0] + 1))
+
+
+@functools.partial(jax.jit, static_argnames=("spec",))
+def _range_order(datas, valids, exists, bound_ops, spec):
+    pid = _range_pids(datas, valids, exists, bound_ops, spec)
+    iota = jnp.arange(pid.shape[0], dtype=jnp.int32)
+    sorted_pid, order = lax.sort((pid, iota), num_keys=1, is_stable=True)
+    return sorted_pid, order
+
+
+def range_partition_ids(datas, valids, exists, bound_ops, spec):
+    """Row-order partition ids for range partitioning, ONE jitted dispatch:
+    key normalization + device searchsorted against resident bounds."""
+    return _dispatch(_range_pids, tuple(datas), tuple(valids), exists,
+                     tuple(bound_ops), spec)
+
+
+def range_partition_order(datas, valids, exists, bound_ops, spec):
+    """Fused range-exchange split: normalize keys, compute partition ids,
+    and stable-sort rows by pid — all in ONE dispatch. Returns
+    (sorted_pids, order); the caller does one gather by ``order`` and
+    slices contiguous pid runs."""
+    return _dispatch(_range_order, tuple(datas), tuple(valids), exists,
+                     tuple(bound_ops), spec)
+
+
 @jax.jit
 def _concat_gather(datas, valids, idx, live):
     big_d = tuple(jnp.concatenate(parts) for parts in datas)

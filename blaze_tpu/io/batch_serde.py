@@ -22,7 +22,11 @@ from typing import BinaryIO, Iterator, List, Optional
 
 import numpy as np
 import pyarrow as pa
-import zstandard
+
+try:
+    import zstandard
+except ImportError:  # python binding absent: native-lib zstd still serves
+    zstandard = None  # when built, else frames degrade to stdlib zlib
 
 from blaze_tpu.config import get_config
 from blaze_tpu.core.batch import ColumnarBatch, DeviceColumn, HostColumn, pack_bitmap, unpack_bitmap
@@ -164,7 +168,7 @@ def deserialize_batch(payload: bytes) -> ColumnarBatch:
     return ColumnarBatch(schema, cols, n)
 
 
-_FRAME_FMT = "<4sIQQ"  # magic, flags (0=raw, 1=zstd, 2=lz4), compressed len, raw len
+_FRAME_FMT = "<4sIQQ"  # magic, flags (0=raw, 1=zstd, 2=lz4, 3=zlib), compressed len, raw len
 _FRAME_LEN = struct.calcsize(_FRAME_FMT)
 
 
@@ -223,6 +227,19 @@ def _zstd_compress(payload: bytes, level: int) -> bytes:
                                    dst.ctypes.data, bound, level)
             if r > 0:
                 return dst[:r].tobytes()
+    sz = native.system_zstd()
+    if sz is not None:
+        import numpy as np
+
+        src = np.frombuffer(payload, dtype=np.uint8)
+        bound = sz.ZSTD_compressBound(len(payload))
+        dst = np.empty(bound, dtype=np.uint8)
+        r = sz.ZSTD_compress(dst.ctypes.data, bound,
+                             src.ctypes.data, len(payload), level)
+        if not sz.ZSTD_isError(r):
+            return dst[:r].tobytes()
+    if zstandard is None:
+        return None  # caller degrades to the zlib frame flavor
     return zstandard.ZstdCompressor(level=level).compress(payload)
 
 
@@ -239,6 +256,20 @@ def _zstd_decompress(payload: bytes, raw_len: int) -> bytes:
                                  dst.ctypes.data, raw_len)
         if r == raw_len:
             return dst.tobytes()
+    sz = native.system_zstd()
+    if sz is not None and raw_len > 0:
+        import numpy as np
+
+        src = np.frombuffer(payload, dtype=np.uint8)
+        dst = np.empty(raw_len, dtype=np.uint8)
+        r = sz.ZSTD_decompress(dst.ctypes.data, raw_len,
+                               src.ctypes.data, len(payload))
+        if r == raw_len:
+            return dst.tobytes()
+    if zstandard is None:
+        raise RuntimeError(
+            "zstd frame but neither the native lib nor the python "
+            "zstandard binding is available")
     return zstandard.ZstdDecompressor().decompress(payload, max_output_size=raw_len or 0)
 
 
@@ -264,13 +295,23 @@ class BatchWriter:
             if out is not None:
                 payload, flags = out, 2
             else:  # liblz4 missing: degrade to zstd, stay readable
-                payload, flags = _zstd_compress(payload, self.level), 1
+                payload, flags = self._zstd_or_zlib(payload)
         elif self.codec != "none":
-            payload, flags = _zstd_compress(payload, self.level), 1
+            payload, flags = self._zstd_or_zlib(payload)
         frame = struct.pack(_FRAME_FMT, _MAGIC, flags, len(payload), raw_len)
         self.f.write(frame)
         self.f.write(payload)
         self.bytes_written += len(frame) + len(payload)
+
+    def _zstd_or_zlib(self, payload: bytes):
+        """zstd when a backend exists; otherwise stdlib zlib (flag 3) so
+        spill/shuffle streams keep compressing in minimal environments."""
+        out = _zstd_compress(payload, self.level)
+        if out is not None:
+            return out, 1
+        import zlib
+
+        return zlib.compress(payload, 1), 3
 
 
 class BatchReader:
@@ -289,4 +330,8 @@ class BatchReader:
                 payload = _lz4_decompress(payload, raw_len)
             elif flags == 1:
                 payload = _zstd_decompress(payload, raw_len)
+            elif flags == 3:
+                import zlib
+
+                payload = zlib.decompress(payload)
             yield deserialize_batch(payload)

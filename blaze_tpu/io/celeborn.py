@@ -239,7 +239,9 @@ class CelebornPartitionWriter:
 
 from blaze_tpu.io.pbwire import (int_field as _pb_int,  # noqa: E402
                                  len_delim as _pb_len,
+                                 packed_ints as _pb_packed,
                                  read_fields as _pb_fields,
+                                 read_packed_ints as _pb_unpack,
                                  str_field as _pb_str)
 
 RPC_REQUEST = 0
@@ -334,7 +336,10 @@ def _pb_decode(payload: bytes, spec: dict) -> dict:
         elif kind == "bytes":
             out[name] = v
         elif kind == "repeated_int":
-            out[name].append(v)
+            if isinstance(v, int):  # unpacked varint element
+                out[name].append(v)
+            else:  # packed wire-type-2 payload (proto3 default encoding)
+                out[name].extend(_pb_unpack(v))
         elif kind == "repeated_str":
             out[name].append(v.decode("utf-8"))
         elif kind == "repeated_bytes":
@@ -457,10 +462,14 @@ class CommitFiles:
     map_attempts: List[int]
 
     def encode(self) -> bytes:
+        # mapAttempts is a packed repeated int32 carrying RAW attempt
+        # numbers (Celeborn 0.5 PbCommitFiles) — packing also keeps
+        # attempt 0 entries on the wire, which per-element proto3 default
+        # elision used to drop (the old +1/-1 shift worked around that)
         return (_pb_str(1, self.app_id) + _pb_int(2, self.shuffle_id)
                 + b"".join(_pb_len(3, p.encode("utf-8"))
                            for p in self.primary_ids)
-                + b"".join(_pb_int(4, a + 1) for a in self.map_attempts))
+                + _pb_packed(4, self.map_attempts))
 
     @classmethod
     def decode(cls, payload: bytes) -> "CommitFiles":
@@ -469,7 +478,7 @@ class CommitFiles:
                                  3: ("primary_ids", "repeated_str"),
                                  4: ("attempts", "repeated_int")})
         return cls(d["app_id"], d["shuffle_id"], d["primary_ids"],
-                   [a - 1 for a in d["attempts"]])
+                   d["attempts"])
 
 
 @dataclasses.dataclass

@@ -41,6 +41,26 @@ def int_field(field: int, v: int) -> bytes:
     return tag(field, 0) + varint(v)
 
 
+def packed_ints(field: int, values) -> bytes:
+    """Packed repeated scalar encoding (proto3 default for repeated ints):
+    one length-delimited field holding the concatenated varints. An empty
+    list elides the field entirely."""
+    if not values:
+        return b""
+    return len_delim(field, b"".join(varint(v) for v in values))
+
+
+def read_packed_ints(payload: bytes) -> list:
+    """Unpack a wire-type-2 packed repeated-int payload."""
+    buf = memoryview(payload)
+    out = []
+    off = 0
+    while off < len(buf):
+        v, off = read_varint(buf, off)
+        out.append(v)
+    return out
+
+
 def read_varint(buf: memoryview, off: int) -> Tuple[int, int]:
     shift = 0
     v = 0

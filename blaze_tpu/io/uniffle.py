@@ -503,10 +503,15 @@ class GetMemoryShuffleDataResponse:
 # RssUtils.serializeBitMap: Roaring64NavigableMap.serialize writes
 #   boolean signedLongs (1 byte, 0) + int32 BE highCount, then per high:
 #   int32 BE high + a standard 32-bit RoaringBitmap (RoaringFormatSpec).
-# The 32-bit bitmaps here use the no-run cookie with array containers —
-# valid per the spec for the cardinalities block ids produce.
+# The 32-bit bitmaps use the no-run cookie; per the spec a container with
+# cardinality <= 4096 is a sorted uint16 array, above that it MUST be an
+# 8192-byte bitset (1024 little-endian uint64 words) — a real reader
+# dispatches on the cardinality, so writing oversized array containers
+# would be misparsed.
 
 _SERIAL_COOKIE_NO_RUN = 12346
+_ARRAY_CONTAINER_MAX = 4096
+_BITSET_CONTAINER_BYTES = 8192
 
 
 def _roaring32_serialize(values: List[int]) -> bytes:
@@ -522,9 +527,18 @@ def _roaring32_serialize(values: List[int]) -> bytes:
     off = 8 + 4 * len(by_key) + 4 * len(by_key)
     for key in sorted(by_key):
         out += struct.pack("<I", off)
-        off += 2 * len(by_key[key])
+        off += (_BITSET_CONTAINER_BYTES
+                if len(by_key[key]) > _ARRAY_CONTAINER_MAX
+                else 2 * len(by_key[key]))
     for key in sorted(by_key):
-        out += b"".join(struct.pack("<H", lo) for lo in by_key[key])
+        lows = by_key[key]
+        if len(lows) > _ARRAY_CONTAINER_MAX:
+            bits = bytearray(_BITSET_CONTAINER_BYTES)
+            for lo in lows:
+                bits[lo >> 3] |= 1 << (lo & 7)
+            out += bytes(bits)
+        else:
+            out += b"".join(struct.pack("<H", lo) for lo in lows)
     return out
 
 
@@ -542,10 +556,21 @@ def _roaring32_deserialize(buf: memoryview, off: int
     off += 4 * size  # offsets (containers follow contiguously anyway)
     values = []
     for key, card in keys:
-        for _ in range(card):
-            (lo,) = struct.unpack_from("<H", buf, off)
-            off += 2
-            values.append((key << 16) | lo)
+        if card > _ARRAY_CONTAINER_MAX:  # bitset container
+            end = off + _BITSET_CONTAINER_BYTES
+            base = key << 16
+            for byte_i, b in enumerate(bytes(buf[off:end])):
+                while b:
+                    low_bit = b & -b
+                    values.append(base | (byte_i << 3)
+                                  | low_bit.bit_length() - 1)
+                    b ^= low_bit
+            off = end
+        else:
+            for _ in range(card):
+                (lo,) = struct.unpack_from("<H", buf, off)
+                off += 2
+                values.append((key << 16) | lo)
     return values, off
 
 

@@ -77,6 +77,8 @@ class IpcReaderExec(Operator):
                 batch = item
                 if batch.schema.names != self.schema.names:
                     batch = batch.rename(self.schema.names)
+                metrics.add("ipc_read_batches", 1)
+                metrics.add("ipc_read_rows", batch.num_rows)
                 yield batch
         finally:
             stop.set()

@@ -28,6 +28,11 @@ from blaze_tpu.ops.shuffle.repartitioner import Repartitioner, create_repartitio
 from blaze_tpu.runtime.memmgr import MemConsumer, SpillFile
 
 
+# rows to accumulate before a bucketize pass (writer-side small-batch
+# coalescing); large scan batches pass through untouched
+_COALESCE_MIN_ROWS = 32768
+
+
 class _PartitionStreams:
     """In-memory per-partition frame buffers."""
 
@@ -93,10 +98,33 @@ class _WriterState(MemConsumer):
         self.streams = _PartitionStreams(self.n, ctx.conf.shuffle_compression_codec)
         # spills: list of (SpillFile-backed raw file, per-partition (off, len))
         self.spills = []
+        # small-batch coalescing: aggregations and joins can emit thousands
+        # of few-row batches; splitting/serializing each one costs a hash +
+        # gather + frame per batch. Buffer until a worthwhile row count.
+        self._pending: List[ColumnarBatch] = []
+        self._pending_rows = 0
+        self._coalesce_min = min(ctx.conf.batch_size, _COALESCE_MIN_ROWS)
 
     def insert(self, batch: ColumnarBatch):
+        self._pending.append(batch)
+        self._pending_rows += batch.num_rows
+        if self._pending_rows >= self._coalesce_min:
+            self.flush_pending()
+
+    def flush_pending(self):
+        if not self._pending:
+            return
+        batch = self._pending[0] if len(self._pending) == 1 else \
+            ColumnarBatch.concat(self._pending)
+        self._pending = []
+        self._pending_rows = 0
+        b0, g0 = self.repart.split_batches, self.repart.split_gathers
         for pid, sub in self.repart.bucketize_host(batch):
             self.streams.write(pid, sub)
+        # hot-path invariant surfaced for soak/tests: one row gather per
+        # split batch, never a per-partition take loop
+        self.metrics.add("split_batches", self.repart.split_batches - b0)
+        self.metrics.add("split_gathers", self.repart.split_gathers - g0)
         self.update_mem_used(self.streams.nbytes)
 
     def spill(self) -> int:
@@ -118,6 +146,12 @@ class _WriterState(MemConsumer):
         return freed
 
     def finish(self):
+        """Merge in-memory + spilled per-partition segments into the final
+        data file (see below)."""
+        self.flush_pending()
+        self._finish_files()
+
+    def _finish_files(self):
         """Merge in-memory + spilled per-partition segments into the final
         data file (partition-major) and write the offset index. BOTH files
         publish via per-attempt unique tmp paths + atomic os.replace:
@@ -179,12 +213,32 @@ class RssShuffleWriterExec(Operator):
         if callable(writer):
             writer = writer(partition)
         codec = ctx.conf.shuffle_compression_codec
+        coalesce_min = min(ctx.conf.batch_size, _COALESCE_MIN_ROWS)
+        pending: List[ColumnarBatch] = []
+        pending_rows = 0
+
+        def _push(batch):
+            b0, g0 = repart.split_batches, repart.split_gathers
+            for pid, sub in repart.bucketize_host(batch):
+                buf = io.BytesIO()
+                BatchWriter(buf, codec=codec).write_batch(sub)
+                writer.write(pid, buf.getvalue())
+            metrics.add("split_batches", repart.split_batches - b0)
+            metrics.add("split_gathers", repart.split_gathers - g0)
+
         for batch in self.execute_child(0, partition, ctx, metrics):
             with metrics.timer("elapsed_compute"):
-                for pid, sub in repart.bucketize_host(batch):
-                    buf = io.BytesIO()
-                    BatchWriter(buf, codec=codec).write_batch(sub)
-                    writer.write(pid, buf.getvalue())
+                pending.append(batch)
+                pending_rows += batch.num_rows
+                if pending_rows >= coalesce_min:
+                    _push(pending[0] if len(pending) == 1 else
+                          ColumnarBatch.concat(pending))
+                    pending = []
+                    pending_rows = 0
+        if pending:
+            with metrics.timer("elapsed_compute"):
+                _push(pending[0] if len(pending) == 1 else
+                      ColumnarBatch.concat(pending))
         writer.flush()
         return
         yield  # pragma: no cover

@@ -121,13 +121,18 @@ class _SortState(MemConsumer):
         if not self.staged:
             return 0
         freed = self.staged_bytes
-        run = self._sorted_run()
         if self.device:
             # squeeze normalized keys into the spilled run so the merge
             # phase never re-evaluates sort keys (reference: squeezed key
-            # blocks in sort_exec.rs); u64 keys store order-preserving as
-            # i64 via a sign-bit flip (host-side numpy — no device bitcasts)
-            run = _append_key_columns(run, SK.merge_keys_matrix(run, self.op.sort_orders))
+            # blocks in sort_exec.rs); the packed matrix derives from the
+            # very operands the run was sorted with (one expression
+            # evaluation per run, zero re-derivation at merge time); u64
+            # keys store order-preserving as i64 via a sign-bit flip
+            # (host-side numpy — no device bitcasts)
+            run, keys = self._sorted_run_with_keys()
+            run = _append_key_columns(run, keys)
+        else:
+            run = self._sorted_run()
         spill = SpillFile("sort")
         with self.metrics.timer("spill_io_time"):
             spill.writer.write_batch(run)
@@ -141,6 +146,18 @@ class _SortState(MemConsumer):
     def _sorted_run(self) -> ColumnarBatch:
         merged = ColumnarBatch.concat(self.staged, self.op.schema)
         return sort_batch(merged, self.op.sort_orders)
+
+    def _sorted_run_with_keys(self) -> Tuple[ColumnarBatch, np.ndarray]:
+        """Sorted run + its (n, 2k) uint64 merge-key matrix, computed from
+        one operand kernel dispatch (device key path only)."""
+        merged = ColumnarBatch.concat(self.staged, self.op.schema)
+        operands = SK.key_operands(merged, self.op.sort_orders)
+        if merged.num_rows <= 1:
+            idx = np.arange(merged.num_rows, dtype=np.int64)
+            return merged, SK.operands_merge_matrix(operands, idx)
+        idx = np.asarray(_device_sort_indices(operands, merged.capacity))
+        idx = idx[: merged.num_rows].astype(np.int64)
+        return merged.take(idx), SK.operands_merge_matrix(operands, idx)
 
     def output(self) -> Iterator[ColumnarBatch]:
         batch_size = self.ctx.conf.batch_size
@@ -157,19 +174,24 @@ class _SortState(MemConsumer):
         yield from self._merge_runs(batch_size)
 
     def _merge_runs(self, batch_size: int):
-        """K-way merge of sorted spilled runs (reference: loser-tree
-        merge). Device-sortable keys ride the squeezed (n, k) i64 key
-        matrix, which admits a VECTORIZED chunk merge (numpy lexsort over
-        safe-to-emit prefixes) instead of a per-row Python heap — the heap
-        walk was ~1000x slower at 10M-row volume (SOAK_r05). Host-compared
-        types keep the row heap."""
+        """K-way merge of sorted spilled runs (reference: loser-tree merge).
+        The vectorized chunk merge over squeezed (n, 2k) i64 key matrices is
+        THE merge path for device-sortable keys (numpy lexsort over
+        safe-to-emit prefixes; the per-row heap walk it replaced was ~1000x
+        slower at 10M-row volume, SOAK_r05). Only var-width (host-compared)
+        keys fall back to the row heap."""
         if self.device:
             yield from self._merge_runs_vectorized(batch_size)
-            return
+        else:
+            yield from self._merge_runs_heap(batch_size)
+
+    def _merge_runs_heap(self, batch_size: int):
+        """Fallback per-row heap merge for var-width keys (python-comparable
+        key tuples; no u64 normalization exists for these)."""
         cursors = []
         for rid, run in enumerate(self.runs):
             it = iter(run.read_batches())
-            cur = _RunCursor(rid, it, self.device, self.op.sort_orders)
+            cur = _RunCursor(rid, it, self.op.sort_orders)
             if cur.advance_batch():
                 cursors.append(cur)
         heap = [(c.key(), c.rid, c) for c in cursors]
@@ -346,12 +368,14 @@ def _strip_key_columns(batch: ColumnarBatch):
 
 
 class _RunCursor:
-    __slots__ = ("rid", "it", "device", "orders", "batch", "keys", "pos")
+    """Host-key cursor for the heap merge fallback (var-width keys only —
+    device-sortable keys always ride _VecCursor)."""
 
-    def __init__(self, rid, it, device, orders):
+    __slots__ = ("rid", "it", "orders", "batch", "keys", "pos")
+
+    def __init__(self, rid, it, orders):
         self.rid = rid
         self.it = it
-        self.device = device
         self.orders = orders
         self.batch = None
         self.keys = None
@@ -361,15 +385,8 @@ class _RunCursor:
         for b in self.it:
             if b.num_rows == 0:
                 continue
-            if self.device:
-                self.batch, keys = _strip_key_columns(b)
-                if keys is None:  # legacy run without squeezed keys
-                    keys = (SK.merge_keys_matrix(self.batch, self.orders)
-                            ^ np.uint64(1 << 63)).view(np.int64)
-                self.keys = [tuple(r) for r in keys]
-            else:
-                self.batch = b
-                self.keys = SK.host_keys_matrix(b, self.orders)
+            self.batch = b
+            self.keys = SK.host_keys_matrix(b, self.orders)
             self.pos = 0
             return True
         return False

@@ -18,7 +18,7 @@ include var-width columns run fully on host via arrow ``sort_indices``
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -42,40 +42,30 @@ def supports_device_sort(schema: T.Schema, sort_orders: List[E.SortOrder]) -> bo
 # ---------------------------------------------------------------------------
 
 
+def key_spec(sort_orders: List[E.SortOrder]) -> tuple:
+    """Static per-key spec keying the jit cache of the operand kernel."""
+    return tuple((so.ascending, so.nulls_first) for so in sort_orders)
+
+
 def key_operands(batch: ColumnarBatch, sort_orders: List[E.SortOrder],
                  evaluator: Optional[ExprEvaluator] = None) -> List[jnp.ndarray]:
-    """Build lax.sort operands [null_rank0, val0, null_rank1, val1, ...];
-    padding rows sort last."""
+    """Build lax.sort operands [rank0, val0, rank1, val1, ...]; padding rows
+    sort last. Normalization of ALL keys runs as one jitted device kernel
+    (core/kernels.sort_key_operands) whose cache is keyed by shapes/dtypes +
+    the static (ascending, nulls_first) spec; NaNs fold into the u8 rank so
+    the operands also order correctly under plain IEEE comparisons (the
+    range-partition kernel reuses them)."""
+    from blaze_tpu.core import kernels as K
+
     ev = evaluator or ExprEvaluator([so.child for so in sort_orders], batch.schema)
     cols = [ev._to_dev(ev._eval(so.child, batch), batch) for so in sort_orders]
-    exists = batch.row_exists_mask()
-    operands = []
-    for so, v in zip(sort_orders, cols):
+    datas, valids = [], []
+    for v in cols:
         data, validity = _broadcast(v, batch)
-        validity = validity & exists
-        if jnp.issubdtype(data.dtype, jnp.floating):
-            canonical = jnp.array(float("nan"), data.dtype)
-            val = jnp.where(jnp.isnan(data), canonical, data)
-            if not so.ascending:
-                val = -val
-            val = jnp.where(validity, val, jnp.zeros((), data.dtype))
-        elif data.dtype == jnp.bool_:
-            val = data.astype(jnp.uint8)
-            if not so.ascending:
-                val = jnp.uint8(1) - val
-            val = jnp.where(validity, val, jnp.zeros((), jnp.uint8))
-        else:
-            val = data
-            if not so.ascending:
-                val = ~val
-            val = jnp.where(validity, val, jnp.zeros((), val.dtype))
-        # null rank: 0 = nulls first, 2 = nulls last; valid rows rank 1;
-        # padding rows rank 3 (always last)
-        null_rank = jnp.where(validity, 1, 0 if so.nulls_first else 2)
-        null_rank = jnp.where(exists, null_rank, 3).astype(jnp.uint8)
-        operands.append(null_rank)
-        operands.append(val)
-    return operands
+        datas.append(data)
+        valids.append(validity)
+    return K.sort_key_operands(datas, valids, batch.row_exists_mask(),
+                               key_spec(sort_orders))
 
 
 # ---------------------------------------------------------------------------
@@ -103,15 +93,57 @@ def _orderable_u64_np(data: np.ndarray, validity: np.ndarray) -> np.ndarray:
     return v.view(np.uint64) ^ np.uint64(1 << 63)
 
 
-def merge_keys_matrix(batch: ColumnarBatch, sort_orders: List[E.SortOrder]) -> np.ndarray:
-    """(n, 2k) uint64 matrix whose row tuples compare in sort order."""
-    ev = ExprEvaluator([so.child for so in sort_orders], batch.schema)
-    cols = ev.evaluate(batch)
-    n = batch.num_rows
+def _orderable_bits_np(val: np.ndarray) -> np.ndarray:
+    """uint64 image of an already direction-adjusted, NaN-free operand value
+    plane (ints stay signed-comparable; floats use the sign-flip trick)."""
+    if val.dtype == np.float64:
+        bits = val.view(np.int64)
+        u = bits.view(np.uint64)
+        return np.where(bits >= 0, u | np.uint64(1 << 63), ~u)
+    if val.dtype == np.float32:
+        bits = val.view(np.int32)
+        u = bits.view(np.uint32).astype(np.uint64)
+        return np.where(bits >= 0, u | np.uint64(1 << 31), (~u) & np.uint64(0xFFFFFFFF))
+    if val.dtype == np.bool_ or val.dtype == np.uint8:
+        return val.astype(np.uint64)
+    return val.astype(np.int64).view(np.uint64) ^ np.uint64(1 << 63)
+
+
+def operands_merge_matrix(operands: List, indices: np.ndarray) -> np.ndarray:
+    """(len(indices), 2k) uint64 merge-key matrix derived straight from the
+    device sort operands — the spill path reuses the operands it just sorted
+    with instead of re-evaluating key expressions on the sorted run. Ranks
+    and values are already direction/null/NaN-normalized, so each pair maps
+    to (rank u64, orderable bits)."""
     mats = []
-    for so, c in zip(sort_orders, cols):
-        data = np.asarray(c.data[:n])
-        validity = np.asarray(c.validity[:n])
+    for j in range(0, len(operands), 2):
+        rank = np.asarray(operands[j])[indices].astype(np.uint64)
+        val = np.asarray(operands[j + 1])[indices]
+        mats.append(rank)
+        mats.append(_orderable_bits_np(val))
+    return (np.stack(mats, axis=1) if mats
+            else np.zeros((len(indices), 0), np.uint64))
+
+
+def pack_key_rows(mat_u64: np.ndarray) -> np.ndarray:
+    """(n, w) uint64 matrix -> (n,) fixed-width big-endian byte rows whose
+    memcmp order equals the row-tuple order, so ONE np.searchsorted replaces
+    a per-row python bisect (numpy's S-dtype compare strips trailing NULs,
+    which never reorders equal-width buffers — NUL is the smallest byte)."""
+    n, w = mat_u64.shape
+    if w == 0:
+        return np.zeros(n, dtype="S1")
+    be = np.ascontiguousarray(mat_u64.astype(">u8"))
+    return be.view(f"S{8 * w}").ravel()
+
+
+def planes_merge_matrix(planes: List[Tuple[np.ndarray, np.ndarray]],
+                        sort_orders: List[E.SortOrder]) -> np.ndarray:
+    """(n, 2k) uint64 matrix over already-host (data, validity) key planes;
+    row tuples compare in sort order."""
+    n = len(planes[0][0]) if planes else 0
+    mats = []
+    for so, (data, validity) in zip(sort_orders, planes):
         key = _orderable_u64_np(data, validity)
         if not so.ascending:
             key = ~key
@@ -120,6 +152,15 @@ def merge_keys_matrix(batch: ColumnarBatch, sort_orders: List[E.SortOrder]) -> n
         mats.append(rank)
         mats.append(key)
     return np.stack(mats, axis=1) if mats else np.zeros((n, 0), np.uint64)
+
+
+def merge_keys_matrix(batch: ColumnarBatch, sort_orders: List[E.SortOrder]) -> np.ndarray:
+    """(n, 2k) uint64 matrix whose row tuples compare in sort order."""
+    ev = ExprEvaluator([so.child for so in sort_orders], batch.schema)
+    cols = ev.evaluate(batch)
+    n = batch.num_rows
+    planes = [(np.asarray(c.data[:n]), np.asarray(c.validity[:n])) for c in cols]
+    return planes_merge_matrix(planes, sort_orders)
 
 
 def host_sort_indices(batch: ColumnarBatch, sort_orders: List[E.SortOrder],

@@ -62,6 +62,7 @@ class MemManager:
         self.total_spilled_bytes = 0
         self.spill_count = 0
         self.wait_count = 0
+        self.peak_used = 0  # high-water mark across all consumers
         self.wait_timeout_s = wait_timeout_s if wait_timeout_s is not None \
             else get_config().mem_wait_timeout_s
 
@@ -136,6 +137,8 @@ class MemManager:
             action = "none"
             with self._cv:
                 consumer.mem_used = new_used
+                self.peak_used = max(self.peak_used,
+                                     sum(c.mem_used for c in self.consumers))
                 if consumer.spill_requested and consumer.spillable:
                     action = "spill"
                 elif self.used > self.total and growing:

@@ -656,6 +656,31 @@ class CelebornWriterFactory:
         return self.shuffle_client.writer_for_map(map_id)
 
 
+# Uniffle blockIds embed a 21-bit taskAttemptId; the real client packs it
+# as (taskIndex << maxFailureBits) | attemptNumber so a retried map attempt
+# mints NEW blockIds. Mirror that with a per-(app, shuffle, map) attempt
+# counter — a writer reusing the bare map_id would let a retry collide
+# blockIds with its failed predecessor and confuse bitmap-side dedup.
+_UNIFFLE_ATTEMPT_BITS = 3
+_uniffle_attempts: Dict[Tuple[str, int, int], int] = {}
+_uniffle_attempts_mu = threading.Lock()
+
+
+def next_uniffle_task_attempt_id(app: str, shuffle_id: int, map_id: int) -> int:
+    with _uniffle_attempts_mu:
+        attempt = _uniffle_attempts.get((app, shuffle_id, map_id), 0)
+        _uniffle_attempts[(app, shuffle_id, map_id)] = attempt + 1
+    if attempt >= (1 << _UNIFFLE_ATTEMPT_BITS):
+        raise ValueError(
+            f"map {map_id} exceeded {1 << _UNIFFLE_ATTEMPT_BITS} attempts: "
+            "taskAttemptId bits exhausted")
+    taid = (map_id << _UNIFFLE_ATTEMPT_BITS) | attempt
+    if taid >= (1 << 21):
+        raise ValueError(f"taskAttemptId {taid} overflows the 21-bit "
+                         f"blockId field (map_id {map_id})")
+    return taid
+
+
 class UniffleMapWriter(_ProtocolMapWriter):
     """RssMapWriter twin over the Uniffle block protocol: pushes
     SendShuffleDataRequest protobufs (io/uniffle.py) with crc'd,
@@ -666,9 +691,11 @@ class UniffleMapWriter(_ProtocolMapWriter):
     def _make_writer(self):
         from blaze_tpu.io.uniffle import UnifflePartitionWriter
 
+        self.task_attempt_id = next_uniffle_task_attempt_id(
+            self.client.app, self.client.shuffle_id, self.map_id)
         return UnifflePartitionWriter(
             self._send, self.client.app, self.client.shuffle_id,
-            task_attempt_id=self.map_id)
+            task_attempt_id=self.task_attempt_id)
 
 
 class UniffleProtoMapWriter:
@@ -683,10 +710,12 @@ class UniffleProtoMapWriter:
 
         self.client = client
         self.map_id = map_id
+        self.task_attempt_id = next_uniffle_task_attempt_id(
+            client.app, client.shuffle_id, map_id)
         self.block_ids: Dict[int, List[int]] = defaultdict(list)
         self._writer = UnifflePartitionWriter(
             None, client.app, client.shuffle_id,
-            task_attempt_id=map_id, object_transport=self._send)
+            task_attempt_id=self.task_attempt_id, object_transport=self._send)
 
     def _rpc(self, method: str, payload: bytes) -> bytes:
         reply = self.client._call({"op": "uniffle_rpc", "method": method,
@@ -718,7 +747,7 @@ class UniffleProtoMapWriter:
 
         self._writer.close(success=True)
         self._rpc("reportShuffleResult", un.ReportShuffleResultRequest(
-            self.client.app, self.client.shuffle_id, self.map_id, 1,
+            self.client.app, self.client.shuffle_id, self.task_attempt_id, 1,
             [un.PartitionToBlockIds(p, ids)
              for p, ids in sorted(self.block_ids.items())]).encode())
 

@@ -281,6 +281,12 @@ class Session:
         finally:
             self._dist_ok = prev_dist_ok
             self._zip_ok = prev_zip_ok
+        if isinstance(node, N.Sort) and \
+                isinstance(node.child, N.CoalesceBatches):
+            # Sort stages its whole input and concatenates once at output
+            # time — a reducer-input coalesce below it gathers the same rows
+            # twice for nothing (a full-fact global sort pays seconds here)
+            node = dataclasses.replace(node, child=node.child.child)
         if isinstance(node, N.ShuffleExchange):
             if isinstance(node.partitioning, N.RangePartitioning) and \
                     not node.partitioning.bounds and \
@@ -861,7 +867,16 @@ class Session:
             with lock:  # commit: only reached when the attempt succeeded
                 committed[m] = bucket.parts
 
-        self._run_tasks(run_map, range(num_maps))
+        try:
+            self._run_tasks(run_map, range(num_maps))
+        finally:
+            # drop every attempt's consumer bucket from the resource map
+            # (success or failure): the buckets hold whole map outputs, and
+            # a long session leaks them otherwise — committed chunks live on
+            # in ``committed``
+            for rid in [r for r in self.resources
+                        if r.startswith(f"{prefix}_consumer_{stage}_")]:
+                self.resources.pop(rid, None)
         # assemble in MAP order, not completion order: downstream top-k
         # sorts resolve ties positionally, and the file-shuffle path reads
         # maps in index order — the collect path must be just as

@@ -59,10 +59,26 @@ def build(quiet: bool = True) -> bool:
     bld = os.path.join(src, f"build-tmp-{os.getpid()}")
     try:
         kw = dict(capture_output=quiet, cwd=_REPO_ROOT, timeout=300)
-        subprocess.run(["cmake", "-S", src, "-B", bld, "-DCMAKE_BUILD_TYPE=Release"],
-                       check=True, **kw)
-        subprocess.run(["cmake", "--build", bld, "--", "-j2"], check=True, **kw)
         built = os.path.join(bld, "libblaze_native.so")
+        if shutil.which("cmake"):
+            subprocess.run(["cmake", "-S", src, "-B", bld,
+                            "-DCMAKE_BUILD_TYPE=Release"], check=True, **kw)
+            subprocess.run(["cmake", "--build", bld, "--", "-j2"], check=True, **kw)
+        elif shutil.which("g++"):
+            # no cmake in the image: drive the compiler directly. zstd links
+            # only when its headers exist (the shared lib alone is served via
+            # system_zstd from python); lz4 dlopens at runtime regardless.
+            os.makedirs(bld, exist_ok=True)
+            cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared",
+                   "-fvisibility=hidden",
+                   os.path.join(src, "src", "blaze_native.cc"), "-o", built,
+                   "-ldl"]
+            if os.path.exists("/usr/include/zstd.h"):
+                cmd[1:1] = ["-DHAVE_ZSTD=1"]
+                cmd.append("-lzstd")
+            subprocess.run(cmd, check=True, **kw)
+        else:
+            return False
         if not os.path.exists(built):
             return False
         os.makedirs(os.path.dirname(_SO_PATH), exist_ok=True)
@@ -74,6 +90,44 @@ def build(quiet: bool = True) -> bool:
         return False
     finally:
         shutil.rmtree(bld, ignore_errors=True)
+
+
+_sys_zstd: Optional[ctypes.CDLL] = None
+_sys_zstd_tried = False
+
+
+def system_zstd() -> Optional[ctypes.CDLL]:
+    """Bind the system libzstd's one-shot API (ZSTD_compress/ZSTD_decompress)
+    directly. Serves compression when neither the repo's native library nor
+    the python ``zstandard`` binding is available — the image often ships the
+    shared library without headers or bindings."""
+    global _sys_zstd, _sys_zstd_tried
+    if _sys_zstd_tried:
+        return _sys_zstd
+    with _lock:
+        if _sys_zstd_tried:
+            return _sys_zstd
+        _sys_zstd_tried = True
+        import ctypes.util
+
+        name = ctypes.util.find_library("zstd") or "libzstd.so.1"
+        try:
+            l = ctypes.CDLL(name)
+            l.ZSTD_compressBound.restype = ctypes.c_size_t
+            l.ZSTD_compressBound.argtypes = [ctypes.c_size_t]
+            l.ZSTD_compress.restype = ctypes.c_size_t
+            l.ZSTD_compress.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
+                                        ctypes.c_void_p, ctypes.c_size_t,
+                                        ctypes.c_int]
+            l.ZSTD_decompress.restype = ctypes.c_size_t
+            l.ZSTD_decompress.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
+                                          ctypes.c_void_p, ctypes.c_size_t]
+            l.ZSTD_isError.restype = ctypes.c_uint
+            l.ZSTD_isError.argtypes = [ctypes.c_size_t]
+            _sys_zstd = l
+        except (OSError, AttributeError):
+            _sys_zstd = None
+        return _sys_zstd
 
 
 def lib() -> Optional[ctypes.CDLL]:

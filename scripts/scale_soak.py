@@ -103,18 +103,25 @@ def main():
                 spills = sess.metrics.total("spill_count")
                 spill_bytes = sess.metrics.total("spilled_bytes")
                 streamed = sess.metrics.total("streamed_partitions")
+                split_batches = sess.metrics.total("split_batches")
+                split_gathers = sess.metrics.total("split_gathers")
+            mgr = MemManager._instance
+            peak_used = int(mgr.peak_used) if mgr is not None else 0
             wall = time.perf_counter() - t0
             check_fn(table, oracles[name])  # correctness AT SCALE
             out["shapes"][name] = {
                 "wall_s": round(wall, 1), "spill_count": int(spills),
                 "spilled_bytes": int(spill_bytes),
                 "streamed_window_partitions": int(streamed),
+                "split_batches": int(split_batches),
+                "split_gathers": int(split_gathers),
+                "peak_mem_used": peak_used,
                 "peak_rss_mb": peak_rss_mb(),
             }
             print(json.dumps({name: out["shapes"][name]}), flush=True)
 
     soak_path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "SOAK_r05.json")
+        os.path.abspath(__file__))), "SOAK_r06.json")
     if "tpcds" not in os.environ.get("SOAK_PHASES", "shapes,tpcds"):
         out["peak_rss_mb"] = peak_rss_mb()
         # keep a previous run's tpcds section (phase-scoped reruns merge)
@@ -184,7 +191,7 @@ def main():
     out["peak_rss_mb"] = peak_rss_mb()
     print(json.dumps(out))
     with open(os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "SOAK_r05.json"), "w") as f:
+            os.path.abspath(__file__))), "SOAK_r06.json"), "w") as f:
         json.dump(out, f, indent=1)
 
 

@@ -56,6 +56,7 @@ def test_final_agg_basic():
     assert out["a"] == [30.0, 20.0]
 
 
+@pytest.mark.quick
 def test_partial_then_final_two_stage():
     data = {
         "k": pa.array(["x", "y", "x", None], type=pa.string()),

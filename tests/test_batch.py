@@ -19,6 +19,7 @@ def make_batch():
     return ColumnarBatch.from_arrow(tbl)
 
 
+@pytest.mark.quick
 def test_roundtrip():
     b = make_batch()
     assert b.num_rows == 4

@@ -41,6 +41,7 @@ def test_push_merged_data_golden_bytes():
     assert frame == struct.pack(">q", 8 + len(want)) + want
 
 
+@pytest.mark.quick
 def test_round_trip_both_frames():
     f1 = cb.decode_frame(cb.encode_push_data(
         42, "myapp-12", "99-1", b"\x00\x01payload", mode=cb.MODE_REPLICA))

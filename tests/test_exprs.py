@@ -27,6 +27,7 @@ def lit(v, t):
     return E.Literal(v, t)
 
 
+@pytest.mark.quick
 def test_arith_nulls():
     out = run(
         [E.BinaryExpr(E.BinaryOp.ADD, col("a"), col("b")),

@@ -80,6 +80,7 @@ def test_basic_join_types(make_op, jt):
 
 @pytest.mark.parametrize("make_op", [HashJoinExec, SortMergeJoinExec],
                          ids=["hash", "smj"])
+@pytest.mark.quick
 def test_semi_anti(make_op):
     got = run_join(make_op, JoinType.LEFT_SEMI)
     assert got == normalize([(2, "b"), (2, "c"), (3, "d")])

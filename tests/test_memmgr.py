@@ -4,6 +4,7 @@ Reference: ``memmgr/mod.rs:301-457`` — producers block on a condvar with
 timeout while over-share peers spill; ``window_exec.rs`` buffering under the
 memory manager's watch."""
 
+import pytest
 import threading
 import time
 
@@ -111,6 +112,7 @@ def test_shrinking_update_never_blocks():
     assert me.spilled == 0
 
 
+@pytest.mark.quick
 def test_over_share_caller_spills_immediately():
     mgr = MemManager(total=1000, wait_timeout_s=5.0)
     a = _Spillable("a")

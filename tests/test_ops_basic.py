@@ -1,3 +1,4 @@
+import pytest
 import numpy as np
 import pyarrow as pa
 
@@ -42,6 +43,7 @@ def test_filter():
     assert out == {"a": [5, 7], "s": ["y", "z"]}
 
 
+@pytest.mark.quick
 def test_filter_project_fusion():
     scan = mem_scan({"a": pa.array([1, 5], type=pa.int64())})
     op = FilterExec(

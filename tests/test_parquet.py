@@ -28,6 +28,7 @@ def pq_file(tmp_path):
     return path, tbl
 
 
+@pytest.mark.quick
 def test_scan_roundtrip(pq_file):
     path, tbl = pq_file
     node = scan_node_for_files([path])

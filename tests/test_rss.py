@@ -54,6 +54,7 @@ def test_rss_shuffle_equals_file_shuffle(rss_server, q01_files):
     assert len(got["store"]) > 0
 
 
+@pytest.mark.quick
 def test_duplicate_attempt_blocks_deduped(rss_server):
     """A retried map task's pushes are invisible: only the first committed
     attempt's blocks serve fetches."""

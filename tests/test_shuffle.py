@@ -69,6 +69,7 @@ def test_bucketize_preserves_rows():
         assert (pids == pid).all()
 
 
+@pytest.mark.quick
 def test_shuffle_write_read_roundtrip(tmp_path):
     rng = np.random.default_rng(1)
     n = 5000

@@ -35,6 +35,7 @@ def test_shuffle_block_golden_bytes():
     assert un.ShuffleBlock.decode(enc) == b
 
 
+@pytest.mark.quick
 def test_send_shuffle_data_request_round_trip():
     blocks = [un.ShuffleBlock(un.pack_block_id(i, 2, 4), 4, 4,
                               un.crc32(b"dat" + bytes([i])),
