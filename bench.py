@@ -420,9 +420,24 @@ def roofline_model(name: str) -> dict:
 def run_engine(paths, plan_fn=plan_q01):
     from blaze_tpu.runtime.session import Session
 
+    # BLAZE_TPU_PROFILE_DIR=<dir>: record spans during the engine run and
+    # dump trace+metrics artifacts there (Perfetto-loadable; obs/dump.py)
+    profile_dir = os.environ.get("BLAZE_TPU_PROFILE_DIR", "")
+    conf = None
+    if profile_dir:
+        import dataclasses as _dc
+
+        from blaze_tpu.config import get_config
+
+        conf = _dc.replace(get_config(), trace_enable=True)
     t0 = time.perf_counter()
-    with Session() as sess:
+    with Session(conf=conf) as sess:
         out = sess.execute_to_table(plan_fn(paths))
+        if profile_dir:
+            from blaze_tpu.obs import TRACER, dump_profile
+
+            dump_profile(sess, profile_dir, plan_fn.__name__)
+            TRACER.reset()
     return time.perf_counter() - t0, out
 
 

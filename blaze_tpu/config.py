@@ -122,6 +122,17 @@ class Config:
     # wire-framed (reference: AuronCelebornShuffleManager).
     rss_protocol: str = "native"
 
+    # Span tracing (obs/tracer.py): record Chrome-trace events for
+    # query/stage/task/operator/spill/shuffle-fetch/kernel spans, served at
+    # /debug/trace (Perfetto-loadable) and dumped by scripts/profile_query.py.
+    # Off by default: every recording site is behind one bool check, so the
+    # disabled path stays near-free (guarded by test_tracing.py's <5%
+    # overhead test). BLAZE_TPU_TRACE=1 force-enables.
+    trace_enable: bool = False
+    # Event-buffer cap: beyond it new events are counted as dropped, not
+    # stored (bounds tracer memory during soaks).
+    trace_max_events: int = 1_000_000
+
     # Number of host worker threads for IO/decode and task overlap
     # (reference: tokio worker threads conf). On the tunneled-TPU backend
     # threads mostly overlap device round trips, not CPU.

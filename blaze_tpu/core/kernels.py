@@ -25,12 +25,37 @@ from jax import lax
 def _dispatch(fn, *args, **kw):
     """Run one jitted kernel dispatch under the device-residency clock
     (utils/device.DEVICE_STATS; on an async backend this times dispatch, on
-    the CPU backend it approximates execution)."""
+    the CPU backend it approximates execution). With tracing enabled each
+    dispatch is a "kernel" span; a dispatch that grew the jit cache (i.e. a
+    fresh trace+compile) is labelled jit_compile instead — compile storms
+    show up as wide blocks in the Perfetto timeline."""
+    from blaze_tpu.obs.tracer import TRACER
     from blaze_tpu.utils.device import DEVICE_STATS
 
+    trace = TRACER.enabled
+    cache0 = -1
+    if trace:
+        try:
+            cache0 = fn._cache_size()
+        except Exception:
+            cache0 = -1
     t0 = time.perf_counter()
     out = fn(*args, **kw)
-    DEVICE_STATS.add_kernel(time.perf_counter() - t0)
+    dt = time.perf_counter() - t0
+    DEVICE_STATS.add_kernel(dt)
+    if trace:
+        name = getattr(fn, "__name__", None) or \
+            getattr(getattr(fn, "__wrapped__", None), "__name__", "kernel")
+        compiled = False
+        if cache0 >= 0:
+            try:
+                compiled = fn._cache_size() > cache0
+            except Exception:
+                compiled = False
+        now = time.perf_counter_ns()
+        TRACER.complete("jit_compile:" + name if compiled else name,
+                        "kernel", now - int(dt * 1e9), int(dt * 1e9),
+                        {"compiled": compiled})
     return out
 
 

@@ -1,0 +1,14 @@
+"""Observability: span tracing (Chrome trace events) + EXPLAIN ANALYZE
+rendering. See ``obs/tracer.py`` and ``obs/explain.py``."""
+
+from blaze_tpu.obs.dump import dump_profile
+from blaze_tpu.obs.explain import (fmt_bytes, fmt_ns, humanize_metrics_dict,
+                                   merge_partition_metrics, op_shape,
+                                   render_explain_analyze)
+from blaze_tpu.obs.tracer import TRACER, Tracer, configure_from, get_tracer
+
+__all__ = [
+    "TRACER", "Tracer", "configure_from", "get_tracer",
+    "fmt_ns", "fmt_bytes", "humanize_metrics_dict", "op_shape",
+    "merge_partition_metrics", "render_explain_analyze", "dump_profile",
+]

@@ -1,0 +1,45 @@
+"""Profile artifact dumping: trace JSON + metrics snapshot (+ explain text).
+
+One helper shared by ``scripts/profile_query.py``, ``scripts/scale_soak.py``
+and ``bench.py`` (env-gated there) so every entry point writes the same
+artifact layout:
+
+- ``<tag>_trace.json``    — Chrome trace events; load in https://ui.perfetto.dev
+- ``<tag>_metrics.json``  — the session metric tree with humanized durations
+- ``<tag>_explain.txt``   — EXPLAIN ANALYZE text (when provided)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from blaze_tpu.obs.explain import humanize_metrics_dict
+from blaze_tpu.obs.tracer import TRACER
+
+
+def dump_profile(session, out_dir: str, tag: str,
+                 explain_text: Optional[str] = None) -> dict:
+    """Write the current trace buffer + session metrics (and optional
+    explain output) under ``out_dir``; returns {artifact: path}."""
+    os.makedirs(out_dir, exist_ok=True)
+    paths = {}
+
+    trace_path = os.path.join(out_dir, f"{tag}_trace.json")
+    with open(trace_path, "w") as f:
+        json.dump(TRACER.to_chrome_trace(f"blaze_tpu {tag}"), f)
+    paths["trace"] = trace_path
+
+    metrics_path = os.path.join(out_dir, f"{tag}_metrics.json")
+    with open(metrics_path, "w") as f:
+        json.dump(humanize_metrics_dict(session.metrics.to_dict()), f,
+                  indent=2)
+    paths["metrics"] = metrics_path
+
+    if explain_text is not None:
+        explain_path = os.path.join(out_dir, f"{tag}_explain.txt")
+        with open(explain_path, "w") as f:
+            f.write(explain_text + "\n")
+        paths["explain"] = explain_path
+    return paths

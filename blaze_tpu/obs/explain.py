@@ -1,0 +1,161 @@
+"""EXPLAIN ANALYZE rendering + duration formatting.
+
+DataFusion's ``EXPLAIN ANALYZE`` prints the physical tree with per-operator
+``metrics=[output_rows=…, elapsed_compute=…]``; the reference engine gets the
+same picture by mirroring its native metric tree into the Spark UI per node.
+Here :func:`render_explain_analyze` walks the *operator shape* (name tree)
+positionally against the task metric trees (which mirror it by construction:
+``Operator.execute_child(i)`` writes into ``metrics.child(i)``), merging all
+partitions/tasks of a stage into one annotated tree.
+
+Time metrics follow the ``*_time_ns`` suffix convention and render as
+human-readable durations (:func:`fmt_ns`).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from blaze_tpu.runtime.metrics import MetricNode
+
+# metrics rendered inline with dedicated labels (everything else *_time_ns
+# renders generically, counters render raw)
+_PRIMARY = ("output_rows", "output_batches", "elapsed_compute_time_ns")
+
+
+def fmt_ns(ns: int) -> str:
+    """Human duration from nanoseconds: 1.23s / 45.6ms / 7.8us / 90ns."""
+    ns = int(ns)
+    if ns >= 1_000_000_000:
+        return f"{ns / 1e9:.2f}s"
+    if ns >= 1_000_000:
+        return f"{ns / 1e6:.1f}ms"
+    if ns >= 1_000:
+        return f"{ns / 1e3:.1f}us"
+    return f"{ns}ns"
+
+
+def fmt_bytes(n: int) -> str:
+    n = int(n)
+    for unit, div in (("GB", 1 << 30), ("MB", 1 << 20), ("KB", 1 << 10)):
+        if n >= div:
+            return f"{n / div:.1f}{unit}"
+    return f"{n}B"
+
+
+def humanize_metrics_dict(d: dict) -> dict:
+    """Recursively annotate a ``MetricNode.to_dict()`` tree: every
+    ``*_time_ns`` value gains a rendered sibling under ``durations`` so
+    ``/debug/metrics`` shows 12.3ms instead of raw nanosecond integers."""
+    values = d.get("values") or {}
+    out = {"name": d.get("name"), "values": values}
+    durations = {k: fmt_ns(v) for k, v in values.items()
+                 if k.endswith("_time_ns")}
+    if durations:
+        out["durations"] = durations
+    out["children"] = [humanize_metrics_dict(c) for c in d.get("children") or []]
+    return out
+
+
+# -- operator shapes ----------------------------------------------------------
+
+
+def op_shape(op) -> Tuple[str, list]:
+    """Lightweight ``(name, [child shapes])`` mirror of an operator tree —
+    what the session records per stage so explain can label the positional
+    metric tree without keeping operators (or plans) alive."""
+    return (op.name, [op_shape(c) for c in op.children])
+
+
+def merge_partition_metrics(parts: List[MetricNode]) -> MetricNode:
+    """Fold per-partition/task metric trees (identical positional shape)
+    into one aggregate tree, keeping the first real node name seen."""
+    merged = MetricNode("merged")
+
+    def fold(dst: MetricNode, src_dict: dict):
+        # adopt the first REAL operator name (auto-created placeholder names
+        # embed a "." path prefix; executed nodes carry bare class names)
+        name = src_dict.get("name") or ""
+        if name and "." not in name and \
+                ("." in dst.name or dst.name == "merged"):
+            dst.name = name
+        for k, v in (src_dict.get("values") or {}).items():
+            dst.add(k, v)
+        for i, c in enumerate(src_dict.get("children") or []):
+            fold(dst.child(i), c)
+
+    for p in parts:
+        fold(merged, p.to_dict())
+    return merged
+
+
+def _node_line(name: str, node: Optional[MetricNode]) -> str:
+    if node is None:
+        return f"{name}  [not executed]"
+    values = dict(node.values)
+    rows = values.pop("output_rows", 0)
+    batches = values.pop("output_batches", 0)
+    elapsed = values.pop("elapsed_compute_time_ns", 0)
+    parts = [f"rows={rows}", f"batches={batches}",
+             f"elapsed_compute={fmt_ns(elapsed)}"]
+    spill_count = values.pop("spill_count", 0)
+    spill_bytes = values.pop("spilled_bytes", 0)
+    spill_time = values.pop("spill_io_time_ns", 0)
+    if spill_count:
+        parts.append(f"spill[count={spill_count} bytes={fmt_bytes(spill_bytes)}"
+                     f" time={fmt_ns(spill_time)}]")
+    mem_spills = values.pop("mem_spill_count", 0)
+    mem_spill_size = values.pop("mem_spill_size", 0)
+    mem_spill_time = values.pop("mem_spill_time_ns", 0)
+    if mem_spills:
+        parts.append(f"mem_spill[count={mem_spills}"
+                     f" size={fmt_bytes(mem_spill_size)}"
+                     f" time={fmt_ns(mem_spill_time)}]")
+    for k in sorted(values):
+        v = values[k]
+        parts.append(f"{k[:-8]}={fmt_ns(v)}" if k.endswith("_time_ns")
+                     else f"{k}={v}")
+    return f"{name}  " + " ".join(parts)
+
+
+def render_annotated_tree(shape: Tuple[str, list],
+                          metrics: Optional[MetricNode],
+                          indent: int = 0) -> List[str]:
+    name, children = shape
+    pad = "  " * indent
+    lines = [pad + _node_line(name, metrics)]
+    for i, child in enumerate(children):
+        child_metrics = None
+        if metrics is not None and i < len(metrics.children):
+            child_metrics = metrics.children[i]
+        lines.extend(render_annotated_tree(child, child_metrics, indent + 1))
+    return lines
+
+
+def render_explain_analyze(query: dict, session_metrics: MetricNode) -> str:
+    """Render one executed query (the record ``Session.execute`` keeps in
+    ``session._last_query``) as an EXPLAIN ANALYZE text block: the result
+    stage tree first, then each exchange stage it ran, all annotated."""
+    lines = [
+        f"== Query {query['id']}: wall {fmt_ns(int(query['wall_s'] * 1e9))},"
+        f" {query['rows']} rows out,"
+        f" {query['nparts']} result partition(s) ==",
+    ]
+    result_parts = [session_metrics.get_named(k)
+                    for k in query["result_keys"]]
+    result_parts = [p for p in result_parts if p is not None]
+    merged = merge_partition_metrics(result_parts) if result_parts else None
+    lines.extend(render_annotated_tree(query["shape"], merged))
+    for stage in query["stages"]:
+        sid = stage["id"]
+        lines.append(f"-- Stage {sid} [{stage['kind']}]"
+                     f" ({stage['num_tasks']} task(s)) --")
+        stage_node = session_metrics.get_named(f"stage_{sid}")
+        task_parts = []
+        if stage_node is not None:
+            task_parts = [stage_node.get_named(f"map_{m}")
+                          for m in range(stage["num_tasks"])]
+            task_parts = [p for p in task_parts if p is not None]
+        merged = merge_partition_metrics(task_parts) if task_parts else None
+        lines.extend(render_annotated_tree(stage["shape"], merged))
+    return "\n".join(lines)

@@ -1,0 +1,206 @@
+"""Chrome-trace-event span recorder (Perfetto-loadable).
+
+The reference engine's tunability hinges on per-operator time attribution
+pushed into the Spark UI (PAPER.md §metrics); Flare-style native engines add
+timelines on top. Here a process-global :class:`Tracer` collects *complete*
+trace events (``"ph": "X"``) for query / stage / task / operator / spill /
+shuffle-fetch / kernel-dispatch work, serializable as Chrome trace JSON that
+``chrome://tracing`` and https://ui.perfetto.dev load directly.
+
+Design constraints:
+
+- **Near-zero overhead when disabled** (the default): every recording site
+  checks the single ``TRACER.enabled`` bool; ``span()`` returns a shared
+  no-op context manager without allocating.
+- **Worker re-basing**: worker processes record spans against their own
+  monotonic clock and ship ``(events, wall_epoch_ns)`` back with task
+  results; :meth:`Tracer.absorb` re-bases them onto the driver timeline via
+  the wall-clock epochs (same machine, so wall clocks agree), keeping the
+  worker's real pid so Perfetto renders one track per process.
+- **Bounded memory**: the event buffer is capped (``trace_max_events``);
+  overflow drops new events and counts them rather than growing unboundedly
+  during a soak.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "name", "cat", "args", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str,
+                 args: Optional[dict]):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def set(self, **kw):
+        """Attach/overwrite span args from inside the span body."""
+        if self.args is None:
+            self.args = {}
+        self.args.update(kw)
+
+    def __exit__(self, *exc):
+        self._tracer._record(self.name, self.cat, self._t0,
+                             time.perf_counter_ns() - self._t0, self.args)
+        return False
+
+
+class Tracer:
+    """Thread-safe trace-event buffer with a monotonic timeline anchored to
+    a wall-clock epoch (the re-basing anchor for worker spans)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.enabled = False
+        self._events: List[dict] = []
+        self.max_events = 1_000_000
+        self.dropped = 0
+        self.pid = os.getpid()
+        # both epochs captured back to back: timeline t=0 <-> wall_epoch_ns
+        self.wall_epoch_ns = time.time_ns()
+        self.perf_epoch_ns = time.perf_counter_ns()
+
+    # -- control --------------------------------------------------------------
+
+    def enable(self):
+        self.enabled = True
+
+    def disable(self):
+        self.enabled = False
+
+    def reset(self):
+        with self._mu:
+            self._events = []
+            self.dropped = 0
+            self.wall_epoch_ns = time.time_ns()
+            self.perf_epoch_ns = time.perf_counter_ns()
+
+    # -- recording ------------------------------------------------------------
+
+    def span(self, name: str, cat: str = "engine",
+             args: Optional[dict] = None):
+        """Context manager timing a block; no-op (and allocation-free) when
+        tracing is disabled."""
+        if not self.enabled:
+            return _NOOP
+        return _Span(self, name, cat, args)
+
+    def instant(self, name: str, cat: str = "engine",
+                args: Optional[dict] = None):
+        if not self.enabled:
+            return
+        ts = (time.perf_counter_ns() - self.perf_epoch_ns) / 1e3
+        self._append({"ph": "i", "name": name, "cat": cat, "ts": ts, "s": "t",
+                      "pid": self.pid, "tid": threading.get_ident(),
+                      **({"args": args} if args else {})})
+
+    def complete(self, name: str, cat: str, t0_ns: int, dur_ns: int,
+                 args: Optional[dict] = None):
+        """Record a complete event from explicit perf_counter_ns stamps (for
+        sites that cannot use the context manager, e.g. generators)."""
+        if not self.enabled:
+            return
+        self._record(name, cat, t0_ns, dur_ns, args)
+
+    def _record(self, name, cat, t0_ns, dur_ns, args):
+        ev = {"ph": "X", "name": name, "cat": cat,
+              "ts": (t0_ns - self.perf_epoch_ns) / 1e3,
+              "dur": dur_ns / 1e3,
+              "pid": self.pid, "tid": threading.get_ident()}
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    def _append(self, ev: dict):
+        with self._mu:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                return
+            self._events.append(ev)
+
+    # -- worker shipping / re-basing ------------------------------------------
+
+    def drain(self) -> List[dict]:
+        """Snapshot AND clear the buffer (worker side: events ship with the
+        task reply; keeping them would re-ship on the next task)."""
+        with self._mu:
+            events, self._events = self._events, []
+            return events
+
+    def absorb(self, events: List[dict], wall_epoch_ns: int):
+        """Fold a remote process's events into this timeline. Remote ``ts``
+        values are µs since the remote epoch; shift by the wall-clock delta
+        between the two epochs so both processes share one time axis."""
+        if not events:
+            return
+        delta_us = (wall_epoch_ns - self.wall_epoch_ns) / 1e3
+        with self._mu:
+            for i, ev in enumerate(events):
+                if len(self._events) >= self.max_events:
+                    self.dropped += len(events) - i
+                    break
+                ev = dict(ev)
+                ev["ts"] = ev.get("ts", 0.0) + delta_us
+                self._events.append(ev)
+
+    # -- export ---------------------------------------------------------------
+
+    def snapshot(self) -> List[dict]:
+        with self._mu:
+            return list(self._events)
+
+    def to_chrome_trace(self, process_name: str = "blaze_tpu-driver") -> Dict[str, Any]:
+        """Perfetto/chrome://tracing-loadable JSON object."""
+        events = self.snapshot()
+        pids = {e.get("pid", self.pid) for e in events} | {self.pid}
+        meta = []
+        for pid in sorted(pids):
+            name = process_name if pid == self.pid else f"blaze_tpu-worker-{pid}"
+            meta.append({"ph": "M", "name": "process_name", "pid": pid,
+                         "tid": 0, "args": {"name": name}})
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms",
+                "otherData": {"dropped_events": self.dropped,
+                              "wall_epoch_ns": self.wall_epoch_ns}}
+
+
+TRACER = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return TRACER
+
+
+def configure_from(conf) -> Tracer:
+    """Enable/disable the process tracer from a Config (Session/worker call
+    this; BLAZE_TPU_TRACE=1 force-enables for ad-hoc runs)."""
+    if getattr(conf, "trace_enable", False) or \
+            os.environ.get("BLAZE_TPU_TRACE", "") not in ("", "0"):
+        TRACER.max_events = getattr(conf, "trace_max_events", TRACER.max_events)
+        TRACER.enable()
+    else:
+        TRACER.disable()
+    return TRACER

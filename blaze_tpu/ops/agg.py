@@ -252,8 +252,8 @@ class AggExec(Operator):
             gave_up = False
             for batch in src_iter:
                 input_rows += batch.num_rows
-                with metrics.timer("elapsed_compute"):
-                    out = agger.process(batch)
+                # self-time lands in elapsed_compute_time_ns via Operator.execute
+                out = agger.process(batch)
                 if out is None or not out.num_rows:
                     continue
                 if gave_up:
@@ -274,8 +274,7 @@ class AggExec(Operator):
                                                       supports_device_merge)
 
                 if supports_device_merge(merge_op, self.schema):
-                    with metrics.timer("elapsed_compute"):
-                        staged = DeviceMergeAgger(merge_op, self.schema).run(staged)
+                    staged = DeviceMergeAgger(merge_op, self.schema).run(staged)
                     metrics.add("partials_consolidated", 1)
             for o in staged:
                 if o.num_rows:
@@ -301,9 +300,8 @@ class AggExec(Operator):
                         too_big = True
                         break
                 if not too_big:
-                    with metrics.timer("elapsed_compute"):
-                        agger = DeviceMergeAgger(self, child_schema)
-                        outs = agger.run(staged)
+                    agger = DeviceMergeAgger(self, child_schema)
+                    outs = agger.run(staged)
                     metrics.add("device_merge_batches", len(staged))
                     for out in outs:
                         if out.num_rows:
@@ -338,16 +336,14 @@ class AggExec(Operator):
             if child_iter is None:
                 child_iter = self.execute_child(0, partition, ctx, metrics)
             for batch in child_iter:
-                with metrics.timer("elapsed_compute"):
-                    table.process_batch(batch)
+                table.process_batch(batch)
                 if skipper is not None and skipper.should_skip(table):
                     # adaptive passthrough: flush table, then stream the rest
                     # of the input as single-row groups (reference:
                     # partial-skipping in agg_table.rs)
                     yield from table.output()
                     for rest in child_iter:
-                        with metrics.timer("elapsed_compute"):
-                            out = table.passthrough_batch(rest)
+                        out = table.passthrough_batch(rest)
                         if out is not None:
                             yield out
                     return
@@ -807,7 +803,7 @@ class AggTable(MemConsumer):
             return 0
         freed = self.mem_used
         spill = SpillFile("agg")
-        with self.metrics.timer("spill_io_time"):
+        with self.metrics.timer("spill_io_time_ns"):
             for b in self._partial_batches(sort_by_key=True, include_key=True):
                 spill.writer.write_batch(b)
             spill.finish_write()

@@ -13,12 +13,32 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from typing import Any, Dict, Iterator, List, Optional
 
 from blaze_tpu.config import Config, get_config
 from blaze_tpu.core.batch import ColumnarBatch
 from blaze_tpu.ir import types as T
+from blaze_tpu.obs.tracer import TRACER
 from blaze_tpu.runtime.metrics import MetricNode
+
+# Per-thread stack of [metric_node, resume_ts_ns] frames for self-time
+# attribution: when a child operator's generator resumes it pauses the
+# parent's clock, so ``elapsed_compute_time_ns`` on every node is SELF time
+# (excludes children; consumer time is excluded because timing stops at
+# yield — same discipline the reference gets from WrappedSender.exclude_time,
+# execution_context.rs:705-730, here enforced structurally by the generator
+# wrapper below).
+_SELF_TIME = threading.local()
+
+SELF_TIME_METRIC = "elapsed_compute_time_ns"
+
+
+def _time_stack() -> list:
+    stack = getattr(_SELF_TIME, "stack", None)
+    if stack is None:
+        stack = _SELF_TIME.stack = []
+    return stack
 
 
 class TaskCancelled(Exception):
@@ -95,11 +115,43 @@ class Operator:
                 ) -> Iterator[ColumnarBatch]:
         node = metrics if metrics is not None else ctx.metrics
         node.name = self.name
-        for batch in self._execute(partition, ctx, node):
-            ctx.check_cancelled()
-            node.add("output_rows", batch.num_rows)
-            node.add("output_batches", 1)
-            yield batch
+        gen = self._execute(partition, ctx, node)
+        stack = _time_stack()
+        trace = TRACER.enabled
+        span_t0 = time.perf_counter_ns() if trace else 0
+        rows = 0
+        try:
+            while True:
+                # resume charging THIS node; pause the caller's clock
+                now = time.perf_counter_ns()
+                if stack:
+                    parent = stack[-1]
+                    parent[0].add(SELF_TIME_METRIC, now - parent[1])
+                stack.append([node, now])
+                try:
+                    batch = next(gen)
+                except StopIteration:
+                    return
+                finally:
+                    # stop charging at yield/exhaustion/error: consumer time
+                    # and downstream work never land on this node
+                    now = time.perf_counter_ns()
+                    frame = stack.pop()
+                    frame[0].add(SELF_TIME_METRIC, now - frame[1])
+                    if stack:
+                        stack[-1][1] = now
+                ctx.check_cancelled()
+                node.add("output_rows", batch.num_rows)
+                node.add("output_batches", 1)
+                rows += batch.num_rows
+                yield batch
+        finally:
+            if trace:
+                t1 = time.perf_counter_ns()
+                TRACER.complete(
+                    self.name, "operator", span_t0, t1 - span_t0,
+                    {"partition": partition, "rows": rows,
+                     "self_time_ms": round(node.get(SELF_TIME_METRIC) / 1e6, 3)})
 
     def _execute(self, partition: int, ctx: ExecContext, metrics: MetricNode
                  ) -> Iterator[ColumnarBatch]:

@@ -41,10 +41,9 @@ class ProjectExec(Operator):
     def _execute(self, partition, ctx, metrics):
         ev = ExprEvaluator(self.exprs, self.children[0].schema)
         for batch in self.execute_child(0, partition, ctx, metrics):
-            with metrics.timer("elapsed_compute"):
-                cols = ev.evaluate(batch)
-                out = ColumnarBatch(self.schema, cols, batch.num_rows)
-            yield out
+            # self-time lands in elapsed_compute_time_ns via Operator.execute
+            cols = ev.evaluate(batch)
+            yield ColumnarBatch(self.schema, cols, batch.num_rows)
 
 
 class FilterExec(Operator):
@@ -74,35 +73,34 @@ class FilterExec(Operator):
             ExprEvaluator(self.projection[0], child_schema) if self.projection else None
         )
         for batch in self.execute_child(0, partition, ctx, metrics):
-            with metrics.timer("elapsed_compute"):
-                mask = pred_ev.evaluate_predicate(batch)
-                all_device = all(isinstance(c, DeviceColumn) for c in batch.columns)
-                if all_device:
-                    # device-side stable compaction: one jitted dispatch and
-                    # one scalar pull (core/kernels.py)
-                    from blaze_tpu.core import kernels
+            mask = pred_ev.evaluate_predicate(batch)
+            all_device = all(isinstance(c, DeviceColumn) for c in batch.columns)
+            if all_device:
+                # device-side stable compaction: one jitted dispatch and
+                # one scalar pull (core/kernels.py)
+                from blaze_tpu.core import kernels
 
-                    count, datas, valids = kernels.compact_planes(
-                        [c.data for c in batch.columns],
-                        [c.validity for c in batch.columns], mask)
-                    if count == 0:
-                        continue
-                    if count == batch.num_rows:
-                        out = batch
-                    else:
-                        cols = [
-                            DeviceColumn(c.dtype, d, v) for c, d, v in
-                            zip(batch.columns, datas, valids)
-                        ]
-                        out = ColumnarBatch(batch.schema, cols, count)
+                count, datas, valids = kernels.compact_planes(
+                    [c.data for c in batch.columns],
+                    [c.validity for c in batch.columns], mask)
+                if count == 0:
+                    continue
+                if count == batch.num_rows:
+                    out = batch
                 else:
-                    indices = np.nonzero(np.asarray(mask))[0]
-                    if len(indices) == 0:
-                        continue
-                    out = batch if len(indices) == batch.num_rows else batch.take(indices)
-                if proj_ev is not None:
-                    cols = proj_ev.evaluate(out)
-                    out = ColumnarBatch(self.schema, cols, out.num_rows)
+                    cols = [
+                        DeviceColumn(c.dtype, d, v) for c, d, v in
+                        zip(batch.columns, datas, valids)
+                    ]
+                    out = ColumnarBatch(batch.schema, cols, count)
+            else:
+                indices = np.nonzero(np.asarray(mask))[0]
+                if len(indices) == 0:
+                    continue
+                out = batch if len(indices) == batch.num_rows else batch.take(indices)
+            if proj_ev is not None:
+                cols = proj_ev.evaluate(out)
+                out = ColumnarBatch(self.schema, cols, out.num_rows)
             yield out
 
 
@@ -147,14 +145,11 @@ class CoalesceBatchesExec(Operator):
             staged.append(batch)
             staged_rows += batch.num_rows
             if staged_rows >= target:
-                with metrics.timer("elapsed_compute"):
-                    out = ColumnarBatch.concat(staged, self.schema)
+                out = ColumnarBatch.concat(staged, self.schema)
                 staged, staged_rows = [], 0
                 yield out
         if staged:
-            with metrics.timer("elapsed_compute"):
-                out = ColumnarBatch.concat(staged, self.schema)
-            yield out
+            yield ColumnarBatch.concat(staged, self.schema)
 
 
 class RenameColumnsExec(Operator):
@@ -256,7 +251,5 @@ class ExpandExec(Operator):
         evs = [ExprEvaluator(p, child_schema) for p in self.projections]
         for batch in self.execute_child(0, partition, ctx, metrics):
             for ev in evs:
-                with metrics.timer("elapsed_compute"):
-                    cols = ev.evaluate(batch)
-                    out = ColumnarBatch(self.schema, cols, batch.num_rows)
-                yield out
+                cols = ev.evaluate(batch)
+                yield ColumnarBatch(self.schema, cols, batch.num_rows)

@@ -38,8 +38,8 @@ class GenerateExec(Operator):
     def _execute(self, partition, ctx, metrics):
         child_schema = self.children[0].schema
         for batch in self.execute_child(0, partition, ctx, metrics):
-            with metrics.timer("elapsed_compute"):
-                out = self._generate(batch, child_schema)
+            # self-time lands in elapsed_compute_time_ns via Operator.execute
+            out = self._generate(batch, child_schema)
             if out is not None and out.num_rows:
                 yield out
 

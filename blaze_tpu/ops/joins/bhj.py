@@ -154,7 +154,7 @@ class _HashJoinBase(Operator):
 
     def _build_from_child(self, partition, ctx, metrics) -> JoinHashMap:
         child = self._build_child()
-        with metrics.timer("build_time"):
+        with metrics.timer("build_time_ns"):
             batches = list(self.execute_child(child, partition, ctx, metrics))
             return JoinHashMap.build(batches, self._key_exprs(for_build=True),
                                      self.children[child].schema)
@@ -197,7 +197,7 @@ class _HashJoinBase(Operator):
             jt == JoinType.INNER and cond_ev is None
             and not track_build_matched and bmap.unique_single_key)
         for batch in self.execute_child(probe_child, partition, ctx, metrics):
-            with metrics.timer("probe_time"):
+            with metrics.timer("probe_time_ns"):
                 cols = key_ev.evaluate(batch)
                 if inner_fast_ok:
                     out = self._inner_fast(batch, bmap, cols, probe_on_left,
@@ -222,7 +222,7 @@ class _HashJoinBase(Operator):
 
         # post-pass: unmatched build rows (right/left-opposite/full, or
         # semi/anti/existence where the kept side was built)
-        with metrics.timer("finish_time"):
+        with metrics.timer("finish_time_ns"):
             tail = self._emit_build_tail(bmap, probe_on_left, jt,
                                          emit_unmatched_build)
         if tail is not None and tail.num_rows:
@@ -483,7 +483,7 @@ class BroadcastJoinBuildHashMapExec(Operator):
 
     def _execute(self, partition, ctx, metrics):
         batches = list(self.execute_child(0, partition, ctx, metrics))
-        with metrics.timer("build_time"):
+        with metrics.timer("build_time_ns"):
             m = JoinHashMap.build(batches, self.keys, self.children[0].schema)
             blob = m.serialize()
         yield ColumnarBatch.from_pydict({"hash_map": [blob]}, self.SCHEMA)

@@ -195,8 +195,7 @@ class OrcScanExec(Operator):
                     stripe = stripe.to_batches()[0]
                 for off in range(0, stripe.num_rows, batch_size):
                     rb = stripe.slice(off, batch_size)
-                    with metrics.timer("elapsed_compute"):
-                        batch = ColumnarBatch.from_arrow(rb, proj_schema)
+                    batch = ColumnarBatch.from_arrow(rb, proj_schema)
                     yield batch
 
 

@@ -231,10 +231,9 @@ class ParquetScanExec(Operator):
                 pfile, rb = item
                 if rb.num_rows == 0:
                     continue
-                with metrics.timer("elapsed_compute"):
-                    batch = ColumnarBatch.from_arrow(rb, proj_schema)
-                    if len(self.conf.partition_schema):
-                        batch = _attach_partition_values(batch, pfile, self.conf, self.schema)
+                batch = ColumnarBatch.from_arrow(rb, proj_schema)
+                if len(self.conf.partition_schema):
+                    batch = _attach_partition_values(batch, pfile, self.conf, self.schema)
                 yield batch
         finally:
             # unblock and reap the producer even on early generator close

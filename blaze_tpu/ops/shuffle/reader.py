@@ -54,8 +54,18 @@ class IpcReaderExec(Operator):
             return False
 
         def produce():
+            # the prefetch thread is where fetch+decode time actually goes;
+            # the consumer side only measures queue wait
+            import time
+
+            from blaze_tpu.obs.tracer import TRACER
+
+            trace = TRACER.enabled
+            t0 = time.perf_counter_ns() if trace else 0
+            nblocks = 0
             try:
                 for block in blocks:
+                    nblocks += 1
                     stream = _open_block(block)
                     for batch in BatchReader(stream):
                         if not _put(batch):
@@ -63,12 +73,18 @@ class IpcReaderExec(Operator):
                 _put(SENTINEL)
             except BaseException as exc:
                 _put(exc)
+            finally:
+                if trace:
+                    t1 = time.perf_counter_ns()
+                    TRACER.complete(
+                        "shuffle_fetch", "shuffle", t0, t1 - t0,
+                        {"partition": partition, "blocks": nblocks})
 
         t = threading.Thread(target=produce, daemon=True, name="ipc-prefetch")
         t.start()
         try:
             while True:
-                with metrics.timer("ipc_read_time"):
+                with metrics.timer("shuffle_read_wait_time_ns"):
                     item = q.get()
                 if item is SENTINEL:
                     break
@@ -183,6 +199,5 @@ class BatchSourceExec(Operator):
     def _execute(self, partition, ctx, metrics):
         provider = ctx.resources[self.resource_id]
         batches = provider(partition) if callable(provider) else provider[partition]
-        for b in batches:
-            metrics.add("output_rows", b.num_rows)
-            yield b
+        # row/batch counting happens once, in Operator.execute
+        yield from batches

@@ -29,6 +29,9 @@ class Repartitioner:
         # input batch (no per-partition take loop)
         self.split_batches = 0
         self.split_gathers = 0
+        # time spent routing rows (hash + gather + slice), surfaced as
+        # repartition_time_ns on the writer's metric node
+        self.split_time_ns = 0
 
     def partition_ids(self, batch: ColumnarBatch) -> np.ndarray:
         """(num_rows,) int32 partition id per row."""
@@ -61,16 +64,21 @@ class Repartitioner:
         gather by partition id, then contiguous slices (reference: radix sort
         by pid in buffered_data.rs). Used when the sub-batches feed further
         device compute; the serialize path uses bucketize_host."""
+        import time
+
         n = batch.num_rows
         if n == 0:
             return []
         self.split_batches += 1
         if self.num_partitions == 1:
             return [(0, batch)]
+        t0 = time.perf_counter_ns()
         order, ranges = self._split_ranges(self.partition_ids(batch))
         self.split_gathers += 1
         gathered = batch.take(order)
-        return [(pid, gathered.slice(s, e - s)) for pid, s, e in ranges]
+        out = [(pid, gathered.slice(s, e - s)) for pid, s, e in ranges]
+        self.split_time_ns += time.perf_counter_ns() - t0
+        return out
 
     def bucketize_host(self, batch: ColumnarBatch) -> List[Tuple[int, HostBatch]]:
         """Shuffle-write fast path: ONE device pull, then numpy-speed routing.
@@ -78,6 +86,8 @@ class Repartitioner:
         to the serializer), so this replaces num_partitions device gathers +
         num_partitions pulls with a single transfer (reference: staged
         host-side radix sort by partition id, buffered_data.rs:88+)."""
+        import time
+
         n = batch.num_rows
         if n == 0:
             return []
@@ -85,13 +95,16 @@ class Repartitioner:
         host = HostBatch.from_batch(batch)
         if self.num_partitions == 1:
             return [(0, host)]
+        t0 = time.perf_counter_ns()
         pids = self.partition_ids_host(host)
         if pids is None:
             pids = self.partition_ids(batch)
         order, ranges = self._split_ranges(pids)
         self.split_gathers += 1
         gathered = host.take(order)
-        return [(pid, gathered.slice(s, e - s)) for pid, s, e in ranges]
+        out = [(pid, gathered.slice(s, e - s)) for pid, s, e in ranges]
+        self.split_time_ns += time.perf_counter_ns() - t0
+        return out
 
 
 class SinglePartitioner(Repartitioner):

@@ -74,10 +74,10 @@ class ShuffleWriterExec(Operator):
         state = _WriterState(self, ctx, metrics, repart)
         ctx.mem.register(state)
         try:
+            # self-time lands in elapsed_compute_time_ns via Operator.execute
             for batch in self.execute_child(0, partition, ctx, metrics):
-                with metrics.timer("elapsed_compute"):
-                    state.insert(batch)
-            with metrics.timer("shuffle_write_time"):
+                state.insert(batch)
+            with metrics.timer("shuffle_write_time_ns"):
                 state.finish()
         finally:
             ctx.mem.unregister(state)
@@ -119,12 +119,14 @@ class _WriterState(MemConsumer):
         self._pending = []
         self._pending_rows = 0
         b0, g0 = self.repart.split_batches, self.repart.split_gathers
+        t0 = self.repart.split_time_ns
         for pid, sub in self.repart.bucketize_host(batch):
             self.streams.write(pid, sub)
         # hot-path invariant surfaced for soak/tests: one row gather per
         # split batch, never a per-partition take loop
         self.metrics.add("split_batches", self.repart.split_batches - b0)
         self.metrics.add("split_gathers", self.repart.split_gathers - g0)
+        self.metrics.add("repartition_time_ns", self.repart.split_time_ns - t0)
         self.update_mem_used(self.streams.nbytes)
 
     def spill(self) -> int:
@@ -134,7 +136,7 @@ class _WriterState(MemConsumer):
         spill = SpillFile("shuffle")
         f = spill._file
         index = {}
-        with self.metrics.timer("spill_io_time"):
+        with self.metrics.timer("spill_io_time_ns"):
             for pid, payload in self.streams.payloads():
                 index[pid] = (f.tell(), len(payload))
                 f.write(payload)
@@ -219,26 +221,26 @@ class RssShuffleWriterExec(Operator):
 
         def _push(batch):
             b0, g0 = repart.split_batches, repart.split_gathers
+            t0 = repart.split_time_ns
             for pid, sub in repart.bucketize_host(batch):
                 buf = io.BytesIO()
                 BatchWriter(buf, codec=codec).write_batch(sub)
                 writer.write(pid, buf.getvalue())
             metrics.add("split_batches", repart.split_batches - b0)
             metrics.add("split_gathers", repart.split_gathers - g0)
+            metrics.add("repartition_time_ns", repart.split_time_ns - t0)
 
         for batch in self.execute_child(0, partition, ctx, metrics):
-            with metrics.timer("elapsed_compute"):
-                pending.append(batch)
-                pending_rows += batch.num_rows
-                if pending_rows >= coalesce_min:
-                    _push(pending[0] if len(pending) == 1 else
-                          ColumnarBatch.concat(pending))
-                    pending = []
-                    pending_rows = 0
-        if pending:
-            with metrics.timer("elapsed_compute"):
+            pending.append(batch)
+            pending_rows += batch.num_rows
+            if pending_rows >= coalesce_min:
                 _push(pending[0] if len(pending) == 1 else
                       ColumnarBatch.concat(pending))
+                pending = []
+                pending_rows = 0
+        if pending:
+            _push(pending[0] if len(pending) == 1 else
+                  ColumnarBatch.concat(pending))
         writer.flush()
         return
         yield  # pragma: no cover

@@ -81,10 +81,10 @@ class SortExec(Operator):
             yield current
 
     def _merge_topk(self, current, staged, k, metrics):
-        with metrics.timer("elapsed_compute"):
-            parts = ([current] if current is not None else []) + staged
-            merged = ColumnarBatch.concat(parts, self.schema)
-            return sort_batch(merged, self.sort_orders, limit=k)
+        # self-time lands in elapsed_compute_time_ns via Operator.execute
+        parts = ([current] if current is not None else []) + staged
+        merged = ColumnarBatch.concat(parts, self.schema)
+        return sort_batch(merged, self.sort_orders, limit=k)
 
     # -- full sort with spill -------------------------------------------------
 
@@ -134,7 +134,7 @@ class _SortState(MemConsumer):
         else:
             run = self._sorted_run()
         spill = SpillFile("sort")
-        with self.metrics.timer("spill_io_time"):
+        with self.metrics.timer("spill_io_time_ns"):
             spill.writer.write_batch(run)
             spill.finish_write()
         self.metrics.add("spilled_bytes", spill.size)
@@ -164,8 +164,7 @@ class _SortState(MemConsumer):
         if not self.runs:
             if not self.staged:
                 return
-            with self.metrics.timer("elapsed_compute"):
-                merged = self._sorted_run()
+            merged = self._sorted_run()
             for off in range(0, merged.num_rows, batch_size):
                 yield merged.slice(off, batch_size)
             return

@@ -93,7 +93,7 @@ class _PartitionBuffer(MemConsumer):
         if len(self.mem) <= 1:
             return 0
         sp = SpillFile("window")
-        with self.metrics.timer("spill_io_time"):
+        with self.metrics.timer("spill_io_time_ns"):
             for b in self.mem[:-1]:
                 sp.writer.write_batch(b)
             sp.finish_write()
@@ -213,12 +213,12 @@ class WindowExec(Operator):
         for batch in self.execute_child(0, partition, ctx, metrics):
             if batch.num_rows == 0:
                 continue
-            with metrics.timer("elapsed_compute"):
-                codes = _partition_codes(batch, self.partition_spec)
-                boundaries = np.nonzero(np.diff(codes))[0] + 1
-                starts = np.concatenate([[0], boundaries])
-                ends = np.concatenate([boundaries, [batch.num_rows]])
-                pieces = [(int(s), int(e)) for s, e in zip(starts, ends)]
+            # self-time lands in elapsed_compute_time_ns via Operator.execute
+            codes = _partition_codes(batch, self.partition_spec)
+            boundaries = np.nonzero(np.diff(codes))[0] + 1
+            starts = np.concatenate([[0], boundaries])
+            ends = np.concatenate([boundaries, [batch.num_rows]])
+            pieces = [(int(s), int(e)) for s, e in zip(starts, ends)]
             # all but the trailing piece complete earlier partitions; the
             # trailing piece may continue into the next batch — but only if
             # its key equals the next batch's first key, which we can't see

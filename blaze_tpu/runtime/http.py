@@ -5,13 +5,21 @@ Reference: the feature-gated poem server started lazily on first
 (CPU pprof) and ``/debug/pprof/heap`` (jemalloc). Here a stdlib HTTP server
 bound to a free port exposes:
 
-- ``/debug/metrics``           — the session metric tree as JSON
+- ``/debug/metrics``           — the session metric tree as JSON (with
+  human-readable renderings of every ``*_time_ns`` value)
 - ``/debug/pprof/profile?seconds=N&frequency=H`` — wall-clock stack sampling
   across ALL threads (sys._current_frames), pprof-style aggregated stacks
 - ``/debug/memory``            — process RSS + memory-manager accounting
+  (spill count/bytes/time and per-consumer usage)
 - ``/debug/config``            — the active engine config
 - ``/debug/device``            — device residency: transfer bytes/calls +
   jitted-kernel dispatch counts/time (utils/device.DEVICE_STATS)
+- ``/debug/trace``             — Chrome-trace-event JSON of recorded spans
+  (query/stage/task/operator/spill/shuffle-fetch/kernel); load the payload
+  in Perfetto or chrome://tracing. Requires ``Config.trace_enable`` (or
+  BLAZE_TPU_TRACE=1); worker-process spans appear as separate pids.
+- ``/debug/queries``           — the session's recent query log (id,
+  wall_s, rows, stages) as recorded for explain_analyze
 
 Start with ``ProfilingService.start(session)``; idempotent per process."""
 
@@ -57,9 +65,24 @@ class ProfilingService:
                 def do_GET(self):
                     url = urlparse(self.path)
                     if url.path == "/debug/metrics":
+                        from blaze_tpu.obs.explain import humanize_metrics_dict
+
                         sess = getattr(self.server, "blaze_session", None)
                         tree = sess.metrics.to_dict() if sess is not None else {}
-                        self._send(json.dumps(tree, indent=2))
+                        self._send(json.dumps(humanize_metrics_dict(tree),
+                                              indent=2))
+                    elif url.path == "/debug/trace":
+                        from blaze_tpu.obs.tracer import TRACER
+
+                        self._send(json.dumps(
+                            TRACER.to_chrome_trace("blaze_tpu driver")))
+                    elif url.path == "/debug/queries":
+                        sess = getattr(self.server, "blaze_session", None)
+                        log = list(getattr(sess, "query_log", []) or [])
+                        # plan shapes are nested tuples — render compactly
+                        body = [{k: v for k, v in q.items() if k != "shape"}
+                                for q in log]
+                        self._send(json.dumps(body, indent=2, default=str))
                     elif url.path == "/debug/pprof/profile":
                         # sampling profiler across ALL threads (cProfile only
                         # hooks the calling thread; engine work runs on task
@@ -75,17 +98,7 @@ class ProfilingService:
                         mm = MemManager._instance
                         body = {
                             "process_rss_bytes": rss,
-                            "mem_manager": None if mm is None else {
-                                "total": mm.total,
-                                "used": mm.used,
-                                "spill_count": mm.spill_count,
-                                "total_spilled_bytes": mm.total_spilled_bytes,
-                                "consumers": [
-                                    {"name": c.name, "mem_used": c.mem_used,
-                                     "spillable": c.spillable}
-                                    for c in mm.consumers
-                                ],
-                            },
+                            "mem_manager": None if mm is None else mm.stats(),
                         }
                         self._send(json.dumps(body, indent=2))
                     elif url.path == "/debug/config":

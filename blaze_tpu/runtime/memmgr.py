@@ -61,6 +61,7 @@ class MemManager:
         self._cv = threading.Condition(self._mu)
         self.total_spilled_bytes = 0
         self.spill_count = 0
+        self.spill_time_ns = 0  # wall time spent inside consumer.spill()
         self.wait_count = 0
         self.peak_used = 0  # high-water mark across all consumers
         self.wait_timeout_s = wait_timeout_s if wait_timeout_s is not None \
@@ -109,6 +110,24 @@ class MemManager:
     def used(self) -> int:
         with self._mu:
             return sum(c.mem_used for c in self.consumers)
+
+    def stats(self) -> dict:
+        """Accounting snapshot for /debug/memory (taken under the lock)."""
+        with self._mu:
+            return {
+                "total": self.total,
+                "used": sum(c.mem_used for c in self.consumers),
+                "peak_used": self.peak_used,
+                "mem_spill_count": self.spill_count,
+                "mem_spill_size": self.total_spilled_bytes,
+                "mem_spill_time_ns": self.spill_time_ns,
+                "wait_count": self.wait_count,
+                "consumers": [
+                    {"name": c.name, "mem_used": c.mem_used,
+                     "spillable": c.spillable}
+                    for c in self.consumers
+                ],
+            }
 
     def fair_share(self) -> int:
         with self._mu:
@@ -176,13 +195,29 @@ class MemManager:
             if action == "spill" or (
                     action == "timeout" and consumer.spillable and
                     consumer.mem_used > 0):
+                from blaze_tpu.obs.tracer import TRACER
+
                 consumer.spill_requested = False
-                freed = consumer.spill()
+                t0 = time.perf_counter_ns()
+                with TRACER.span("spill", "spill",
+                                 {"consumer": consumer.name,
+                                  "mem_used": consumer.mem_used}):
+                    freed = consumer.spill()
+                spill_ns = time.perf_counter_ns() - t0
                 with self._cv:
                     self.spill_count += 1
                     self.total_spilled_bytes += freed
+                    self.spill_time_ns += spill_ns
                     consumer.mem_used = max(0, consumer.mem_used - freed)
                     self._cv.notify_all()
+                # surface manager-decided spills in the TASK metric tree too
+                # (consumers created by operators carry their metric node):
+                # spills were previously invisible outside operator counters
+                node = getattr(consumer, "metrics", None)
+                if node is not None:
+                    node.add("mem_spill_count", 1)
+                    node.add("mem_spill_size", freed)
+                    node.add("mem_spill_time_ns", spill_ns)
                 return
             if action == "wait":
                 continue

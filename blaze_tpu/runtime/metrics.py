@@ -30,7 +30,8 @@ class MetricNode:
             self.values[metric] = int(value)
 
     def get(self, metric: str) -> int:
-        return self.values.get(metric, 0)
+        with self._mu:
+            return self.values.get(metric, 0)
 
     def child(self, i: int) -> "MetricNode":
         with self._mu:
@@ -48,18 +49,33 @@ class MetricNode:
                 self.children.append(node)
             return node
 
+    def get_named(self, key: str) -> Optional["MetricNode"]:
+        """Existing keyed child or None — the read-only counterpart of
+        ``named_child`` (explain/debug rendering must not grow the tree)."""
+        with self._mu:
+            return self._named.get(key)
+
     def timer(self, metric: str) -> "Timer":
         return Timer(self, metric)
 
     def to_dict(self) -> dict:
+        # snapshot under the lock: /debug/metrics and explain_analyze read
+        # this tree while task threads mutate values/children concurrently
+        with self._mu:
+            name = self.name
+            values = dict(self.values)
+            children = list(self.children)
         return {
-            "name": self.name,
-            "values": dict(self.values),
-            "children": [c.to_dict() for c in self.children],
+            "name": name,
+            "values": values,
+            "children": [c.to_dict() for c in children],
         }
 
     def total(self, metric: str) -> int:
-        return self.get(metric) + sum(c.total(metric) for c in self.children)
+        with self._mu:
+            own = self.values.get(metric, 0)
+            children = list(self.children)
+        return own + sum(c.total(metric) for c in children)
 
     def merge_dict(self, d: dict):
         """Fold a serialized metric tree (to_dict of a remote task) into
@@ -67,7 +83,15 @@ class MetricNode:
         (reference: update_spark_metric_node pushing native metrics into the
         JVM MetricNode mirror at task end). Children merge POSITIONALLY:
         remote node names embed the remote root's prefix, and name-keyed
-        merging would give pool and in-driver runs different tree shapes."""
+        merging would give pool and in-driver runs different tree shapes.
+        Auto-created child placeholders do adopt the remote OPERATOR name
+        (bare class names, no '.' path prefix) so pool-run task trees render
+        with real node labels in /debug/metrics and explain_analyze."""
+        name = d.get("name") or ""
+        if name and "." not in name:
+            with self._mu:
+                if "." in self.name:
+                    self.name = name
         for k, v in (d.get("values") or {}).items():
             self.add(k, v)
         for i, c in enumerate(d.get("children") or []):

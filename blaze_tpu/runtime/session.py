@@ -20,6 +20,7 @@ import itertools
 import os
 import tempfile
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Iterator, List, Optional
 
@@ -29,6 +30,9 @@ from blaze_tpu.config import Config, get_config
 from blaze_tpu.core.batch import ColumnarBatch
 from blaze_tpu.ir import nodes as N
 from blaze_tpu.ir import types as T
+from blaze_tpu.obs.explain import op_shape, render_explain_analyze
+from blaze_tpu.obs.tracer import TRACER
+from blaze_tpu.obs.tracer import configure_from as _tracer_configure
 from blaze_tpu.ops.base import ExecContext, Operator, TaskContext
 from blaze_tpu.ops.shuffle.writer import (BytesBlockProvider,
                                            FileSegmentBlockProvider,
@@ -126,6 +130,14 @@ class Session:
         self._ids = itertools.count()
         self._stage_ids = itertools.count()
         self.metrics = MetricNode("session")
+        # observability (obs/): span tracing + per-query records consumed by
+        # explain_analyze, /debug/trace and /debug/queries
+        _tracer_configure(self.conf)
+        self._query_ids = itertools.count()
+        self._stage_meta: Dict[int, dict] = {}
+        self.query_log: List[dict] = []  # last _QUERY_LOG_MAX finished queries
+
+    _QUERY_LOG_MAX = 50
 
     # -- public API -----------------------------------------------------------
 
@@ -136,13 +148,40 @@ class Session:
         order."""
         from blaze_tpu.utils.logutil import clear_task_context, set_task_context
 
+        qid = next(self._query_ids)
+        t0 = time.perf_counter_ns()
+        stages_before = set(self._stage_meta)
         if self.conf.column_pruning_enable:
             from blaze_tpu.ir.optimizer import prune_plan
 
             plan = prune_plan(plan)
+        # map stages run EAGERLY during lowering, so by the time the final
+        # operator exists every stage this query ran is in _stage_meta
         lowered = self._lower(plan)
         op = build_operator(lowered)
         nparts = op.num_partitions()
+        query = {
+            "id": qid,
+            "shape": op_shape(op),
+            "nparts": nparts,
+            "result_keys": [f"result_{p}" for p in range(nparts)],
+            "stages": [self._stage_meta[s]
+                       for s in sorted(set(self._stage_meta) - stages_before)],
+            "rows": 0,
+            "wall_s": 0.0,
+        }
+
+        def finish_query(rows: int):
+            dur_ns = time.perf_counter_ns() - t0
+            query["rows"] = rows
+            query["wall_s"] = dur_ns / 1e9
+            self.query_log.append(query)
+            del self.query_log[:-self._QUERY_LOG_MAX]
+            if TRACER.enabled:
+                TRACER.complete(f"query_{qid}", "query", t0, dur_ns,
+                                {"rows": rows, "nparts": nparts,
+                                 "stages": len(query["stages"])})
+
         where = self._decide_placement(lowered, "result")
 
         def run_partition_stream(p: int):
@@ -158,6 +197,7 @@ class Session:
                 clear_task_context()
 
         if nparts <= 0:
+            finish_query(0)
             return
 
         # Every partition — including a single one — drains through a
@@ -192,6 +232,7 @@ class Session:
             except BaseException as exc:
                 _put(queues[p], exc)
 
+        rows_out = 0
         with ThreadPoolExecutor(
                 max_workers=max(1, min(self.max_workers, nparts))) as pool:
             try:
@@ -204,6 +245,7 @@ class Session:
                             break
                         if isinstance(item, BaseException):
                             raise item
+                        rows_out += item.num_rows
                         yield item
             finally:
                 # unblock producers on early close so pool shutdown completes
@@ -214,6 +256,7 @@ class Session:
                             q.get_nowait()
                         except _queue.Empty:
                             break
+                finish_query(rows_out)
 
     def execute_to_table(self, plan: N.PlanNode) -> pa.Table:
         batches = [b.to_arrow() for b in self.execute(plan) if b.num_rows]
@@ -224,6 +267,14 @@ class Session:
 
     def execute_to_pydict(self, plan: N.PlanNode) -> dict:
         return self.execute_to_table(plan).to_pydict()
+
+    def explain_analyze(self, plan: N.PlanNode) -> str:
+        """EXPLAIN ANALYZE: execute the plan to completion and render its
+        operator tree annotated with the observed per-node metrics (rows,
+        batches, self-time, spills) — the textual sibling of /debug/trace."""
+        for _ in self.execute(plan):
+            pass
+        return render_explain_analyze(self.query_log[-1], self.metrics)
 
     def close(self):
         """Remove shuffle files and release resources (a failed stage is
@@ -256,6 +307,19 @@ class Session:
         self.metrics.add(f"placement_{where}_stages", 1)
         self.metrics.named_child(label).add(f"placement_{where}", 1)
         return where
+
+    def _record_stage(self, stage: int, kind: str, num_tasks: int,
+                      child_op: Operator, wrapper: Optional[str] = None):
+        """Remember a stage's plan shape so explain_analyze can walk the
+        merged task metric trees positionally after the query finishes.
+        ``wrapper`` names the sink operator (ShuffleWriter/IpcWriter) that
+        run_map wraps around ``child_op`` — the task metric tree is rooted
+        at the sink, so the recorded shape must be too."""
+        shape = op_shape(child_op)
+        if wrapper is not None:
+            shape = (wrapper, [shape])
+        self._stage_meta[stage] = {"id": stage, "kind": kind,
+                                   "num_tasks": num_tasks, "shape": shape}
 
     def _make_ctx(self, partition: int, stage: int = 0) -> ExecContext:
         return ExecContext(
@@ -396,6 +460,8 @@ class Session:
         stage = next(self._stage_ids)
         child_op = build_operator(node.child)
         num_maps = child_op.num_partitions()
+        self._record_stage(stage, "shuffle_map", num_maps, child_op,
+                           wrapper="ShuffleWriterExec")
         shuffle_dir = os.path.join(self.work_dir, f"shuffle_{stage}")
         os.makedirs(shuffle_dir, exist_ok=True)
 
@@ -403,31 +469,36 @@ class Session:
             return (os.path.join(shuffle_dir, f"map_{m}.data"),
                     os.path.join(shuffle_dir, f"map_{m}.index"))
 
-        outputs = None
-        if self.pool is not None:
-            outputs = self._run_map_stage_on_pool(node, stage, num_maps, paths_for)
-        if outputs is None:
-            where = self._decide_placement(node.child, f"stage_{stage}")
+        with TRACER.span(f"stage_{stage}", "stage",
+                         {"kind": "shuffle_map", "num_maps": num_maps}):
+            outputs = None
+            if self.pool is not None:
+                outputs = self._run_map_stage_on_pool(node, stage, num_maps,
+                                                      paths_for)
+            if outputs is None:
+                where = self._decide_placement(node.child, f"stage_{stage}")
 
-            def run_map(m: int):
-                from blaze_tpu.ops.shuffle.writer import ShuffleWriterExec
-                from blaze_tpu.runtime import placement
-                from blaze_tpu.utils.logutil import clear_task_context, set_task_context
+                def run_map(m: int):
+                    from blaze_tpu.ops.shuffle.writer import ShuffleWriterExec
+                    from blaze_tpu.runtime import placement
+                    from blaze_tpu.utils.logutil import clear_task_context, set_task_context
 
-                data, index = paths_for(m)
-                writer = ShuffleWriterExec(child_op, node.partitioning, data, index)
-                ctx = self._make_ctx(m, stage)
-                task_metrics = self.metrics.named_child(f"stage_{stage}").named_child(f"map_{m}")
-                set_task_context(stage, m)
-                try:
-                    with placement.placed(where):
-                        for _ in writer.execute(m, ctx, task_metrics):
-                            pass
-                finally:
-                    clear_task_context()
-                return data, index
+                    data, index = paths_for(m)
+                    writer = ShuffleWriterExec(child_op, node.partitioning, data, index)
+                    ctx = self._make_ctx(m, stage)
+                    task_metrics = self.metrics.named_child(f"stage_{stage}").named_child(f"map_{m}")
+                    set_task_context(stage, m)
+                    try:
+                        with placement.placed(where), \
+                                TRACER.span("task", "task",
+                                            {"stage": stage, "map": m}):
+                            for _ in writer.execute(m, ctx, task_metrics):
+                                pass
+                    finally:
+                        clear_task_context()
+                    return data, index
 
-            outputs = self._run_tasks(run_map, range(num_maps))
+                outputs = self._run_tasks(run_map, range(num_maps))
 
         return stage, [(data, read_index_file(index)) for data, index in outputs]
 
@@ -620,6 +691,8 @@ class Session:
         child_op = build_operator(node.child)
         num_maps = child_op.num_partitions()
         num_reducers = node.partitioning.num_partitions
+        self._record_stage(stage, "rss_map", num_maps, child_op,
+                           wrapper="RssShuffleWriterExec")
         from blaze_tpu.runtime.rss import (CelebornShuffleClient,
                                            CelebornWriterFactory,
                                            RssWriterFactory,
@@ -662,7 +735,9 @@ class Session:
                     f"stage_{stage}").named_child(f"map_{m}")
                 set_task_context(stage, m)
                 try:
-                    with placement.placed(where):
+                    with placement.placed(where), \
+                            TRACER.span("task", "task",
+                                        {"stage": stage, "map": m}):
                         for _ in writer.execute(m, ctx, task_metrics):
                             pass
                 finally:
@@ -708,6 +783,7 @@ class Session:
         child_op = build_operator(node.child)
         num_maps = child_op.num_partitions()
         num_reducers = node.partitioning.num_partitions
+        self._record_stage(stage, "mesh_map", num_maps, child_op)
         schema = node.child.output_schema
         n = self.mesh.devices.size
 
@@ -813,6 +889,12 @@ class Session:
         for m, r in enumerate(replies):
             stage_metrics.named_child(f"map_{m}").merge_dict(
                 r.get("metrics") or {})
+            # worker-process spans ride back with the task result; re-base
+            # them into the driver timeline (wall epochs anchor the shift)
+            tr = r.get("trace")
+            if tr and TRACER.enabled:
+                TRACER.absorb(tr.get("events") or [],
+                              tr.get("wall_epoch_ns") or TRACER.wall_epoch_ns)
         return True
 
     def _run_map_stage_on_pool(self, node: N.ShuffleExchange, stage: int,
@@ -833,6 +915,8 @@ class Session:
         from its atomic tmp-file rename)."""
         child_op = build_operator(child)
         num_maps = child_op.num_partitions()
+        self._record_stage(stage, f"{prefix}_collect", num_maps, child_op,
+                           wrapper="IpcWriterExec")
         committed: Dict[int, List[bytes]] = {}
         lock = threading.Lock()
         where = self._decide_placement(child, f"stage_{stage}")
@@ -859,7 +943,9 @@ class Session:
                 f"stage_{stage}").named_child(f"map_{m}")
             set_task_context(stage, m)
             try:
-                with placement.placed(where):
+                with placement.placed(where), \
+                        TRACER.span("task", "task",
+                                    {"stage": stage, "map": m}):
                     for _ in writer.execute(m, ctx, task_metrics):
                         pass
             finally:

@@ -38,6 +38,8 @@ def run_task(msg: dict, shared: dict = None) -> dict:
 
     from blaze_tpu.config import Config, set_config
     from blaze_tpu.ir.protoserde import task_definition_from_bytes
+    from blaze_tpu.obs.tracer import TRACER
+    from blaze_tpu.obs.tracer import configure_from as _tracer_configure
     from blaze_tpu.ops.base import ExecContext, TaskContext
     from blaze_tpu.runtime.executor import build_operator
     from blaze_tpu.runtime.metrics import MetricNode
@@ -46,6 +48,7 @@ def run_task(msg: dict, shared: dict = None) -> dict:
     conf = Config(**msg["conf"]) if msg.get("conf") else None
     if conf is not None:
         set_config(conf)
+        _tracer_configure(conf)
     task, plan = task_definition_from_bytes(msg["task_bytes"])
     op = build_operator(plan)
     metrics = MetricNode("task")
@@ -63,10 +66,18 @@ def run_task(msg: dict, shared: dict = None) -> dict:
         where = placement.decide(plan, resources, conf) if conf is not None \
             else "device"
         rows = 0
-        with placement.placed(where):
+        with placement.placed(where), \
+                TRACER.span("task", "task", {"stage": task.stage_id,
+                                             "map": task.partition_id}):
             for batch in op.execute(task.partition_id, ctx, metrics):
                 rows += batch.num_rows  # sink plans emit nothing; drain anyway
-        return {"ok": True, "rows": rows, "metrics": metrics.to_dict()}
+        reply = {"ok": True, "rows": rows, "metrics": metrics.to_dict()}
+        if TRACER.enabled:
+            # ship this task's spans back with the result; the driver
+            # re-bases them into its timeline (Session._ship_stage_to_pool)
+            reply["trace"] = {"events": TRACER.drain(),
+                             "wall_epoch_ns": TRACER.wall_epoch_ns}
+        return reply
     finally:
         clear_task_context()
 

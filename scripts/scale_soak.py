@@ -11,7 +11,9 @@ cites. Reference analogue: the 1 GB TPC-DS dataset gate
 
 Run: python scripts/scale_soak.py   (CPU; ~15-30 min)
 Env: SOAK_ROWS (10_000_000), SOAK_PARTS (32), SOAK_BUDGET_MB (512),
-SOAK_TPCDS_SCALE (40).
+SOAK_TPCDS_SCALE (40). SOAK_PROFILE_DIR=<dir> additionally enables span
+tracing and dumps per-query trace/metrics artifacts there
+(obs/dump.dump_profile; load the *_trace.json files in Perfetto).
 """
 
 import json
@@ -29,6 +31,7 @@ ROWS = int(os.environ.get("SOAK_ROWS", 10_000_000))
 PARTS = int(os.environ.get("SOAK_PARTS", 32))
 BUDGET_MB = int(os.environ.get("SOAK_BUDGET_MB", 128))
 TPCDS_SCALE = int(os.environ.get("SOAK_TPCDS_SCALE", 40))
+PROFILE_DIR = os.environ.get("SOAK_PROFILE_DIR", "")
 
 os.environ["BENCH_ROWS"] = str(ROWS)
 os.environ["BENCH_PARTITIONS"] = str(PARTS)
@@ -97,7 +100,8 @@ def main():
             MemManager.reset()
             t0 = time.perf_counter()
             conf = Config(memory_total=BUDGET_MB << 20, memory_fraction=1.0,
-                          mem_wait_timeout_s=5.0)
+                          mem_wait_timeout_s=5.0,
+                          trace_enable=bool(PROFILE_DIR))
             with Session(conf=conf) as sess:
                 table = sess.execute_to_table(plan_fn(paths))
                 spills = sess.metrics.total("spill_count")
@@ -105,6 +109,11 @@ def main():
                 streamed = sess.metrics.total("streamed_partitions")
                 split_batches = sess.metrics.total("split_batches")
                 split_gathers = sess.metrics.total("split_gathers")
+                if PROFILE_DIR:
+                    from blaze_tpu.obs import TRACER, dump_profile
+
+                    dump_profile(sess, PROFILE_DIR, name)
+                    TRACER.reset()
             mgr = MemManager._instance
             peak_used = int(mgr.peak_used) if mgr is not None else 0
             wall = time.perf_counter() - t0
@@ -167,11 +176,17 @@ def main():
             MemManager.reset()
             t0 = time.perf_counter()
             conf = Config(memory_total=BUDGET_MB << 20, memory_fraction=1.0,
-                          mem_wait_timeout_s=5.0)
+                          mem_wait_timeout_s=5.0,
+                          trace_enable=bool(PROFILE_DIR))
             with Session(conf=conf) as sess:
                 table = sess.execute_to_table(res.plan)
                 spills = sess.metrics.total("spill_count")
                 spill_bytes = sess.metrics.total("spilled_bytes")
+                if PROFILE_DIR:
+                    from blaze_tpu.obs import TRACER, dump_profile
+
+                    dump_profile(sess, PROFILE_DIR, name)
+                    TRACER.reset()
             wall = time.perf_counter() - t0
             if extract is None:
                 d = table.to_pydict()
