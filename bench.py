@@ -1,13 +1,14 @@
-"""Benchmark: the four BASELINE.md query shapes over a generated TPC-DS-like
+"""Benchmark: the five BASELINE.md query shapes over a generated TPC-DS-like
 star schema (the reference's headline workloads, driver `BASELINE.json`):
 
   q01  scan -> decimal filter -> two-stage hash agg over an exchange -> top-k
   q06  group-by agg + broadcast hash join (BHJ)
   q17  star-schema multi-way join + shuffle exchange
   q47  sort + window rank within partition (SMJ/window class)
+  q67  window rank over MANY tiny partitions (segmented-window class)
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "shapes"}.
-``value`` is the total engine wall-clock across the four shapes;
+``value`` is the total engine wall-clock across the five shapes;
 ``vs_baseline`` is speedup vs pandas doing the identical queries on the same
 parquet files (the round-1/2 denominator, kept for cross-round
 comparability); ``vs_arrow`` is speedup vs pyarrow Acero (multithreaded C++
@@ -375,6 +376,62 @@ def check_q47(out, oracle):
     assert got == want, "q47 ranked rows mismatch"
 
 
+def plan_q67(paths):
+    """q67-style window over MANY tiny partitions: top-3 stores per item by
+    quantity over the (item, store) agg — the shape the segmented window
+    path exists for (hundreds of thousands of window segments; the buffered
+    per-group loop paid one python iteration + device dispatch per group)."""
+    from blaze_tpu.ops.parquet import scan_node_for_files
+
+    sales = scan_node_for_files(paths["store_sales"], num_partitions=PARTS)
+    agg = _two_stage_agg(sales, [("ss_item_sk", _col("ss_item_sk")),
+                                 ("ss_store_sk", _col("ss_store_sk"))], [
+        ("qty", E.AggExpr(F.SUM, [_col("ss_quantity")]), None),
+    ], PARTS)
+    single = N.ShuffleExchange(agg, N.SinglePartitioning(1))
+    srt = N.Sort(single, [E.SortOrder(_col("ss_item_sk")),
+                          E.SortOrder(_col("qty"), ascending=False)])
+    win = N.Window(srt, [N.WindowExpr("rank", "rk")],
+                   [_col("ss_item_sk")],
+                   [E.SortOrder(_col("qty"), ascending=False)])
+    return N.Filter(win, [E.BinaryExpr(E.BinaryOp.LTEQ, _col("rk"),
+                                       E.Literal(3, T.I32))])
+
+
+def pandas_q67(dfs):
+    g = dfs["store_sales"].groupby(
+        ["ss_item_sk", "ss_store_sk"]).ss_quantity.sum().reset_index()
+    g["rk"] = g.groupby("ss_item_sk").ss_quantity.rank(
+        method="min", ascending=False)
+    return g[g.rk <= 3]
+
+
+def acero_q67(tables):
+    g = tables["store_sales"].group_by(["ss_item_sk", "ss_store_sk"]).aggregate(
+        [("ss_quantity", "sum")])
+    # acero has no window operator: numpy rank over the agg output (same
+    # bolt-on as acero_q47, here over ~N_ITEMS*N_STORES groups)
+    key = np.asarray(g["ss_item_sk"])
+    qty = np.asarray(g["ss_quantity_sum"])
+    order = np.lexsort((-qty, key))
+    key_s, qty_s = key[order], qty[order]
+    new_key = np.concatenate([[True], key_s[1:] != key_s[:-1]])
+    grp_start = np.maximum.accumulate(np.where(new_key, np.arange(len(key_s)), 0))
+    new_val = np.concatenate([[True], (qty_s[1:] != qty_s[:-1]) | new_key[1:]])
+    val_start = np.maximum.accumulate(np.where(new_val, np.arange(len(key_s)), 0))
+    rk = val_start - grp_start + 1
+    return g.take(order[rk <= 3])
+
+
+def check_q67(out, oracle):
+    got = sorted(zip(out.to_pydict()["ss_item_sk"],
+                     out.to_pydict()["ss_store_sk"],
+                     out.to_pydict()["qty"]))
+    want = sorted(zip(oracle.ss_item_sk, oracle.ss_store_sk,
+                      oracle.ss_quantity))
+    assert got == want, "q67 ranked rows mismatch"
+
+
 SHAPES = [
     # (name, plan, pandas oracle, acero baseline, check, tables the query
     #  touches — the acero timing reads exactly these, as the engine does)
@@ -383,6 +440,7 @@ SHAPES = [
     ("q17", plan_q17, pandas_q17, acero_q17, check_q17,
      ("store_sales", "item", "store")),
     ("q47", plan_q47, pandas_q47, acero_q47, check_q47, ("store_sales", "item")),
+    ("q67", plan_q67, pandas_q67, acero_q67, check_q67, ("store_sales",)),
 ]
 
 
@@ -407,6 +465,9 @@ def roofline_model(name: str) -> dict:
         "q17": (3 * 8 + 24, 32),
         # q47: 2 pruned fact cols; probe + agg + rank over tiny agg output
         "q47": (2 * 8, 20),
+        # q67: 3 fact cols; 2-key hash agg + segmented rank over the
+        # (item, store) groups
+        "q67": (3 * 8, 14),
     }[name]
     return {"model_bytes": per_row[0] * r, "model_flops": per_row[1] * r,
             "flops_per_byte": round(per_row[1] / per_row[0], 3)}
@@ -430,15 +491,18 @@ def run_engine(paths, plan_fn=plan_q01):
         from blaze_tpu.config import get_config
 
         conf = _dc.replace(get_config(), trace_enable=True)
+    from blaze_tpu.runtime.metrics import tripwire_totals
+
     t0 = time.perf_counter()
     with Session(conf=conf) as sess:
         out = sess.execute_to_table(plan_fn(paths))
+        trips = tripwire_totals(sess.metrics)
         if profile_dir:
             from blaze_tpu.obs import TRACER, dump_profile
 
             dump_profile(sess, profile_dir, plan_fn.__name__)
             TRACER.reset()
-    return time.perf_counter() - t0, out
+    return time.perf_counter() - t0, out, trips
 
 
 def load_dfs(paths):
@@ -540,7 +604,7 @@ def main():
         for name, plan_fn, _oracle_fn, _acero_fn, check_fn, _t in SHAPES:
             run_engine(paths, plan_fn)  # warmup compiles the shape's kernels
             DEVICE_STATS.reset()
-            engine_s, out = run_engine(paths, plan_fn)
+            engine_s, out, trips = run_engine(paths, plan_fn)
             dev = DEVICE_STATS.snapshot()
             check_fn(out, oracles[name])  # correctness gate before numbers
             rl = roofline_model(name)
@@ -549,6 +613,11 @@ def main():
                     rl["model_bytes"] / dev["kernel_time_s"] / 1e9, 2)
                 rl["effective_gflops"] = round(
                     rl["model_flops"] / dev["kernel_time_s"] / 1e9, 2)
+            # invariant tripwires next to the timing (metrics.TRIPWIRE_METRICS):
+            # a silently-degraded fast path shows up as a counter diff here,
+            # not a slowdown hunt (window_group_loops must stay 0;
+            # window-bearing shapes must report window_segments > 0)
+            dev = dict(dev, **trips)
             shapes[name] = {"value": round(engine_s, 3), "unit": "s",
                             "backend": backend,
                             "kernel_stats": dev,
@@ -567,7 +636,7 @@ def main():
             shapes[name]["vs_arrow"] = round(
                 arrow_shapes[name] / shapes[name]["value"], 3)
         record = {
-            "metric": f"tpcds_4shape_{ROWS}rows_total_wallclock",
+            "metric": f"tpcds_5shape_{ROWS}rows_total_wallclock",
             "value": round(total, 3),
             "unit": "s",
             # vs pandas on the identical four queries (the round-1/2

@@ -240,6 +240,153 @@ def _concat_gather(datas, valids, idx, live):
     return out_d, out_v
 
 
+# -- segmented scans ---------------------------------------------------------
+#
+# Shared by the window operator (and usable by rollup/partial-agg): group
+# structure arrives as a boundary MASK over pre-sorted rows, never as control
+# flow. Every helper supports a "carry" so a segment spanning batch boundaries
+# continues from the previous batch's accumulators instead of forcing the
+# caller to buffer the open segment.
+
+
+def seg_start_index(seg_start: np.ndarray) -> np.ndarray:
+    """Per-row index of the most recent True in ``seg_start`` at or before
+    the row; -1 for head rows that continue a segment carried in from the
+    previous batch."""
+    n = len(seg_start)
+    idx = np.arange(n, dtype=np.int64)
+    return np.maximum.accumulate(np.where(seg_start, idx, np.int64(-1)))
+
+
+def restarting_counters(part_start: np.ndarray, new_peer: np.ndarray,
+                        carry_rn: int = 0, carry_rank: int = 1,
+                        carry_dense: int = 0):
+    """row_number / rank / dense_rank as restart-at-segment prefix scans.
+
+    ``part_start``/``new_peer`` are boundary masks over rows pre-sorted by
+    (partition, order); every partition start must also be a peer start.
+    Carries seed rows belonging to the partition left open by the previous
+    batch: carry_rn = its last row_number, carry_rank = the rank of its open
+    peer group, carry_dense = its last dense_rank."""
+    n = len(part_start)
+    idx = np.arange(n, dtype=np.int64)
+    psi = seg_start_index(part_start)
+    rn = np.where(psi >= 0, idx - psi + 1, idx + 1 + carry_rn)
+    ppi = seg_start_index(new_peer)
+    rank = np.where(ppi >= 0, rn[np.clip(ppi, 0, None)], carry_rank)
+    c = np.cumsum(new_peer.astype(np.int64))
+    base = np.where(psi >= 0, c[np.clip(psi, 0, None)] - 1,
+                    np.int64(-carry_dense))
+    dense = c - base
+    return rn, rank, dense
+
+
+def segment_cumsum(vals: np.ndarray, valid: np.ndarray,
+                   seg_start: np.ndarray, carry_sum=0, carry_cnt: int = 0):
+    """Inclusive per-row (sum, count) of ``vals`` masked by ``valid``,
+    restarting at every True in ``seg_start``; head rows continue the carried
+    accumulators. Works on numeric AND object (Decimal) planes — one global
+    cumsum with per-segment base subtraction, no per-group loop."""
+    n = len(vals)
+    masked = np.where(valid, vals, 0)
+    cs = np.cumsum(masked)
+    cc = np.cumsum(valid.astype(np.int64))
+    si = seg_start_index(seg_start)
+    prev = np.clip(si - 1, 0, None)
+    out_s = cs - np.where(si >= 1, cs[prev], 0)
+    out_c = cc - np.where(si >= 1, cc[prev], 0)
+    head = si < 0
+    if head.any():
+        out_s[head] += carry_sum
+        out_c[head] += carry_cnt
+    return out_s, out_c
+
+
+def segment_running_reduce(vals: np.ndarray, valid: np.ndarray,
+                           seg_start: np.ndarray, is_min: bool, carry=None):
+    """Per-row running min/max within segments (restarting at ``seg_start``),
+    invalid rows transparent; ``carry`` (or None) is the extremum of the open
+    head segment. Min/max is not invertible, so instead of base subtraction
+    this runs log2(n) masked Hillis-Steele doubling passes — still fully
+    vectorized. Rows whose running count is 0 hold an identity sentinel
+    (numeric) or None (object); callers null them out via the paired count."""
+    n = len(vals)
+    si = seg_start_index(seg_start)
+    begin = np.where(si >= 0, si, 0)
+    if vals.dtype == object:
+        def _comb2(a, b):
+            if a is None:
+                return b
+            if b is None:
+                return a
+            return min(a, b) if is_min else max(a, b)
+        comb = np.frompyfunc(_comb2, 2, 1)
+        out = np.where(valid, vals, None)
+    else:
+        if np.issubdtype(vals.dtype, np.floating):
+            sent = np.array(np.inf if is_min else -np.inf, dtype=vals.dtype)
+        else:
+            info = np.iinfo(vals.dtype)
+            sent = np.array(info.max if is_min else info.min, dtype=vals.dtype)
+        comb = np.minimum if is_min else np.maximum
+        out = np.where(valid, vals, sent)
+    idx = np.arange(n, dtype=np.int64)
+    off = 1
+    while off < n:
+        ok = idx - off >= begin
+        if not ok.any():
+            break
+        out = np.where(ok, comb(out, out[np.clip(idx - off, 0, None)]), out)
+        off <<= 1
+    head = si < 0
+    if carry is not None and head.any():
+        out[head] = comb(out[head], carry)
+    return out
+
+
+@jax.jit
+def _seg_scan(data, validity, exists, seg_start, carry_sum, carry_cnt):
+    n = data.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int64)
+    si = lax.cummax(jnp.where(seg_start, idx, jnp.int64(-1)), axis=0)
+    if jnp.issubdtype(data.dtype, jnp.integer):
+        data = data.astype(jnp.int64)  # match numpy's cumsum promotion
+    validity = validity & exists
+    masked = jnp.where(validity, data, jnp.zeros((), data.dtype))
+    cs = jnp.cumsum(masked)
+    cc = jnp.cumsum(validity.astype(jnp.int64))
+    prev = jnp.clip(si - 1, 0, None)
+    out_s = cs - jnp.where(si >= 1, cs[prev], jnp.zeros((), cs.dtype))
+    out_c = cc - jnp.where(si >= 1, cc[prev], 0)
+    head = si < 0
+    out_s = out_s + jnp.where(head, carry_sum.astype(cs.dtype),
+                              jnp.zeros((), cs.dtype))
+    out_c = out_c + jnp.where(head, carry_cnt, 0)
+    return out_s, out_c
+
+
+def segment_scan_planes(data: jax.Array, validity: jax.Array,
+                        exists: jax.Array, seg_start: np.ndarray,
+                        carry_sum, carry_cnt: int):
+    """Device-resident segmented (sum, count) scan in ONE jitted dispatch.
+
+    A ``jax.ops.segment_sum`` formulation would key the jit cache on the
+    dynamic per-batch segment count and recompile constantly; this cumsum +
+    cummax-restart form is shape-stable (capacity buckets recur). seg_start
+    has batch length n <= capacity and is padded here; padding rows carry
+    exists False so they never perturb prefixes below n. Returns numpy
+    (sum, count) planes for host-side frame backfill."""
+    cap = data.shape[0]
+    n = len(seg_start)
+    pad = np.zeros(cap, dtype=bool)
+    pad[:n] = seg_start
+    cdt = data.dtype if jnp.issubdtype(data.dtype, jnp.floating) else jnp.int64
+    out_s, out_c = _dispatch(
+        _seg_scan, data, validity, exists, jnp.asarray(pad),
+        jnp.asarray(carry_sum, dtype=cdt), jnp.int64(carry_cnt))
+    return np.asarray(out_s)[:n], np.asarray(out_c)[:n]
+
+
 def concat_planes(per_field_datas: List[Tuple[jax.Array, ...]],
                   per_field_valids: List[Tuple[jax.Array, ...]],
                   num_rows: Sequence[int], out_cap: int):

@@ -314,24 +314,37 @@ class BatchWriter:
         return zlib.compress(payload, 1), 3
 
 
+def read_frames(fileobj) -> Iterator[tuple]:
+    """Yield raw ``(flags, payload, raw_len)`` frames without decoding —
+    frame READS stay sequential (one stream position) while the shuffle
+    reader fans DECODE out to worker threads: the ctypes zstd/lz4 one-shots
+    release the GIL, so decompression genuinely parallelizes."""
+    while True:
+        head = fileobj.read(_FRAME_LEN)
+        if not head:
+            return
+        magic, flags, plen, raw_len = struct.unpack(_FRAME_FMT, head)
+        assert magic == _MAGIC, f"bad frame magic {magic!r}"
+        yield flags, fileobj.read(plen), raw_len
+
+
+def decode_frame(flags: int, payload: bytes, raw_len: int) -> ColumnarBatch:
+    """Decompress + deserialize one frame (thread-safe)."""
+    if flags == 2:
+        payload = _lz4_decompress(payload, raw_len)
+    elif flags == 1:
+        payload = _zstd_decompress(payload, raw_len)
+    elif flags == 3:
+        import zlib
+
+        payload = zlib.decompress(payload)
+    return deserialize_batch(payload)
+
+
 class BatchReader:
     def __init__(self, fileobj: BinaryIO):
         self.f = fileobj
 
     def __iter__(self) -> Iterator[ColumnarBatch]:
-        while True:
-            head = self.f.read(_FRAME_LEN)
-            if not head:
-                return
-            magic, flags, plen, raw_len = struct.unpack(_FRAME_FMT, head)
-            assert magic == _MAGIC, f"bad frame magic {magic!r}"
-            payload = self.f.read(plen)
-            if flags == 2:
-                payload = _lz4_decompress(payload, raw_len)
-            elif flags == 1:
-                payload = _zstd_decompress(payload, raw_len)
-            elif flags == 3:
-                import zlib
-
-                payload = zlib.decompress(payload)
-            yield deserialize_batch(payload)
+        for flags, payload, raw_len in read_frames(self.f):
+            yield decode_frame(flags, payload, raw_len)

@@ -78,18 +78,10 @@ def key_codes(batch: ColumnarBatch, cols: List[Column], key_map: Dict,
         codes[null_any] = -1
         return codes
     # host path: canonical python tuples
-    def _canon(v):
-        if isinstance(v, float):
-            if v != v:
-                return float("nan")  # one canonical NaN payload
-            if v == 0.0:
-                return 0.0  # fold -0.0
-        return v
-
     pylists = [c.to_arrow(n).to_pylist() for c in cols]
     codes = np.empty(n, dtype=np.int64)
     for i in range(n):
-        key = tuple(_canon(pl[i]) for pl in pylists)
+        key = tuple(_canon_value(pl[i]) for pl in pylists)
         if any(v is None for v in key):
             codes[i] = -1
             continue
@@ -103,6 +95,87 @@ def key_codes(batch: ColumnarBatch, cols: List[Column], key_map: Dict,
                 code = -1
         codes[i] = code
     return codes
+
+
+def _canon_value(v):
+    """Canonical python key value (host paths): one NaN payload, -0.0
+    folded — same equality as the device word encoding."""
+    if isinstance(v, float):
+        if v != v:
+            return float("nan")
+        if v == 0.0:
+            return 0.0
+    return v
+
+
+def key_rows(batch: ColumnarBatch, cols: List[Column]):
+    """Canonical PER-ROW key representation for sorted-adjacent consumers
+    (window partition/peer boundaries): unlike ``key_codes`` there is no
+    interning dict to rebuild per batch — a single row is O(1) to carry
+    across a batch boundary, and nulls are grouped as values (null == null,
+    Spark grouping semantics) instead of coding every null-keyed row -1.
+    That also fixes the key_codes-based boundary detection merging adjacent
+    (1, NULL) and (2, NULL) partitions, which both coded -1.
+
+    Device columns -> (n, 2k) int64 matrix of (canonical word, null flag)
+    pairs; any host column -> list of canonical python tuples."""
+    n = batch.num_rows
+    if all(isinstance(c, DeviceColumn) for c in cols):
+        from blaze_tpu.utils.device import pull_columns
+
+        pulled = pull_columns(cols, n)
+        mats = []
+        for data, valid in pulled:
+            mats.append(_canon_words(np.where(valid, data, data.dtype.type(0))))
+            mats.append((~valid).astype(np.int64))
+        return np.column_stack(mats)
+    pylists = [c.to_arrow(n).to_pylist() for c in cols]
+    return [tuple(_canon_value(pl[i]) for pl in pylists) for i in range(n)]
+
+
+class RunningKeyCodes:
+    """Run-boundary detector over batches whose rows arrive sorted by the
+    key (window input): O(1) carried state (the last row's canonical key)
+    instead of a per-batch interning map, so partitions spanning batches are
+    recognized as continuations for free."""
+
+    def __init__(self):
+        self.last = None      # canonical last key row seen (or None)
+        self.next_code = 0    # next unassigned run code
+
+    def push_rows(self, rows) -> np.ndarray:
+        """Consume precomputed ``key_rows`` output; returns the (n,) bool
+        run-start mask (True where the row differs from its predecessor,
+        including across the batch boundary)."""
+        if isinstance(rows, np.ndarray):
+            n = rows.shape[0]
+            if n == 0:
+                return np.zeros(0, dtype=bool)
+            ch = np.zeros(n, dtype=bool)
+            ch[1:] = (rows[1:] != rows[:-1]).any(axis=1)
+            ch[0] = self.last is None or not np.array_equal(rows[0], self.last)
+            self.last = rows[-1].copy()
+        else:
+            n = len(rows)
+            if n == 0:
+                return np.zeros(0, dtype=bool)
+            ch = np.zeros(n, dtype=bool)
+            ch[1:] = np.fromiter(
+                (rows[i] != rows[i - 1] for i in range(1, n)), bool, n - 1)
+            ch[0] = self.last is None or rows[0] != self.last
+            self.last = rows[-1]
+        return ch
+
+    def change_mask(self, batch: ColumnarBatch, cols: List[Column]) -> np.ndarray:
+        return self.push_rows(key_rows(batch, cols))
+
+    def codes(self, batch: ColumnarBatch, cols: List[Column]) -> np.ndarray:
+        """Cross-batch-stable run codes (each maximal equal-key run gets the
+        next integer; a run spanning batches keeps ONE code)."""
+        ch = self.change_mask(batch, cols)
+        out = (self.next_code - 1) + np.cumsum(ch.astype(np.int64))
+        self.next_code = int(out[-1]) + 1 if len(out) else self.next_code
+        return out
 
 
 def _canon_words(data: np.ndarray) -> np.ndarray:

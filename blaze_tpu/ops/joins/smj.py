@@ -1,15 +1,22 @@
-"""Sort-merge join: streaming cursors over key-sorted inputs.
+"""Sort-merge join: vectorized run matching over key-sorted inputs.
 
-Reference: ``sort_merge_join_exec.rs:57-375`` + ``joins/smj/*.rs`` +
-``joins/stream_cursor.rs`` — inner/left/right/full/semi/anti/existence over
-StreamCursors that advance equal-key runs. Here cursors compare host
-key-tuples (total order incl. null rank, shared with the sort operator) and
-each equal-key run pair emits its cross product via vectorized gathers;
-rows with null join keys never match (Spark equi-join semantics)."""
+Reference: ``sort_merge_join_exec.rs:57-375`` + ``joins/smj/*.rs`` — cursors
+advancing equal-key runs. A literal cursor port paid one batch-concat plus
+two device gathers PER RUN; on post-shuffle near-unique keys (the q47/q57
+self-joins) that is tens of thousands of device dispatches per task — the
+same per-group pathology the segmented window rewrite removed. Both inputs
+arrive key-sorted from full-materializing sorts, so buffering a side adds no
+asymptotic memory; the join therefore interns each side's key rows to integer
+codes once (``keymap.key_codes`` — the hash-join canonicalization; rows with
+any null key code -1 and never match, Spark equi-join semantics), finds each
+side's equal-key runs with one boundary mask, pairs runs by code, and expands
+matched (left, right) row indices with repeat/arange arithmetic. Emission is
+one gather per output chunk, never per run. Sort DIRECTION never matters
+here: equal keys are adjacent either way, and codes match by equality."""
 
 from __future__ import annotations
 
-from typing import Iterator, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -17,74 +24,28 @@ from blaze_tpu.core.batch import ColumnarBatch, DeviceColumn
 from blaze_tpu.ir import exprs as E
 from blaze_tpu.ir import types as T
 from blaze_tpu.ir.nodes import JoinType, _join_output_schema
-from blaze_tpu.ops import sort_keys as SK
 from blaze_tpu.ops.base import Operator
+from blaze_tpu.ops.joins import keymap
 
 
-class _SideCursor:
-    """Iterates a sorted child as (key_tuple, rows) runs; a run's rows may
-    span batches (reference: StreamCursor)."""
-
-    def __init__(self, batch_iter, key_exprs: List[E.Expr],
-                 sort_options: List[Tuple[bool, bool]], schema):
-        self.it = batch_iter
-        self.orders = [
-            E.SortOrder(e, asc, nf) for e, (asc, nf) in zip(key_exprs, sort_options)
-        ]
-        self.schema = schema
-        self.batch: Optional[ColumnarBatch] = None
-        self.keys: Optional[list] = None
-        self.pos = 0
-        self.exhausted = False
-        self._advance_batch()
-
-    def _advance_batch(self) -> bool:
-        for b in self.it:
-            if b.num_rows == 0:
-                continue
-            self.batch = b
-            self.keys = SK.host_keys_matrix(b, self.orders)
-            self.pos = 0
-            return True
-        self.batch = None
-        self.exhausted = True
-        return False
-
-    def peek_key(self):
-        return self.keys[self.pos]
-
-    def key_is_null(self) -> bool:
-        return any(part[0] != 1 for part in self.peek_key())
-
-    def next_run(self) -> Tuple[tuple, List[Tuple[ColumnarBatch, int, int]]]:
-        """Pop the run of rows equal to the current key."""
-        key = self.peek_key()
-        segments = []
-        while True:
-            start = self.pos
-            n = self.batch.num_rows
-            while self.pos < n and self.keys[self.pos] == key:
-                self.pos += 1
-            if self.pos > start:
-                segments.append((self.batch, start, self.pos))
-            if self.pos < n:
-                return key, segments
-            if not self._advance_batch():
-                return key, segments
-
-    def skip_nulls(self) -> List[Tuple[ColumnarBatch, int, int]]:
-        """Pop all leading null-keyed rows (they sort together at the null
-        rank); returns their segments for outer emission."""
-        segments = []
-        while not self.exhausted and self.key_is_null():
-            _, segs = self.next_run()
-            segments.extend(segs)
-        return segments
+def _gather_side(batch_iter, schema) -> ColumnarBatch:
+    batches = [b for b in batch_iter if b.num_rows]
+    if not batches:
+        return ColumnarBatch.empty(schema)
+    if len(batches) == 1:
+        return batches[0]
+    return ColumnarBatch.concat(batches, schema)
 
 
-def _materialize(segments: List[Tuple[ColumnarBatch, int, int]], schema) -> ColumnarBatch:
-    parts = [b.slice(s, e - s) for b, s, e in segments]
-    return ColumnarBatch.concat(parts, schema)
+def _runs(codes: np.ndarray):
+    """(start, end, code) per maximal equal-code run of a sorted side."""
+    n = len(codes)
+    if n == 0:
+        e = np.empty(0, dtype=np.int64)
+        return e, e, e
+    starts = np.flatnonzero(np.concatenate([[True], codes[1:] != codes[:-1]]))
+    ends = np.concatenate([starts[1:], [n]]).astype(np.int64)
+    return starts.astype(np.int64), ends, codes[starts]
 
 
 class SortMergeJoinExec(Operator):
@@ -106,59 +67,115 @@ class SortMergeJoinExec(Operator):
         return self.children[0].num_partitions()
 
     def _execute(self, partition, ctx, metrics):
+        from blaze_tpu.exprs.compiler import ExprEvaluator
+
         jt = self.join_type
-        lcur = _SideCursor(self.execute_child(0, partition, ctx, metrics),
-                           [l for l, _ in self.on], self.sort_options,
-                           self.children[0].schema)
-        rcur = _SideCursor(self.execute_child(1, partition, ctx, metrics),
-                           [r for _, r in self.on], self.sort_options,
-                           self.children[1].schema)
+        lschema = self.children[0].schema
+        rschema = self.children[1].schema
+        lbig = _gather_side(self.execute_child(0, partition, ctx, metrics),
+                            lschema)
+        rbig = _gather_side(self.execute_child(1, partition, ctx, metrics),
+                            rschema)
+        nl, nr = lbig.num_rows, rbig.num_rows
         emitter = _Emitter(self, ctx.conf.batch_size)
+        keep_left_unmatched = jt in (JoinType.LEFT, JoinType.FULL)
+        keep_right_unmatched = jt in (JoinType.RIGHT, JoinType.FULL)
 
-        keep_left_unmatched = jt in (JoinType.LEFT, JoinType.FULL,
-                                     JoinType.LEFT_ANTI, JoinType.EXISTENCE)
-        keep_right_unmatched = jt in (JoinType.RIGHT, JoinType.FULL,
-                                      JoinType.RIGHT_ANTI)
+        key_map: dict = {}
+        lcodes = keymap.key_codes(
+            lbig, ExprEvaluator([l for l, _ in self.on],
+                                lschema).evaluate(lbig),
+            key_map, insert=True) if nl else np.empty(0, dtype=np.int64)
+        rcodes = keymap.key_codes(
+            rbig, ExprEvaluator([r for _, r in self.on],
+                                rschema).evaluate(rbig),
+            key_map, insert=False) if nr else np.empty(0, dtype=np.int64)
 
-        while not lcur.exhausted or not rcur.exhausted:
-            # null-keyed rows can never match: treat as unmatched
-            lnull = lcur.skip_nulls() if not lcur.exhausted else []
-            rnull = rcur.skip_nulls() if not rcur.exhausted else []
-            if lnull and keep_left_unmatched:
-                yield from emitter.left_unmatched(_materialize(lnull, lcur.schema))
-            if rnull and keep_right_unmatched:
-                yield from emitter.right_unmatched(_materialize(rnull, rcur.schema))
-            if lcur.exhausted and rcur.exhausted:
-                break
-            if lcur.exhausted:
-                if keep_right_unmatched:
-                    _, segs = rcur.next_run()
-                    yield from emitter.right_unmatched(_materialize(segs, rcur.schema))
-                else:
-                    rcur.next_run()
+        rstarts, rends, rrun_codes = _runs(rcodes)
+        rrun = {int(c): (int(s), int(e))
+                for s, e, c in zip(rstarts, rends, rrun_codes) if c >= 0}
+
+        # per-left-row match window into the right side (one dict lookup per
+        # left RUN, not per row; everything after this is array arithmetic)
+        match_rs = np.zeros(nl, dtype=np.int64)
+        counts = np.zeros(nl, dtype=np.int64)
+        r_matched = np.zeros(nr, dtype=bool)
+        lstarts, lends, lrun_codes = _runs(lcodes)
+        for s, e, c in zip(lstarts, lends, lrun_codes):
+            if c < 0:
                 continue
-            if rcur.exhausted:
-                if keep_left_unmatched:
-                    _, segs = lcur.next_run()
-                    yield from emitter.left_unmatched(_materialize(segs, lcur.schema))
-                else:
-                    lcur.next_run()
+            hit = rrun.get(int(c))
+            if hit is None:
                 continue
-            lk, rk = lcur.peek_key(), rcur.peek_key()
-            if lk < rk:
-                _, segs = lcur.next_run()
-                if keep_left_unmatched:
-                    yield from emitter.left_unmatched(_materialize(segs, lcur.schema))
-            elif rk < lk:
-                _, segs = rcur.next_run()
-                if keep_right_unmatched:
-                    yield from emitter.right_unmatched(_materialize(segs, rcur.schema))
-            else:
-                _, lsegs = lcur.next_run()
-                _, rsegs = rcur.next_run()
-                lrun = _materialize(lsegs, lcur.schema)
-                rrun = _materialize(rsegs, rcur.schema)
-                yield from emitter.matched(lrun, rrun)
+            rs, re = hit
+            match_rs[s:e] = rs
+            counts[s:e] = re - rs
+            r_matched[rs:re] = True
+        l_matched = counts > 0
+        total = int(counts.sum())
+        metrics.add("smj_matched_pairs", total)
+
+        # matched pair index expansion, grouped by left row
+        li = np.repeat(np.arange(nl, dtype=np.int64), counts)
+        excl = np.cumsum(counts) - counts
+        ri = np.repeat(match_rs, counts) + \
+            (np.arange(total, dtype=np.int64) - np.repeat(excl, counts))
+
+        bs = ctx.conf.batch_size
+        cond = self.condition
+        if cond is not None and total:
+            # re-derive matched flags from pairs that actually pass
+            l_matched = np.zeros(nl, dtype=bool)
+            r_matched = np.zeros(nr, dtype=bool)
+            emit_pairs = jt in (JoinType.INNER, JoinType.LEFT, JoinType.RIGHT,
+                                JoinType.FULL)
+            for a in range(0, total, bs):
+                lic, ric = li[a:a + bs], ri[a:a + bs]
+                lout = lbig.take(lic)
+                rout = rbig.take(ric)
+                pair = ColumnarBatch(self._pair_schema,
+                                     lout.columns + rout.columns, len(lic))
+                keep = np.asarray(
+                    emitter.cond_ev.evaluate_predicate(pair))[:len(lic)]
+                l_matched[lic[keep]] = True
+                r_matched[ric[keep]] = True
+                if emit_pairs and keep.any():
+                    kept = pair.take(np.flatnonzero(keep))
+                    yield from emitter._push(
+                        ColumnarBatch(self.schema, kept.columns,
+                                      kept.num_rows))
+        elif total and jt in (JoinType.INNER, JoinType.LEFT, JoinType.RIGHT,
+                              JoinType.FULL):
+            for a in range(0, total, bs):
+                lout = lbig.take(li[a:a + bs])
+                rout = rbig.take(ri[a:a + bs])
+                yield from emitter._push(
+                    ColumnarBatch(self.schema, lout.columns + rout.columns,
+                                  lout.num_rows))
+
+        # membership join types resolve from the flags, in input order
+        if jt == JoinType.LEFT_SEMI:
+            yield from emitter._take_push(lbig, np.flatnonzero(l_matched))
+        elif jt == JoinType.LEFT_ANTI:
+            yield from emitter._take_push(lbig, np.flatnonzero(~l_matched))
+        elif jt == JoinType.RIGHT_SEMI:
+            yield from emitter._take_push(rbig, np.flatnonzero(r_matched))
+        elif jt == JoinType.RIGHT_ANTI:
+            yield from emitter._take_push(rbig, np.flatnonzero(~r_matched))
+        elif jt == JoinType.EXISTENCE:
+            for a in range(0, nl, bs):
+                chunk = lbig.take(
+                    np.arange(a, min(a + bs, nl), dtype=np.int64))
+                yield from emitter._push(
+                    emitter._with_exists(chunk, l_matched[a:a + bs]))
+        if keep_left_unmatched:
+            lun = np.flatnonzero(~l_matched)
+            if len(lun):
+                yield from emitter.left_unmatched(lbig.take(lun))
+        if keep_right_unmatched:
+            run_ = np.flatnonzero(~r_matched)
+            if len(run_):
+                yield from emitter.right_unmatched(rbig.take(run_))
         yield from emitter.flush()
 
 
@@ -173,7 +190,7 @@ class _Emitter:
         if op.condition is not None:
             from blaze_tpu.exprs.compiler import ExprEvaluator
 
-            # one evaluator for all runs: keeps the CSE/jit caches warm
+            # one evaluator for all chunks: keeps the CSE/jit caches warm
             self.cond_ev = ExprEvaluator([op.condition], op._pair_schema)
 
     def _push(self, batch: Optional[ColumnarBatch]):
@@ -189,111 +206,28 @@ class _Emitter:
             self.rows = rest.num_rows
             yield out
 
+    def _take_push(self, batch: ColumnarBatch, idx: np.ndarray):
+        for a in range(0, len(idx), self.batch_size):
+            yield from self._push(batch.take(idx[a:a + self.batch_size]))
+
     def flush(self):
         if self.buf:
             yield ColumnarBatch.concat(self.buf, self.op.schema)
             self.buf, self.rows = [], 0
 
-    # -- emission by join type ------------------------------------------------
-
-    def matched(self, lrun: ColumnarBatch, rrun: ColumnarBatch):
-        jt = self.op.join_type
-        nl, nr = lrun.num_rows, rrun.num_rows
-        cond = self.op.condition
-        if cond is None:
-            # no pair expansion for the non-pair join types (a skewed run
-            # would otherwise allocate O(nl*nr) just to learn "all matched")
-            if jt == JoinType.LEFT_SEMI:
-                yield from self._push(lrun)
-                return
-            if jt == JoinType.RIGHT_SEMI:
-                yield from self._push(rrun)
-                return
-            if jt in (JoinType.LEFT_ANTI, JoinType.RIGHT_ANTI):
-                return
-            if jt == JoinType.EXISTENCE:
-                yield from self._push(
-                    self._with_exists(lrun, np.ones(nl, dtype=bool)))
-                return
-        li = np.repeat(np.arange(nl), nr)
-        ri = np.tile(np.arange(nr), nl)
-        if cond is not None:
-            lout = lrun.take(li)
-            rout = rrun.take(ri)
-            pair = ColumnarBatch(self.op._pair_schema,
-                                 lout.columns + rout.columns, nl * nr)
-            keep = np.asarray(self.cond_ev.evaluate_predicate(pair))[: nl * nr]
-            li, ri = li[keep], ri[keep]
-        l_matched = np.zeros(nl, dtype=bool)
-        l_matched[li] = True
-        r_matched = np.zeros(nr, dtype=bool)
-        r_matched[ri] = True
-
-        if jt == JoinType.LEFT_SEMI:
-            idx = np.nonzero(l_matched)[0]
-            if len(idx):
-                yield from self._push(lrun.take(idx))
-            return
-        if jt == JoinType.RIGHT_SEMI:
-            idx = np.nonzero(r_matched)[0]
-            if len(idx):
-                yield from self._push(rrun.take(idx))
-            return
-        if jt == JoinType.LEFT_ANTI:
-            idx = np.nonzero(~l_matched)[0]  # condition-failed rows
-            if len(idx):
-                yield from self._push(lrun.take(idx))
-            return
-        if jt == JoinType.RIGHT_ANTI:
-            idx = np.nonzero(~r_matched)[0]
-            if len(idx):
-                yield from self._push(rrun.take(idx))
-            return
-        if jt == JoinType.EXISTENCE:
-            yield from self._push(self._with_exists(lrun, l_matched))
-            return
-        if len(li):
-            lout = lrun.take(li)
-            rout = rrun.take(ri)
-            yield from self._push(
-                ColumnarBatch(self.op.schema, lout.columns + rout.columns, len(li)))
-        # key-matched rows whose every pair failed the condition are
-        # unmatched for outer purposes
-        if cond is not None:
-            lun = np.nonzero(~l_matched)[0]
-            if len(lun):
-                yield from self.left_unmatched(lrun.take(lun))
-            run_ = np.nonzero(~r_matched)[0]
-            if len(run_):
-                yield from self.right_unmatched(rrun.take(run_))
-
     def left_unmatched(self, lrun: ColumnarBatch):
-        jt = self.op.join_type
-        if jt in (JoinType.LEFT_ANTI,):
-            yield from self._push(lrun)
-            return
-        if jt == JoinType.EXISTENCE:
-            yield from self._push(
-                self._with_exists(lrun, np.zeros(lrun.num_rows, dtype=bool)))
-            return
-        if jt in (JoinType.LEFT, JoinType.FULL):
-            rnulls = ColumnarBatch.empty(self.op.children[1].schema).take_nullable(
-                np.full(lrun.num_rows, -1, np.int64))
-            yield from self._push(
-                ColumnarBatch(self.op.schema, lrun.columns + rnulls.columns,
-                              lrun.num_rows))
+        rnulls = ColumnarBatch.empty(self.op.children[1].schema).take_nullable(
+            np.full(lrun.num_rows, -1, np.int64))
+        yield from self._push(
+            ColumnarBatch(self.op.schema, lrun.columns + rnulls.columns,
+                          lrun.num_rows))
 
     def right_unmatched(self, rrun: ColumnarBatch):
-        jt = self.op.join_type
-        if jt == JoinType.RIGHT_ANTI:
-            yield from self._push(rrun)
-            return
-        if jt in (JoinType.RIGHT, JoinType.FULL):
-            lnulls = ColumnarBatch.empty(self.op.children[0].schema).take_nullable(
-                np.full(rrun.num_rows, -1, np.int64))
-            yield from self._push(
-                ColumnarBatch(self.op.schema, lnulls.columns + rrun.columns,
-                              rrun.num_rows))
+        lnulls = ColumnarBatch.empty(self.op.children[0].schema).take_nullable(
+            np.full(rrun.num_rows, -1, np.int64))
+        yield from self._push(
+            ColumnarBatch(self.op.schema, lnulls.columns + rrun.columns,
+                          rrun.num_rows))
 
     def _with_exists(self, lrun: ColumnarBatch, flags: np.ndarray) -> ColumnarBatch:
         exists = DeviceColumn.from_numpy(T.BOOL, np.asarray(flags, dtype=bool),

@@ -34,12 +34,22 @@ class IpcReaderExec(Operator):
     def num_partitions(self):
         return self._num_partitions
 
+    _DECODE_WORKERS = 3
+
     def _execute(self, partition, ctx, metrics):
         import queue
         import threading
+        from concurrent.futures import Future, ThreadPoolExecutor
+
+        from blaze_tpu.io.batch_serde import decode_frame, read_frames
 
         provider = ctx.resources[self.resource_id]
         blocks: Iterable = provider(partition) if callable(provider) else provider
+        # the queue holds FUTURES in frame order: frame reads stay sequential
+        # on the prefetch thread, decompress + deserialize fan out to the
+        # worker pool (ctypes zstd/lz4 one-shots release the GIL), and the
+        # consumer resolves in order — bounded in-flight frames =
+        # qsize + workers
         q: "queue.Queue" = queue.Queue(maxsize=4)
         stop = threading.Event()
         SENTINEL = object()
@@ -53,8 +63,16 @@ class IpcReaderExec(Operator):
                     continue
             return False
 
+        def _decode(flags, payload, raw_len):
+            batch = decode_frame(flags, payload, raw_len)
+            metrics.add("ipc_decode_in_prefetch", 1)
+            return batch
+
+        pool = ThreadPoolExecutor(max_workers=self._DECODE_WORKERS,
+                                  thread_name_prefix="ipc-decode")
+
         def produce():
-            # the prefetch thread is where fetch+decode time actually goes;
+            # the prefetch side is where fetch+decode time actually goes;
             # the consumer side only measures queue wait
             import time
 
@@ -67,8 +85,8 @@ class IpcReaderExec(Operator):
                 for block in blocks:
                     nblocks += 1
                     stream = _open_block(block)
-                    for batch in BatchReader(stream):
-                        if not _put(batch):
+                    for frame in read_frames(stream):
+                        if not _put(pool.submit(_decode, *frame)):
                             return
                 _put(SENTINEL)
             except BaseException as exc:
@@ -86,6 +104,8 @@ class IpcReaderExec(Operator):
             while True:
                 with metrics.timer("shuffle_read_wait_time_ns"):
                     item = q.get()
+                    if isinstance(item, Future):
+                        item = item.result()  # re-raises worker exceptions
                 if item is SENTINEL:
                     break
                 if isinstance(item, BaseException):
@@ -104,6 +124,7 @@ class IpcReaderExec(Operator):
                 except queue.Empty:
                     break
             t.join(timeout=5)
+            pool.shutdown(wait=False)
 
 
 def _open_block(block):

@@ -163,6 +163,23 @@ def merge_keys_matrix(batch: ColumnarBatch, sort_orders: List[E.SortOrder]) -> n
     return planes_merge_matrix(planes, sort_orders)
 
 
+def peer_key_rows(batch: ColumnarBatch, sort_orders: List[E.SortOrder],
+                  evaluator: Optional[ExprEvaluator] = None):
+    """Canonical per-row ORDER-key rows for window peer-boundary detection.
+
+    Delegates to the join keymap's carryable row encoding (keymap.key_rows)
+    so peer equality matches partition-key equality — floats folded
+    (-0.0 == 0.0, one NaN payload), nulls grouped as values — and the last
+    row is O(1) to carry across batches via keymap.RunningKeyCodes. Sort
+    DIRECTION is irrelevant here: peers are equal-key runs, and the input
+    is already sorted, so only the equality encoding matters."""
+    from blaze_tpu.ops.joins.keymap import key_rows
+
+    ev = evaluator or ExprEvaluator([so.child for so in sort_orders],
+                                    batch.schema)
+    return key_rows(batch, ev.evaluate(batch))
+
+
 def host_sort_indices(batch: ColumnarBatch, sort_orders: List[E.SortOrder],
                       evaluator: Optional[ExprEvaluator] = None) -> np.ndarray:
     """Multi-key sort on host via arrow (var-width keys)."""

@@ -6,13 +6,21 @@ that detects group boundaries via row-format keys; WindowGroupLimit arrives
 as ``group_limit``. Input is sorted by (partition_spec, order_spec) — the
 converter guarantees it, as Spark does.
 
-Execution buffers each window partition until complete (partitions may span
-input batches), then computes every function vectorized over the whole
-partition: counters are numpy prefix scans over peer-boundary masks, and
-agg-over-window uses Spark's default frames (whole partition without ORDER
-BY; RANGE unbounded-preceding..current-row with ORDER BY, peers sharing the
-frame value via segment backfill). Partitions must fit in memory — the
-reference holds the same constraint per window group."""
+Execution is SEGMENTED for the common shapes (rank-family counters and
+default-frame aggregates): each input batch is processed in one shot over
+segment-boundary masks — partition starts from carryable key rows
+(keymap.key_rows / RunningKeyCodes), peer starts from order keys — with a
+small carry (counter bases, open aggregate accumulators, the last key row)
+threaded across batches. Group structure is data (masks feeding the
+restart-at-segment prefix scans in core/kernels), never control flow, so a
+batch with 100k tiny partitions costs the same as one with a single
+partition. Only the OPEN tail group is withheld until its frame value is
+known, and only when aggregates are present; the withheld slices live in a
+memmgr-watched _PartitionBuffer, so a single giant group degrades to the
+spill path instead of OOM. Explicit ROWS/RANGE offset frames need random
+access within the partition and keep the buffer-then-process path (those
+partitions must fit at process time — the reference holds the same
+constraint per window group)."""
 
 from __future__ import annotations
 
@@ -30,49 +38,10 @@ from blaze_tpu.ops.base import Operator
 from blaze_tpu.runtime.memmgr import MemConsumer, SpillFile
 
 
-def _partition_codes(batch: ColumnarBatch, exprs: List[E.Expr]) -> np.ndarray:
-    """Within-batch partition codes (consecutive equal keys share a code):
-    vectorized via the join keymap interning."""
-    if not exprs:
-        return np.zeros(batch.num_rows, dtype=np.int64)
-    from blaze_tpu.ops.joins.keymap import key_codes
-
-    ev = ExprEvaluator(exprs, batch.schema)
-    cols = ev.evaluate(batch)
-    # fresh map per batch: codes only need to distinguish neighbors
-    codes = key_codes(batch, cols, {}, insert=True)
-    # null keys (-1) form their own partitions: remap by run boundaries
-    change = np.empty(batch.num_rows, dtype=bool)
-    change[0] = True
-    change[1:] = codes[1:] != codes[:-1]
-    return np.cumsum(change) - 1
-
-
-def _peer_mask(batch: ColumnarBatch, order_spec: List[E.SortOrder]) -> np.ndarray:
-    """True where a new peer group starts (order-key change), within one
-    partition batch."""
-    n = batch.num_rows
-    if not order_spec:
-        out = np.zeros(n, dtype=bool)
-        if n:
-            out[0] = True
-        return out
-    from blaze_tpu.ops.joins.keymap import key_codes
-
-    ev = ExprEvaluator([so.child for so in order_spec], batch.schema)
-    cols = ev.evaluate(batch)
-    codes = key_codes(batch, cols, {}, insert=True)
-    out = np.empty(n, dtype=bool)
-    out[0] = True
-    out[1:] = codes[1:] != codes[:-1]
-    return out
-
-
 class _PartitionBuffer(MemConsumer):
-    """Memmgr-watched buffer for the current window partition: batches
-    accumulate in memory, spill to a compressed disk stream under pressure
-    (keeping the tail batch resident — the partition-continuation check
-    reads its last row), and replay in order at process time."""
+    """Memmgr-watched buffer for withheld window rows: batches accumulate in
+    memory, spill to a compressed disk stream under pressure (keeping the
+    tail batch resident), and replay in order at process time."""
 
     def __init__(self, schema: T.Schema, metrics):
         super().__init__("WindowExec", spillable=True)
@@ -113,15 +82,15 @@ class _PartitionBuffer(MemConsumer):
         return self.mem[-1]
 
     def iter_batches(self) -> Iterator[ColumnarBatch]:
-        """Stream the partition WITHOUT materializing it: spill files replay
-        from disk, resident batches follow. Re-iterable (spill files seek to
-        0 on each pass) — the streaming window path reads twice."""
+        """Stream the buffered rows WITHOUT materializing them: spill files
+        replay from disk, resident batches follow. Re-iterable (spill files
+        seek to 0 on each pass)."""
         for sp in self.spills:
             yield from sp.read_batches()
         yield from self.mem
 
     def discard(self):
-        """Drop the partition after a streaming pass consumed it."""
+        """Drop the buffered rows after a pass consumed them."""
         for sp in self.spills:
             sp.release()
         self.spills = []
@@ -174,12 +143,22 @@ class WindowExec(Operator):
             extra.append(T.StructField(w.name, dt))
         return T.Schema(child_schema.fields + tuple(extra))
 
+    def _segmentable(self) -> bool:
+        """Rank-family counters and default-frame aggregates compute as
+        restart-at-segment scans with only a carry across batches; explicit
+        ROWS/RANGE offset frames need random access within the partition and
+        keep the buffer-then-process path."""
+        return all(w.kind in ("row_number", "rank", "dense_rank")
+                   or (w.kind == "agg" and w.frame is None)
+                   for w in self.window_exprs)
+
     def _execute(self, partition, ctx, metrics):
+        if self._segmentable():
+            yield from self._execute_segmented(partition, ctx, metrics)
+            return
         child_schema = self.children[0].schema
         # buffered partition slices are memmgr-watched: accumulation spills
-        # to disk under pressure (reference holds the same must-fit-at-
-        # process-time constraint per group, but its MemManager watches the
-        # buffering — weak #9 of the round-1 verdict)
+        # to disk under pressure, but the partition must fit at process time
         pending = _PartitionBuffer(child_schema, metrics)
         ctx.mem.register(pending)
         bs = ctx.conf.batch_size
@@ -187,15 +166,10 @@ class WindowExec(Operator):
         def process_partition() -> Iterator[ColumnarBatch]:
             if pending.empty():
                 return
-            if pending.spills and self._streamable():
-                # the partition outgrew the memory budget: stream it off the
-                # spill files with running state instead of concatenating a
-                # bigger-than-memory batch (round-4 verdict weak #6; the
-                # reference's WindowExec streams groups the same way)
-                metrics.add("streamed_partitions", 1)
-                yield from self._process_partition_streaming(pending)
-                pending.discard()
-                return
+            # tripwire: the segmented path never takes this per-group loop —
+            # a nonzero count on a default-frame plan means a fast-path
+            # regression (scale_soak records it next to window_segments)
+            metrics.add("window_group_loops", 1)
             part = ColumnarBatch.concat(pending.drain(), child_schema)
             out = self._process_one_partition(part)
             for off in range(0, out.num_rows, bs):
@@ -210,52 +184,306 @@ class WindowExec(Operator):
 
     def _execute_buffered(self, partition, ctx, metrics, pending,
                           process_partition):
+        from blaze_tpu.ops.joins.keymap import RunningKeyCodes
+
+        part_ev = ExprEvaluator(self.partition_spec,
+                                self.children[0].schema) \
+            if self.partition_spec else None
+        part_keys = RunningKeyCodes()
+        started = False
         for batch in self.execute_child(0, partition, ctx, metrics):
-            if batch.num_rows == 0:
+            n = batch.num_rows
+            if n == 0:
                 continue
             # self-time lands in elapsed_compute_time_ns via Operator.execute
-            codes = _partition_codes(batch, self.partition_spec)
-            boundaries = np.nonzero(np.diff(codes))[0] + 1
-            starts = np.concatenate([[0], boundaries])
-            ends = np.concatenate([boundaries, [batch.num_rows]])
-            pieces = [(int(s), int(e)) for s, e in zip(starts, ends)]
-            # all but the trailing piece complete earlier partitions; the
-            # trailing piece may continue into the next batch — but only if
-            # its key equals the next batch's first key, which we can't see
-            # yet, so: first piece joins the pending partition ONLY if keys
-            # match; simplest correct rule: flush pending before the first
-            # piece iff this batch starts a new partition
-            first_s, first_e = pieces[0]
-            if not pending.empty() and not self._continues(pending.last(), batch):
+            if part_ev is None:
+                ch = np.zeros(n, dtype=bool)
+                ch[0] = not started
+            else:
+                ch = part_keys.change_mask(batch, part_ev.evaluate(batch))
+            started = True
+            bounds = np.nonzero(ch)[0]
+            # a True at row 0 closes the pending partition; later Trues
+            # close the piece before them — the carried key row makes the
+            # continuation check free (no one-row pylist comparison)
+            if not pending.empty() and len(bounds) and bounds[0] == 0:
                 yield from process_partition()
-            pending.append(batch.slice(first_s, first_e - first_s))
-            for s, e in pieces[1:]:
-                yield from process_partition()
+            starts = [0] + [int(b) for b in bounds if b > 0]
+            ends = starts[1:] + [n]
+            for i, (s, e) in enumerate(zip(starts, ends)):
+                if i > 0:
+                    yield from process_partition()
                 pending.append(batch.slice(s, e - s))
         yield from process_partition()
 
-    def _continues(self, prev_tail: ColumnarBatch, batch: ColumnarBatch) -> bool:
-        """Does batch's first row belong to the pending partition?"""
-        if not self.partition_spec:
-            return True
-        last = prev_tail.slice(prev_tail.num_rows - 1, 1)
-        first = batch.slice(0, 1)
-        def key_of(b):
-            ev = ExprEvaluator(self.partition_spec, b.schema)
-            cols = ev.evaluate(b)
-            return tuple(c.to_arrow(1).to_pylist()[0] for c in cols)
-        return key_of(last) == key_of(first)
+    # -- segmented execution (counters + default-frame aggregates) ------------
 
-    # -- streaming computation for spilled (bigger-than-memory) partitions ----
+    def _execute_segmented(self, partition, ctx, metrics):
+        """One pass, one shot per batch: boundary masks + restart-at-segment
+        scans (core/kernels) replace the per-group loop entirely. The carry
+        across batches is O(1): counter bases, per-aggregate (sum, count,
+        extremum) accumulators, and the last partition/order key row inside
+        the RunningKeyCodes detectors."""
+        from blaze_tpu.core import kernels as K
+        from blaze_tpu.ops import sort_keys as SK
+        from blaze_tpu.ops.joins.keymap import RunningKeyCodes
 
-    def _streamable(self) -> bool:
-        """Rank-family counters and default-frame aggregates compute with
-        running state + at most the CURRENT peer group buffered; explicit
-        ROWS/RANGE offset frames need random access and keep the concat
-        path."""
-        return all(w.kind in ("row_number", "rank", "dense_rank")
-                   or (w.kind == "agg" and w.frame is None)
-                   for w in self.window_exprs)
+        child_schema = self.children[0].schema
+        aggs = [w for w in self.window_exprs if w.kind == "agg"]
+        has_order = bool(self.order_spec)
+        part_ev = ExprEvaluator(self.partition_spec, child_schema) \
+            if self.partition_spec else None
+        order_ev = ExprEvaluator([so.child for so in self.order_spec],
+                                 child_schema) if has_order else None
+        part_keys = RunningKeyCodes()
+        order_keys = RunningKeyCodes()
+        started = False
+        c_rn, c_rank, c_dense = 0, 1, 0
+        acc = {id(w): [0, 0, None] for w in aggs}   # sum, count, extremum
+        # the open tail group, withheld until its frame value is known: its
+        # counters are degenerate (rank/dense constant, row_number
+        # consecutive), so the buffer carries child rows + three scalars
+        hold = _PartitionBuffer(child_schema, metrics)
+        ctx.mem.register(hold)
+        hold_rn0 = hold_rank = hold_dense = 1
+
+        def flush_hold(close_vals):
+            if hold.empty():
+                return
+            if hold.spills:
+                metrics.add("streamed_partitions", 1)
+            off = 0
+            for hb in hold.iter_batches():
+                m = hb.num_rows
+                rn_h = hold_rn0 + off + np.arange(m, dtype=np.int64)
+                off += m
+                rank_h = np.full(m, hold_rank, np.int64)
+                dense_h = np.full(m, hold_dense, np.int64)
+                sel = self._limit_select(rn_h, rank_h, dense_h)
+                if sel is not None:
+                    if not len(sel):
+                        continue
+                    hb = hb.take(sel)
+                    rn_h, rank_h, dense_h = rn_h[sel], rank_h[sel], dense_h[sel]
+                m = hb.num_rows
+                vals = {k: ([v[0]] * m, [v[1]] * m, [v[2]] * m)
+                        for k, v in close_vals.items()}
+                yield self._emit_rows(hb, rn_h, rank_h, dense_h, vals)
+            hold.discard()
+
+        try:
+            for batch in self.execute_child(0, partition, ctx, metrics):
+                n = batch.num_rows
+                if n == 0:
+                    continue
+                if part_ev is None:
+                    part_start = np.zeros(n, dtype=bool)
+                    part_start[0] = not started
+                else:
+                    part_start = part_keys.change_mask(
+                        batch, part_ev.evaluate(batch))
+                if has_order:
+                    new_peer = part_start | order_keys.push_rows(
+                        SK.peer_key_rows(batch, self.order_spec, order_ev))
+                else:
+                    new_peer = part_start.copy()
+                started = True
+                metrics.add("window_segments", int(part_start.sum()))
+                rn, rank, dense = K.restarting_counters(
+                    part_start, new_peer, c_rn, c_rank, c_dense)
+                if not aggs:
+                    # counters are final the moment they're computed: emit
+                    # the whole batch, nothing withheld, nothing buffered
+                    sel = self._limit_select(rn, rank, dense)
+                    if sel is None:
+                        yield self._emit_rows(batch, rn, rank, dense, {})
+                    elif len(sel):
+                        yield self._emit_rows(batch.take(sel), rn[sel],
+                                              rank[sel], dense[sel], {})
+                    c_rn, c_rank = int(rn[-1]), int(rank[-1])
+                    c_dense = int(dense[-1])
+                    continue
+                # default frames close at the row's boundary-segment END:
+                # the peer group when ordered (RANGE unbounded..current row,
+                # peers share the value), the whole partition otherwise
+                bmask = new_peer if has_order else part_start
+                scans = {id(w): self._seg_agg_scan(w, batch, part_start,
+                                                   acc[id(w)])
+                         for w in aggs}
+                bounds = np.nonzero(bmask)[0]
+                if not len(bounds):
+                    # the entire batch continues the open group
+                    keep = self._trim_tail(rn, rank, dense)
+                    if keep:
+                        hold.append(batch if keep == n
+                                    else batch.slice(0, keep))
+                    self._roll_carry(aggs, scans, acc)
+                    c_rn, c_rank = int(rn[-1]), int(rank[-1])
+                    c_dense = int(dense[-1])
+                    continue
+                b0 = int(bounds[0])
+                hold_from = int(bounds[-1])
+                # the boundary at b0 closes the withheld group: its frame
+                # value is the carry-seeded cumulative just before it
+                close_vals = {}
+                for w in aggs:
+                    k = id(w)
+                    cs, cc, run = scans[k]
+                    if b0 > 0:
+                        close_vals[k] = (cs[b0 - 1], int(cc[b0 - 1]),
+                                         run[b0 - 1] if run is not None
+                                         else None)
+                    else:
+                        close_vals[k] = tuple(acc[k])
+                yield from flush_hold(close_vals)
+                if hold_from > 0:
+                    # rows before the last boundary close within this batch:
+                    # backfill each row's value from its segment end
+                    j = np.searchsorted(bounds, np.arange(hold_from),
+                                        side="right")
+                    end_idx = bounds[j] - 1
+                    rn_e, rank_e = rn[:hold_from], rank[:hold_from]
+                    dense_e = dense[:hold_from]
+                    sel = self._limit_select(rn_e, rank_e, dense_e)
+                    if sel is None or len(sel):
+                        if sel is None:
+                            rows = batch.slice(0, hold_from)
+                            ei = end_idx
+                        else:
+                            rows = batch.take(sel)
+                            rn_e, rank_e = rn_e[sel], rank_e[sel]
+                            dense_e = dense_e[sel]
+                            ei = end_idx[sel]
+                        vals = {}
+                        for w in aggs:
+                            k = id(w)
+                            cs, cc, run = scans[k]
+                            vals[k] = (list(cs[ei]), list(cc[ei]),
+                                       list(run[ei]) if run is not None
+                                       else [None] * len(ei))
+                        yield self._emit_rows(rows, rn_e, rank_e, dense_e,
+                                              vals)
+                # withhold the open tail group (emits when it closes); rows
+                # that can no longer survive the group limit never enter
+                keep = self._trim_tail(rn[hold_from:], rank[hold_from:],
+                                       dense[hold_from:])
+                if keep:
+                    hold.append(batch.slice(hold_from, keep))
+                    hold_rn0 = int(rn[hold_from])
+                    hold_rank = int(rank[hold_from])
+                    hold_dense = int(dense[hold_from])
+                self._roll_carry(aggs, scans, acc)
+                c_rn, c_rank = int(rn[-1]), int(rank[-1])
+                c_dense = int(dense[-1])
+            yield from flush_hold({k: tuple(v) for k, v in acc.items()})
+        finally:
+            ctx.mem.unregister(hold)
+            hold.release()
+
+    @staticmethod
+    def _roll_carry(aggs, scans, acc):
+        """Advance the open-partition accumulators to the batch's last row
+        (the scans restart at partition starts, so the last value IS the
+        open partition's running state)."""
+        for w in aggs:
+            k = id(w)
+            cs, cc, run = scans[k]
+            acc[k] = [cs[-1], int(cc[-1]),
+                      run[-1] if run is not None else acc[k][2]]
+
+    def _seg_agg_scan(self, w: WindowExpr, batch: ColumnarBatch,
+                      part_start: np.ndarray, a):
+        """Carry-seeded within-partition cumulatives (sum, count[, running
+        extremum]) for one aggregate over one batch. Device-resident
+        SUM/AVG/COUNT arguments scan in ONE jitted dispatch
+        (kernels.segment_scan_planes); everything else — decimals, host
+        columns, MIN/MAX — takes the numpy segmented scans."""
+        from blaze_tpu.core import kernels as K
+
+        F = E.AggFunction
+        agg = w.agg
+        if agg.args and agg.fn in (F.SUM, F.AVG, F.COUNT):
+            arg_t = E.infer_type(agg.args[0], batch.schema)
+            if not isinstance(arg_t, T.DecimalType):
+                col = ExprEvaluator(list(agg.args),
+                                    batch.schema).evaluate(batch)[0]
+                if isinstance(col, DeviceColumn) and \
+                        col.data.shape[0] == batch.capacity and \
+                        col.data.dtype != bool:
+                    cs, cc = K.segment_scan_planes(
+                        col.data, col.validity, batch.row_exists_mask(),
+                        part_start, a[0], a[1])
+                    return cs, cc, None
+        nv, valid = self._agg_arg(w, batch)
+        cs, cc = K.segment_cumsum(nv, valid, part_start, a[0], a[1])
+        run = None
+        if agg.fn in (F.MIN, F.MAX):
+            run = K.segment_running_reduce(nv, valid, part_start,
+                                           agg.fn == F.MIN, a[2])
+        return cs, cc, run
+
+    def _limit_vals(self, rn, rank, dense):
+        """The plane group_limit filters on (reference: window_exec.rs:
+        227-236): rank() <= K and dense_rank() <= K keep boundary-tied rows;
+        anything else limits by row number."""
+        kinds = {w.kind for w in self.window_exprs}
+        if kinds == {"rank"}:
+            return rank
+        if kinds == {"dense_rank"}:
+            return dense
+        return rn
+
+    def _limit_select(self, rn, rank, dense):
+        """Surviving-row indices under group_limit, or None for keep-all."""
+        if self.group_limit is None:
+            return None
+        keep = np.nonzero(
+            self._limit_vals(rn, rank, dense) <= self.group_limit)[0]
+        return None if len(keep) == len(rn) else keep
+
+    def _trim_tail(self, rn, rank, dense) -> int:
+        """How many leading rows of the open tail group can still survive
+        the group limit. Limit values are nondecreasing within a partition
+        (rank/dense constant over the tail, row_number consecutive), so
+        survivors form a prefix — rows past rank k are masked out BEFORE the
+        remaining window columns are computed or buffered."""
+        if self.group_limit is None:
+            return len(rn)
+        vals = self._limit_vals(rn, rank, dense)
+        return int(np.searchsorted(vals, self.group_limit, side="right"))
+
+    def _emit_rows(self, rows: ColumnarBatch, rn, rank, dense, agg_vals):
+        """Child rows + computed window columns -> one output batch. ``rows``
+        is already group-limited, so aggregate finalization (the python-level
+        typed/decimal conversion) runs only on surviving rows."""
+        if not self.output_window_cols:
+            return rows
+        out_cols = list(rows.columns)
+        fields = list(rows.schema.fields)
+        child_schema = self.children[0].schema
+        for w in self.window_exprs:
+            if w.kind == "row_number":
+                col, dt = DeviceColumn.from_numpy(
+                    T.I64, np.asarray(rn, np.int64), None,
+                    rows.capacity), T.I64
+            elif w.kind == "rank":
+                col, dt = DeviceColumn.from_numpy(
+                    T.I32, np.asarray(rank).astype(np.int32), None,
+                    rows.capacity), T.I32
+            elif w.kind == "dense_rank":
+                col, dt = DeviceColumn.from_numpy(
+                    T.I32, np.asarray(dense).astype(np.int32), None,
+                    rows.capacity), T.I32
+            else:
+                fsum, fcnt, fval = agg_vals[id(w)]
+                col, dt = self._agg_result_col(w, child_schema, fsum, fcnt,
+                                               fval)
+            out_cols.append(col)
+            fields.append(T.StructField(w.name, dt))
+        return ColumnarBatch(T.Schema(tuple(fields)), out_cols,
+                             rows.num_rows)
+
+    # -- shared aggregate plumbing --------------------------------------------
 
     def _agg_arg(self, w: WindowExpr, batch: ColumnarBatch):
         """(masked_values, valid) for one aggregate's argument over a batch
@@ -283,8 +511,7 @@ class WindowExec(Operator):
     def _agg_result_col(self, w: WindowExpr, child_schema: T.Schema,
                         fsum, fcnt, fval):
         """Finalize per-row (sum, count, min/max) frame values into the
-        typed output column — shared by the vectorized and streaming
-        paths."""
+        typed output column — shared by the segmented and buffered paths."""
         agg = w.agg
         arg_t = (E.infer_type(agg.args[0], child_schema)
                  if agg.args else T.NULL)
@@ -314,241 +541,26 @@ class WindowExec(Operator):
                           pa.array(out, type=T.to_arrow_type(result_t))), \
             result_t
 
-    def _order_key_row(self, batch: ColumnarBatch, idx: int):
-        row = batch.slice(idx, 1)
-        ev = ExprEvaluator([so.child for so in self.order_spec], row.schema)
-        return tuple(c.to_arrow(1).to_pylist()[0]
-                     for c in ev.evaluate(row))
+    # -- per-partition computation (explicit-frame path) ----------------------
 
-    def _emit_stream_rows(self, batch: ColumnarBatch, rn, rank, dense,
-                          agg_cols):
-        """Assemble one output batch from child rows + computed window
-        columns, applying the group limit."""
-        n = batch.num_rows
-        out_cols = list(batch.columns)
-        fields = list(batch.schema.fields)
-        limit_vals = rn
-        kinds = {w.kind for w in self.window_exprs}
-        if kinds == {"rank"}:
-            limit_vals = rank
-        elif kinds == {"dense_rank"}:
-            limit_vals = dense
-        for w in self.window_exprs:
-            if w.kind == "row_number":
-                col, dt = DeviceColumn.from_numpy(
-                    T.I64, rn, None, batch.capacity), T.I64
-            elif w.kind == "rank":
-                col, dt = DeviceColumn.from_numpy(
-                    T.I32, rank.astype(np.int32), None, batch.capacity), T.I32
-            elif w.kind == "dense_rank":
-                col, dt = DeviceColumn.from_numpy(
-                    T.I32, dense.astype(np.int32), None,
-                    batch.capacity), T.I32
-            else:
-                col, dt = agg_cols[id(w)]
-            if self.output_window_cols:
-                out_cols.append(col)
-                fields.append(T.StructField(w.name, dt))
-        out = ColumnarBatch(T.Schema(tuple(fields)), out_cols, n) \
-            if self.output_window_cols else batch
-        if self.group_limit is not None:
-            keep = np.nonzero(limit_vals <= self.group_limit)[0]
-            if len(keep) < n:
-                out = out.take(keep)
-        return out
+    def _single_peer_mask(self, part: ColumnarBatch) -> np.ndarray:
+        """Peer-boundary mask within ONE fully-buffered partition."""
+        n = part.num_rows
+        out = np.zeros(n, dtype=bool)
+        if n == 0:
+            return out
+        if not self.order_spec:
+            out[0] = True
+            return out
+        from blaze_tpu.ops import sort_keys as SK
+        from blaze_tpu.ops.joins.keymap import RunningKeyCodes
 
-    def _process_partition_streaming(self, pending: "_PartitionBuffer"
-                                     ) -> Iterator[ColumnarBatch]:
-        """Two streaming passes over the spilled partition. Pass 1 (only
-        when an aggregate has no ORDER BY and therefore frames the WHOLE
-        partition) accumulates totals. Pass 2 emits: rank-family counters
-        carry running state across batches; ordered aggregates emit a peer
-        group as soon as it closes, so resident memory is one peer group +
-        one batch regardless of partition size."""
-        child_schema = self.children[0].schema
-        aggs = [w for w in self.window_exprs if w.kind == "agg"]
-        has_order = bool(self.order_spec)
-        F = E.AggFunction
-
-        totals = {}
-        if aggs and not has_order:
-            for w in aggs:
-                totals[id(w)] = [0, 0, None]  # sum, count, min-or-max
-            for b in pending.iter_batches():
-                for w in aggs:
-                    nv, valid = self._agg_arg(w, b)
-                    t = totals[id(w)]
-                    t[0] = t[0] + (nv[valid].sum() if valid.any() else 0)
-                    t[1] += int(valid.sum())
-                    if w.agg.fn in (F.MIN, F.MAX) and valid.any():
-                        vv = nv[valid]
-                        ext = vv.min() if w.agg.fn == F.MIN else vv.max()
-                        if t[2] is None:
-                            t[2] = ext
-                        else:
-                            t[2] = min(t[2], ext) if w.agg.fn == F.MIN \
-                                else max(t[2], ext)
-
-        # pass 2 running state
-        base = 0                     # rows emitted before this batch
-        carried_rank = 1
-        carried_dense = 0
-        carried_key = None
-        run_sum = {id(w): 0 for w in aggs}       # cumulative incl. carry
-        run_cnt = {id(w): 0 for w in aggs}
-        run_ext = {id(w): None for w in aggs}    # running min/max
-        # open peer group held until it closes: (child_rows, rn, rank, dense)
-        hold: List[tuple] = []
-
-        def agg_cols_const(nrows: int, sums, cnts, exts):
-            cols = {}
-            for w in aggs:
-                k = id(w)
-                col, dt = self._agg_result_col(
-                    w, child_schema, [sums[k]] * nrows, [cnts[k]] * nrows,
-                    [exts[k]] * nrows)
-                cols[id(w)] = (col, dt)
-            return cols
-
-        def flush_hold():
-            # the open peer group closed: its frame value is the running
-            # cumulative as of the last appended row
-            for hb, h_rn, h_rank, h_dense in hold:
-                if aggs and has_order:
-                    cols = agg_cols_const(hb.num_rows, run_sum, run_cnt,
-                                          run_ext)
-                elif aggs:
-                    cols = agg_cols_const(
-                        hb.num_rows, {k: t[0] for k, t in totals.items()},
-                        {k: t[1] for k, t in totals.items()},
-                        {k: t[2] for k, t in totals.items()})
-                else:
-                    cols = {}
-                yield self._emit_stream_rows(hb, h_rn, h_rank, h_dense, cols)
-            hold.clear()
-
-        for b in pending.iter_batches():
-            n = b.num_rows
-            if n == 0:
-                continue
-            rn = base + np.arange(1, n + 1, dtype=np.int64)
-            if has_order:
-                new_peer = _peer_mask(b, self.order_spec)
-                first_key = self._order_key_row(b, 0)
-                new_peer[0] = carried_key is None or first_key != carried_key
-            else:
-                new_peer = np.zeros(n, dtype=bool)
-                new_peer[0] = carried_key is None
-                carried_key = ()
-            if new_peer[0] and hold:
-                yield from flush_hold()
-            starts = np.where(new_peer, rn, 0)
-            rank = np.maximum.accumulate(starts)
-            rank[rank == 0] = carried_rank
-            dense = carried_dense + np.cumsum(new_peer)
-            # ordered aggregates: frame value = cumulative at peer-group end
-            boundaries = np.nonzero(new_peer)[0]
-            open_start = int(boundaries[-1]) if len(boundaries) else 0
-            agg_cols = {}
-            if aggs and has_order:
-                per_row = {}
-                for w in aggs:
-                    k = id(w)
-                    nv, valid = self._agg_arg(w, b)
-                    cs = np.cumsum(nv) + run_sum[k]
-                    cc = np.cumsum(valid.astype(np.int64)) + run_cnt[k]
-                    if w.agg.fn in (F.MIN, F.MAX):
-                        accfn = np.minimum if w.agg.fn == F.MIN \
-                            else np.maximum
-                        run = _masked_running(nv, valid,
-                                              accfn, w.agg.fn == F.MIN)
-                        if run_ext[k] is not None:
-                            if run.dtype == object:
-                                cmp = (lambda a, c: c if a is None else
-                                       (min(a, c) if w.agg.fn == F.MIN
-                                        else max(a, c)))
-                                run = np.array(
-                                    [cmp(v, run_ext[k]) if v is not None
-                                     else run_ext[k] for v in run],
-                                    dtype=object)
-                            else:
-                                run = accfn(run, run[0].dtype.type(
-                                    run_ext[k]))
-                    else:
-                        run = None
-                    per_row[k] = (cs, cc, run)
-                    run_sum[k] = cs[-1]
-                    run_cnt[k] = int(cc[-1])
-                    if run is not None:
-                        run_ext[k] = run[-1]
-                # group end index per row, for rows in groups CLOSED here
-                grp = np.cumsum(new_peer)  # 0 = continuation of held group
-                if len(boundaries):
-                    ends = np.concatenate([boundaries[1:] - 1, [n - 1]])
-                    # map each closed row to its group-end index
-                    end_of_row = np.where(
-                        grp > 0, ends[np.clip(grp - 1, 0, len(ends) - 1)], 0)
-                closed = np.arange(n) < open_start
-                if closed.any():
-                    cslice = b.slice(0, open_start)
-                    for w in aggs:
-                        k = id(w)
-                        cs, cc, run = per_row[k]
-                        e = end_of_row[:open_start]
-                        # continuation rows (grp==0) close at the first
-                        # boundary
-                        if (grp[:open_start] == 0).any():
-                            e = e.copy()
-                            e[grp[:open_start] == 0] = boundaries[0] - 1
-                        fsum = cs[e]
-                        fcnt = cc[e]
-                        fval = run[e] if run is not None else [None] * len(e)
-                        agg_cols[k] = self._agg_result_col(
-                            w, child_schema, list(fsum), list(fcnt),
-                            list(fval))
-                    # flush any held rows first: they closed at the first
-                    # boundary of this batch
-                    if hold:
-                        held_sum = {k: per_row[k][0][boundaries[0] - 1]
-                                    for k in per_row}
-                        held_cnt = {k: int(per_row[k][1][boundaries[0] - 1])
-                                    for k in per_row}
-                        held_ext = {
-                            k: (per_row[k][2][boundaries[0] - 1]
-                                if per_row[k][2] is not None else None)
-                            for k in per_row}
-                        for hb, h_rn, h_rank, h_dense in hold:
-                            yield self._emit_stream_rows(
-                                hb, h_rn, h_rank, h_dense,
-                                agg_cols_const(hb.num_rows, held_sum,
-                                               held_cnt, held_ext))
-                        hold.clear()
-                    yield self._emit_stream_rows(
-                        cslice, rn[:open_start], rank[:open_start],
-                        dense[:open_start], agg_cols)
-                hold.append((b.slice(open_start, n - open_start),
-                             rn[open_start:], rank[open_start:],
-                             dense[open_start:]))
-            else:
-                # counters only, or whole-partition aggregates: every value
-                # is already known — emit the batch immediately
-                cols = agg_cols_const(
-                    n, {k: t[0] for k, t in totals.items()},
-                    {k: t[1] for k, t in totals.items()},
-                    {k: t[2] for k, t in totals.items()}) if aggs else {}
-                yield self._emit_stream_rows(b, rn, rank, dense, cols)
-            base += n
-            carried_rank = int(rank[-1])
-            carried_dense = int(dense[-1])
-            if has_order:
-                carried_key = self._order_key_row(b, n - 1)
-        yield from flush_hold()
-
-    # -- per-partition computation (vectorized) -------------------------------
+        return RunningKeyCodes().push_rows(
+            SK.peer_key_rows(part, self.order_spec))
 
     def _process_one_partition(self, part: ColumnarBatch) -> ColumnarBatch:
         n = part.num_rows
-        new_peer = _peer_mask(part, self.order_spec)
+        new_peer = self._single_peer_mask(part)
         rn = np.arange(1, n + 1, dtype=np.int64)
         # rank: row number at each peer-group start, broadcast over the group
         peer_start_rn = np.where(new_peer, rn, 0)
@@ -576,17 +588,8 @@ class WindowExec(Operator):
         out = ColumnarBatch(T.Schema(tuple(fields)), out_cols, n) \
             if self.output_window_cols else part
         if self.group_limit is not None:
-            # Filter on the produced window function's values (reference:
-            # window_exec.rs:227-236), not the raw row number: rank() <= K and
-            # dense_rank() <= K keep ALL boundary-tied rows.
-            kinds = {w.kind for w in self.window_exprs}
-            if kinds == {"rank"}:
-                limit_vals = rank
-            elif kinds == {"dense_rank"}:
-                limit_vals = dense
-            else:
-                limit_vals = rn
-            keep = np.nonzero(limit_vals <= self.group_limit)[0]
+            keep = np.nonzero(
+                self._limit_vals(rn, rank, dense) <= self.group_limit)[0]
             if len(keep) < n:
                 out = out.take(keep)
         return out

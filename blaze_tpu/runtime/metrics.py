@@ -98,6 +98,36 @@ class MetricNode:
             self.child(i).merge_dict(c)
 
 
+# Invariant "tripwire" counters: cheap global counts whose expected
+# relationship flags a silently-degraded fast path — a plan can produce
+# correct results at 10x the cost and no test notices, but a diffed counter
+# does. bench/scale_soak record these next to timings so a regression shows
+# up as a number, not a slowdown hunt. Current invariants:
+#   split_gathers == split_batches   range split gathers ONCE per batch
+#   window_group_loops == 0          segmentable windows (counters +
+#                                    default-frame aggs) never take the
+#                                    buffered per-group loop
+#   window_segments > 0              on window-bearing plans: the segmented
+#                                    path actually ran (and saw partitions)
+#   ipc_decode_in_prefetch > 0       on shuffle-bearing plans: frame decode
+#                                    happens in the reader's worker pool,
+#                                    not on the consumer thread
+TRIPWIRE_METRICS = (
+    "split_batches",
+    "split_gathers",
+    "window_segments",
+    "window_group_loops",
+    "streamed_partitions",
+    "ipc_decode_in_prefetch",
+)
+
+
+def tripwire_totals(node: "MetricNode") -> Dict[str, int]:
+    """Totals of the tripwire counters for a metric tree (session root or a
+    single query) — the shape bench/SOAK records embed."""
+    return {m: node.total(m) for m in TRIPWIRE_METRICS}
+
+
 class Timer:
     """Accumulates nanoseconds into a metric. The reference subtracts
     downstream send-wait so self-time is accurate

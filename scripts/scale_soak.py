@@ -106,9 +106,13 @@ def main():
                 table = sess.execute_to_table(plan_fn(paths))
                 spills = sess.metrics.total("spill_count")
                 spill_bytes = sess.metrics.total("spilled_bytes")
-                streamed = sess.metrics.total("streamed_partitions")
-                split_batches = sess.metrics.total("split_batches")
-                split_gathers = sess.metrics.total("split_gathers")
+                # invariant tripwires (runtime/metrics.TRIPWIRE_METRICS):
+                # split_gathers == split_batches, window_group_loops == 0,
+                # window_segments > 0 on window-bearing shapes — a degraded
+                # fast path shows up as a counter diff in the artifact
+                from blaze_tpu.runtime.metrics import tripwire_totals
+
+                trips = tripwire_totals(sess.metrics)
                 if PROFILE_DIR:
                     from blaze_tpu.obs import TRACER, dump_profile
 
@@ -121,16 +125,19 @@ def main():
             out["shapes"][name] = {
                 "wall_s": round(wall, 1), "spill_count": int(spills),
                 "spilled_bytes": int(spill_bytes),
-                "streamed_window_partitions": int(streamed),
-                "split_batches": int(split_batches),
-                "split_gathers": int(split_gathers),
+                "streamed_window_partitions": trips["streamed_partitions"],
+                "split_batches": trips["split_batches"],
+                "split_gathers": trips["split_gathers"],
+                "window_segments": trips["window_segments"],
+                "window_group_loops": trips["window_group_loops"],
+                "ipc_decode_in_prefetch": trips["ipc_decode_in_prefetch"],
                 "peak_mem_used": peak_used,
                 "peak_rss_mb": peak_rss_mb(),
             }
             print(json.dumps({name: out["shapes"][name]}), flush=True)
 
     soak_path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "SOAK_r06.json")
+        os.path.abspath(__file__))), "SOAK_r07.json")
     if "tpcds" not in os.environ.get("SOAK_PHASES", "shapes,tpcds"):
         out["peak_rss_mb"] = peak_rss_mb()
         # keep a previous run's tpcds section (phase-scoped reruns merge)
@@ -206,7 +213,7 @@ def main():
     out["peak_rss_mb"] = peak_rss_mb()
     print(json.dumps(out))
     with open(os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "SOAK_r06.json"), "w") as f:
+            os.path.abspath(__file__))), "SOAK_r07.json"), "w") as f:
         json.dump(out, f, indent=1)
 
 
