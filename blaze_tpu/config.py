@@ -164,6 +164,19 @@ class Config:
     # rounded ranges). Ranges beyond this fall back to the sort kernel.
     dense_agg_max_buckets: int = 65536
 
+    # Query serving layer (serve/scheduler.py): concurrency slots, queue
+    # bounds, and admission control. A query is admitted only when the
+    # MemManager's headroom covers its estimated footprint; a full queue or
+    # a queue wait past the timeout sheds the query with a typed Overloaded
+    # error (graceful degradation instead of OOM — the role Spark's
+    # scheduler + YARN admission play for the reference).
+    serve_max_concurrent: int = 4
+    serve_max_queue: int = 64
+    serve_queue_timeout_s: float = 30.0
+    # admission estimate floor when the plan-based estimate has no stateful
+    # operators (scans/projections still buffer batches)
+    serve_default_mem_estimate: int = 64 << 20
+
     # Adaptive device placement (runtime/placement.py — the TPU analogue of
     # the reference's removeInefficientConverts): "auto" runs each stage
     # where the measured-link cost model says it is cheapest; "device" /

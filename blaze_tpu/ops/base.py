@@ -45,6 +45,47 @@ class TaskCancelled(Exception):
     pass
 
 
+class QueryCancelled(TaskCancelled):
+    """Whole-query cancellation (client cancel or deadline) as opposed to a
+    single task's cancel flag; carries the reason the serving layer set."""
+
+
+class CancelToken:
+    """Query-level cancellation + deadline token shared by every task of one
+    query (reference: ``is_task_running`` flipped through the JNI on Spark
+    task kill; here the serving layer owns the flip). Checked cooperatively
+    between batches (``Operator.execute``), at stage boundaries
+    (``Session._run_tasks``), and in the worker-pool scheduling loop
+    (``WorkerPool.run_tasks``). ``deadline`` is a ``time.monotonic()``
+    stamp; the token self-fires on the first check past it, so deadline
+    enforcement needs no dedicated timer thread."""
+
+    __slots__ = ("_event", "deadline", "reason")
+
+    def __init__(self, deadline: Optional[float] = None):
+        self._event = threading.Event()
+        self.deadline = deadline
+        self.reason: Optional[str] = None
+
+    def cancel(self, reason: str = "cancelled"):
+        if not self._event.is_set():
+            self.reason = reason
+            self._event.set()
+
+    @property
+    def cancelled(self) -> bool:
+        if self._event.is_set():
+            return True
+        if self.deadline is not None and time.monotonic() >= self.deadline:
+            self.cancel("deadline exceeded")
+            return True
+        return False
+
+    def check(self):
+        if self.cancelled:
+            raise QueryCancelled(self.reason or "cancelled")
+
+
 @dataclasses.dataclass
 class TaskContext:
     """Identity of one task: (stage, partition, attempt) — reference:
@@ -67,12 +108,16 @@ class ExecContext:
         metrics: Optional[MetricNode] = None,
         resources: Optional[Dict[str, Any]] = None,
         mem_manager=None,
+        cancel_token: Optional[CancelToken] = None,
     ):
         self.task = task or TaskContext()
         self.conf = conf or get_config()
         self.metrics = metrics or MetricNode("root")
         self.resources = resources if resources is not None else {}
         self._cancelled = threading.Event()
+        # query-level token shared by every task of one query; the per-task
+        # flag above stays for single-task cancellation (tests, tools)
+        self.cancel_token = cancel_token
         if mem_manager is None:
             from blaze_tpu.runtime.memmgr import MemManager
 
@@ -84,10 +129,13 @@ class ExecContext:
 
     @property
     def is_cancelled(self) -> bool:
-        return self._cancelled.is_set()
+        return self._cancelled.is_set() or (
+            self.cancel_token is not None and self.cancel_token.cancelled)
 
     def check_cancelled(self):
-        if self.is_cancelled:
+        if self.cancel_token is not None:
+            self.cancel_token.check()  # raises QueryCancelled with reason
+        if self._cancelled.is_set():
             raise TaskCancelled(f"task {self.task} cancelled")
 
 

@@ -96,11 +96,15 @@ class WorkerPool:
     # -- scheduling -----------------------------------------------------------
 
     def run_tasks(self, task_msgs: List[dict],
-                  shared: Optional[dict] = None) -> List[dict]:
+                  shared: Optional[dict] = None,
+                  cancel=None) -> List[dict]:
         """Run every task to completion (unordered internally, ordered
         results); failed/lost tasks retry on a (re)spawned worker.
         ``shared`` (stage-level resources) ships ONCE per worker, not per
-        task message."""
+        task message. ``cancel`` (a CancelToken) is polled in the scheduling
+        loops: on cancel no new tasks dispatch, and workers still mid-task
+        are killed by the post-stage reset — a cancelled query stops its map
+        stage at the PROCESS level, not after the stage drains."""
         pending: "queue.Queue" = queue.Queue()
         for i, msg in enumerate(task_msgs):
             pending.put((i, msg, 0))
@@ -145,6 +149,9 @@ class WorkerPool:
                 except Exception:
                     return
             while not done.is_set():
+                if cancel is not None and cancel.cancelled:
+                    done.set()
+                    return
                 try:
                     i, msg, attempt = pending.get(timeout=0.1)
                 except queue.Empty:
@@ -196,9 +203,16 @@ class WorkerPool:
                    for w in self.workers]
         for t in threads:
             t.start()
-        done.wait()
+        while not done.wait(0.1):
+            if cancel is not None and cancel.cancelled:
+                done.set()
+                break
+        cancelled = cancel is not None and cancel.cancelled \
+            and len(results) < len(task_msgs)
         for t in threads:
-            t.join(timeout=5)
+            # on cancel don't wait for in-flight replies: those workers are
+            # about to be killed by the reset below
+            t.join(timeout=0.5 if cancelled else 5)
         # a serve thread still blocked in recv (losing speculative copy or
         # straggler original) would desynchronize this worker's
         # request/reply channel for the NEXT stage — reset such workers
@@ -209,6 +223,10 @@ class WorkerPool:
                     w.spawn()
                 except Exception as exc:
                     log.error("post-stage worker reset failed: %s", exc)
+        if cancelled:
+            from blaze_tpu.ops.base import QueryCancelled
+
+            raise QueryCancelled(cancel.reason or "cancelled")
         if errors:
             raise TaskFailed("; ".join(errors))
         return [results[i] for i in range(len(task_msgs))]

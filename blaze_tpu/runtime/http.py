@@ -18,8 +18,18 @@ bound to a free port exposes:
   (query/stage/task/operator/spill/shuffle-fetch/kernel); load the payload
   in Perfetto or chrome://tracing. Requires ``Config.trace_enable`` (or
   BLAZE_TPU_TRACE=1); worker-process spans appear as separate pids.
-- ``/debug/queries``           — the session's recent query log (id,
-  wall_s, rows, stages) as recorded for explain_analyze
+- ``/debug/queries``           — live in-flight queries (serve scheduler
+  queue + running, session executions with elapsed time) followed by the
+  session's recent finished query log as recorded for explain_analyze
+- ``/serve/submit`` (POST)     — submit a plan to the serving scheduler:
+  JSON body with ``plan_b64`` (base64 of ir/protoserde plan bytes) or
+  ``spark_plan`` (Spark-plan JSON for frontend/converter), plus optional
+  ``priority``/``deadline_s``/``label``; 503 + typed body when Overloaded
+- ``/serve/queries``           — scheduler snapshot (queued + running)
+- ``/serve/status?id=N``       — one query's state/elapsed/error
+- ``/serve/cancel?id=N``       — flip a query's cancel token
+- ``/serve/result?id=N&timeout_s=T`` — block (bounded) for a result; the
+  table returns as columns JSON
 
 Start with ``ProfilingService.start(session)``; idempotent per process."""
 
@@ -54,13 +64,19 @@ class ProfilingService:
                 def log_message(self, *args):
                     pass
 
-                def _send(self, body: str, ctype: str = "application/json"):
+                def _send(self, body: str, ctype: str = "application/json",
+                          status: int = 200):
                     data = body.encode()
-                    self.send_response(200)
+                    self.send_response(status)
                     self.send_header("Content-Type", ctype)
                     self.send_header("Content-Length", str(len(data)))
                     self.end_headers()
                     self.wfile.write(data)
+
+                def _scheduler(self):
+                    sess = getattr(self.server, "blaze_session", None)
+                    return getattr(sess, "serve_scheduler", None) \
+                        if sess is not None else None
 
                 def do_GET(self):
                     url = urlparse(self.path)
@@ -78,11 +94,86 @@ class ProfilingService:
                             TRACER.to_chrome_trace("blaze_tpu driver")))
                     elif url.path == "/debug/queries":
                         sess = getattr(self.server, "blaze_session", None)
+                        body = []
+                        # in-flight first, finished log LAST: consumers key
+                        # off "the most recent finished query is queries[-1]"
+                        sched = self._scheduler()
+                        if sched is not None:
+                            snap = sched.snapshot()
+                            body.extend(snap["queued"] + snap["running"])
+                        now = time.time()
+                        for q in list(getattr(sess, "inflight", {}).values()
+                                      if sess is not None else []):
+                            mg = q.get("mem_group") or ""
+                            if mg.startswith("serve_"):
+                                continue  # already shown via the scheduler
+                            d = {k: v for k, v in q.items() if k != "shape"}
+                            d["elapsed_s"] = round(
+                                now - q.get("started_unix", now), 3)
+                            body.append(d)
                         log = list(getattr(sess, "query_log", []) or [])
                         # plan shapes are nested tuples — render compactly
-                        body = [{k: v for k, v in q.items() if k != "shape"}
-                                for q in log]
+                        body += [{k: v for k, v in q.items() if k != "shape"}
+                                 for q in log]
                         self._send(json.dumps(body, indent=2, default=str))
+                    elif url.path == "/serve/queries":
+                        sched = self._scheduler()
+                        if sched is None:
+                            self._send(json.dumps(
+                                {"error": "no serve scheduler attached"}),
+                                status=404)
+                        else:
+                            self._send(json.dumps(sched.snapshot(), indent=2,
+                                                  default=str))
+                    elif url.path in ("/serve/status", "/serve/cancel",
+                                      "/serve/result"):
+                        sched = self._scheduler()
+                        q = parse_qs(url.query)
+                        if sched is None or "id" not in q:
+                            self._send(json.dumps(
+                                {"error": "no scheduler or missing id"}),
+                                status=404)
+                            return
+                        qid = int(q["id"][0])
+                        if url.path == "/serve/status":
+                            st = sched.status(qid)
+                            self._send(json.dumps(st, indent=2, default=str),
+                                       status=200 if st is not None else 404)
+                        elif url.path == "/serve/cancel":
+                            ok = sched.cancel(qid)
+                            self._send(json.dumps({"qid": qid,
+                                                   "cancelled": ok}),
+                                       status=200 if ok else 404)
+                        else:  # /serve/result
+                            with sched._mu:
+                                h = sched._handles.get(qid)
+                            if h is None:
+                                self._send(json.dumps(
+                                    {"error": f"unknown query {qid}"}),
+                                    status=404)
+                                return
+                            timeout = min(float(
+                                q.get("timeout_s", ["60"])[0]), 600.0)
+                            try:
+                                table = h.result(timeout=timeout)
+                            except TimeoutError as exc:
+                                self._send(json.dumps({"error": str(exc)}),
+                                           status=408)
+                                return
+                            except BaseException as exc:
+                                from blaze_tpu.serve import Overloaded
+
+                                self._send(json.dumps(
+                                    {"error": type(exc).__name__,
+                                     "reason": str(exc),
+                                     "state": h.state}),
+                                    status=503 if isinstance(exc, Overloaded)
+                                    else 500)
+                                return
+                            self._send(json.dumps(
+                                {"qid": qid, "rows": table.num_rows,
+                                 "columns": table.to_pydict()},
+                                default=str))
                     elif url.path == "/debug/pprof/profile":
                         # sampling profiler across ALL threads (cProfile only
                         # hooks the calling thread; engine work runs on task
@@ -113,6 +204,65 @@ class ProfilingService:
                     else:
                         self.send_response(404)
                         self.end_headers()
+
+                def do_POST(self):
+                    url = urlparse(self.path)
+                    if url.path != "/serve/submit":
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    sched = self._scheduler()
+                    if sched is None:
+                        self._send(json.dumps(
+                            {"error": "no serve scheduler attached"}),
+                            status=503)
+                        return
+                    from blaze_tpu.serve import Overloaded
+
+                    try:
+                        length = int(self.headers.get("Content-Length", 0))
+                        req = json.loads(self.rfile.read(length) or b"{}")
+                        if "plan_b64" in req:
+                            import base64
+
+                            from blaze_tpu.ir.protoserde import \
+                                plan_from_bytes
+
+                            plan = plan_from_bytes(
+                                base64.b64decode(req["plan_b64"]))
+                        elif "spark_plan" in req:
+                            from blaze_tpu.frontend.converter import \
+                                SparkPlanConverter
+
+                            conv = SparkPlanConverter(
+                                tables=req.get("tables") or {})
+                            plan = conv.convert(
+                                json.dumps(req["spark_plan"])).plan
+                        else:
+                            self._send(json.dumps(
+                                {"error": "need plan_b64 or spark_plan"}),
+                                status=400)
+                            return
+                        deadline = req.get("deadline_s")
+                        h = sched.submit(
+                            plan, priority=int(req.get("priority", 0)),
+                            deadline_s=float(deadline)
+                            if deadline is not None else None,
+                            label=req.get("label"))
+                    except Overloaded as exc:
+                        # typed load shed: clients back off, they don't retry
+                        # into the same wall
+                        self._send(json.dumps({"error": "Overloaded",
+                                               "reason": exc.reason}),
+                                   status=503)
+                        return
+                    except Exception as exc:
+                        self._send(json.dumps(
+                            {"error": f"{type(exc).__name__}: {exc}"}),
+                            status=400)
+                        return
+                    self._send(json.dumps({"qid": h.qid, "state": h.state,
+                                           "label": h.label}))
 
             server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
             server.blaze_session = session

@@ -13,10 +13,11 @@ Used by sort/agg/join/shuffle operators: they register as consumers, call
 
 from __future__ import annotations
 
+import contextlib
 import os
 import tempfile
 import threading
-from typing import BinaryIO, List, Optional
+from typing import BinaryIO, Dict, List, Optional
 
 from blaze_tpu.config import Config, get_config
 
@@ -37,6 +38,10 @@ class MemConsumer:
         self.mem_used = 0
         self.spill_requested = False
         self.owner_thread: Optional[int] = None  # set at register time
+        # reservation group (one per query in the serving layer): fair share
+        # is split per GROUP first, then per consumer within the group, so
+        # one spilling giant query cannot starve small interactive queries
+        self.group: Optional[str] = None
         self._manager: Optional["MemManager"] = None
 
     def spill(self) -> int:
@@ -64,6 +69,11 @@ class MemManager:
         self.spill_time_ns = 0  # wall time spent inside consumer.spill()
         self.wait_count = 0
         self.peak_used = 0  # high-water mark across all consumers
+        # per-group admission reservations (serve/scheduler.py): bytes set
+        # aside for an admitted query before its consumers register
+        self._reservations: Dict[str, int] = {}
+        # ambient group for register(): set per task thread via group_scope
+        self._tls = threading.local()
         self.wait_timeout_s = wait_timeout_s if wait_timeout_s is not None \
             else get_config().mem_wait_timeout_s
 
@@ -90,10 +100,16 @@ class MemManager:
         with cls._lock:
             cls._instance = None
 
-    def register(self, consumer: MemConsumer):
+    def register(self, consumer: MemConsumer, group: Optional[str] = None):
         with self._mu:
             consumer._manager = self
             consumer.owner_thread = threading.get_ident()
+            if group is not None:
+                consumer.group = group
+            elif consumer.group is None:
+                # operators register from inside task threads that the
+                # session wrapped in group_scope(query group)
+                consumer.group = getattr(self._tls, "group", None)
             self.consumers.append(consumer)
 
     def unregister(self, consumer: MemConsumer):
@@ -103,6 +119,62 @@ class MemManager:
             if consumer in self.consumers:
                 self.consumers.remove(consumer)
             self._cv.notify_all()  # freed memory may unblock waiters
+
+    @contextlib.contextmanager
+    def group_scope(self, group: Optional[str]):
+        """Ambient reservation group for consumers registered on this thread
+        (the session wraps each task body so operator-created consumers land
+        in their query's group without touching every operator)."""
+        prev = getattr(self._tls, "group", None)
+        self._tls.group = group
+        try:
+            yield
+        finally:
+            self._tls.group = prev
+
+    # -- per-query reservations (serving-layer admission control) -------------
+
+    def reserve_group(self, group: str, nbytes: int):
+        """Set aside ``nbytes`` for an admitted query before any of its
+        consumers register — concurrent admissions cannot double-book the
+        same headroom."""
+        with self._mu:
+            self._reservations[group] = \
+                self._reservations.get(group, 0) + int(nbytes)
+
+    def release_group(self, group: str) -> int:
+        """Drop a query's reservation and force-unregister any consumers
+        still in its group (a cancelled/failed query's leak guard); returns
+        the leaked consumer bytes reclaimed."""
+        with self._mu:
+            self._reservations.pop(group, None)
+            freed = 0
+            for c in [c for c in self.consumers if c.group == group]:
+                freed += c.mem_used
+                c._manager = None
+                c.mem_used = 0
+                self.consumers.remove(c)
+            self._cv.notify_all()
+            return freed
+
+    def headroom(self) -> int:
+        """Admittable bytes: total minus each group's committed footprint
+        (the larger of its reservation and its live usage) minus ungrouped
+        usage. May go negative when running queries overshoot estimates."""
+        with self._mu:
+            used_by_group: Dict[str, int] = {}
+            ungrouped = 0
+            for c in self.consumers:
+                if c.group is None:
+                    ungrouped += c.mem_used
+                else:
+                    used_by_group[c.group] = \
+                        used_by_group.get(c.group, 0) + c.mem_used
+            committed = ungrouped
+            for g in set(self._reservations) | set(used_by_group):
+                committed += max(self._reservations.get(g, 0),
+                                 used_by_group.get(g, 0))
+            return self.total - committed
 
     # -- accounting -----------------------------------------------------------
 
@@ -117,20 +189,46 @@ class MemManager:
             return {
                 "total": self.total,
                 "used": sum(c.mem_used for c in self.consumers),
+                "headroom": self.headroom(),
                 "peak_used": self.peak_used,
                 "mem_spill_count": self.spill_count,
                 "mem_spill_size": self.total_spilled_bytes,
                 "mem_spill_time_ns": self.spill_time_ns,
                 "wait_count": self.wait_count,
+                "reservations": dict(self._reservations),
                 "consumers": [
                     {"name": c.name, "mem_used": c.mem_used,
-                     "spillable": c.spillable}
+                     "spillable": c.spillable, "group": c.group}
                     for c in self.consumers
                 ],
             }
 
-    def fair_share(self) -> int:
+    def _spillable_group_counts(self) -> Dict[Optional[str], int]:
+        counts: Dict[Optional[str], int] = {}
+        for c in self.consumers:
+            if c.spillable:
+                counts[c.group] = counts.get(c.group, 0) + 1
+        return counts
+
+    def _share_locked(self, consumer: MemConsumer,
+                      counts: Optional[Dict[Optional[str], int]] = None) -> int:
+        """Fair share of one consumer: the budget splits evenly across the
+        active reservation GROUPS (one per query), then across the group's
+        spillable consumers — so fair_share is per query, not per consumer
+        globally, and a many-consumer query cannot crowd out a small one
+        (reference splits per consumer only: memmgr/mod.rs:36-457; the
+        grouping is the standalone multi-query extension)."""
+        counts = counts if counts is not None else \
+            self._spillable_group_counts()
+        if not counts:
+            return self.total
+        per_group = self.total // len(counts)
+        return per_group // max(counts.get(consumer.group, 1), 1)
+
+    def fair_share(self, consumer: Optional[MemConsumer] = None) -> int:
         with self._mu:
+            if consumer is not None:
+                return self._share_locked(consumer)
             n = sum(1 for c in self.consumers if c.spillable) or 1
             return self.total // n
 
@@ -164,14 +262,15 @@ class MemManager:
                     # a shrinking update must NEVER block — freeing memory
                     # while waiting for someone else to free memory inverts
                     # the backpressure
-                    share = self.fair_share()
-                    if consumer.spillable and consumer.mem_used > share:
+                    counts = self._spillable_group_counts()
+                    if consumer.spillable and consumer.mem_used > \
+                            self._share_locked(consumer, counts):
                         action = "spill"
                     else:
                         foreign_peer = False
                         for c in self.consumers:
                             if c is not consumer and c.spillable and \
-                                    c.mem_used > share:
+                                    c.mem_used > self._share_locked(c, counts):
                                 c.spill_requested = True
                                 # a peer on the CALLING thread can only spill
                                 # on its own next update — which this wait

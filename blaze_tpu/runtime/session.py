@@ -89,6 +89,27 @@ class _CoalescedBlockProvider:
         return blocks
 
 
+class _QueryRun:
+    """Driver-side state of ONE executing query: its cancel token, its
+    MemManager reservation group, and everything that must be torn down if
+    it fails or is cancelled mid-flight (shuffle dirs, resource-map entries).
+    Stage records accumulate here instead of on shared Session dicts so two
+    driver threads can't interleave each other's stages (re-entrancy)."""
+
+    __slots__ = ("qid", "token", "mem_group", "label", "stage_meta",
+                 "shuffle_dirs", "resource_ids")
+
+    def __init__(self, qid: int, token=None, mem_group: Optional[str] = None,
+                 label: Optional[str] = None):
+        self.qid = qid
+        self.token = token
+        self.mem_group = mem_group
+        self.label = label
+        self.stage_meta: Dict[int, dict] = {}
+        self.shuffle_dirs: List[str] = []
+        self.resource_ids: List[str] = []
+
+
 class Session:
     def __init__(self, conf: Optional[Config] = None, work_dir: Optional[str] = None,
                  max_workers: Optional[int] = None, mesh=None,
@@ -136,61 +157,120 @@ class Session:
         self._query_ids = itertools.count()
         self._stage_meta: Dict[int, dict] = {}
         self.query_log: List[dict] = []  # last _QUERY_LOG_MAX finished queries
+        self.inflight: Dict[int, dict] = {}  # qid -> live query record
+        self._qlog_mu = threading.Lock()  # guards query_log + inflight
+        # per-thread current _QueryRun: set on the lowering thread by
+        # execute() and re-established on task threads by _run_tasks, so
+        # stage records / cancel tokens / memory groups reach operator code
+        # without threading a parameter through every closure
+        self._tls = threading.local()
+        self.serve_scheduler = None  # set by serve.QueryScheduler
 
     _QUERY_LOG_MAX = 50
 
     # -- public API -----------------------------------------------------------
 
-    def execute(self, plan: N.PlanNode) -> Iterator[ColumnarBatch]:
+    def execute(self, plan: N.PlanNode,
+                cancel_token=None,
+                mem_group: Optional[str] = None,
+                release_on_finish: bool = False,
+                label: Optional[str] = None) -> Iterator[ColumnarBatch]:
         """Run a plan, yielding all result batches (final-stage partitions in
         order). Partitions execute concurrently on the task pool — device
         round-trip latency overlaps — while batches are yielded in partition
-        order."""
+        order.
+
+        ``cancel_token``: a serving-layer ``CancelToken`` (deadline and/or
+        explicit cancel) checked at stage boundaries, between batches, and in
+        the worker-pool loop; cancellation raises ``QueryCancelled`` and
+        tears the query's shuffle dirs / memory group down immediately.
+        ``mem_group``: MemManager reservation group for every consumer this
+        query registers (per-query fair share). ``release_on_finish``: drop
+        the query's shuffle dirs and resources as soon as it finishes instead
+        of at session close — what a long-lived serving session needs."""
+        from blaze_tpu.ops.base import QueryCancelled, TaskCancelled
         from blaze_tpu.utils.logutil import clear_task_context, set_task_context
 
         qid = next(self._query_ids)
+        qrun = _QueryRun(qid, cancel_token, mem_group, label)
         t0 = time.perf_counter_ns()
-        stages_before = set(self._stage_meta)
-        if self.conf.column_pruning_enable:
-            from blaze_tpu.ir.optimizer import prune_plan
-
-            plan = prune_plan(plan)
-        # map stages run EAGERLY during lowering, so by the time the final
-        # operator exists every stage this query ran is in _stage_meta
-        lowered = self._lower(plan)
-        op = build_operator(lowered)
-        nparts = op.num_partitions()
         query = {
             "id": qid,
-            "shape": op_shape(op),
-            "nparts": nparts,
-            "result_keys": [f"result_{p}" for p in range(nparts)],
-            "stages": [self._stage_meta[s]
-                       for s in sorted(set(self._stage_meta) - stages_before)],
+            "state": "running",
+            "label": label,
+            "mem_group": mem_group,
+            "started_unix": time.time(),
+            "shape": None,
+            "nparts": 0,
+            "result_keys": [],
+            "stages": [],
             "rows": 0,
             "wall_s": 0.0,
         }
+        with self._qlog_mu:
+            self.inflight[qid] = query
 
-        def finish_query(rows: int):
+        def finish_query(rows: int, state: str = "done"):
             dur_ns = time.perf_counter_ns() - t0
             query["rows"] = rows
             query["wall_s"] = dur_ns / 1e9
-            self.query_log.append(query)
-            del self.query_log[:-self._QUERY_LOG_MAX]
+            query["state"] = state
+            with self._qlog_mu:
+                self.inflight.pop(qid, None)
+                self.query_log.append(query)
+                del self.query_log[:-self._QUERY_LOG_MAX]
+            if state != "done" or release_on_finish:
+                self._release_query(qrun)
             if TRACER.enabled:
                 TRACER.complete(f"query_{qid}", "query", t0, dur_ns,
-                                {"rows": rows, "nparts": nparts,
-                                 "stages": len(query["stages"])})
+                                {"rows": rows, "nparts": query["nparts"],
+                                 "stages": len(query["stages"]),
+                                 "state": state})
 
-        where = self._decide_placement(lowered, "result")
+        def classify(exc: BaseException) -> str:
+            # GeneratorExit: the consumer abandoned the stream (e.g. the
+            # serving layer closed a cancelled query's iterator)
+            if isinstance(exc, (TaskCancelled, GeneratorExit)):
+                return "cancelled"
+            return "failed"
+
+        try:
+            if cancel_token is not None:
+                cancel_token.check()
+            if self.conf.column_pruning_enable:
+                from blaze_tpu.ir.optimizer import prune_plan
+
+                plan = prune_plan(plan)
+            # map stages run EAGERLY during lowering, so by the time the
+            # final operator exists every stage this query ran is in
+            # qrun.stage_meta (query-scoped: concurrent queries don't see
+            # each other's stages)
+            prev_qrun = getattr(self._tls, "qrun", None)
+            self._tls.qrun = qrun
+            try:
+                lowered = self._lower(plan)
+            finally:
+                self._tls.qrun = prev_qrun
+            op = build_operator(lowered)
+            nparts = op.num_partitions()
+            query["shape"] = op_shape(op)
+            query["nparts"] = nparts
+            query["result_keys"] = [f"result_{p}" for p in range(nparts)]
+            query["stages"] = [qrun.stage_meta[s]
+                               for s in sorted(qrun.stage_meta)]
+            where = self._decide_placement(lowered, "result")
+        except BaseException as exc:
+            finish_query(0, classify(exc))
+            raise
 
         def run_partition_stream(p: int):
             from blaze_tpu.runtime import placement
 
-            ctx = self._make_ctx(p)
+            ctx = self._make_ctx(p, qrun=qrun)
             set_task_context(0, p)
             try:
-                with placement.placed(where):
+                with placement.placed(where), \
+                        ctx.mem.group_scope(qrun.mem_group):
                     yield from op.execute(p, ctx,
                                           self.metrics.named_child(f"result_{p}"))
             finally:
@@ -233,6 +313,7 @@ class Session:
                 _put(queues[p], exc)
 
         rows_out = 0
+        state = "done"
         with ThreadPoolExecutor(
                 max_workers=max(1, min(self.max_workers, nparts))) as pool:
             try:
@@ -240,13 +321,25 @@ class Session:
                     pool.submit(produce, p)
                 for p in range(nparts):
                     while True:
-                        item = queues[p].get()
+                        try:
+                            # bounded wait: a deadline must fire even while a
+                            # producer is wedged inside a long device step
+                            item = queues[p].get(timeout=0.1)
+                        except _queue.Empty:
+                            if cancel_token is not None:
+                                cancel_token.check()
+                            continue
                         if item is DONE:
                             break
                         if isinstance(item, BaseException):
                             raise item
+                        if cancel_token is not None:
+                            cancel_token.check()
                         rows_out += item.num_rows
                         yield item
+            except BaseException as exc:
+                state = classify(exc)
+                raise
             finally:
                 # unblock producers on early close so pool shutdown completes
                 stop.set()
@@ -256,17 +349,17 @@ class Session:
                             q.get_nowait()
                         except _queue.Empty:
                             break
-                finish_query(rows_out)
+                finish_query(rows_out, state)
 
-    def execute_to_table(self, plan: N.PlanNode) -> pa.Table:
-        batches = [b.to_arrow() for b in self.execute(plan) if b.num_rows]
+    def execute_to_table(self, plan: N.PlanNode, **kw) -> pa.Table:
+        batches = [b.to_arrow() for b in self.execute(plan, **kw) if b.num_rows]
         schema = T.schema_to_arrow(plan.output_schema)
         if not batches:
             return schema.empty_table()
         return pa.Table.from_batches(batches)
 
-    def execute_to_pydict(self, plan: N.PlanNode) -> dict:
-        return self.execute_to_table(plan).to_pydict()
+    def execute_to_pydict(self, plan: N.PlanNode, **kw) -> dict:
+        return self.execute_to_table(plan, **kw).to_pydict()
 
     def explain_analyze(self, plan: N.PlanNode) -> str:
         """EXPLAIN ANALYZE: execute the plan to completion and render its
@@ -275,6 +368,27 @@ class Session:
         for _ in self.execute(plan):
             pass
         return render_explain_analyze(self.query_log[-1], self.metrics)
+
+    def _release_query(self, qrun: _QueryRun):
+        """Tear one query's intermediates down NOW instead of at session
+        close: its shuffle dirs, its resource-map entries, and — the leak
+        backstop for cancelled/failed queries — any MemConsumers still
+        registered in its memory group (operators unregister in try/finally,
+        so a nonzero reclaim here is surfaced as a metric, not silence)."""
+        import shutil
+
+        for d in qrun.shuffle_dirs:
+            shutil.rmtree(d, ignore_errors=True)
+        for rid in qrun.resource_ids:
+            self.resources.pop(rid, None)
+        if qrun.mem_group is not None:
+            from blaze_tpu.runtime.memmgr import MemManager
+
+            mm = MemManager._instance
+            if mm is not None:
+                leaked = mm.release_group(qrun.mem_group)
+                if leaked:
+                    self.metrics.add("query_leaked_mem_reclaimed", leaked)
 
     def close(self):
         """Remove shuffle files and release resources (a failed stage is
@@ -318,33 +432,55 @@ class Session:
         shape = op_shape(child_op)
         if wrapper is not None:
             shape = (wrapper, [shape])
-        self._stage_meta[stage] = {"id": stage, "kind": kind,
-                                   "num_tasks": num_tasks, "shape": shape}
+        meta = {"id": stage, "kind": kind,
+                "num_tasks": num_tasks, "shape": shape}
+        self._stage_meta[stage] = meta
+        qrun = getattr(self._tls, "qrun", None)
+        if qrun is not None:
+            qrun.stage_meta[stage] = meta
 
-    def _make_ctx(self, partition: int, stage: int = 0) -> ExecContext:
+    def _qrun(self) -> Optional[_QueryRun]:
+        return getattr(self._tls, "qrun", None)
+
+    def _register_resource(self, rid: str, provider):
+        """Resource-map insert that also charges the resource to the current
+        query, so _release_query can drop it without a session close."""
+        self.resources[rid] = provider
+        qrun = self._qrun()
+        if qrun is not None:
+            qrun.resource_ids.append(rid)
+
+    def _make_ctx(self, partition: int, stage: int = 0,
+                  qrun: Optional[_QueryRun] = None) -> ExecContext:
+        if qrun is None:
+            qrun = self._qrun()
         return ExecContext(
             task=TaskContext(stage_id=stage, partition_id=partition),
             conf=self.conf,
             resources=self.resources,
+            cancel_token=qrun.token if qrun is not None else None,
         )
 
     def _lower(self, node: N.PlanNode) -> N.PlanNode:
         self._check_op_enabled(node)
         if isinstance(node, N.SortMergeJoin) and self.conf.skew_join_enable \
                 and self.mesh is None and self.rss_sock_path is None \
-                and getattr(self, "_dist_ok", True):
+                and getattr(self._tls, "dist_ok", True):
             out = self._try_skew_join(node)
             if out is not None:
                 return out
-        prev_dist_ok = getattr(self, "_dist_ok", True)
-        prev_zip_ok = getattr(self, "_zip_ok", True)
-        self._dist_ok = self._child_dist_ok(node, prev_dist_ok)
-        self._zip_ok = self._child_zip_ok(node, prev_zip_ok)
+        # lowering recursion state lives on the thread, not the session:
+        # two driver threads lowering concurrently must not clobber each
+        # other's distribution/zip freedom flags (re-entrancy)
+        prev_dist_ok = getattr(self._tls, "dist_ok", True)
+        prev_zip_ok = getattr(self._tls, "zip_ok", True)
+        self._tls.dist_ok = self._child_dist_ok(node, prev_dist_ok)
+        self._tls.zip_ok = self._child_zip_ok(node, prev_zip_ok)
         try:
             node = N.map_children(node, self._lower)
         finally:
-            self._dist_ok = prev_dist_ok
-            self._zip_ok = prev_zip_ok
+            self._tls.dist_ok = prev_dist_ok
+            self._tls.zip_ok = prev_zip_ok
         if isinstance(node, N.Sort) and \
                 isinstance(node.child, N.CoalesceBatches):
             # Sort stages its whole input and concatenates once at output
@@ -464,6 +600,11 @@ class Session:
                            wrapper="ShuffleWriterExec")
         shuffle_dir = os.path.join(self.work_dir, f"shuffle_{stage}")
         os.makedirs(shuffle_dir, exist_ok=True)
+        qrun = self._qrun()
+        if qrun is not None:
+            # charged BEFORE the tasks run: a query cancelled/failed mid-map
+            # tears down its partial map files, not just completed stages
+            qrun.shuffle_dirs.append(shuffle_dir)
 
         def paths_for(m: int):
             return (os.path.join(shuffle_dir, f"map_{m}.data"),
@@ -526,10 +667,10 @@ class Session:
             # one partition, and the _zip_ok guard blocks it under
             # partition-zipping ancestors (joins/unions)
             self.metrics.add("coalesced_partitions", num_reducers - len(groups))
-            self.resources[rid] = _CoalescedBlockProvider(indexes, groups)
+            self._register_resource(rid, _CoalescedBlockProvider(indexes, groups))
             num_reducers = len(groups)
         else:
-            self.resources[rid] = FileSegmentBlockProvider(indexes)
+            self._register_resource(rid, FileSegmentBlockProvider(indexes))
         # coalesce reducer input: maps emit many small (e.g. per-batch
         # partial-agg) batches; merging them cuts downstream per-batch
         # overheads (reference: ExecutionContext.coalesce on every stream)
@@ -634,10 +775,10 @@ class Session:
             self.metrics.add("skew_partitions_split", 1)
 
         lrid, rrid = f"shuffle_{lstage}", f"shuffle_{rstage}"
-        self.resources[lrid] = _SubsetBlockProvider(
-            lindexes, parts, subset_applies=split_left)
-        self.resources[rrid] = _SubsetBlockProvider(
-            rindexes, parts, subset_applies=split_right)
+        self._register_resource(lrid, _SubsetBlockProvider(
+            lindexes, parts, subset_applies=split_left))
+        self._register_resource(rrid, _SubsetBlockProvider(
+            rindexes, parts, subset_applies=split_right))
         nparts = len(parts)
         left: N.PlanNode = N.CoalesceBatches(
             N.IpcReader(schema=lex.child.output_schema, resource_id=lrid,
@@ -658,7 +799,7 @@ class Session:
         import numpy as np
 
         if not self.conf.coalesce_partitions_enable or num_reducers <= 1 \
-                or not getattr(self, "_zip_ok", True):
+                or not getattr(self._tls, "zip_ok", True):
             return None
         sizes = np.zeros(num_reducers, dtype=np.int64)
         for _, offsets in indexes:
@@ -709,15 +850,15 @@ class Session:
             shuffle_client = CelebornShuffleClient(client, num_maps,
                                                    num_reducers)
             shuffle_client.register()
-            self.resources[wid] = CelebornWriterFactory(shuffle_client)
+            self._register_resource(wid, CelebornWriterFactory(shuffle_client))
         elif self.conf.rss_protocol == "uniffle":
             # requireBuffer-gated sends + reportShuffleResult commits; the
             # reader follows the blockId bitmap (no stage-end seal RPC in
             # uniffle's model)
             shuffle_client = UniffleShuffleClient(client)
-            self.resources[wid] = UniffleWriterFactory(shuffle_client)
+            self._register_resource(wid, UniffleWriterFactory(shuffle_client))
         else:
-            self.resources[wid] = RssWriterFactory(client)
+            self._register_resource(wid, RssWriterFactory(client))
 
         shipped = None
         if self.pool is not None:
@@ -753,9 +894,10 @@ class Session:
             # chunk-fetch frames / bitmap + getMemoryShuffleData)
             if hasattr(shuffle_client, "commit_files"):
                 shuffle_client.commit_files()
-            self.resources[rid] = shuffle_client
+            self._register_resource(rid, shuffle_client)
         else:
-            self.resources[rid] = client  # provider: client(pid) -> blocks
+            # provider: client(pid) -> blocks
+            self._register_resource(rid, client)
         return N.CoalesceBatches(
             N.IpcReader(schema=node.child.output_schema, resource_id=rid,
                         num_partitions=num_reducers),
@@ -849,7 +991,7 @@ class Session:
                 return []
             return [rb.to_columnar() if isinstance(rb, _HB) else rb]
 
-        self.resources[rid] = _read
+        self._register_resource(rid, _read)
         return N.CoalesceBatches(
             N.BatchSource(schema=schema, resource_id=rid,
                           num_partitions=num_reducers),
@@ -884,7 +1026,10 @@ class Session:
             return False
         # stage resources (shuffle block indexes, broadcast chunks) go to
         # each worker ONCE, not inside every task message
-        replies = self.pool.run_tasks(msgs, shared=resources)
+        qrun = self._qrun()
+        replies = self.pool.run_tasks(
+            msgs, shared=resources,
+            cancel=qrun.token if qrun is not None else None)
         stage_metrics = self.metrics.named_child(f"stage_{stage}")
         for m, r in enumerate(replies):
             stage_metrics.named_child(f"map_{m}").merge_dict(
@@ -976,7 +1121,7 @@ class Session:
         stage = next(self._stage_ids)
         chunks = self._collect_child_chunks(node.child, stage, "single")
         rid = f"single_{stage}"
-        self.resources[rid] = BytesBlockProvider(chunks)
+        self._register_resource(rid, BytesBlockProvider(chunks))
         return N.CoalesceBatches(
             N.IpcReader(schema=node.child.output_schema, resource_id=rid,
                         num_partitions=1),
@@ -990,7 +1135,7 @@ class Session:
         stage = next(self._stage_ids)
         chunks = self._collect_child_chunks(node.child, stage, "broadcast")
         rid = f"broadcast_{stage}"
-        self.resources[rid] = BytesBlockProvider(chunks)
+        self._register_resource(rid, BytesBlockProvider(chunks))
         return N.IpcReader(schema=node.child.output_schema, resource_id=rid,
                            num_partitions=1)
 
@@ -1012,13 +1157,40 @@ class Session:
         import logging
         import time
 
+        from blaze_tpu.ops.base import TaskCancelled
+
         log = logging.getLogger("blaze_tpu.session")
+        # captured on the LOWERING thread (where the TLS is set) so task-pool
+        # threads inherit the query's token + memory group through the
+        # closure, then re-established as their own TLS below
+        qrun = self._qrun()
+
+        def run_task(p):
+            if qrun is None:
+                return fn(p)
+            if qrun.token is not None:
+                qrun.token.check()  # don't even start a doomed task
+            prev = getattr(self._tls, "qrun", None)
+            self._tls.qrun = qrun
+            try:
+                from blaze_tpu.runtime.memmgr import MemManager
+
+                mm = MemManager.get_or_init(self.conf)
+                with mm.group_scope(qrun.mem_group):
+                    return fn(p)
+            finally:
+                self._tls.qrun = prev
 
         def run_with_retry(p):
             attempt = 0
             while True:
                 try:
-                    return fn(p)
+                    return run_task(p)
+                except TaskCancelled:
+                    # cancellation is not a failure: no retry, no backoff —
+                    # surface immediately so sibling tasks stop too
+                    self.metrics.add("task_cancelled", 1)
+                    raise
                 except self._DETERMINISTIC_ERRORS as exc:
                     import pyarrow as _pa
 

@@ -1,0 +1,16 @@
+"""Query serving layer: concurrent scheduler with admission control,
+deadlines, cancellation, and per-query memory budgets (serve/scheduler.py).
+
+The reference delegates multi-query scheduling to Spark's scheduler + YARN
+admission; a standalone driver needs its own. ``QueryScheduler`` accepts
+plans from many client threads, runs up to ``serve_max_concurrent`` at
+once, arbitrates the rest with a priority queue plus MemManager-headroom
+admission, and sheds excess load with a typed ``Overloaded`` error.
+"""
+
+from blaze_tpu.serve.scheduler import (Overloaded, QueryHandle,
+                                       QueryScheduler,
+                                       estimate_plan_memory)
+
+__all__ = ["Overloaded", "QueryHandle", "QueryScheduler",
+           "estimate_plan_memory"]

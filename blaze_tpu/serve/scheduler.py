@@ -1,0 +1,388 @@
+"""Concurrent query scheduler: admission control, deadlines, cancellation.
+
+The reference hands multi-query scheduling to Spark's scheduler (slots via
+executor cores, admission via YARN queues, cancellation via task kill
+through the JNI ``is_task_running`` flag). The standalone driver has
+nothing in that role, so this module provides it natively:
+
+- ``QueryScheduler.submit`` accepts a plan from any client thread and
+  returns a ``QueryHandle``; up to ``serve_max_concurrent`` queries run at
+  once and the rest wait in a priority queue.
+- Admission is MEMORY-based: a query is admitted only when the
+  ``MemManager``'s headroom covers its estimated footprint
+  (``estimate_plan_memory`` walks the plan for stateful operators). The
+  estimate is reserved as a per-query group at admission, so concurrent
+  admissions cannot double-book headroom — graceful degradation instead of
+  OOM (Sparkle, arxiv 1708.05746, on cross-query memory arbitration).
+- Overload sheds: a full queue rejects at submit; a queued query that
+  waits past ``serve_queue_timeout_s`` is shed by the dispatcher — both
+  with the typed ``Overloaded`` error ("Accelerating Presto with GPUs",
+  arxiv 2606.24647, on explicit concurrency slots + load shedding for
+  bounded tail latency).
+- Every handle carries a ``CancelToken`` (client cancel and/or deadline)
+  that Session stage execution, operator batch loops, and the WorkerPool
+  scheduling loop all poll; cancellation stops map stages mid-flight and
+  ``Session._release_query`` reclaims shuffle dirs + the memory group.
+"""
+
+from __future__ import annotations
+
+import collections
+import heapq
+import itertools
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+import pyarrow as pa
+
+from blaze_tpu.ir import nodes as N
+from blaze_tpu.ir import types as T
+from blaze_tpu.ops.base import CancelToken, QueryCancelled, TaskCancelled
+from blaze_tpu.runtime.memmgr import MemManager
+
+
+class Overloaded(RuntimeError):
+    """Typed load-shed error: the scheduler refused or dropped the query to
+    protect queries already running (full queue, queue timeout, shutdown)."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+# operators that hold per-task state proportional to their input (the spill
+# consumers): the admission estimate counts these
+_STATEFUL = (N.Sort, N.Agg, N.Window, N.SortMergeJoin, N.HashJoin,
+             N.BroadcastJoin, N.ShuffleExchange, N.BroadcastExchange)
+
+
+def estimate_plan_memory(plan: N.PlanNode, conf=None,
+                         floor: Optional[int] = None) -> int:
+    """Admission-control footprint estimate: ~4 in-flight batches per
+    stateful operator, floored at ``serve_default_mem_estimate``. Coarse on
+    purpose — underestimates are absorbed by the spill machinery, and the
+    reservation groups keep overestimates from deadlocking admission (an
+    empty scheduler always admits)."""
+    if conf is None:
+        from blaze_tpu.config import get_config
+
+        conf = get_config()
+    if floor is None:
+        floor = conf.serve_default_mem_estimate
+    n = 0
+    stack = [plan]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _STATEFUL):
+            n += 1
+        stack.extend(node.children())
+    return max(floor, n * 4 * conf.suggested_batch_mem_size)
+
+
+class QueryHandle:
+    """One submission's lifetime: queued -> admitted -> running ->
+    done | failed | cancelled, or queued -> shed. ``result()`` blocks for
+    the outcome; ``cancel()`` flips the token the whole execution polls."""
+
+    def __init__(self, scheduler: "QueryScheduler", qid: int,
+                 plan: N.PlanNode, priority: int,
+                 deadline_s: Optional[float], mem_estimate: int,
+                 label: Optional[str]):
+        self.scheduler = scheduler
+        self.qid = qid
+        self.plan = plan
+        self.priority = priority
+        self.deadline_s = deadline_s
+        self.mem_estimate = mem_estimate
+        self.label = label or f"query_{qid}"
+        self.submitted_at = time.monotonic()
+        self.token = CancelToken(
+            deadline=(self.submitted_at + deadline_s)
+            if deadline_s is not None else None)
+        self.mem_group = f"serve_{qid}"
+        self.state = "queued"
+        self.error: Optional[BaseException] = None
+        self.table: Optional[pa.Table] = None
+        self.admitted_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self._done = threading.Event()
+
+    def cancel(self, reason: str = "cancelled by client"):
+        self.token.cancel(reason)
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> pa.Table:
+        """Block for the outcome: the result table, or the typed error the
+        query ended with (``Overloaded`` for sheds, ``QueryCancelled`` for
+        cancel/deadline, the original exception for failures)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"query {self.qid} ({self.label}) still {self.state} "
+                f"after {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return self.table
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        d = {"qid": self.qid, "label": self.label, "state": self.state,
+             "priority": self.priority, "mem_estimate": self.mem_estimate,
+             "deadline_s": self.deadline_s,
+             "elapsed_s": round(now - self.submitted_at, 3)}
+        if self.admitted_at is not None:
+            d["run_s"] = round((self.finished_at or now) - self.admitted_at, 3)
+        if self.error is not None:
+            d["error"] = f"{type(self.error).__name__}: {self.error}"
+        if self.table is not None:
+            d["rows"] = self.table.num_rows
+        return d
+
+
+class QueryScheduler:
+    """Priority queue + concurrency slots + memory admission in front of one
+    ``Session``. Thread-safe: submit/cancel/status from any thread; a
+    dispatcher thread admits and sheds; queries run on a bounded executor."""
+
+    _FINISHED_KEEP = 512  # finished handles retained for /serve/status
+
+    def __init__(self, session, max_concurrent: Optional[int] = None,
+                 max_queue: Optional[int] = None,
+                 queue_timeout_s: Optional[float] = None,
+                 default_mem_estimate: Optional[int] = None):
+        conf = session.conf
+        self.session = session
+        self.max_concurrent = max_concurrent or conf.serve_max_concurrent
+        self.max_queue = max_queue or conf.serve_max_queue
+        self.queue_timeout_s = queue_timeout_s if queue_timeout_s is not None \
+            else conf.serve_queue_timeout_s
+        self.default_mem_estimate = default_mem_estimate or \
+            conf.serve_default_mem_estimate
+        self._ids = itertools.count()
+        self._seq = itertools.count()  # FIFO tie-break within a priority
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._queue: List[tuple] = []  # heap of (-priority, seq, handle)
+        self._running: Dict[int, QueryHandle] = {}
+        self._handles: Dict[int, QueryHandle] = {}
+        self._finished: "collections.deque" = collections.deque()
+        self._closed = False
+        self.peak_inflight = 0
+        self.metrics = session.metrics.named_child("serve")
+        self._exec = ThreadPoolExecutor(max_workers=self.max_concurrent,
+                                        thread_name_prefix="serve")
+        self._dispatcher = threading.Thread(target=self._dispatch_loop,
+                                            name="serve-dispatch", daemon=True)
+        self._dispatcher.start()
+        session.serve_scheduler = self
+
+    # -- client API -----------------------------------------------------------
+
+    def submit(self, plan: N.PlanNode, priority: int = 0,
+               deadline_s: Optional[float] = None,
+               mem_estimate: Optional[int] = None,
+               label: Optional[str] = None) -> QueryHandle:
+        """Enqueue a plan; returns immediately with a QueryHandle. Raises
+        ``Overloaded`` right here when the queue is full or the scheduler is
+        shut down (shedding at the door keeps the queue a bound, not a
+        buffer)."""
+        if mem_estimate is None:
+            mem_estimate = estimate_plan_memory(
+                plan, self.session.conf, self.default_mem_estimate)
+        with self._cv:
+            if self._closed:
+                self.metrics.add("queries_shed", 1)
+                raise Overloaded("scheduler closed")
+            if len(self._queue) >= self.max_queue:
+                self.metrics.add("queries_shed", 1)
+                self._log_terminal(None, label or "query", "shed",
+                                   "queue full", 0.0)
+                raise Overloaded(
+                    f"queue full ({self.max_queue} queries waiting)")
+            qid = next(self._ids)
+            h = QueryHandle(self, qid, plan, priority, deadline_s,
+                            mem_estimate, label)
+            self._handles[qid] = h
+            heapq.heappush(self._queue, (-priority, next(self._seq), h))
+            self.metrics.add("queries_submitted", 1)
+            self._cv.notify_all()
+        return h
+
+    def status(self, qid: int) -> Optional[dict]:
+        with self._mu:
+            h = self._handles.get(qid)
+        return h.snapshot() if h is not None else None
+
+    def cancel(self, qid: int, reason: str = "cancelled by client") -> bool:
+        with self._mu:
+            h = self._handles.get(qid)
+        if h is None:
+            return False
+        h.cancel(reason)
+        with self._cv:
+            self._cv.notify_all()  # wake the dispatcher to reap queued ones
+        return True
+
+    def snapshot(self) -> dict:
+        """Live view for /serve/queries and /debug/queries."""
+        with self._mu:
+            queued = [item[2].snapshot() for item in sorted(self._queue)]
+            running = [h.snapshot() for h in self._running.values()]
+        return {"max_concurrent": self.max_concurrent,
+                "max_queue": self.max_queue,
+                "peak_inflight": self.peak_inflight,
+                "queued": queued, "running": running}
+
+    def close(self, cancel_running: bool = True, timeout: float = 30.0):
+        """Shut down: shed everything queued, optionally cancel everything
+        running, wait for the dispatcher and executor to drain."""
+        with self._cv:
+            self._closed = True
+            while self._queue:
+                _, _, h = heapq.heappop(self._queue)
+                self._finish_unstarted_locked(h, "shed",
+                                              Overloaded("scheduler closed"))
+            if cancel_running:
+                for h in list(self._running.values()):
+                    h.token.cancel("scheduler closed")
+            self._cv.notify_all()
+        self._dispatcher.join(timeout=timeout)
+        self._exec.shutdown(wait=True)
+        if self.session.serve_scheduler is self:
+            self.session.serve_scheduler = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- dispatcher -----------------------------------------------------------
+
+    def _dispatch_loop(self):
+        while True:
+            with self._cv:
+                if self._closed and not self._queue and not self._running:
+                    return
+                self._shed_expired_locked()
+                self._admit_locked()
+                self._cv.wait(timeout=0.05)
+
+    def _shed_expired_locked(self):
+        now = time.monotonic()
+        keep = []
+        for item in self._queue:
+            h = item[2]
+            if h.token.cancelled:  # client cancel / deadline while queued
+                self._finish_unstarted_locked(
+                    h, "cancelled",
+                    QueryCancelled(h.token.reason or "cancelled"))
+            elif now - h.submitted_at > self.queue_timeout_s:
+                self.metrics.add("queries_shed", 1)
+                self._finish_unstarted_locked(
+                    h, "shed",
+                    Overloaded(f"queued {now - h.submitted_at:.1f}s > "
+                               f"queue timeout {self.queue_timeout_s}s"))
+            else:
+                keep.append(item)
+        if len(keep) != len(self._queue):
+            self._queue[:] = keep
+            heapq.heapify(self._queue)
+        for h in self._running.values():
+            h.token.cancelled  # touch: deadline fires with no other polls
+
+    def _admit_locked(self):
+        mm = MemManager.get_or_init(self.session.conf)
+        while self._queue and len(self._running) < self.max_concurrent \
+                and not self._closed:
+            h = self._queue[0][2]
+            # progress guarantee: an empty scheduler admits unconditionally
+            # — an estimate above the whole budget must degrade to "run
+            # alone and spill", not wait forever
+            if self._running and mm.headroom() < h.mem_estimate:
+                self.metrics.add("admission_blocked", 1)
+                break
+            heapq.heappop(self._queue)
+            mm.reserve_group(h.mem_group, h.mem_estimate)
+            h.state = "admitted"
+            h.admitted_at = time.monotonic()
+            self._running[h.qid] = h
+            if len(self._running) > self.peak_inflight:
+                self.peak_inflight = len(self._running)
+                self.metrics.set("peak_inflight", self.peak_inflight)
+            self._exec.submit(self._run, h)
+
+    def _run(self, h: QueryHandle):
+        h.state = "running"
+        err: Optional[BaseException] = None
+        state = "done"
+        try:
+            h.token.check()
+            batches = [
+                b.to_arrow()
+                for b in self.session.execute(
+                    h.plan, cancel_token=h.token, mem_group=h.mem_group,
+                    release_on_finish=True, label=h.label)
+                if b.num_rows]
+            if batches:
+                h.table = pa.Table.from_batches(batches)
+            else:
+                h.table = T.schema_to_arrow(h.plan.output_schema).empty_table()
+        except TaskCancelled as exc:  # QueryCancelled included
+            err, state = exc, "cancelled"
+        except BaseException as exc:
+            err, state = exc, "failed"
+        finally:
+            # leak backstop: Session releases the group on cancel/failure,
+            # but the RESERVATION made at admission must go even when the
+            # query never reached execute()
+            mm = MemManager._instance
+            if mm is not None:
+                mm.release_group(h.mem_group)
+            with self._cv:
+                h.error = err
+                h.state = state
+                h.finished_at = time.monotonic()
+                self._running.pop(h.qid, None)
+                self.metrics.add(f"queries_{state}", 1)
+                self._retire_locked(h)
+                self._cv.notify_all()
+            h._done.set()
+
+    # -- bookkeeping ----------------------------------------------------------
+
+    def _finish_unstarted_locked(self, h: QueryHandle, state: str,
+                                 error: BaseException):
+        """Terminal transition for a query that never ran (shed or cancelled
+        while queued): resolve waiters and log it — these queries have no
+        Session record, so the serve layer writes the query_log entry."""
+        h.state = state
+        h.error = error
+        h.finished_at = time.monotonic()
+        if state == "cancelled":
+            self.metrics.add("queries_cancelled", 1)
+        self._log_terminal(h.qid, h.label, state, str(error),
+                           h.finished_at - h.submitted_at)
+        self._retire_locked(h)
+        h._done.set()
+
+    def _retire_locked(self, h: QueryHandle):
+        self._finished.append(h.qid)
+        while len(self._finished) > self._FINISHED_KEEP:
+            self._handles.pop(self._finished.popleft(), None)
+
+    def _log_terminal(self, qid: Optional[int], label: str, state: str,
+                      reason: str, wall_s: float):
+        """Append a shed/queued-cancel record to the session query_log so
+        /debug/queries shows the full picture, not just executed queries."""
+        rec = {"id": None, "serve_qid": qid, "label": label, "state": state,
+               "reason": reason, "rows": 0, "wall_s": round(wall_s, 4),
+               "nparts": 0, "stages": []}
+        sess = self.session
+        with sess._qlog_mu:
+            sess.query_log.append(rec)
+            del sess.query_log[:-sess._QUERY_LOG_MAX]

@@ -1,0 +1,218 @@
+"""Serving soak: N client threads hammer one QueryScheduler with mixed
+TPC-DS-like query shapes under a constrained memory budget, measuring
+end-to-end latency percentiles, shed rate, and peak in-flight concurrency.
+
+Three shapes over a store_sales-like parquet fact table:
+  agg    — two-stage hash agg (partial -> hash exchange -> final)
+  sort   — global sort over a single-partition exchange + limit
+  window — per-store rank() window over a hash exchange
+
+A fraction of submissions carry tight deadlines (exercising the cancel
+path) and the queue is kept small relative to the client count so the
+admission controller genuinely sheds. Writes SERVE_r01.json at the repo
+root with p50/p95/p99 latency, shed/cancelled/completed counts, peak
+in-flight, peak memory, and spill count — the numbers BASELINE.md cites.
+
+Run: python scripts/serve_soak.py   (CPU; ~1-3 min)
+Env: SERVE_CLIENTS (8), SERVE_QUERIES (48 total), SERVE_CONCURRENT (2),
+SERVE_BUDGET_MB (64), SERVE_ROWS (300_000), SERVE_QUEUE (4),
+SERVE_QUEUE_TIMEOUT_S (20).
+"""
+
+import json
+import os
+import random
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+CLIENTS = int(os.environ.get("SERVE_CLIENTS", 8))
+QUERIES = int(os.environ.get("SERVE_QUERIES", 48))
+CONCURRENT = int(os.environ.get("SERVE_CONCURRENT", 2))
+BUDGET_MB = int(os.environ.get("SERVE_BUDGET_MB", 64))
+ROWS = int(os.environ.get("SERVE_ROWS", 300_000))
+QUEUE = int(os.environ.get("SERVE_QUEUE", 4))
+QUEUE_TIMEOUT_S = float(os.environ.get("SERVE_QUEUE_TIMEOUT_S", 20.0))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def pctl(xs, p):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(round(p / 100.0 * (len(xs) - 1))))]
+
+
+def main():
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from blaze_tpu.config import Config, set_config
+    from blaze_tpu.ir import exprs as E
+    from blaze_tpu.ir import nodes as N
+    from blaze_tpu.ir import types as T
+    from blaze_tpu.ops.base import QueryCancelled
+    from blaze_tpu.ops.parquet import scan_node_for_files
+    from blaze_tpu.runtime.memmgr import MemManager
+    from blaze_tpu.runtime.session import Session
+    from blaze_tpu.serve import Overloaded, QueryScheduler
+
+    F, M, HASH = E.AggFunction, E.AggMode, E.AggExecMode.HASH_AGG
+
+    set_config(Config(memory_total=BUDGET_MB << 20, memory_fraction=1.0,
+                      mem_wait_timeout_s=5.0))
+    MemManager.reset()
+
+    out = {"clients": CLIENTS, "queries": QUERIES, "concurrent": CONCURRENT,
+           "budget_mb": BUDGET_MB, "rows": ROWS}
+    t_all = time.perf_counter()
+    with tempfile.TemporaryDirectory(prefix="blaze_serve_soak_") as tmpdir:
+        # store_sales-like fact: (store, item, qty, price)
+        rng = random.Random(7)
+        path = os.path.join(tmpdir, "store_sales.parquet")
+        pq.write_table(pa.table({
+            "ss_store_sk": [rng.randrange(12) for _ in range(ROWS)],
+            "ss_item_sk": [rng.randrange(2000) for _ in range(ROWS)],
+            "ss_quantity": [rng.randrange(1, 100) for _ in range(ROWS)],
+            "ss_net_paid": [rng.randrange(1, 50_000) for _ in range(ROWS)],
+        }), path)
+
+        def scan():
+            return scan_node_for_files([path], num_partitions=4)
+
+        def agg_plan():
+            # sum(net_paid) group by store (Q3/Q7-style rollup)
+            g = [("ss_store_sk", E.Column("ss_store_sk"))]
+            partial = N.Agg(scan(), HASH, g, [N.AggColumn(
+                E.AggExpr(F.SUM, [E.Column("ss_net_paid")], T.I64),
+                M.PARTIAL, "paid")])
+            ex = N.ShuffleExchange(
+                partial, N.HashPartitioning([E.Column("ss_store_sk")], 4))
+            return N.Agg(ex, HASH, g, [N.AggColumn(
+                E.AggExpr(F.SUM, [E.Column("ss_net_paid")], T.I64),
+                M.FINAL, "paid")])
+
+        def sort_plan():
+            # global top ordering by net_paid (Q98-style ordered report)
+            ex = N.ShuffleExchange(scan(), N.SinglePartitioning(1))
+            srt = N.Sort(ex, [E.SortOrder(E.Column("ss_net_paid"),
+                                          ascending=False)])
+            return N.Limit(srt, 1000)
+
+        def window_plan():
+            # rank() over (partition by store order by net_paid) (Q67-style)
+            ex = N.ShuffleExchange(
+                scan(), N.HashPartitioning([E.Column("ss_store_sk")], 4))
+            return N.Window(
+                ex,
+                [N.WindowExpr(kind="rank", name="rnk")],
+                [E.Column("ss_store_sk")],
+                [E.SortOrder(E.Column("ss_net_paid"), ascending=False)])
+
+        # explicit per-shape admission estimates (measured: peak engine
+        # usage for these plans at SERVE_ROWS=300k is ~12 MB); the generic
+        # plan-based estimate is sized for unknown clients and would keep
+        # a 64 MB budget to one query at a time
+        shapes = [("agg", agg_plan, 12 << 20),
+                  ("sort", sort_plan, 24 << 20),
+                  ("window", window_plan, 24 << 20)]
+
+        latencies_ms, lat_by_shape = [], {k: [] for k, _, _ in shapes}
+        counts = {"completed": 0, "shed": 0, "cancelled": 0, "failed": 0}
+        mu = threading.Lock()
+        seq = iter(range(QUERIES))
+
+        with Session() as sess:
+            with QueryScheduler(sess, max_concurrent=CONCURRENT,
+                                max_queue=QUEUE,
+                                queue_timeout_s=QUEUE_TIMEOUT_S) as sched:
+                def client(cid):
+                    rng = random.Random(100 + cid)
+                    while True:
+                        with mu:
+                            i = next(seq, None)
+                        if i is None:
+                            return
+                        name, mk, est = shapes[i % len(shapes)]
+                        # ~1 in 8 queries carries a hopeless deadline:
+                        # exercises mid-flight cancel + reclamation
+                        deadline = 0.05 if i % 8 == 5 else None
+                        t0 = time.perf_counter()
+                        try:
+                            h = None
+                            for attempt in range(4):
+                                try:
+                                    h = sched.submit(mk(), deadline_s=deadline,
+                                                     mem_estimate=est,
+                                                     label=f"{name}_{i}")
+                                    break
+                                except Overloaded:
+                                    # real clients back off on a full queue;
+                                    # give up (counted shed) after 3 retries
+                                    if attempt == 3:
+                                        raise
+                                    time.sleep(rng.uniform(0.1, 0.4))
+                            h.result(timeout=300)
+                            ms = (time.perf_counter() - t0) * 1e3
+                            with mu:
+                                counts["completed"] += 1
+                                latencies_ms.append(ms)
+                                lat_by_shape[name].append(ms)
+                        except Overloaded:
+                            with mu:
+                                counts["shed"] += 1
+                        except QueryCancelled:
+                            with mu:
+                                counts["cancelled"] += 1
+                        except BaseException as exc:
+                            print(f"[client {cid}] {name}_{i} failed: "
+                                  f"{type(exc).__name__}: {exc}",
+                                  file=sys.stderr)
+                            with mu:
+                                counts["failed"] += 1
+                        time.sleep(rng.uniform(0, 0.05))
+
+                ts = [threading.Thread(target=client, args=(c,), daemon=True)
+                      for c in range(CLIENTS)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                out["peak_inflight"] = sched.peak_inflight
+                out["serve_metrics"] = sched.metrics.to_dict()
+
+        mm = MemManager._instance
+        out.update({
+            **counts,
+            "latency_ms": {"p50": pctl(latencies_ms, 50),
+                           "p95": pctl(latencies_ms, 95),
+                           "p99": pctl(latencies_ms, 99)},
+            "latency_ms_by_shape": {
+                k: {"p50": pctl(v, 50), "p95": pctl(v, 95)}
+                for k, v in lat_by_shape.items()},
+            "spill_count": mm.spill_count if mm else 0,
+            "peak_mem_used": mm.peak_used if mm else None,
+            "leaked_mem": mm.used if mm else 0,
+            "wall_s": round(time.perf_counter() - t_all, 2),
+        })
+
+    dst = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "SERVE_r01.json")
+    with open(dst, "w") as f:
+        json.dump(out, f, indent=2, default=str)
+    print(json.dumps(out, indent=2, default=str))
+    assert counts["failed"] == 0, "soak had hard failures"
+    assert out["leaked_mem"] == 0, "memory leaked across queries"
+    print(f"\nwrote {dst}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,486 @@
+"""Serving-layer tests: concurrent scheduling with admission control,
+deadlines/cancellation with full resource reclamation, overload shedding,
+per-query memory arbitration, re-entrant Session.execute, and the
+/serve HTTP endpoints."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pyarrow as pa
+import pytest
+
+from blaze_tpu.config import Config
+from blaze_tpu.core import ColumnarBatch
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import nodes as N
+from blaze_tpu.ir import types as T
+from blaze_tpu.ops.base import QueryCancelled
+from blaze_tpu.runtime.memmgr import MemConsumer, MemManager
+from blaze_tpu.runtime.session import Session
+from blaze_tpu.serve import (Overloaded, QueryScheduler,
+                             estimate_plan_memory)
+
+F = E.AggFunction
+M = E.AggMode
+HASH = E.AggExecMode.HASH_AGG
+
+
+@pytest.fixture(autouse=True)
+def _fresh_memmgr():
+    MemManager.reset()
+    yield
+    MemManager.reset()
+
+
+def _register_src(sess, rid, data, num_batches=8):
+    big = ColumnarBatch.from_pydict(data)
+    n = big.num_rows
+    per = max(1, (n + num_batches - 1) // num_batches)
+    batches = [big.slice(i, per).to_arrow() for i in range(0, n, per)]
+    sess.resources[rid] = lambda p: list(batches)
+    return big.schema
+
+
+def _agg_plan(schema, rid, reducers=3):
+    """Two-stage hash agg (partial -> exchange -> final) over an FFI source:
+    the canonical multi-stage serving shape."""
+    scan = N.FFIReader(schema=schema, resource_id=rid, num_partitions=1)
+    groupings = [("k", E.Column("k"))]
+    partial = N.Agg(scan, HASH, groupings,
+                    [N.AggColumn(E.AggExpr(F.SUM, [E.Column("v")], T.I64),
+                                 M.PARTIAL, "s")])
+    ex = N.ShuffleExchange(partial, N.HashPartitioning([E.Column("k")],
+                                                       reducers))
+    return N.Agg(ex, HASH, groupings,
+                 [N.AggColumn(E.AggExpr(F.SUM, [E.Column("v")], T.I64),
+                              M.FINAL, "s")])
+
+
+def _sort_plan(schema, rid, nparts=1):
+    scan = N.FFIReader(schema=schema, resource_id=rid, num_partitions=nparts)
+    ex = N.ShuffleExchange(scan, N.SinglePartitioning(1))
+    return N.Sort(ex, [E.SortOrder(E.Column("v"))])
+
+
+def _slow_source(sess, rid, batches=100, sleep_s=0.05, nparts=2):
+    """A multi-second scan: a generator provider that sleeps between
+    batches, placed below an exchange so cancellation lands mid-map-stage."""
+    b = ColumnarBatch.from_pydict({"k": [1, 2, 3, 4] * 50,
+                                   "v": list(range(200))})
+
+    def provider(p):
+        def gen():
+            for _ in range(batches):
+                time.sleep(sleep_s)
+                yield b.to_arrow()
+        return gen()
+
+    sess.resources[rid] = provider
+    scan = N.FFIReader(schema=b.schema, resource_id=rid, num_partitions=nparts)
+    ex = N.ShuffleExchange(scan, N.HashPartitioning([E.Column("k")], 2))
+    return N.Sort(ex, [E.SortOrder(E.Column("v"))])
+
+
+# -- acceptance: >= 8 concurrent queries, 2 slots, constrained memory --------
+
+
+@pytest.mark.quick
+def test_concurrent_queries_two_slots_constrained_memory():
+    """8 queries with distinct data through serve_max_concurrent=2 under a
+    constrained budget: every query either completes with ITS OWN correct
+    result (isolation) or sheds with the typed Overloaded error; in-flight
+    concurrency never exceeds the slot count."""
+    conf = Config(memory_total=64 << 20, memory_fraction=1.0,
+                  mem_wait_timeout_s=2.0)
+    NQ = 8
+    with Session(conf=conf) as sess:
+        plans, oracles = [], []
+        for i in range(NQ):
+            n = 4000 + 500 * i
+            data = {"k": [j % (3 + i) for j in range(n)],
+                    "v": [j + i * 1_000_000 for j in range(n)]}
+            schema = _register_src(sess, f"src_{i}", data)
+            plans.append(_agg_plan(schema, f"src_{i}"))
+            want = {}
+            for k, v in zip(data["k"], data["v"]):
+                want[k] = want.get(k, 0) + v
+            oracles.append(want)
+        with QueryScheduler(sess, max_concurrent=2,
+                            queue_timeout_s=60.0) as sched:
+            handles = [sched.submit(p, label=f"q{i}")
+                       for i, p in enumerate(plans)]
+            completed = shed = 0
+            for i, h in enumerate(handles):
+                try:
+                    table = h.result(timeout=120)
+                except Overloaded:
+                    shed += 1
+                    continue
+                completed += 1
+                got = dict(zip(table["k"].to_pylist(),
+                               table["s"].to_pylist()))
+                assert got == oracles[i], f"query {i} wrong/cross-talk"
+            assert completed + shed == NQ
+            assert completed >= 1
+            assert sched.peak_inflight <= 2
+            assert sched.metrics.get("queries_submitted") == NQ
+    assert MemManager._instance is None or MemManager._instance.used == 0
+
+
+# -- acceptance: 50 ms deadline on a multi-second plan ------------------------
+
+
+@pytest.mark.quick
+def test_deadline_cancels_multisecond_plan_and_reclaims():
+    conf = Config(memory_total=64 << 20, memory_fraction=1.0)
+    with Session(conf=conf) as sess:
+        plan = _slow_source(sess, "slow", batches=100, sleep_s=0.05)
+        with QueryScheduler(sess, max_concurrent=2) as sched:
+            t0 = time.monotonic()
+            h = sched.submit(plan, deadline_s=0.05, label="deadline_q")
+            with pytest.raises(QueryCancelled):
+                h.result(timeout=30)
+            wall = time.monotonic() - t0
+            assert h.state == "cancelled"
+            assert "deadline" in str(h.error)
+            assert wall < 5.0, f"cancel took {wall:.1f}s on a ~10s plan"
+        # shuffle dirs deleted, every MemConsumer unregistered
+        assert os.listdir(sess.work_dir) == []
+        assert MemManager._instance is not None
+        assert MemManager._instance.used == 0
+
+
+# -- satellite: mid-map-stage cancel always cleans up -------------------------
+
+
+@pytest.mark.quick
+def test_mid_stage_cancel_cleans_shuffle_dirs_and_memory():
+    conf = Config(memory_total=64 << 20, memory_fraction=1.0)
+    with Session(conf=conf) as sess:
+        plan = _slow_source(sess, "slow2", batches=200, sleep_s=0.05)
+        with QueryScheduler(sess, max_concurrent=1) as sched:
+            h = sched.submit(plan, label="to_cancel")
+            # wait until the map stage is genuinely in flight...
+            deadline = time.monotonic() + 10
+            while h.state != "running" and time.monotonic() < deadline:
+                time.sleep(0.01)
+            time.sleep(0.3)  # ...and mid-stage (a few batches in)
+            assert os.listdir(sess.work_dir), "map stage never started"
+            h.cancel("test cancel")
+            with pytest.raises(QueryCancelled):
+                h.result(timeout=30)
+        assert os.listdir(sess.work_dir) == [], \
+            "cancelled query left shuffle dirs behind"
+        assert MemManager._instance.used == 0, \
+            "cancelled query left MemConsumers registered"
+
+
+def test_failed_query_cleans_shuffle_dirs():
+    """The same reclamation guarantee for FAILURES, without the scheduler:
+    a plan whose final stage explodes mid-map leaves no shuffle dirs."""
+    conf = Config(memory_total=64 << 20, memory_fraction=1.0)
+    with Session(conf=conf) as sess:
+        b = ColumnarBatch.from_pydict({"k": [1, 2] * 100,
+                                       "v": list(range(200))})
+
+        def provider(p):
+            def gen():
+                yield b.to_arrow()
+                raise RuntimeError("boom mid stream")
+            return gen()
+
+        sess.resources["bad"] = provider
+        scan = N.FFIReader(schema=b.schema, resource_id="bad",
+                           num_partitions=2)
+        ex = N.ShuffleExchange(scan, N.HashPartitioning([E.Column("k")], 2))
+        plan = N.Sort(ex, [E.SortOrder(E.Column("v"))])
+        with pytest.raises(RuntimeError):
+            # mem_group marks it serve-managed; failure must still clean up
+            # even with retries burning through their budget first
+            sess.execute_to_table(plan, mem_group="serve_t")
+        log = sess.query_log[-1]
+        assert log["state"] == "failed"
+        assert os.listdir(sess.work_dir) == []
+        assert MemManager._instance.used == 0
+
+
+# -- overload shedding --------------------------------------------------------
+
+
+@pytest.mark.quick
+def test_overload_sheds_typed_errors():
+    conf = Config(memory_total=64 << 20, memory_fraction=1.0)
+    with Session(conf=conf) as sess:
+        slow = _slow_source(sess, "slow3", batches=60, sleep_s=0.05,
+                            nparts=1)
+        schema = _register_src(sess, "fast", {"k": [1, 2, 3],
+                                              "v": [10, 20, 30]})
+        fast = _agg_plan(schema, "fast", reducers=2)
+        with QueryScheduler(sess, max_concurrent=1, max_queue=2,
+                            queue_timeout_s=0.15) as sched:
+            running = sched.submit(slow, label="hog")
+            deadline = time.monotonic() + 10
+            while running.state in ("queued", "admitted") \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)  # hog must leave the queue first
+            q1 = sched.submit(fast, label="will_timeout_1")
+            q2 = sched.submit(fast, label="will_timeout_2")
+            # queue full: shed AT SUBMIT with the typed error
+            with pytest.raises(Overloaded):
+                sched.submit(fast, label="door_shed")
+            # queue timeout: shed by the dispatcher, surfaced via result()
+            for q in (q1, q2):
+                with pytest.raises(Overloaded) as ei:
+                    q.result(timeout=10)
+                assert "timeout" in str(ei.value)
+                assert q.state == "shed"
+            running.cancel()
+            assert sched.metrics.get("queries_shed") == 3
+        shed_logged = [q for q in sess.query_log if q.get("state") == "shed"]
+        assert len(shed_logged) == 3
+
+
+# -- per-query memory arbitration ---------------------------------------------
+
+
+def test_per_query_memory_arbitration_big_spills_small_completes():
+    """Two concurrent queries under a tight budget: the big sort spills
+    against ITS per-query share, the small agg completes, and both results
+    are exactly their own (fairness + isolation)."""
+    conf = Config(memory_total=4 << 20, memory_fraction=1.0,
+                  mem_wait_timeout_s=2.0, batch_size=16384)
+    with Session(conf=conf) as sess:
+        nbig = 400_000
+        big_schema = _register_src(
+            sess, "big", {"k": [i % 7 for i in range(nbig)],
+                          "v": [(i * 48271) % nbig for i in range(nbig)]},
+            num_batches=32)
+        big_plan = _sort_plan(big_schema, "big")
+        nsmall = 20_000
+        small_schema = _register_src(
+            sess, "small", {"k": [i % 5 for i in range(nsmall)],
+                            "v": list(range(nsmall))})
+        small_plan = _agg_plan(small_schema, "small")
+        with QueryScheduler(sess, max_concurrent=2,
+                            queue_timeout_s=60.0) as sched:
+            hbig = sched.submit(big_plan, label="big_sort",
+                                mem_estimate=1 << 20)
+            hsmall = sched.submit(small_plan, label="small_agg",
+                                  mem_estimate=1 << 20)
+            small = hsmall.result(timeout=120)
+            big = hbig.result(timeout=240)
+        got = dict(zip(small["k"].to_pylist(), small["s"].to_pylist()))
+        want = {k: sum(range(k, nsmall, 5)) for k in range(5)}
+        assert got == want
+        vs = big["v"].to_pylist()
+        assert len(vs) == nbig
+        assert vs == sorted(vs)
+        mm = MemManager._instance
+        assert mm.spill_count > 0, "big sort never spilled under 4MB budget"
+        assert mm.used == 0
+
+
+# -- memmgr group semantics ---------------------------------------------------
+
+
+@pytest.mark.quick
+def test_memmgr_per_group_shares_and_reservations():
+    mm = MemManager(total=1000, wait_timeout_s=0.1)
+    a1, a2, b1 = MemConsumer("a1"), MemConsumer("a2"), MemConsumer("b1")
+    mm.register(a1, group="qa")
+    mm.register(a2, group="qa")
+    mm.register(b1, group="qb")
+    # budget splits per GROUP first (500 each), then within the group
+    assert mm.fair_share(a1) == 250
+    assert mm.fair_share(a2) == 250
+    assert mm.fair_share(b1) == 500
+    # ambient group via group_scope (how session task threads register)
+    with mm.group_scope("qc"):
+        c1 = MemConsumer("c1")
+        mm.register(c1)
+    assert c1.group == "qc"
+    mm.unregister(c1)
+    # reservations reduce headroom by max(reservation, usage) per group
+    mm.reserve_group("qr", 400)
+    a1.mem_used = 100
+    assert mm.headroom() == 1000 - 400 - 100
+    mm.reserve_group("qa", 50)  # usage (100) above reservation: max wins
+    assert mm.headroom() == 1000 - 400 - 100
+    # release reclaims leaked consumers and drops the reservation
+    freed = mm.release_group("qa")
+    assert freed == 100
+    assert a2 not in mm.consumers
+    assert mm.release_group("qr") == 0
+    assert mm.headroom() == 1000
+    assert mm.used == 0
+
+
+def test_memmgr_ungrouped_share_unchanged():
+    """No groups anywhere -> the pre-serving fair share (total // n)."""
+    mm = MemManager(total=900, wait_timeout_s=0.1)
+    cs = [MemConsumer(f"c{i}") for i in range(3)]
+    for c in cs:
+        mm.register(c)
+    assert mm.fair_share() == 300
+    assert all(mm.fair_share(c) == 300 for c in cs)
+
+
+def test_estimate_plan_memory_counts_stateful_ops():
+    conf = Config(suggested_batch_mem_size=1 << 20,
+                  serve_default_mem_estimate=3 << 20)
+    schema = T.Schema((T.StructField("k", T.I64), T.StructField("v", T.I64)))
+    scan = N.FFIReader(schema=schema, resource_id="x", num_partitions=1)
+    assert estimate_plan_memory(scan, conf) == 3 << 20  # floor
+    plan = _agg_plan(schema, "x")  # agg + exchange + agg = 3 stateful
+    assert estimate_plan_memory(plan, conf) == 3 * 4 * (1 << 20)
+
+
+# -- satellite: re-entrant Session.execute ------------------------------------
+
+
+@pytest.mark.quick
+def test_session_execute_reentrant_two_threads():
+    conf = Config(memory_total=64 << 20, memory_fraction=1.0)
+    with Session(conf=conf) as sess:
+        datas, plans = [], []
+        for i in range(2):
+            n = 6000
+            data = {"k": [j % (4 + i) for j in range(n)],
+                    "v": [j + i * 10_000_000 for j in range(n)]}
+            schema = _register_src(sess, f"r_{i}", data)
+            datas.append(data)
+            plans.append(_agg_plan(schema, f"r_{i}"))
+        results: dict = {}
+        errors: list = []
+
+        def run(i):
+            try:
+                results[i] = sess.execute_to_table(plans[i])
+            except BaseException as exc:  # pragma: no cover
+                errors.append(exc)
+
+        ts = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=120)
+        assert not errors
+        for i in range(2):
+            got = dict(zip(results[i]["k"].to_pylist(),
+                           results[i]["s"].to_pylist()))
+            want: dict = {}
+            for k, v in zip(datas[i]["k"], datas[i]["v"]):
+                want[k] = want.get(k, 0) + v
+            assert got == want, f"thread {i} saw interleaved stages"
+        assert len(sess.query_log) == 2
+        # stage records are query-scoped AND disjoint (each query ran its
+        # own exchange stage; ids come from the shared session counter)
+        sets = [set(s["id"] for s in q["stages"]) for q in sess.query_log]
+        assert all(s for s in sets)
+        assert not (sets[0] & sets[1])
+        assert all(q["state"] == "done" for q in sess.query_log)
+
+
+# -- HTTP endpoints -----------------------------------------------------------
+
+
+@pytest.mark.quick
+def test_http_serve_submit_status_result_cancel(tmp_path):
+    import base64
+
+    import pyarrow.parquet as pq
+
+    from blaze_tpu.ir.protoserde import plan_to_bytes
+    from blaze_tpu.ops.parquet import scan_node_for_files
+    from blaze_tpu.runtime.http import ProfilingService
+
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({"k": [i % 3 for i in range(900)],
+                             "v": list(range(900))}), path)
+    scan = scan_node_for_files([path], num_partitions=2)
+    groupings = [("k", E.Column("k"))]
+    partial = N.Agg(scan, HASH, groupings,
+                    [N.AggColumn(E.AggExpr(F.SUM, [E.Column("v")], T.I64),
+                                 M.PARTIAL, "s")])
+    ex = N.ShuffleExchange(partial, N.HashPartitioning([E.Column("k")], 2))
+    plan = N.Agg(ex, HASH, groupings,
+                 [N.AggColumn(E.AggExpr(F.SUM, [E.Column("v")], T.I64),
+                              M.FINAL, "s")])
+
+    conf = Config(memory_total=64 << 20, memory_fraction=1.0)
+    ProfilingService.stop()
+    with Session(conf=conf) as sess:
+        with QueryScheduler(sess, max_concurrent=2) as sched:
+            svc = ProfilingService.start(sess)
+            base = f"http://127.0.0.1:{svc.port}"
+            body = json.dumps({
+                "plan_b64": base64.b64encode(plan_to_bytes(plan)).decode(),
+                "label": "http_q"}).encode()
+            req = urllib.request.Request(f"{base}/serve/submit", data=body,
+                                         method="POST")
+            with urllib.request.urlopen(req) as resp:
+                sub = json.loads(resp.read())
+            qid = sub["qid"]
+            with urllib.request.urlopen(
+                    f"{base}/serve/result?id={qid}&timeout_s=60") as resp:
+                res = json.loads(resp.read())
+            assert res["rows"] == 3
+            got = dict(zip(res["columns"]["k"], res["columns"]["s"]))
+            assert got == {k: sum(range(k, 900, 3)) for k in range(3)}
+            with urllib.request.urlopen(
+                    f"{base}/serve/status?id={qid}") as resp:
+                st = json.loads(resp.read())
+            assert st["state"] == "done"
+            # cancel endpoint on a slow query
+            slow = _slow_source(sess, "http_slow", batches=100,
+                                sleep_s=0.05, nparts=1)
+            h = sched.submit(slow, label="http_slow_q")
+            with urllib.request.urlopen(
+                    f"{base}/serve/cancel?id={h.qid}") as resp:
+                assert json.loads(resp.read())["cancelled"]
+            with pytest.raises(QueryCancelled):
+                h.result(timeout=30)
+            # /serve/queries + /debug/queries render without error
+            with urllib.request.urlopen(f"{base}/serve/queries") as resp:
+                snap = json.loads(resp.read())
+            assert snap["max_concurrent"] == 2
+            with urllib.request.urlopen(f"{base}/debug/queries") as resp:
+                dq = json.loads(resp.read())
+            assert any(q.get("label") == "http_q" for q in dq)
+    ProfilingService.stop()
+
+
+@pytest.mark.quick
+def test_debug_queries_shows_inflight():
+    from blaze_tpu.runtime.http import ProfilingService
+
+    conf = Config(memory_total=64 << 20, memory_fraction=1.0)
+    ProfilingService.stop()
+    with Session(conf=conf) as sess:
+        plan = _slow_source(sess, "inflight_slow", batches=100,
+                            sleep_s=0.05, nparts=1)
+        svc = ProfilingService.start(sess)
+        base = f"http://127.0.0.1:{svc.port}"
+        with QueryScheduler(sess, max_concurrent=1) as sched:
+            h = sched.submit(plan, label="watched")
+            deadline = time.monotonic() + 10
+            seen = None
+            while time.monotonic() < deadline:
+                with urllib.request.urlopen(f"{base}/debug/queries") as resp:
+                    dq = json.loads(resp.read())
+                live = [q for q in dq if q.get("label") == "watched"
+                        and q.get("state") in ("queued", "admitted",
+                                               "running")]
+                if live:
+                    seen = live[0]
+                    break
+                time.sleep(0.02)
+            assert seen is not None, "in-flight query never surfaced"
+            assert "elapsed_s" in seen
+            h.cancel()
+            with pytest.raises(QueryCancelled):
+                h.result(timeout=30)
+    ProfilingService.stop()
