@@ -133,6 +133,33 @@ class Config:
     # stored (bounds tracer memory during soaks).
     trace_max_events: int = 1_000_000
 
+    # Process-wide metrics registry (obs/telemetry.py): typed counters /
+    # gauges / log-bucketed histograms exposed as Prometheus text at
+    # GET /metrics and exact values at GET /debug/metrics?format=raw.
+    # ON by default — one instrument update is a dict upsert under a
+    # per-instrument lock; disabling turns every handle into a no-op
+    # (guarded by test_telemetry.py's overhead test).
+    # BLAZE_TPU_TELEMETRY=0/1 force-overrides.
+    telemetry_enabled: bool = True
+
+    # Flight recorder: the tracer keeps the last N span events in a ring
+    # buffer even when full Chrome tracing (trace_enable) is off, so
+    # incident bundles can include the moments before a failure. 0 disables
+    # the ring.
+    flight_recorder_events: int = 2048
+
+    # Failure forensics (obs/dump.py record_incident): when a query fails /
+    # sheds / cancels / misses its deadline, a JSON bundle (plan shape,
+    # per-operator metrics, memmgr group state, scheduler snapshot, last
+    # ring-buffer spans, exception) is written here and served at
+    # GET /debug/incidents[/<id>]. The directory is capped at
+    # incident_max_bundles (oldest deleted first); <= 0 disables bundles.
+    incident_dir: str = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "BLAZE_TPU_INCIDENT_DIR", "/tmp/blaze_tpu_incidents")
+    )
+    incident_max_bundles: int = 64
+
     # Number of host worker threads for IO/decode and task overlap
     # (reference: tokio worker threads conf). On the tunneled-TPU backend
     # threads mostly overlap device round trips, not CPU.

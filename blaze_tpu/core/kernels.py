@@ -22,19 +22,46 @@ import numpy as np
 from jax import lax
 
 
+_TM = None
+
+
+def _telemetry():
+    # lazy so kernels.py stays importable before the registry (and to keep
+    # module import free of blaze_tpu deps beyond jax)
+    global _TM
+    if _TM is None:
+        from blaze_tpu.obs.telemetry import get_registry
+
+        reg = get_registry()
+        _TM = (
+            reg,
+            reg.histogram("blaze_kernel_dispatch_seconds",
+                          "jitted kernel dispatch wall time"),
+            reg.counter("blaze_kernel_jit_compile_total",
+                        "dispatches that grew a jit cache (trace+compile)"),
+            reg.histogram("blaze_kernel_jit_compile_seconds",
+                          "wall time of compiling dispatches"),
+        )
+    return _TM
+
+
 def _dispatch(fn, *args, **kw):
     """Run one jitted kernel dispatch under the device-residency clock
     (utils/device.DEVICE_STATS; on an async backend this times dispatch, on
     the CPU backend it approximates execution). With tracing enabled each
     dispatch is a "kernel" span; a dispatch that grew the jit cache (i.e. a
     fresh trace+compile) is labelled jit_compile instead — compile storms
-    show up as wide blocks in the Perfetto timeline."""
+    show up as wide blocks in the Perfetto timeline. The registry always
+    gets the dispatch-time histogram and compile counters (kernel spans
+    would flood the flight-recorder ring, so those stay trace-gated)."""
     from blaze_tpu.obs.tracer import TRACER
     from blaze_tpu.utils.device import DEVICE_STATS
 
+    reg, tm_dispatch, tm_jit, tm_jit_secs = _telemetry()
     trace = TRACER.enabled
+    track = reg.enabled
     cache0 = -1
-    if trace:
+    if trace or track:
         try:
             cache0 = fn._cache_size()
         except Exception:
@@ -43,19 +70,25 @@ def _dispatch(fn, *args, **kw):
     out = fn(*args, **kw)
     dt = time.perf_counter() - t0
     DEVICE_STATS.add_kernel(dt)
-    if trace:
-        name = getattr(fn, "__name__", None) or \
-            getattr(getattr(fn, "__wrapped__", None), "__name__", "kernel")
+    if trace or track:
         compiled = False
         if cache0 >= 0:
             try:
                 compiled = fn._cache_size() > cache0
             except Exception:
                 compiled = False
-        now = time.perf_counter_ns()
-        TRACER.complete("jit_compile:" + name if compiled else name,
-                        "kernel", now - int(dt * 1e9), int(dt * 1e9),
-                        {"compiled": compiled})
+        if track:
+            tm_dispatch.observe(dt)
+            if compiled:
+                tm_jit.inc()
+                tm_jit_secs.observe(dt)
+        if trace:
+            name = getattr(fn, "__name__", None) or \
+                getattr(getattr(fn, "__wrapped__", None), "__name__", "kernel")
+            now = time.perf_counter_ns()
+            TRACER.complete("jit_compile:" + name if compiled else name,
+                            "kernel", now - int(dt * 1e9), int(dt * 1e9),
+                            {"compiled": compiled})
     return out
 
 

@@ -1,14 +1,23 @@
-"""Observability: span tracing (Chrome trace events) + EXPLAIN ANALYZE
-rendering. See ``obs/tracer.py`` and ``obs/explain.py``."""
+"""Observability: span tracing (Chrome trace events), the process metrics
+registry (Prometheus exposition), EXPLAIN ANALYZE rendering, and incident
+forensics. See ``obs/tracer.py``, ``obs/telemetry.py``, ``obs/explain.py``
+and ``obs/dump.py``."""
 
-from blaze_tpu.obs.dump import dump_profile
+from blaze_tpu.obs.dump import (dump_profile, list_incidents, load_incident,
+                                record_incident)
 from blaze_tpu.obs.explain import (fmt_bytes, fmt_ns, humanize_metrics_dict,
                                    merge_partition_metrics, op_shape,
                                    render_explain_analyze)
+from blaze_tpu.obs.telemetry import (REGISTRY, Counter, Gauge, Histogram,
+                                     MetricsRegistry, get_registry,
+                                     parse_prometheus_text)
 from blaze_tpu.obs.tracer import TRACER, Tracer, configure_from, get_tracer
 
 __all__ = [
     "TRACER", "Tracer", "configure_from", "get_tracer",
+    "REGISTRY", "MetricsRegistry", "Counter", "Gauge", "Histogram",
+    "get_registry", "parse_prometheus_text",
     "fmt_ns", "fmt_bytes", "humanize_metrics_dict", "op_shape",
     "merge_partition_metrics", "render_explain_analyze", "dump_profile",
+    "record_incident", "list_incidents", "load_incident",
 ]

@@ -18,16 +18,29 @@ Design constraints:
   the wall-clock epochs (same machine, so wall clocks agree), keeping the
   worker's real pid so Perfetto renders one track per process.
 - **Bounded memory**: the event buffer is capped (``trace_max_events``);
-  overflow drops new events and counts them rather than growing unboundedly
-  during a soak.
+  overflow drops new events and counts them (also published as the
+  ``blaze_obs_tracer_events_dropped_total`` registry counter) rather than
+  growing unboundedly during a soak.
+- **Flight recorder**: independent of the explicit enable/disable above, a
+  small always-on ring buffer (``flight_recorder_events``, a deque) keeps
+  the most recent span events so incident bundles (obs/dump.py) can show
+  what the engine was doing right before a failure — without paying the
+  full trace buffer's memory or requiring tracing to have been on.
 """
 
 from __future__ import annotations
 
+import collections
 import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
+
+from blaze_tpu.obs.telemetry import get_registry
+
+_EVENTS_DROPPED = get_registry().counter(
+    "blaze_obs_tracer_events_dropped_total",
+    "trace events dropped because the tracer buffer was full")
 
 
 class _NoopSpan:
@@ -79,6 +92,11 @@ class Tracer:
         self._events: List[dict] = []
         self.max_events = 1_000_000
         self.dropped = 0
+        # flight-recorder ring: always-on unless sized to 0; deque.append is
+        # atomic under the GIL, so ring writes take no lock
+        self.ring_max = 2048
+        self._ring: Optional[collections.deque] = collections.deque(
+            maxlen=self.ring_max)
         self.pid = os.getpid()
         # both epochs captured back to back: timeline t=0 <-> wall_epoch_ns
         self.wall_epoch_ns = time.time_ns()
@@ -92,10 +110,40 @@ class Tracer:
     def disable(self):
         self.enabled = False
 
+    @property
+    def active(self) -> bool:
+        """True when span events should be built at all: either full tracing
+        is on, or the flight-recorder ring wants them."""
+        return self.enabled or self._ring is not None
+
+    def set_ring(self, n: int):
+        """Resize the flight-recorder ring (keeping the newest events); 0
+        disables it entirely."""
+        n = max(0, int(n))
+        if n == self.ring_max and (self._ring is not None) == (n > 0):
+            return
+        with self._mu:
+            self.ring_max = n
+            if n == 0:
+                self._ring = None
+            else:
+                old = list(self._ring) if self._ring is not None else []
+                self._ring = collections.deque(old[-n:], maxlen=n)
+
+    def ring_snapshot(self, last: Optional[int] = None) -> List[dict]:
+        """The newest ring events (all of them, or just the last N)."""
+        ring = self._ring
+        if ring is None:
+            return []
+        events = list(ring)
+        return events[-last:] if last is not None else events
+
     def reset(self):
         with self._mu:
             self._events = []
             self.dropped = 0
+            if self._ring is not None:
+                self._ring.clear()
             self.wall_epoch_ns = time.time_ns()
             self.perf_epoch_ns = time.perf_counter_ns()
 
@@ -104,14 +152,14 @@ class Tracer:
     def span(self, name: str, cat: str = "engine",
              args: Optional[dict] = None):
         """Context manager timing a block; no-op (and allocation-free) when
-        tracing is disabled."""
-        if not self.enabled:
+        neither tracing nor the flight-recorder ring wants events."""
+        if not self.active:
             return _NOOP
         return _Span(self, name, cat, args)
 
     def instant(self, name: str, cat: str = "engine",
                 args: Optional[dict] = None):
-        if not self.enabled:
+        if not self.active:
             return
         ts = (time.perf_counter_ns() - self.perf_epoch_ns) / 1e3
         self._append({"ph": "i", "name": name, "cat": cat, "ts": ts, "s": "t",
@@ -122,7 +170,7 @@ class Tracer:
                  args: Optional[dict] = None):
         """Record a complete event from explicit perf_counter_ns stamps (for
         sites that cannot use the context manager, e.g. generators)."""
-        if not self.enabled:
+        if not self.active:
             return
         self._record(name, cat, t0_ns, dur_ns, args)
 
@@ -136,11 +184,20 @@ class Tracer:
         self._append(ev)
 
     def _append(self, ev: dict):
+        ring = self._ring
+        if ring is not None:
+            ring.append(ev)  # atomic; overwrite-oldest is the point
+        if not self.enabled:
+            return
+        full = False
         with self._mu:
             if len(self._events) >= self.max_events:
                 self.dropped += 1
-                return
-            self._events.append(ev)
+                full = True
+            else:
+                self._events.append(ev)
+        if full:
+            _EVENTS_DROPPED.inc()
 
     # -- worker shipping / re-basing ------------------------------------------
 
@@ -158,14 +215,18 @@ class Tracer:
         if not events:
             return
         delta_us = (wall_epoch_ns - self.wall_epoch_ns) / 1e3
+        absorbed_drops = 0
         with self._mu:
             for i, ev in enumerate(events):
                 if len(self._events) >= self.max_events:
-                    self.dropped += len(events) - i
+                    absorbed_drops = len(events) - i
+                    self.dropped += absorbed_drops
                     break
                 ev = dict(ev)
                 ev["ts"] = ev.get("ts", 0.0) + delta_us
                 self._events.append(ev)
+        if absorbed_drops:
+            _EVENTS_DROPPED.inc(absorbed_drops)
 
     # -- export ---------------------------------------------------------------
 
@@ -197,6 +258,7 @@ def get_tracer() -> Tracer:
 def configure_from(conf) -> Tracer:
     """Enable/disable the process tracer from a Config (Session/worker call
     this; BLAZE_TPU_TRACE=1 force-enables for ad-hoc runs)."""
+    TRACER.set_ring(getattr(conf, "flight_recorder_events", TRACER.ring_max))
     if getattr(conf, "trace_enable", False) or \
             os.environ.get("BLAZE_TPU_TRACE", "") not in ("", "0"):
         TRACER.max_events = getattr(conf, "trace_max_events", TRACER.max_events)

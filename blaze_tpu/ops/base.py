@@ -165,7 +165,7 @@ class Operator:
         node.name = self.name
         gen = self._execute(partition, ctx, node)
         stack = _time_stack()
-        trace = TRACER.enabled
+        trace = TRACER.active  # full trace OR the flight-recorder ring
         span_t0 = time.perf_counter_ns() if trace else 0
         rows = 0
         try:

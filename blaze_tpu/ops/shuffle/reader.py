@@ -18,7 +18,12 @@ from typing import Iterable
 
 from blaze_tpu.io.batch_serde import BatchReader
 from blaze_tpu.ir import types as T
+from blaze_tpu.obs.telemetry import get_registry
 from blaze_tpu.ops.base import Operator
+
+_TM_FETCH_SECS = get_registry().histogram(
+    "blaze_shuffle_fetch_seconds",
+    "prefetch-side wall time fetching+decoding one partition's blocks")
 
 
 class IpcReaderExec(Operator):
@@ -78,8 +83,8 @@ class IpcReaderExec(Operator):
 
             from blaze_tpu.obs.tracer import TRACER
 
-            trace = TRACER.enabled
-            t0 = time.perf_counter_ns() if trace else 0
+            trace = TRACER.active
+            t0 = time.perf_counter_ns()
             nblocks = 0
             try:
                 for block in blocks:
@@ -92,8 +97,9 @@ class IpcReaderExec(Operator):
             except BaseException as exc:
                 _put(exc)
             finally:
+                t1 = time.perf_counter_ns()
+                _TM_FETCH_SECS.observe((t1 - t0) / 1e9)
                 if trace:
-                    t1 = time.perf_counter_ns()
                     TRACER.complete(
                         "shuffle_fetch", "shuffle", t0, t1 - t0,
                         {"partition": partition, "blocks": nblocks})

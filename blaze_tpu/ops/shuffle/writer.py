@@ -23,6 +23,7 @@ import numpy as np
 
 from blaze_tpu.core.batch import ColumnarBatch
 from blaze_tpu.io.batch_serde import BatchWriter
+from blaze_tpu.obs.telemetry import get_registry
 from blaze_tpu.ops.base import ExecContext, Operator
 from blaze_tpu.ops.shuffle.repartitioner import Repartitioner, create_repartitioner
 from blaze_tpu.runtime.memmgr import MemConsumer, SpillFile
@@ -31,6 +32,11 @@ from blaze_tpu.runtime.memmgr import MemConsumer, SpillFile
 # rows to accumulate before a bucketize pass (writer-side small-batch
 # coalescing); large scan batches pass through untouched
 _COALESCE_MIN_ROWS = 32768
+
+_TM_WRITE_BYTES = get_registry().histogram(
+    "blaze_shuffle_write_size_bytes", "bytes per committed map output file")
+_TM_WRITE_SECS = get_registry().histogram(
+    "blaze_shuffle_write_seconds", "wall time of the final merge+publish")
 
 
 class _PartitionStreams:
@@ -77,8 +83,12 @@ class ShuffleWriterExec(Operator):
             # self-time lands in elapsed_compute_time_ns via Operator.execute
             for batch in self.execute_child(0, partition, ctx, metrics):
                 state.insert(batch)
+            import time as _time
+
+            t0 = _time.perf_counter()
             with metrics.timer("shuffle_write_time_ns"):
                 state.finish()
+            _TM_WRITE_SECS.observe(_time.perf_counter() - t0)
         finally:
             ctx.mem.unregister(state)
             state.release()
@@ -185,6 +195,7 @@ class _WriterState(MemConsumer):
             idx.write(offsets.astype("<i8").tobytes())
         os.replace(itmp, self.op.output_index_file)
         self.metrics.add("data_size", int(offsets[self.n]))
+        _TM_WRITE_BYTES.observe(int(offsets[self.n]))
         self.streams = _PartitionStreams(self.n, self.ctx.conf.shuffle_compression_codec)
 
     def release(self):

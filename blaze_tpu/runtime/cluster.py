@@ -188,7 +188,19 @@ class WorkerPool:
                 finally:
                     w.in_flight = False
                 if reply.get("ok"):
-                    results.setdefault(i, reply)  # first completion wins
+                    # first completion wins; merge its registry deltas into
+                    # the driver registry exactly once (a losing speculative
+                    # copy's deltas are discarded — counting both would
+                    # double-book the stage's spill/shuffle volume)
+                    first = results.setdefault(i, reply) is reply
+                    if first and reply.get("telemetry"):
+                        try:
+                            from blaze_tpu.obs.telemetry import get_registry
+
+                            get_registry().merge_deltas(reply["telemetry"])
+                        except Exception:
+                            log.warning("telemetry merge failed for task %d",
+                                        i, exc_info=True)
                     if len(results) == len(task_msgs):
                         done.set()
                 elif attempt == _SPECULATIVE or i in results:

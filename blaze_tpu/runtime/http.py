@@ -5,8 +5,15 @@ Reference: the feature-gated poem server started lazily on first
 (CPU pprof) and ``/debug/pprof/heap`` (jemalloc). Here a stdlib HTTP server
 bound to a free port exposes:
 
+- ``/metrics``                 — Prometheus text exposition of the process
+  metrics registry (obs/telemetry.py): serve SLO histograms, memmgr pool
+  gauges, spill/shuffle/kernel counters — the scrape target
 - ``/debug/metrics``           — the session metric tree as JSON (with
-  human-readable renderings of every ``*_time_ns`` value)
+  human-readable renderings of every ``*_time_ns`` value) plus a humanized
+  ``registry`` view; ``?format=raw`` returns exact integer values for both
+- ``/debug/incidents``         — flight-recorder incident bundle index
+  (newest first); ``/debug/incidents/<id>`` returns one full forensic
+  bundle (plan shape, metrics, memmgr/scheduler state, ring spans, error)
 - ``/debug/pprof/profile?seconds=N&frequency=H`` — wall-clock stack sampling
   across ALL threads (sys._current_frames), pprof-style aggregated stacks
 - ``/debug/memory``            — process RSS + memory-manager accounting
@@ -80,13 +87,49 @@ class ProfilingService:
 
                 def do_GET(self):
                     url = urlparse(self.path)
-                    if url.path == "/debug/metrics":
+                    if url.path == "/metrics":
+                        # Prometheus text exposition (scrape target)
+                        from blaze_tpu.obs.telemetry import get_registry
+
+                        self._send(
+                            get_registry().to_prometheus(),
+                            ctype="text/plain; version=0.0.4; charset=utf-8")
+                    elif url.path == "/debug/metrics":
                         from blaze_tpu.obs.explain import humanize_metrics_dict
+                        from blaze_tpu.obs.telemetry import get_registry
 
                         sess = getattr(self.server, "blaze_session", None)
                         tree = sess.metrics.to_dict() if sess is not None else {}
-                        self._send(json.dumps(humanize_metrics_dict(tree),
-                                              indent=2))
+                        reg = get_registry()
+                        fmt = parse_qs(url.query).get("format", [""])[0]
+                        if fmt == "raw":
+                            # exact integers: what soak scripts cross-check
+                            body = {"session": tree, "registry": reg.to_raw()}
+                            self._send(json.dumps(body, indent=2))
+                        else:
+                            body = humanize_metrics_dict(tree)
+                            body["registry"] = reg.to_human()
+                            self._send(json.dumps(body, indent=2))
+                    elif url.path == "/debug/incidents":
+                        from blaze_tpu.obs.dump import list_incidents
+
+                        sess = getattr(self.server, "blaze_session", None)
+                        conf = getattr(sess, "conf", None)
+                        self._send(json.dumps(list_incidents(conf), indent=2))
+                    elif url.path.startswith("/debug/incidents/"):
+                        from blaze_tpu.obs.dump import load_incident
+
+                        sess = getattr(self.server, "blaze_session", None)
+                        conf = getattr(sess, "conf", None)
+                        incident_id = url.path[len("/debug/incidents/"):]
+                        bundle = load_incident(incident_id, conf)
+                        if bundle is None:
+                            self._send(json.dumps(
+                                {"error": f"no incident {incident_id!r}"}),
+                                status=404)
+                        else:
+                            self._send(json.dumps(bundle, indent=2,
+                                                  default=str))
                     elif url.path == "/debug/trace":
                         from blaze_tpu.obs.tracer import TRACER
 

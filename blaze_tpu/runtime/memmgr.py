@@ -20,6 +20,33 @@ import threading
 from typing import BinaryIO, Dict, List, Optional
 
 from blaze_tpu.config import Config, get_config
+from blaze_tpu.obs.telemetry import get_registry
+
+
+def _register_pool_gauges():
+    """Collect-time gauges over the CURRENT singleton (read through the
+    class attribute so MemManager.reset() never leaves stale callbacks);
+    evaluated only at scrape time, never on the allocation path."""
+    reg = get_registry()
+
+    def over(fn):
+        def read():
+            mm = MemManager._instance
+            return fn(mm) if mm is not None else 0
+        return read
+
+    reg.gauge("blaze_mem_pool_total_bytes",
+              "managed memory pool size").set_function(
+        over(lambda mm: mm.total))
+    reg.gauge("blaze_mem_pool_used_bytes",
+              "bytes held by registered consumers").set_function(
+        over(lambda mm: mm.used))
+    reg.gauge("blaze_mem_pool_headroom_bytes",
+              "admittable bytes (total minus committed group footprints)"
+              ).set_function(over(lambda mm: mm.headroom()))
+    reg.gauge("blaze_mem_pool_reserved_bytes",
+              "sum of per-query admission reservations").set_function(
+        over(lambda mm: sum(mm._reservations.copy().values())))
 
 
 class MemConsumer:
@@ -76,6 +103,23 @@ class MemManager:
         self._tls = threading.local()
         self.wait_timeout_s = wait_timeout_s if wait_timeout_s is not None \
             else get_config().mem_wait_timeout_s
+        # registry instruments (idempotent by name; pool gauges read the
+        # live singleton so re-init keeps them accurate)
+        reg = get_registry()
+        _register_pool_gauges()
+        self._tm_group_reserved = reg.gauge(
+            "blaze_mem_group_reserved_bytes",
+            "admission reservation per live query group")
+        self._tm_spill_events = reg.counter(
+            "blaze_mem_spill_events_total",
+            "manager-decided spills, by consumer name")
+        self._tm_spill_bytes = reg.histogram(
+            "blaze_mem_spill_size_bytes", "bytes freed per spill")
+        self._tm_spill_secs = reg.histogram(
+            "blaze_mem_spill_seconds", "wall time per consumer spill()")
+        self._tm_wait_events = reg.counter(
+            "blaze_mem_wait_events_total",
+            "updates that blocked waiting for peer spills")
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -141,6 +185,8 @@ class MemManager:
         with self._mu:
             self._reservations[group] = \
                 self._reservations.get(group, 0) + int(nbytes)
+            reserved = self._reservations[group]
+        self._tm_group_reserved.labels(group=group).set(reserved)
 
     def release_group(self, group: str) -> int:
         """Drop a query's reservation and force-unregister any consumers
@@ -155,7 +201,9 @@ class MemManager:
                 c.mem_used = 0
                 self.consumers.remove(c)
             self._cv.notify_all()
-            return freed
+        # drop the label so gauge cardinality tracks LIVE groups only
+        self._tm_group_reserved.remove(group=group)
+        return freed
 
     def headroom(self) -> int:
         """Admittable bytes: total minus each group's committed footprint
@@ -287,6 +335,7 @@ class MemManager:
                     if deadline is None:
                         deadline = now + self.wait_timeout_s
                         self.wait_count += 1
+                        self._tm_wait_events.inc()
                     if now >= deadline:
                         action = "timeout"
                     else:
@@ -309,6 +358,9 @@ class MemManager:
                     self.spill_time_ns += spill_ns
                     consumer.mem_used = max(0, consumer.mem_used - freed)
                     self._cv.notify_all()
+                self._tm_spill_events.labels(consumer=consumer.name).inc()
+                self._tm_spill_bytes.observe(freed)
+                self._tm_spill_secs.observe(spill_ns / 1e9)
                 # surface manager-decided spills in the TASK metric tree too
                 # (consumers created by operators carry their metric node):
                 # spills were previously invisible outside operator counters

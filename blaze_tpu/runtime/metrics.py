@@ -146,3 +146,30 @@ class Timer:
     def __exit__(self, *exc):
         self.node.add(self.metric, time.perf_counter_ns() - self._t0)
         return False
+
+
+def query_metric_snapshot(session_metrics: "MetricNode", query: dict) -> dict:
+    """Per-operator metric snapshot for ONE query record (the dict
+    ``Session.execute`` keeps in ``query_log``/``inflight``): the merged
+    result-partition tree plus each exchange stage's merged task tree —
+    the metrics half of an incident bundle, shaped like ``to_dict()``."""
+    from blaze_tpu.obs.explain import merge_partition_metrics
+
+    out = {"result": None, "stages": {}}
+    parts = [session_metrics.get_named(k)
+             for k in (query.get("result_keys") or [])]
+    parts = [p for p in parts if p is not None]
+    if parts:
+        out["result"] = merge_partition_metrics(parts).to_dict()
+    for stage in (query.get("stages") or []):
+        sid = stage.get("id")
+        stage_node = session_metrics.get_named(f"stage_{sid}")
+        if stage_node is None:
+            continue
+        task_parts = [stage_node.get_named(f"map_{m}")
+                      for m in range(stage.get("num_tasks") or 0)]
+        task_parts = [p for p in task_parts if p is not None]
+        if task_parts:
+            out["stages"][str(sid)] = \
+                merge_partition_metrics(task_parts).to_dict()
+    return out

@@ -31,6 +31,8 @@ from blaze_tpu.core.batch import ColumnarBatch
 from blaze_tpu.ir import nodes as N
 from blaze_tpu.ir import types as T
 from blaze_tpu.obs.explain import op_shape, render_explain_analyze
+from blaze_tpu.obs.telemetry import get_registry
+from blaze_tpu.obs.telemetry import configure_from as _telemetry_configure
 from blaze_tpu.obs.tracer import TRACER
 from blaze_tpu.obs.tracer import configure_from as _tracer_configure
 from blaze_tpu.ops.base import ExecContext, Operator, TaskContext
@@ -39,6 +41,11 @@ from blaze_tpu.ops.shuffle.writer import (BytesBlockProvider,
                                            read_index_file)
 from blaze_tpu.runtime.executor import build_operator
 from blaze_tpu.runtime.metrics import MetricNode
+
+_TM_QUERIES = get_registry().counter(
+    "blaze_session_queries_total", "queries finished, by terminal state")
+_TM_QUERY_SECS = get_registry().histogram(
+    "blaze_session_query_seconds", "query wall time, by terminal state")
 
 
 class _SubsetBlockProvider:
@@ -151,9 +158,10 @@ class Session:
         self._ids = itertools.count()
         self._stage_ids = itertools.count()
         self.metrics = MetricNode("session")
-        # observability (obs/): span tracing + per-query records consumed by
-        # explain_analyze, /debug/trace and /debug/queries
+        # observability (obs/): span tracing + metrics registry + per-query
+        # records consumed by explain_analyze, /debug/trace, /debug/queries
         _tracer_configure(self.conf)
+        _telemetry_configure(self.conf)
         self._query_ids = itertools.count()
         self._stage_meta: Dict[int, dict] = {}
         self.query_log: List[dict] = []  # last _QUERY_LOG_MAX finished queries
@@ -209,6 +217,7 @@ class Session:
         }
         with self._qlog_mu:
             self.inflight[qid] = query
+        err_holder: List[Optional[BaseException]] = [None]
 
         def finish_query(rows: int, state: str = "done"):
             dur_ns = time.perf_counter_ns() - t0
@@ -221,11 +230,22 @@ class Session:
                 del self.query_log[:-self._QUERY_LOG_MAX]
             if state != "done" or release_on_finish:
                 self._release_query(qrun)
-            if TRACER.enabled:
+            _TM_QUERIES.labels(state=state).inc()
+            _TM_QUERY_SECS.labels(state=state).observe(dur_ns / 1e9)
+            if TRACER.active:
                 TRACER.complete(f"query_{qid}", "query", t0, dur_ns,
                                 {"rows": rows, "nparts": query["nparts"],
                                  "stages": len(query["stages"]),
                                  "state": state})
+            # flight-recorder dump for direct (non-serve) failures; serve
+            # queries get richer bundles from QueryScheduler (which adds its
+            # own snapshot), so skip those here to avoid double bundles
+            if state != "done" and not (mem_group or "").startswith("serve_"):
+                from blaze_tpu.obs import dump as _dump
+
+                _dump.record_incident(state, label or f"query_{qid}",
+                                      error=err_holder[0], session=self,
+                                      query=query, conf=self.conf)
 
         def classify(exc: BaseException) -> str:
             # GeneratorExit: the consumer abandoned the stream (e.g. the
@@ -260,6 +280,7 @@ class Session:
                                for s in sorted(qrun.stage_meta)]
             where = self._decide_placement(lowered, "result")
         except BaseException as exc:
+            err_holder[0] = exc
             finish_query(0, classify(exc))
             raise
 
@@ -338,6 +359,7 @@ class Session:
                         rows_out += item.num_rows
                         yield item
             except BaseException as exc:
+                err_holder[0] = exc
                 state = classify(exc)
                 raise
             finally:

@@ -38,6 +38,8 @@ def run_task(msg: dict, shared: dict = None) -> dict:
 
     from blaze_tpu.config import Config, set_config
     from blaze_tpu.ir.protoserde import task_definition_from_bytes
+    from blaze_tpu.obs.telemetry import get_registry
+    from blaze_tpu.obs.telemetry import configure_from as _telemetry_configure
     from blaze_tpu.obs.tracer import TRACER
     from blaze_tpu.obs.tracer import configure_from as _tracer_configure
     from blaze_tpu.ops.base import ExecContext, TaskContext
@@ -49,6 +51,7 @@ def run_task(msg: dict, shared: dict = None) -> dict:
     if conf is not None:
         set_config(conf)
         _tracer_configure(conf)
+        _telemetry_configure(conf)
     task, plan = task_definition_from_bytes(msg["task_bytes"])
     op = build_operator(plan)
     metrics = MetricNode("task")
@@ -77,6 +80,11 @@ def run_task(msg: dict, shared: dict = None) -> dict:
             # re-bases them into its timeline (Session._ship_stage_to_pool)
             reply["trace"] = {"events": TRACER.drain(),
                              "wall_epoch_ns": TRACER.wall_epoch_ns}
+        # child-registry deltas ride the same reply (counters/histograms are
+        # zeroed by the drain, so each task ships only its own increments)
+        deltas = get_registry().drain_deltas()
+        if deltas:
+            reply["telemetry"] = deltas
         return reply
     finally:
         clear_task_context()

@@ -39,6 +39,7 @@ import pyarrow as pa
 
 from blaze_tpu.ir import nodes as N
 from blaze_tpu.ir import types as T
+from blaze_tpu.obs.telemetry import get_registry
 from blaze_tpu.ops.base import CancelToken, QueryCancelled, TaskCancelled
 from blaze_tpu.runtime.memmgr import MemManager
 
@@ -172,6 +173,34 @@ class QueryScheduler:
         self._closed = False
         self.peak_inflight = 0
         self.metrics = session.metrics.named_child("serve")
+        # SLO instruments (the continuous fleet view next to the per-query
+        # MetricNode tree). blaze_serve_rejected_total counts door sheds
+        # (submit-time Overloaded, one per ATTEMPT — no QueryHandle exists);
+        # blaze_serve_queries_total counts terminal outcomes of accepted
+        # queries (done / failed / cancelled / deadline / shed-from-queue),
+        # so the two reconcile exactly against a client-side tally.
+        reg = get_registry()
+        self._tm_queries = reg.counter(
+            "blaze_serve_queries_total",
+            "accepted queries by terminal outcome")
+        self._tm_rejected = reg.counter(
+            "blaze_serve_rejected_total",
+            "submit-time rejections (no handle created), by reason")
+        self._tm_queue_wait = reg.histogram(
+            "blaze_serve_queue_wait_seconds",
+            "submit-to-admission wait of admitted queries")
+        self._tm_run = reg.histogram(
+            "blaze_serve_run_seconds",
+            "admission-to-terminal wall time")
+        self._tm_e2e = reg.histogram(
+            "blaze_serve_e2e_seconds",
+            "submit-to-terminal wall time, by outcome")
+        reg.gauge("blaze_serve_queue_depth_count",
+                  "queries waiting for admission").set_function(
+            lambda: len(self._queue))
+        reg.gauge("blaze_serve_inflight_count",
+                  "queries admitted and not yet terminal").set_function(
+            lambda: len(self._running))
         self._exec = ThreadPoolExecutor(max_workers=self.max_concurrent,
                                         thread_name_prefix="serve")
         self._dispatcher = threading.Thread(target=self._dispatch_loop,
@@ -195,9 +224,11 @@ class QueryScheduler:
         with self._cv:
             if self._closed:
                 self.metrics.add("queries_shed", 1)
+                self._tm_rejected.labels(reason="closed").inc()
                 raise Overloaded("scheduler closed")
             if len(self._queue) >= self.max_queue:
                 self.metrics.add("queries_shed", 1)
+                self._tm_rejected.labels(reason="queue_full").inc()
                 self._log_terminal(None, label or "query", "shed",
                                    "queue full", 0.0)
                 raise Overloaded(
@@ -229,8 +260,13 @@ class QueryScheduler:
     def snapshot(self) -> dict:
         """Live view for /serve/queries and /debug/queries."""
         with self._mu:
-            queued = [item[2].snapshot() for item in sorted(self._queue)]
-            running = [h.snapshot() for h in self._running.values()]
+            return self._snapshot_locked()
+
+    def _snapshot_locked(self) -> dict:
+        # split out so incident recording (already under _mu/_cv — a plain
+        # Lock, NOT reentrant) can build the same view without deadlocking
+        queued = [item[2].snapshot() for item in sorted(self._queue)]
+        running = [h.snapshot() for h in self._running.values()]
         return {"max_concurrent": self.max_concurrent,
                 "max_queue": self.max_queue,
                 "peak_inflight": self.peak_inflight,
@@ -310,6 +346,7 @@ class QueryScheduler:
             mm.reserve_group(h.mem_group, h.mem_estimate)
             h.state = "admitted"
             h.admitted_at = time.monotonic()
+            self._tm_queue_wait.observe(h.admitted_at - h.submitted_at)
             self._running[h.qid] = h
             if len(self._running) > self.peak_inflight:
                 self.peak_inflight = len(self._running)
@@ -351,7 +388,22 @@ class QueryScheduler:
                 self.metrics.add(f"queries_{state}", 1)
                 self._retire_locked(h)
                 self._cv.notify_all()
-            h._done.set()
+                scheduler_state = self._snapshot_locked() \
+                    if state != "done" else None
+            # SLO accounting + forensics happen OUTSIDE the lock but BEFORE
+            # _done.set(): a waiter that sees the outcome can already read
+            # the counters and fetch the incident bundle. Nothing here may
+            # prevent _done.set() — waiters would hang.
+            try:
+                outcome = self._outcome(state, err, h)
+                self._tm_queries.labels(outcome=outcome).inc()
+                self._tm_run.observe(h.finished_at - h.admitted_at)
+                self._tm_e2e.labels(outcome=outcome).observe(
+                    h.finished_at - h.submitted_at)
+                if state != "done":
+                    self._record_incident(h, outcome, err, scheduler_state)
+            finally:
+                h._done.set()
 
     # -- bookkeeping ----------------------------------------------------------
 
@@ -365,10 +417,41 @@ class QueryScheduler:
         h.finished_at = time.monotonic()
         if state == "cancelled":
             self.metrics.add("queries_cancelled", 1)
-        self._log_terminal(h.qid, h.label, state, str(error),
-                           h.finished_at - h.submitted_at)
+        query = self._log_terminal(h.qid, h.label, state, str(error),
+                                   h.finished_at - h.submitted_at)
         self._retire_locked(h)
-        h._done.set()
+        try:
+            outcome = self._outcome(state, error, h)
+            self._tm_queries.labels(outcome=outcome).inc()
+            self._tm_e2e.labels(outcome=outcome).observe(
+                h.finished_at - h.submitted_at)
+            self._record_incident(h, outcome, error,
+                                  self._snapshot_locked(), query=query)
+        finally:
+            h._done.set()
+
+    @staticmethod
+    def _outcome(state: str, err: Optional[BaseException],
+                 h: QueryHandle) -> str:
+        """SLO outcome class: ``cancelled`` splits into ``deadline`` when
+        the cancel came from the token's deadline firing."""
+        if state == "cancelled" and (
+                "deadline" in str(err or "").lower()
+                or "deadline" in (h.token.reason or "").lower()):
+            return "deadline"
+        return state
+
+    def _record_incident(self, h: QueryHandle, outcome: str,
+                         err: Optional[BaseException],
+                         scheduler_state: Optional[dict],
+                         query: Optional[dict] = None):
+        from blaze_tpu.obs import dump as _dump
+
+        _dump.record_incident(outcome, h.label, error=err,
+                              session=self.session,
+                              scheduler_state=scheduler_state,
+                              handle=h, query=query,
+                              conf=self.session.conf)
 
     def _retire_locked(self, h: QueryHandle):
         self._finished.append(h.qid)
@@ -376,7 +459,7 @@ class QueryScheduler:
             self._handles.pop(self._finished.popleft(), None)
 
     def _log_terminal(self, qid: Optional[int], label: str, state: str,
-                      reason: str, wall_s: float):
+                      reason: str, wall_s: float) -> dict:
         """Append a shed/queued-cancel record to the session query_log so
         /debug/queries shows the full picture, not just executed queries."""
         rec = {"id": None, "serve_qid": qid, "label": label, "state": state,
@@ -386,3 +469,4 @@ class QueryScheduler:
         with sess._qlog_mu:
             sess.query_log.append(rec)
             del sess.query_log[:-sess._QUERY_LOG_MAX]
+        return rec

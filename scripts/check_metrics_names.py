@@ -1,0 +1,103 @@
+#!/usr/bin/env python3
+"""Static lint for metrics-registry instrument names.
+
+Walks every registration call site (``<reg>.counter("...")`` /
+``.gauge("...")`` / ``.histogram("...")`` with a literal name) under
+``blaze_tpu/`` and ``scripts/`` and enforces:
+
+1. every name matches the ``blaze_<area>_<name>_<unit>`` convention with a
+   unit from ``telemetry.ALLOWED_UNITS`` (same check the registry applies at
+   runtime — this catches names on paths tests never execute);
+2. no two call sites register the same name via different instrument types
+   (the runtime would raise on whichever loses the import race; the lint
+   reports both locations deterministically).
+
+Tests are deliberately NOT scanned: they register intentionally-bad names
+to assert the runtime validation. Standalone: exits 1 with a report on any
+violation. Also run by ``tests/test_telemetry.py`` in the quick tier.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCAN_DIRS = ("blaze_tpu", "scripts")
+METHODS = ("counter", "gauge", "histogram")
+
+
+def iter_registrations(root: str):
+    """Yield (path, lineno, method, name) for literal-name registrations."""
+    for scan in SCAN_DIRS:
+        base = os.path.join(root, scan)
+        for dirpath, _dirnames, filenames in os.walk(base):
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                with open(path) as f:
+                    try:
+                        tree = ast.parse(f.read(), filename=path)
+                    except SyntaxError as exc:
+                        yield (path, exc.lineno or 0, "syntax", str(exc))
+                        continue
+                for node in ast.walk(tree):
+                    if not (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and node.func.attr in METHODS
+                            and node.args
+                            and isinstance(node.args[0], ast.Constant)
+                            and isinstance(node.args[0].value, str)):
+                        continue
+                    name = node.args[0].value
+                    if not name.startswith("blaze_"):
+                        continue  # MetricNode.timer etc. — not registry names
+                    yield (os.path.relpath(path, root), node.lineno,
+                           node.func.attr, name)
+
+
+def run_lint(root: str = REPO):
+    """Returns a list of violation strings (empty = clean)."""
+    sys.path.insert(0, root)
+    from blaze_tpu.obs.telemetry import validate_name
+
+    violations = []
+    seen = {}  # name -> (method, where)
+    count = 0
+    for path, lineno, method, name in iter_registrations(root):
+        where = f"{path}:{lineno}"
+        if method == "syntax":
+            violations.append(f"{where}: unparseable: {name}")
+            continue
+        count += 1
+        try:
+            validate_name(name)
+        except ValueError as exc:
+            violations.append(f"{where}: {exc}")
+        prev = seen.get(name)
+        if prev is not None and prev[0] != method:
+            violations.append(
+                f"{where}: {name!r} registered as {method} but as "
+                f"{prev[0]} at {prev[1]}")
+        else:
+            seen.setdefault(name, (method, where))
+    if count == 0:
+        violations.append("no registrations found — scan roots wrong?")
+    return violations
+
+
+def main() -> int:
+    violations = run_lint()
+    if violations:
+        print(f"check_metrics_names: {len(violations)} violation(s)")
+        for v in violations:
+            print(f"  {v}")
+        return 1
+    print("check_metrics_names: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,9 +9,16 @@ Three shapes over a store_sales-like parquet fact table:
 
 A fraction of submissions carry tight deadlines (exercising the cancel
 path) and the queue is kept small relative to the client count so the
-admission controller genuinely sheds. Writes SERVE_r01.json at the repo
-root with p50/p95/p99 latency, shed/cancelled/completed counts, peak
-in-flight, peak memory, and spill count — the numbers BASELINE.md cites.
+admission controller genuinely sheds.
+
+Round 2 (telemetry): latency percentiles now come from the registry's
+serve SLO histograms scraped over HTTP ``GET /metrics`` while the
+scheduler is open — the same numbers a Prometheus deployment would see —
+and every client-side tally is cross-checked EXACTLY against the
+registry's counters (``/debug/metrics?format=raw`` returns exact
+integers). Deadline-expired queries must leave a retrievable forensic
+bundle at ``/debug/incidents/<id>``. Writes SERVE_r02.json at the repo
+root — the numbers BASELINE.md cites.
 
 Run: python scripts/serve_soak.py   (CPU; ~1-3 min)
 Env: SERVE_CLIENTS (8), SERVE_QUERIES (48 total), SERVE_CONCURRENT (2),
@@ -26,6 +33,7 @@ import sys
 import tempfile
 import threading
 import time
+import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
@@ -51,6 +59,22 @@ def pctl(xs, p):
     return xs[min(len(xs) - 1, int(round(p / 100.0 * (len(xs) - 1))))]
 
 
+def _get(base, path):
+    return urllib.request.urlopen(base + path, timeout=10).read().decode()
+
+
+def _counter(raw_registry, name, **labels):
+    """Exact integer value of one counter series out of format=raw (0 when
+    the series never fired — drain/exposition skip empty series)."""
+    fam = raw_registry.get(name)
+    if not fam:
+        return 0
+    for s in fam["series"]:
+        if s.get("labels", {}) == labels:
+            return int(s["value"])
+    return 0
+
+
 def main():
     import pyarrow as pa
     import pyarrow.parquet as pq
@@ -59,22 +83,28 @@ def main():
     from blaze_tpu.ir import exprs as E
     from blaze_tpu.ir import nodes as N
     from blaze_tpu.ir import types as T
+    from blaze_tpu.obs.telemetry import (get_registry,
+                                         histogram_quantiles_from_text,
+                                         parse_prometheus_text)
     from blaze_tpu.ops.base import QueryCancelled
     from blaze_tpu.ops.parquet import scan_node_for_files
+    from blaze_tpu.runtime.http import ProfilingService
     from blaze_tpu.runtime.memmgr import MemManager
     from blaze_tpu.runtime.session import Session
     from blaze_tpu.serve import Overloaded, QueryScheduler
 
     F, M, HASH = E.AggFunction, E.AggMode, E.AggExecMode.HASH_AGG
 
-    set_config(Config(memory_total=BUDGET_MB << 20, memory_fraction=1.0,
-                      mem_wait_timeout_s=5.0))
-    MemManager.reset()
-
     out = {"clients": CLIENTS, "queries": QUERIES, "concurrent": CONCURRENT,
            "budget_mb": BUDGET_MB, "rows": ROWS}
     t_all = time.perf_counter()
     with tempfile.TemporaryDirectory(prefix="blaze_serve_soak_") as tmpdir:
+        set_config(Config(memory_total=BUDGET_MB << 20, memory_fraction=1.0,
+                          mem_wait_timeout_s=5.0,
+                          incident_dir=os.path.join(tmpdir, "incidents"),
+                          incident_max_bundles=64))
+        MemManager.reset()
+
         # store_sales-like fact: (store, item, qty, price)
         rng = random.Random(7)
         path = os.path.join(tmpdir, "store_sales.parquet")
@@ -125,28 +155,50 @@ def main():
                   ("sort", sort_plan, 24 << 20),
                   ("window", window_plan, 24 << 20)]
 
-        latencies_ms, lat_by_shape = [], {k: [] for k, _, _ in shapes}
-        counts = {"completed": 0, "shed": 0, "cancelled": 0, "failed": 0}
+        client_ms = []
+        # client-truth tallies, split by WHERE the failure surfaced:
+        #   door_overloads — every Overloaded raised by submit() (retries
+        #                    each count: mirrors rejected_total{queue_full})
+        #   shed_door      — queries abandoned after exhausting retries
+        #   shed_queued    — accepted, then shed out of the queue (Overloaded
+        #                    raised by result()): mirrors outcome="shed"
+        counts = {"completed": 0, "shed_door": 0, "shed_queued": 0,
+                  "cancelled": 0, "failed": 0, "door_overloads": 0}
         mu = threading.Lock()
         seq = iter(range(QUERIES))
 
         with Session() as sess:
-            with QueryScheduler(sess, max_concurrent=CONCURRENT,
-                                max_queue=QUEUE,
-                                queue_timeout_s=QUEUE_TIMEOUT_S) as sched:
-                def client(cid):
-                    rng = random.Random(100 + cid)
-                    while True:
-                        with mu:
-                            i = next(seq, None)
-                        if i is None:
-                            return
-                        name, mk, est = shapes[i % len(shapes)]
-                        # ~1 in 8 queries carries a hopeless deadline:
-                        # exercises mid-flight cancel + reclamation
-                        deadline = 0.05 if i % 8 == 5 else None
-                        t0 = time.perf_counter()
-                        try:
+            get_registry().reset_values()  # exact-match bookkeeping below
+            svc = ProfilingService.start(sess)
+            base = f"http://127.0.0.1:{svc.port}"
+            scrape_errors = []
+            stop_sampler = threading.Event()
+
+            def sampler():
+                # a live Prometheus would scrape mid-soak: prove /metrics
+                # stays parseable and cheap under concurrent load
+                while not stop_sampler.wait(1.0):
+                    try:
+                        parse_prometheus_text(_get(base, "/metrics"))
+                    except Exception as exc:  # noqa: BLE001
+                        scrape_errors.append(repr(exc))
+
+            try:
+                with QueryScheduler(sess, max_concurrent=CONCURRENT,
+                                    max_queue=QUEUE,
+                                    queue_timeout_s=QUEUE_TIMEOUT_S) as sched:
+                    def client(cid):
+                        rng = random.Random(100 + cid)
+                        while True:
+                            with mu:
+                                i = next(seq, None)
+                            if i is None:
+                                return
+                            name, mk, est = shapes[i % len(shapes)]
+                            # ~1 in 8 queries carries a hopeless deadline:
+                            # exercises mid-flight cancel + reclamation
+                            deadline = 0.05 if i % 8 == 5 else None
+                            t0 = time.perf_counter()
                             h = None
                             for attempt in range(4):
                                 try:
@@ -157,47 +209,146 @@ def main():
                                 except Overloaded:
                                     # real clients back off on a full queue;
                                     # give up (counted shed) after 3 retries
+                                    with mu:
+                                        counts["door_overloads"] += 1
                                     if attempt == 3:
-                                        raise
+                                        break
                                     time.sleep(rng.uniform(0.1, 0.4))
-                            h.result(timeout=300)
-                            ms = (time.perf_counter() - t0) * 1e3
-                            with mu:
-                                counts["completed"] += 1
-                                latencies_ms.append(ms)
-                                lat_by_shape[name].append(ms)
-                        except Overloaded:
-                            with mu:
-                                counts["shed"] += 1
-                        except QueryCancelled:
-                            with mu:
-                                counts["cancelled"] += 1
-                        except BaseException as exc:
-                            print(f"[client {cid}] {name}_{i} failed: "
-                                  f"{type(exc).__name__}: {exc}",
-                                  file=sys.stderr)
-                            with mu:
-                                counts["failed"] += 1
-                        time.sleep(rng.uniform(0, 0.05))
+                            if h is None:
+                                with mu:
+                                    counts["shed_door"] += 1
+                                continue
+                            try:
+                                h.result(timeout=300)
+                                ms = (time.perf_counter() - t0) * 1e3
+                                with mu:
+                                    counts["completed"] += 1
+                                    client_ms.append(ms)
+                            except Overloaded:
+                                with mu:
+                                    counts["shed_queued"] += 1
+                            except QueryCancelled:
+                                with mu:
+                                    counts["cancelled"] += 1
+                            except BaseException as exc:
+                                print(f"[client {cid}] {name}_{i} failed: "
+                                      f"{type(exc).__name__}: {exc}",
+                                      file=sys.stderr)
+                                with mu:
+                                    counts["failed"] += 1
+                            time.sleep(rng.uniform(0, 0.05))
 
-                ts = [threading.Thread(target=client, args=(c,), daemon=True)
-                      for c in range(CLIENTS)]
-                for t in ts:
-                    t.start()
-                for t in ts:
-                    t.join()
-                out["peak_inflight"] = sched.peak_inflight
-                out["serve_metrics"] = sched.metrics.to_dict()
+                    smp = threading.Thread(target=sampler, daemon=True)
+                    smp.start()
+                    ts = [threading.Thread(target=client, args=(c,),
+                                           daemon=True)
+                          for c in range(CLIENTS)]
+                    for t in ts:
+                        t.start()
+                    for t in ts:
+                        t.join()
+                    stop_sampler.set()
+                    smp.join(timeout=5)
+
+                    # -- scrape while the scheduler is still open ---------
+                    prom_text = _get(base, "/metrics")
+                    parsed = parse_prometheus_text(prom_text)
+                    raw = json.loads(_get(base, "/debug/metrics?format=raw"))
+                    reg = raw["registry"]
+                    incidents = json.loads(_get(base, "/debug/incidents"))
+                    dl = [i for i in incidents if i["kind"] == "deadline"]
+                    dl_bundle = (
+                        json.loads(_get(
+                            base, f"/debug/incidents/{dl[0]['id']}"))
+                        if dl else None)
+
+                    out["peak_inflight"] = sched.peak_inflight
+                    out["serve_metrics"] = sched.metrics.to_dict()
+            finally:
+                ProfilingService.stop()
+
+            assert not scrape_errors, scrape_errors
+
+            # -- latency SLOs from the scraped histograms ------------------
+            def hist_ms(name, **labels):
+                qs = histogram_quantiles_from_text(
+                    parsed, name, labels, [0.5, 0.95, 0.99])
+                return {f"p{int(q * 100)}":
+                        None if v is None else round(v * 1e3, 2)
+                        for q, v in qs.items()}
+
+            out["latency_ms"] = hist_ms("blaze_serve_e2e_seconds",
+                                        outcome="done")
+            out["run_ms"] = hist_ms("blaze_serve_run_seconds")
+            out["queue_wait_ms"] = hist_ms("blaze_serve_queue_wait_seconds")
+            out["client_latency_ms"] = {"p50": pctl(client_ms, 50),
+                                        "p95": pctl(client_ms, 95),
+                                        "p99": pctl(client_ms, 99)}
+
+            # -- exact reconciliation: registry vs client ground truth -----
+            reg_counts = {
+                "door_overloads": _counter(reg, "blaze_serve_rejected_total",
+                                           reason="queue_full"),
+                "shed_queued": _counter(reg, "blaze_serve_queries_total",
+                                        outcome="shed"),
+                "completed": _counter(reg, "blaze_serve_queries_total",
+                                      outcome="done"),
+                "deadline": _counter(reg, "blaze_serve_queries_total",
+                                     outcome="deadline"),
+                "cancelled": _counter(reg, "blaze_serve_queries_total",
+                                      outcome="cancelled"),
+                "failed": _counter(reg, "blaze_serve_queries_total",
+                                   outcome="failed"),
+            }
+            recon = {
+                "door_overloads": (counts["door_overloads"],
+                                   reg_counts["door_overloads"]),
+                "shed_queued": (counts["shed_queued"],
+                                reg_counts["shed_queued"]),
+                "completed": (counts["completed"], reg_counts["completed"]),
+                "cancelled": (counts["cancelled"],
+                              reg_counts["deadline"]
+                              + reg_counts["cancelled"]),
+                "failed": (counts["failed"], reg_counts["failed"]),
+            }
+            mismatches = {k: v for k, v in recon.items() if v[0] != v[1]}
+            assert not mismatches, (
+                f"registry counters disagree with client truth "
+                f"(client, registry): {mismatches}")
+            out["registry_counts"] = reg_counts
+            out["reconciled"] = {k: v[0] for k, v in recon.items()}
+
+            # every accepted query must land in exactly one outcome bucket
+            accepted_total = sum(
+                int(s["value"])
+                for s in reg["blaze_serve_queries_total"]["series"])
+            assert accepted_total == (counts["completed"]
+                                      + counts["shed_queued"]
+                                      + counts["cancelled"]
+                                      + counts["failed"]), accepted_total
+
+            # -- the histogram must agree with the counters too ------------
+            done_in_hist = sum(
+                int(v) for labels, v in
+                parsed.get("blaze_serve_e2e_seconds_count",
+                           {}).get("samples", [])
+                if labels.get("outcome") == "done")
+            assert done_in_hist == counts["completed"], (
+                done_in_hist, counts["completed"])
+
+            # -- deadline forensics: bundle must be retrievable over HTTP --
+            assert reg_counts["deadline"] > 0, \
+                "soak never exercised the deadline path"
+            assert dl, f"no deadline bundle among {len(incidents)} incidents"
+            assert dl_bundle["spans"], "bundle is missing ring-buffer spans"
+            assert dl_bundle["memmgr"] is not None
+            out["incidents"] = {"total": len(incidents),
+                                "deadline_bundle": dl[0]["id"],
+                                "bundle_spans": len(dl_bundle["spans"])}
 
         mm = MemManager._instance
         out.update({
             **counts,
-            "latency_ms": {"p50": pctl(latencies_ms, 50),
-                           "p95": pctl(latencies_ms, 95),
-                           "p99": pctl(latencies_ms, 99)},
-            "latency_ms_by_shape": {
-                k: {"p50": pctl(v, 50), "p95": pctl(v, 95)}
-                for k, v in lat_by_shape.items()},
             "spill_count": mm.spill_count if mm else 0,
             "peak_mem_used": mm.peak_used if mm else None,
             "leaked_mem": mm.used if mm else 0,
@@ -205,7 +356,7 @@ def main():
         })
 
     dst = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "SERVE_r01.json")
+        os.path.abspath(__file__))), "SERVE_r02.json")
     with open(dst, "w") as f:
         json.dump(out, f, indent=2, default=str)
     print(json.dumps(out, indent=2, default=str))

@@ -1,0 +1,458 @@
+"""Telemetry tests: registry semantics, log-bucketed histograms, Prometheus
+exposition roundtrip, worker→driver delta merge over a real WorkerPool,
+disabled-path overhead guard, flight-recorder incident bundles (+ GC cap),
+and the instrument-name lint."""
+
+import json
+import math
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from blaze_tpu.config import Config
+from blaze_tpu.core import ColumnarBatch
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import nodes as N
+from blaze_tpu.ir import types as T
+from blaze_tpu.obs import telemetry as TM
+from blaze_tpu.obs.telemetry import (MetricsRegistry, bucket_index,
+                                     bucket_upper_bound, get_registry,
+                                     parse_prometheus_text,
+                                     quantile_from_le_buckets)
+from blaze_tpu.obs.tracer import TRACER, Tracer
+from blaze_tpu.runtime.memmgr import MemManager
+from blaze_tpu.runtime.session import Session
+
+F = E.AggFunction
+M = E.AggMode
+HASH = E.AggExecMode.HASH_AGG
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    MemManager.reset()
+    get_registry().enabled = True
+    yield
+    MemManager.reset()
+    get_registry().enabled = True
+
+
+def _agg_plan(schema, rid, reducers=3):
+    scan = N.FFIReader(schema=schema, resource_id=rid, num_partitions=1)
+    groupings = [("k", E.Column("k"))]
+    partial = N.Agg(scan, HASH, groupings,
+                    [N.AggColumn(E.AggExpr(F.SUM, [E.Column("v")], T.I64),
+                                 M.PARTIAL, "s")])
+    ex = N.ShuffleExchange(partial, N.HashPartitioning([E.Column("k")],
+                                                       reducers))
+    return N.Agg(ex, HASH, groupings,
+                 [N.AggColumn(E.AggExpr(F.SUM, [E.Column("v")], T.I64),
+                              M.FINAL, "s")])
+
+
+# -- registry semantics --------------------------------------------------------
+
+
+@pytest.mark.quick
+def test_registry_types_labels_and_validation():
+    reg = MetricsRegistry()
+    c = reg.counter("blaze_test_things_total", "help text")
+    c.inc()
+    c.labels(kind="a").inc(2)
+    c.labels(kind="a").inc()
+    assert c.value() == 1
+    assert c.value(kind="a") == 3
+    assert c.total() == 4
+    # idempotent by name, conflicting type raises
+    assert reg.counter("blaze_test_things_total") is c
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("blaze_test_things_total")
+    # naming convention enforced at registration
+    for bad in ("test_things_total", "blaze_things_total",
+                "blaze_test_things_sizes", "blaze_test_Things_total",
+                "blaze_test_total"):
+        with pytest.raises(ValueError):
+            reg.counter(bad)
+    g = reg.gauge("blaze_test_level_bytes")
+    g.labels(group="q1").set(100)
+    g.labels(group="q2").set(200)
+    assert g.value(group="q1") == 100
+    g.remove(group="q1")
+    assert g.value(group="q1") is None
+    assert g.value(group="q2") == 200
+    # disabled registry: handles become no-ops, values freeze
+    reg.enabled = False
+    c.inc(50)
+    c.labels(kind="a").inc(50)
+    g.labels(group="q2").set(999)
+    assert c.total() == 4 and g.value(group="q2") == 200
+    reg.enabled = True
+    # reset_values zeroes series but keeps instrument objects valid
+    reg.reset_values()
+    assert reg.counter("blaze_test_things_total") is c
+    c.inc()
+    assert c.total() == 1
+
+
+@pytest.mark.quick
+def test_histogram_bucketing_and_quantiles():
+    # bucket k holds [2^(k/4), 2^((k+1)/4)): index and reported le agree
+    for v in (1e-9, 1e-4, 0.003, 0.5, 1.0, 7.5, 1000.0, 2.0**30):
+        idx = bucket_index(v)
+        assert v <= bucket_upper_bound(idx)
+        assert v >= bucket_upper_bound(idx - 1) / (2 ** 0.25) * 0.999
+    assert bucket_index(0) == bucket_index(-5.0) == TM._MIN_IDX
+    # relative bucket width is 2^(1/4) (~19%): quantile estimates land
+    # within one bucket of the true value
+    reg = MetricsRegistry()
+    h = reg.histogram("blaze_test_lat_seconds")
+    values = [0.001 * (1.07 ** i) for i in range(200)]  # 1ms .. ~0.77s
+    for v in values:
+        h.observe(v)
+    st = h.snapshot()
+    assert st["count"] == 200
+    assert abs(st["sum"] - sum(values)) < 1e-9
+    values.sort()
+    width = 2 ** (1 / TM.BUCKETS_PER_OCTAVE)
+    for q in (0.5, 0.95, 0.99):
+        est = h.quantile(q)
+        true = values[min(199, int(q * 200))]
+        assert true / width <= est <= true * width, (q, est, true)
+
+
+@pytest.mark.quick
+def test_prometheus_exposition_roundtrip():
+    reg = MetricsRegistry()
+    reg.counter("blaze_test_ops_total").labels(kind="x").inc(7)
+    reg.gauge("blaze_test_depth_count").set(3)
+    fn_g = reg.gauge("blaze_test_live_count")
+    fn_g.set_function(lambda: 42)
+    h = reg.histogram("blaze_test_wait_seconds")
+    for v in (0.01, 0.02, 0.04, 1.5):
+        h.observe(v)
+    txt = reg.to_prometheus()
+    assert "# TYPE blaze_test_ops_total counter" in txt
+    assert "# TYPE blaze_test_wait_seconds histogram" in txt
+    parsed = parse_prometheus_text(txt)
+    assert parsed["blaze_test_ops_total"]["samples"] == [({"kind": "x"}, 7.0)]
+    assert parsed["blaze_test_depth_count"]["samples"] == [({}, 3.0)]
+    assert parsed["blaze_test_live_count"]["samples"] == [({}, 42.0)]
+    buckets = parsed["blaze_test_wait_seconds_bucket"]["samples"]
+    # cumulative and ending at +Inf == count
+    cums = [v for _labels, v in buckets]
+    assert cums == sorted(cums)
+    assert buckets[-1][0]["le"] == "+Inf" and buckets[-1][1] == 4.0
+    assert parsed["blaze_test_wait_seconds_count"]["samples"][0][1] == 4.0
+    total = parsed["blaze_test_wait_seconds_sum"]["samples"][0][1]
+    assert abs(total - 1.57) < 1e-6
+    # every reported finite le bounds its cumulative contents correctly
+    est = quantile_from_le_buckets(
+        [(math.inf if s[0]["le"] == "+Inf" else float(s[0]["le"]), int(s[1]))
+         for s in buckets], 0.5)
+    assert 0.01 <= est <= 0.05
+
+
+@pytest.mark.quick
+def test_drain_deltas_and_merge():
+    child = MetricsRegistry()
+    child.counter("blaze_test_evs_total").labels(kind="spill").inc(5)
+    child.histogram("blaze_test_sz_bytes").observe(1024)
+    child.histogram("blaze_test_sz_bytes").observe(4096)
+    child.gauge("blaze_test_depth_count").set(9)
+    fn_g = child.gauge("blaze_test_live_count")
+    fn_g.set_function(lambda: 1)  # process-local: must NOT ship
+
+    payload = child.drain_deltas()
+    payload = json.loads(json.dumps(payload))  # what the wire does
+    assert "blaze_test_live_count" not in payload
+
+    driver = MetricsRegistry()
+    driver.counter("blaze_test_evs_total").labels(kind="spill").inc(1)
+    driver.merge_deltas(payload)
+    assert driver.counter("blaze_test_evs_total").value(kind="spill") == 6
+    assert driver.histogram("blaze_test_sz_bytes").count() == 2
+    assert driver.gauge("blaze_test_depth_count").value() == 9
+    # drain zeroed the child counters/histograms: a second drain ships nothing
+    assert child.counter("blaze_test_evs_total").total() == 0
+    second = child.drain_deltas()
+    assert "blaze_test_evs_total" not in second \
+        or all(s["value"] == 0 for s in second["blaze_test_evs_total"]["series"])
+
+
+# -- overhead guard ------------------------------------------------------------
+
+
+@pytest.mark.quick
+def test_telemetry_disabled_overhead_under_5_percent():
+    """Disabled-path guard, same analytic shape as the tracer's: microbench
+    the per-update cost of DISABLED instrument handles, scale by the event
+    count a real 1M-row query would emit, compare to its wall-clock."""
+    n = 1_000_000
+    b = ColumnarBatch.from_pydict({"k": [i % 97 for i in range(n)],
+                                   "v": list(range(n))})
+    with Session(conf=Config(batch_size=65_536,
+                             telemetry_enabled=False)) as sess:
+        assert not get_registry().enabled
+        sess.resources["src"] = lambda p: [b.to_arrow()]
+        scan = N.FFIReader(schema=b.schema, resource_id="src",
+                           num_partitions=1)
+        plan = N.Agg(scan, HASH, [("k", E.Column("k"))],
+                     [N.AggColumn(E.AggExpr(F.SUM, [E.Column("v")], T.I64),
+                                  M.COMPLETE, "total")])
+        t0 = time.perf_counter_ns()
+        out = sess.execute_to_pydict(plan)
+        wall_ns = time.perf_counter_ns() - t0
+        assert len(out["k"]) == 97
+        events = sess.metrics.total("output_batches")
+
+        reg = get_registry()
+        c = reg.counter("blaze_test_hot_total")
+        h = reg.histogram("blaze_test_hot_seconds")
+        bound = c.labels(kind="x")
+        ITER = 100_000
+        t0 = time.perf_counter_ns()
+        for _ in range(ITER):
+            c.inc()
+            bound.inc()
+            h.observe(0.001)
+        bench_ns = time.perf_counter_ns() - t0
+    per_update_ns = bench_ns / (ITER * 3)
+    # generously assume 4 registry updates per batch event end to end
+    overhead_ns = per_update_ns * 4 * max(events, 32)
+    assert overhead_ns < 0.05 * wall_ns, (
+        f"disabled telemetry {overhead_ns / 1e6:.2f}ms vs query "
+        f"{wall_ns / 1e6:.1f}ms: disabled-path overhead exceeds 5%")
+    # and the absolute per-update cost stays sub-microsecond-ish
+    assert per_update_ns < 2_000, f"disabled update {per_update_ns:.0f}ns"
+
+
+# -- worker -> driver merge over a real pool -----------------------------------
+
+
+@pytest.mark.slow
+def test_worker_deltas_merge_into_driver_registry(tmp_path):
+    """Pool-run map tasks update the worker process's OWN registry; the
+    deltas must ride back in task replies and fold into the driver registry
+    (shuffle write bytes recorded worker-side become visible driver-side).
+    Needs a parquet-backed plan — resource lambdas aren't pool-shippable."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from blaze_tpu.ops.parquet import scan_node_for_files
+
+    reg = get_registry()
+    reg.reset_values()
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({"k": [i % 7 for i in range(10_000)],
+                             "v": list(range(10_000))}), path)
+    scan = scan_node_for_files([path], num_partitions=2)
+    groupings = [("k", E.Column("k"))]
+    partial = N.Agg(scan, HASH, groupings,
+                    [N.AggColumn(E.AggExpr(F.SUM, [E.Column("v")], T.I64),
+                                 M.PARTIAL, "s")])
+    ex = N.ShuffleExchange(partial, N.HashPartitioning([E.Column("k")], 3))
+    plan = N.Agg(ex, HASH, groupings,
+                 [N.AggColumn(E.AggExpr(F.SUM, [E.Column("v")], T.I64),
+                              M.FINAL, "s")])
+
+    before = reg.histogram("blaze_shuffle_write_size_bytes").count()
+    with Session(conf=Config(batch_size=4096,
+                             shuffle_compression_codec="none",
+                             spill_compression_codec="none"),
+                 num_worker_processes=1) as sess:
+        out = sess.execute_to_pydict(plan)
+    assert len(out["k"]) == 7
+    after = reg.histogram("blaze_shuffle_write_size_bytes").count()
+    # the map stage ran in the worker process: its shuffle-write observations
+    # can only appear here via the reply-delta merge
+    assert after >= before + 2, (before, after)
+    assert reg.counter("blaze_session_queries_total").value(state="done") >= 1
+
+
+# -- flight recorder + incidents -----------------------------------------------
+
+
+@pytest.mark.quick
+def test_tracer_ring_dropped_counter_in_registry():
+    reg = get_registry()
+    dropped = reg.counter("blaze_obs_tracer_events_dropped_total")
+    base = dropped.total()
+    tr = TRACER
+    old_max, old_enabled = tr.max_events, tr.enabled
+    tr.reset()
+    tr.enable()
+    tr.max_events = 3
+    try:
+        for i in range(5):
+            tr.complete(f"e{i}", "engine", 0, 1)
+        assert tr.dropped == 2
+        assert dropped.total() == base + 2
+        # the ring still holds the most recent events despite buffer drops
+        assert [e["name"] for e in tr.ring_snapshot(last=2)] == ["e3", "e4"]
+        assert "blaze_obs_tracer_events_dropped_total" in \
+            reg.to_prometheus()
+    finally:
+        tr.max_events = old_max
+        tr.enabled = old_enabled
+        tr.reset()
+
+
+@pytest.mark.quick
+def test_deadline_query_writes_exactly_one_bundle_then_gc(tmp_path):
+    """A 50ms-deadline slow query must produce EXACTLY one incident bundle
+    containing its ring spans and memmgr group state; the bundle directory
+    is then GC'd down to incident_max_bundles."""
+    from blaze_tpu.obs.dump import list_incidents, load_incident, \
+        record_incident
+    from blaze_tpu.serve import QueryScheduler
+
+    inc_dir = str(tmp_path / "incidents")
+    conf = Config(incident_dir=inc_dir, incident_max_bundles=4)
+    with Session(conf=conf) as sess:
+        b = ColumnarBatch.from_pydict({"k": [1, 2, 3, 4] * 50,
+                                       "v": list(range(200))})
+
+        def provider(p):
+            def gen():
+                for _ in range(100):
+                    time.sleep(0.05)
+                    yield b.to_arrow()
+            return gen()
+
+        sess.resources["slow"] = provider
+        scan = N.FFIReader(schema=b.schema, resource_id="slow",
+                           num_partitions=2)
+        ex = N.ShuffleExchange(scan, N.HashPartitioning([E.Column("k")], 2))
+        plan = N.Sort(ex, [E.SortOrder(E.Column("v"))])
+        with QueryScheduler(sess, max_concurrent=2) as sched:
+            h = sched.submit(plan, deadline_s=0.05, label="dl_query")
+            with pytest.raises(Exception, match="deadline"):
+                h.result(timeout=30)
+            incidents = list_incidents(conf)
+            assert len(incidents) == 1, incidents
+            assert incidents[0]["kind"] == "deadline"
+            assert incidents[0]["label"] == "dl_query"
+            bundle = load_incident(incidents[0]["id"], conf)
+            assert bundle["error"]["type"] == "QueryCancelled"
+            assert bundle["spans"], "ring-buffer spans missing"
+            assert bundle["memmgr"] is not None
+            # the handle's serve_<qid> group shows up in the scheduler view
+            assert bundle["handle"]["state"] == "cancelled"
+            assert bundle["scheduler"]["max_concurrent"] == 2
+            assert bundle["plan_shape"] is not None
+
+        # GC: cap at 4 bundles, write 6 more -> oldest deleted, 4 remain
+        for i in range(6):
+            record_incident("failed", f"gc_{i}",
+                            error=RuntimeError(f"boom {i}"), conf=conf)
+        remaining = list_incidents(conf)
+        assert len(remaining) == 4
+        labels = [r["label"] for r in remaining]
+        assert "dl_query" not in labels, "oldest bundle must be GC'd"
+        assert labels == ["gc_5", "gc_4", "gc_3", "gc_2"]
+
+
+@pytest.mark.quick
+def test_failed_direct_query_writes_bundle(tmp_path):
+    """Non-serve failures go through Session.execute's finish_query path."""
+    from blaze_tpu.obs.dump import list_incidents
+
+    conf = Config(incident_dir=str(tmp_path / "inc"), incident_max_bundles=8)
+    with Session(conf=conf) as sess:
+        def provider(p):
+            def gen():
+                yield ColumnarBatch.from_pydict(
+                    {"k": [1], "v": [2]}).to_arrow()
+                raise RuntimeError("source exploded")
+            return gen()
+
+        sess.resources["bad"] = provider
+        schema = T.Schema.of(("k", T.I64), ("v", T.I64))
+        plan = N.FFIReader(schema=schema, resource_id="bad",
+                           num_partitions=1)
+        with pytest.raises(RuntimeError, match="source exploded"):
+            list(sess.execute(plan, label="direct_fail"))
+        incidents = list_incidents(conf)
+        assert [i["kind"] for i in incidents] == ["failed"]
+        assert incidents[0]["error_type"] == "RuntimeError"
+
+
+# -- serve SLO instruments over HTTP -------------------------------------------
+
+
+@pytest.mark.quick
+def test_metrics_endpoint_and_raw_format(tmp_path):
+    from blaze_tpu.runtime.http import ProfilingService
+    from blaze_tpu.serve import QueryScheduler
+
+    conf = Config(incident_dir=str(tmp_path / "inc"))
+    with Session(conf=conf) as sess:
+        big = ColumnarBatch.from_pydict({"k": [i % 5 for i in range(2000)],
+                                         "v": list(range(2000))})
+        sess.resources["src"] = lambda p: [big.to_arrow()]
+        plan = _agg_plan(big.schema, "src")
+        svc = ProfilingService.start(sess)
+        try:
+            with QueryScheduler(sess, max_concurrent=2) as sched:
+                h = sched.submit(plan, label="http_q")
+                assert h.result(timeout=60).num_rows == 5
+                base = f"http://127.0.0.1:{svc.port}"
+                txt = urllib.request.urlopen(base + "/metrics").read().decode()
+                parsed = parse_prometheus_text(txt)
+                done = [v for labels, v in
+                        parsed["blaze_serve_queries_total"]["samples"]
+                        if labels.get("outcome") == "done"]
+                assert done and done[0] >= 1
+                assert parsed["blaze_serve_e2e_seconds_bucket"]["samples"]
+                assert parsed["blaze_mem_pool_total_bytes"]["samples"]
+                assert parsed["blaze_shuffle_write_size_bytes_count"][
+                    "samples"][0][1] >= 1
+                raw = json.load(urllib.request.urlopen(
+                    base + "/debug/metrics?format=raw"))
+                assert isinstance(raw["registry"]
+                                  ["blaze_serve_queries_total"]
+                                  ["series"][0]["value"], int)
+                assert raw["session"]["name"] == "session"
+                human = json.load(urllib.request.urlopen(
+                    base + "/debug/metrics"))
+                assert "registry" in human and "children" in human
+        finally:
+            ProfilingService.stop()
+
+
+# -- naming lint ---------------------------------------------------------------
+
+
+@pytest.mark.quick
+def test_check_metrics_names_lint_passes():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "check_metrics_names.py")],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@pytest.mark.quick
+def test_lint_catches_bad_names_and_type_conflicts(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import check_metrics_names as lint
+    finally:
+        sys.path.pop(0)
+    root = tmp_path
+    (root / "blaze_tpu").mkdir()
+    (root / "scripts").mkdir()
+    (root / "blaze_tpu" / "x.py").write_text(
+        "reg.counter('blaze_bad_unit_sizes')\n"
+        "reg.counter('blaze_dup_things_total')\n"
+        "reg.gauge('blaze_dup_things_total')\n")
+    violations = lint.run_lint(str(root))
+    assert any("blaze_bad_unit_sizes" in v for v in violations)
+    assert any("registered as gauge" in v for v in violations)

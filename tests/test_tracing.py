@@ -68,7 +68,10 @@ def test_span_records_complete_events_and_nesting():
 
 
 def test_disabled_tracer_records_nothing_and_reuses_noop():
+    # with BOTH full tracing and the flight-recorder ring off, span() must
+    # return the shared allocation-free no-op
     tr = Tracer()
+    tr.set_ring(0)
     s1, s2 = tr.span("a"), tr.span("b")
     assert s1 is s2, "disabled span() must return the shared no-op"
     with s1:
@@ -76,6 +79,22 @@ def test_disabled_tracer_records_nothing_and_reuses_noop():
     tr.instant("x")
     tr.complete("y", "engine", 0, 10)
     assert tr.snapshot() == []
+
+
+def test_ring_records_while_trace_buffer_stays_empty():
+    # default posture: tracing off, flight recorder on — events land in the
+    # ring (for incident bundles) but never in the Chrome-trace buffer
+    tr = Tracer()
+    assert not tr.enabled and tr.active
+    with tr.span("a", "engine"):
+        pass
+    tr.complete("b", "engine", 0, 10)
+    assert tr.snapshot() == []
+    assert [e["name"] for e in tr.ring_snapshot()] == ["a", "b"]
+    # bounded: oldest events fall off
+    tr.set_ring(2)
+    tr.complete("c", "engine", 0, 10)
+    assert [e["name"] for e in tr.ring_snapshot()] == ["b", "c"]
 
 
 def test_buffer_cap_counts_drops():
