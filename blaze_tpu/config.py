@@ -177,6 +177,20 @@ class Config:
     # amortized by the persistent compile cache). True/False force it.
     fused_filter_agg: Optional[bool] = None
 
+    # Whole-stage fusion (ir/fusion.py): collapse maximal chains of narrow
+    # batch-local operators (project / filter / rename / expand, with
+    # coalesce-batches as an in-stage staging point) into one FusedStageExec
+    # whose body is a single jitted XLA computation per chain fingerprint —
+    # one dispatch per batch instead of one eager dispatch per expression
+    # node plus a compaction kernel per filter. False restores the exact
+    # unfused operator tree (escape hatch, test-guarded).
+    fusion_enabled: bool = True
+
+    # Minimum estimated eager dispatches a chain must save before it is
+    # worth the fused closure (the SystemML-style cost cut: a lone
+    # column-reference projection saves nothing and stays unfused).
+    fusion_min_saved_dispatches: int = 1
+
     # Dense-bucket grouped aggregation: when a partial agg's group keys are
     # integers whose observed range fits a small table, the kernel scatters
     # into range-sized segment tables instead of capacity-sized ones (the

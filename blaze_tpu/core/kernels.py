@@ -41,6 +41,10 @@ def _telemetry():
                         "dispatches that grew a jit cache (trace+compile)"),
             reg.histogram("blaze_kernel_jit_compile_seconds",
                           "wall time of compiling dispatches"),
+            reg.counter("blaze_kernel_jit_cache_hits_total",
+                        "fused-stage dispatches served from the jit cache"),
+            reg.counter("blaze_kernel_jit_cache_misses_total",
+                        "fused-stage dispatches that had to trace+compile"),
         )
     return _TM
 
@@ -57,7 +61,7 @@ def _dispatch(fn, *args, **kw):
     from blaze_tpu.obs.tracer import TRACER
     from blaze_tpu.utils.device import DEVICE_STATS
 
-    reg, tm_dispatch, tm_jit, tm_jit_secs = _telemetry()
+    reg, tm_dispatch, tm_jit, tm_jit_secs = _telemetry()[:4]
     trace = TRACER.enabled
     track = reg.enabled
     cache0 = -1
@@ -92,11 +96,62 @@ def _dispatch(fn, *args, **kw):
     return out
 
 
+def fused_dispatch(fn, *args):
+    """Dispatch one fused-stage closure and report whether it hit the jit
+    cache. Unlike :func:`_dispatch`, the cache-size sample is unconditional:
+    the fused-stage hit/miss counters are a fast-path tripwire (recompile
+    storms must be visible in every BENCH/SOAK artifact, not only under
+    tracing). Returns ``(out, compiled)``."""
+    from blaze_tpu.obs.tracer import TRACER
+    from blaze_tpu.utils.device import DEVICE_STATS
+
+    reg, tm_dispatch, _, tm_jit_secs, tm_hit, tm_miss = _telemetry()
+    try:
+        cache0 = fn._cache_size()
+    except Exception:
+        cache0 = -1
+    t0 = time.perf_counter()
+    out = fn(*args)
+    dt = time.perf_counter() - t0
+    DEVICE_STATS.add_kernel(dt)
+    compiled = False
+    if cache0 >= 0:
+        try:
+            compiled = fn._cache_size() > cache0
+        except Exception:
+            compiled = False
+    if reg.enabled:
+        tm_dispatch.observe(dt)
+        if compiled:
+            tm_miss.inc()
+            tm_jit_secs.observe(dt)
+        else:
+            tm_hit.inc()
+    if TRACER.enabled:
+        now = time.perf_counter_ns()
+        TRACER.complete(
+            "jit_compile:fused_stage" if compiled else "fused_stage",
+            "kernel", now - int(dt * 1e9), int(dt * 1e9),
+            {"compiled": compiled})
+    return out, compiled
+
+
 @jax.jit
 def _gather(datas, valids, idx, live):
     # per-field clip: columns of one batch may carry different capacities
     # (e.g. agg state columns assembled at another bucket); live rows index
     # only [0, num_rows) which is within every column's capacity
+    out_d = tuple(
+        jnp.where(live, d[jnp.clip(idx, 0, d.shape[0] - 1)],
+                  jnp.zeros((), d.dtype))
+        for d in datas)
+    out_v = tuple(v[jnp.clip(idx, 0, v.shape[0] - 1)] & live for v in valids)
+    return out_d, out_v
+
+
+@jax.jit
+def _gather_n(datas, valids, idx, n_out):
+    live = jnp.arange(idx.shape[0]) < n_out
     out_d = tuple(
         jnp.where(live, d[jnp.clip(idx, 0, d.shape[0] - 1)],
                   jnp.zeros((), d.dtype))
@@ -111,14 +166,17 @@ def gather_planes(datas: Sequence[jax.Array], valids: Sequence[jax.Array],
     """Gather rows from every (data, validity) plane in ONE jitted dispatch.
 
     ``idx`` is host int64 of length n_out (already < num_rows); rows where
-    ``null_mask`` is True come out null (outer-join extension)."""
+    ``null_mask`` is True come out null (outer-join extension). The common
+    no-null-mask case computes the live prefix mask ON DEVICE from the
+    traced count — uploading it was a capacity-sized host->device transfer
+    per call carrying information already present in one scalar."""
     buf = np.zeros(out_cap, dtype=np.int64)
     buf[:n_out] = idx
-    lbuf = np.zeros(out_cap, dtype=bool)
     if null_mask is None:
-        lbuf[:n_out] = True
-    else:
-        lbuf[:n_out] = ~null_mask
+        return _dispatch(_gather_n, tuple(datas), tuple(valids),
+                         jnp.asarray(buf), jnp.int64(n_out))
+    lbuf = np.zeros(out_cap, dtype=bool)
+    lbuf[:n_out] = ~null_mask
     return _dispatch(_gather, tuple(datas), tuple(valids), jnp.asarray(buf), jnp.asarray(lbuf))
 
 
@@ -265,7 +323,10 @@ def range_partition_order(datas, valids, exists, bound_ops, spec):
 
 
 @jax.jit
-def _concat_gather(datas, valids, idx, live):
+def _concat_gather(datas, valids, idx, total):
+    # live prefix mask derived on device from the traced row total — the
+    # former host-built bool plane was a capacity-sized upload per concat
+    live = jnp.arange(idx.shape[0]) < total
     big_d = tuple(jnp.concatenate(parts) for parts in datas)
     big_v = tuple(jnp.concatenate(parts) for parts in valids)
     out_d = tuple(jnp.where(live, d[idx], jnp.zeros((), d.dtype)) for d in big_d)
@@ -436,10 +497,8 @@ def concat_planes(per_field_datas: List[Tuple[jax.Array, ...]],
         idx[pos:pos + n_j] = np.arange(base, base + n_j)
         pos += n_j
         base += cap_j
-    live = np.zeros(out_cap, dtype=bool)
-    live[:total] = True
     return _dispatch(
         _concat_gather,
         tuple(tuple(p) for p in per_field_datas),
         tuple(tuple(p) for p in per_field_valids),
-        jnp.asarray(idx), jnp.asarray(live))
+        jnp.asarray(idx), jnp.int64(total))

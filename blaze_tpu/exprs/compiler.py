@@ -163,6 +163,21 @@ class ExprEvaluator:
             mask = keep if mask is None else (mask & keep)
         return mask & batch.row_exists_mask()
 
+    def evaluate_traced(self, batch) -> List[DeviceColumn]:
+        """``evaluate`` for use inside a fused-stage jit trace: every value
+        must stay on the device path (a HostVal is a bug — the whitelist in
+        :func:`fusable_expr` admitted something it shouldn't have), and
+        nothing may read ``batch.num_rows`` (a traced TraceBatch raises)."""
+        self._reset_cse(batch)
+        out = []
+        for expr in self.exprs:
+            val = self._eval(expr, batch)
+            if not isinstance(val, DevVal):
+                raise ExprError(
+                    f"host value escaped into fused trace: {type(expr).__name__}")
+            out.append(self._to_column(val, batch))
+        return out
+
     # -- value conversions ----------------------------------------------------
 
     def _to_column(self, val: Val, batch: ColumnarBatch) -> Column:
@@ -868,21 +883,225 @@ def _arrow_to_devcol(arr: pa.Array, dt: T.DataType, capacity: int) -> DeviceColu
     return col
 
 
+# Device scalars for literals, keyed by (value, dtype repr, default device).
+# Without this every evaluation of every literal re-staged a fresh host
+# scalar onto the device per batch — on the tunnel backend that is a
+# synchronous host->device hop per constant per batch (the "transfers
+# outnumber kernels" finding in BENCH_r06). DevVals are immutable so
+# sharing one array across expressions and batches is safe.
+_LITERAL_CACHE: dict = {}
+_LITERAL_CACHE_MAX = 4096
+
+
 def make_literal(value: Any, dtype: T.DataType) -> Val:
     """Build a scalar Val for a python literal value."""
     if _is_device_type(dtype):
+        try:
+            key = (value, repr(dtype), jax.config.jax_default_device)
+            cached = _LITERAL_CACHE.get(key)
+        except TypeError:  # unhashable literal value
+            key = cached = None
+        if cached is not None:
+            return cached
         npdt = dtype.np_dtype
         if value is None:
-            return DevVal(dtype, jnp.zeros((), npdt), jnp.zeros((), bool))
-        v = value
-        if isinstance(dtype, T.DecimalType):
-            from decimal import Decimal
+            out = DevVal(dtype, jnp.zeros((), npdt), jnp.zeros((), bool))
+        else:
+            v = value
+            if isinstance(dtype, T.DecimalType):
+                from decimal import Decimal
 
-            v = int(Decimal(str(value)).scaleb(dtype.scale).to_integral_value())
-        elif isinstance(dtype, T.TimestampType) and not isinstance(value, (int, np.integer)):
-            v = int(pa.scalar(value, type=pa.timestamp("us")).value)
-        elif isinstance(dtype, T.DateType) and not isinstance(value, (int, np.integer)):
-            v = int(pa.scalar(value, type=pa.date32()).value)
-        return DevVal(dtype, jnp.array(v, npdt), jnp.ones((), bool))
+                v = int(Decimal(str(value)).scaleb(dtype.scale).to_integral_value())
+            elif isinstance(dtype, T.TimestampType) and not isinstance(value, (int, np.integer)):
+                v = int(pa.scalar(value, type=pa.timestamp("us")).value)
+            elif isinstance(dtype, T.DateType) and not isinstance(value, (int, np.integer)):
+                v = int(pa.scalar(value, type=pa.date32()).value)
+            out = DevVal(dtype, jnp.array(v, npdt), jnp.ones((), bool))
+        # never cache a value built while some enclosing jit is tracing
+        # (device-agg probes, fused closures): jnp "constants" are staged as
+        # tracers there, and a tracer in a global cache poisons every later
+        # eager evaluation (UnexpectedTracerError)
+        if key is not None and len(_LITERAL_CACHE) < _LITERAL_CACHE_MAX \
+                and not isinstance(out.data, jax.core.Tracer):
+            _LITERAL_CACHE[key] = out
+        return out
     at = T.to_arrow_type(dtype)
     return HostVal(dtype, pa.array([value], type=at))
+
+
+# -- whole-stage fusion: traceable closures over operator chains --------------
+#
+# The fused-stage operator (ops/fused.py) evaluates a project/filter/rename/
+# expand chain inside ONE jax.jit trace. The evaluator above already keeps
+# the all-fixed-width path in pure jnp (DevVal in, DevVal out), so tracing is
+# a matter of (a) admitting only expressions that provably stay on that path
+# (fusable_expr), and (b) feeding _eval a batch stand-in whose columns hold
+# tracers and whose row-exists mask is the chain's running live mask
+# (TraceBatch). Filters do NOT compact mid-chain: they narrow the live mask,
+# and each output group compacts once at the end with the same stable
+# argsort-gather as kernels._compact — elementwise expressions commute with
+# stable compaction, so results are identical to the unfused operators.
+
+
+class TraceBatch:
+    """Duck-typed ColumnarBatch stand-in used inside a fused jit trace:
+    static schema + capacity, DeviceColumns holding tracers, and a traced
+    row-exists mask. ``num_rows`` raises so any host-path leak surfaces as a
+    loud fallback instead of a silent wrong answer."""
+
+    def __init__(self, schema: T.Schema, columns: List[DeviceColumn],
+                 capacity: int, exists: jax.Array):
+        self.schema = schema
+        self.columns = columns
+        self.capacity = capacity
+        self._exists = exists
+
+    def row_exists_mask(self) -> jax.Array:
+        return self._exists
+
+    @property
+    def num_rows(self):
+        raise ExprError("num_rows is not defined inside a fused trace")
+
+
+def fusable_expr(expr: E.Expr, schema: T.Schema) -> bool:
+    """True when ``expr`` evaluates entirely on the device (pure-jnp) path
+    for batches of ``schema``, i.e. it is safe to trace inside a fused
+    stage. Host-path expressions (strings, structs, UDFs, stateful RowNum,
+    bloom probes, scalar functions) are rejected; so is anything whose
+    result type cannot live on device."""
+    try:
+        return _fusable(expr, schema) and _is_device_type(E.infer_type(expr, schema))
+    except Exception:
+        return False
+
+
+def _fusable(expr: E.Expr, schema: T.Schema) -> bool:
+    if isinstance(expr, E.BoundReference):
+        return _is_device_type(schema[expr.index].dtype)
+    if isinstance(expr, E.Column):
+        return _is_device_type(schema[schema.index_of(expr.name)].dtype)
+    if isinstance(expr, (E.Literal, E.ScalarSubquery)):
+        return _is_device_type(expr.dtype)
+    if isinstance(expr, E.BinaryExpr):
+        return _fusable(expr.left, schema) and _fusable(expr.right, schema)
+    if isinstance(expr, (E.Not, E.IsNull, E.IsNotNull)):
+        return _fusable(expr.child, schema)
+    if isinstance(expr, E.Case):
+        parts = [p for branch in expr.branches for p in branch]
+        if expr.else_expr is not None:
+            parts.append(expr.else_expr)
+        return all(_fusable(p, schema) for p in parts)
+    if isinstance(expr, E.InList):
+        return _fusable(expr.child, schema) and \
+            all(_fusable(v, schema) for v in expr.values)
+    if isinstance(expr, (E.Cast, E.TryCast)):
+        # cast_dev needs device source AND target dtypes
+        return _fusable(expr.child, schema) and _is_device_type(expr.dtype) \
+            and _is_device_type(E.infer_type(expr.child, schema))
+    if isinstance(expr, E.SortOrder):
+        return _fusable(expr.child, schema)
+    return False
+
+
+def fused_chain_schemas(input_schema: T.Schema, steps) -> List[T.Schema]:
+    """Per-step input schemas of a fused chain (index i = schema seen by
+    steps[i]; the final entry is the chain's output schema). Expand emits a
+    single declared schema for all its projections, so the schema stays
+    uniform across groups at every step."""
+    schemas = [input_schema]
+    s = input_schema
+    for st in steps:
+        kind = st[0]
+        if kind == "project":
+            s = T.Schema(tuple(
+                T.StructField(n, E.infer_type(e, s))
+                for n, e in zip(st[2], st[1])))
+        elif kind == "rename":
+            s = s.rename(list(st[1]))
+        elif kind == "expand":
+            s = st[2]
+        schemas.append(s)
+    return schemas
+
+
+def fused_group_flags(steps) -> List[bool]:
+    """Static per-output-group "was filtered" flags: a group whose live mask
+    was never narrowed by a filter step passes ``num_rows`` through and its
+    compaction is skipped inside the trace (and the count sync skipped by
+    the operator)."""
+    flags = [False]
+    for st in steps:
+        if st[0] == "filter":
+            flags = [True] * len(flags)
+        elif st[0] == "expand":
+            flags = [f for f in flags for _ in range(len(st[1]))]
+    return flags
+
+
+def build_fused_closure(input_schema: T.Schema, steps):
+    """Compose a fused chain into one jax-traceable function.
+
+    ``steps`` is a tuple of ("project", exprs, names) | ("filter", preds) |
+    ("rename", names) | ("expand", projections, schema). Returns a function
+    ``(datas, valids, num_rows) -> (groups, counts)`` over one batch's
+    device planes, where ``groups[g]`` is that output group's
+    ``(datas, valids)`` tuples at input capacity and ``counts[g]`` its live
+    row count (traced; equal to ``num_rows`` for never-filtered groups).
+    Callers jit it; the jit cache keys on (capacity, dtypes), which the
+    capacity-bucket discipline makes recur."""
+    schemas = fused_chain_schemas(input_schema, steps)
+
+    def fused_chain(datas, valids, num_rows):
+        cap = datas[0].shape[0]
+        exists = jnp.arange(cap) < num_rows
+        cols = [DeviceColumn(f.dtype, d, v)
+                for f, d, v in zip(input_schema, datas, valids)]
+        groups = [(cols, exists, False)]
+        for si, st in enumerate(steps):
+            kind = st[0]
+            schema = schemas[si]
+            out_groups = []
+            for cols, live, filtered in groups:
+                tb = TraceBatch(schema, cols, cap, live)
+                if kind == "project":
+                    ev = ExprEvaluator(list(st[1]), schema)
+                    out_groups.append((ev.evaluate_traced(tb), live, filtered))
+                elif kind == "filter":
+                    ev = ExprEvaluator(list(st[1]), schema)
+                    out_groups.append((cols, ev.evaluate_predicate(tb), True))
+                elif kind == "rename":
+                    out_groups.append((cols, live, filtered))
+                elif kind == "expand":
+                    for proj in st[1]:
+                        ev = ExprEvaluator(list(proj), schema)
+                        out_groups.append(
+                            (ev.evaluate_traced(tb), live, filtered))
+                else:
+                    raise ExprError(f"unknown fused step {kind!r}")
+            groups = out_groups
+        outs = []
+        counts = []
+        for cols, live, filtered in groups:
+            ds = tuple(c.data for c in cols)
+            vs = tuple(c.validity for c in cols)
+            if filtered:
+                # end-of-chain compaction, same stable order + dead-lane
+                # zeroing as kernels._compact
+                count = jnp.sum(live)
+                order = jnp.argsort(~live, stable=True)
+                out_live = jnp.arange(cap) < count
+                ds = tuple(
+                    jnp.where(out_live, d[jnp.clip(order, 0, d.shape[0] - 1)],
+                              jnp.zeros((), d.dtype))
+                    for d in ds)
+                vs = tuple(
+                    v[jnp.clip(order, 0, v.shape[0] - 1)] & out_live
+                    for v in vs)
+            else:
+                count = num_rows
+            outs.append((ds, vs))
+            counts.append(count)
+        return tuple(outs), tuple(counts)
+
+    return fused_chain

@@ -299,6 +299,28 @@ class Expand(PlanNode):
 
 
 @dataclasses.dataclass
+class FusedStage(PlanNode):
+    """A maximal chain of narrow batch-local operators collapsed by the
+    whole-stage fusion pass (``ir/fusion.py``) into one operator whose body
+    is a single jitted XLA computation. ``ops`` holds the original chain
+    nodes innermost-first (each still linked to its original child, so
+    per-op schemas stay derivable); the executor evaluates their expressions
+    inside one trace instead of building one operator per node."""
+
+    child: PlanNode
+    ops: Tuple[PlanNode, ...]
+
+    def children(self) -> List["PlanNode"]:
+        # ops are absorbed, not children: traversals must not walk the
+        # original chain again (the base class would pick the tuple up)
+        return [self.child]
+
+    @property
+    def output_schema(self):
+        return self.ops[-1].output_schema
+
+
+@dataclasses.dataclass
 class AggColumn:
     """One output aggregate: expression + mode (reference: AggExprNode with
     per-agg AggMode in proto :672-686)."""

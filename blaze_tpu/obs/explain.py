@@ -63,8 +63,30 @@ def humanize_metrics_dict(d: dict) -> dict:
 def op_shape(op) -> Tuple[str, list]:
     """Lightweight ``(name, [child shapes])`` mirror of an operator tree —
     what the session records per stage so explain can label the positional
-    metric tree without keeping operators (or plans) alive."""
-    return (op.name, [op_shape(c) for c in op.children])
+    metric tree without keeping operators (or plans) alive.
+
+    A FusedStageExec additionally lists its absorbed operators as "+"-marked
+    pseudo-children (outermost-first, after the real child shapes so the
+    positional metric match is undisturbed): the fusion boundary stays
+    visible in EXPLAIN ANALYZE / ``/debug/queries`` even though the whole
+    stage executed as one operator with one self-time."""
+    children = [op_shape(c) for c in op.children]
+    fused = getattr(op, "fused_op_names", None)
+    if fused:
+        children += [(f"+ {n} (fused)", []) for n in reversed(fused)]
+    return (op.name, children)
+
+
+def shape_lines(shape: Tuple[str, list], indent: int = 0) -> List[str]:
+    """Indented plan outline from an ``op_shape`` tree, metrics-free — the
+    compact form ``/debug/queries`` embeds so fusion boundaries (the
+    "+ …(fused)" pseudo-children) are visible per query without the full
+    EXPLAIN ANALYZE."""
+    name, children = shape
+    lines = [("  " * indent) + name]
+    for c in children:
+        lines.extend(shape_lines(c, indent + 1))
+    return lines
 
 
 def merge_partition_metrics(parts: List[MetricNode]) -> MetricNode:
@@ -90,6 +112,10 @@ def merge_partition_metrics(parts: List[MetricNode]) -> MetricNode:
 
 
 def _node_line(name: str, node: Optional[MetricNode]) -> str:
+    if name.startswith("+ "):
+        # fused pseudo-child: absorbed into the enclosing FusedStageExec,
+        # which carries the stage's single self-time — no metrics of its own
+        return name
     if node is None:
         return f"{name}  [not executed]"
     values = dict(node.values)

@@ -3,7 +3,17 @@
 The analogue of the reference's ``from_proto.rs:118-735`` (``TryInto<Arc<dyn
 ExecutionPlan>>``): one constructor per plan-IR node. Exchange nodes
 (ShuffleExchange/BroadcastExchange) are *driver* concepts and must be
-lowered by the Session before building (build_operator rejects them)."""
+lowered by the Session before building (build_operator rejects them).
+
+Whole-stage fusion (ir/fusion.py) runs HERE, at the entry of every build:
+this is the one chokepoint every execution path shares — driver-built
+stages, the in-process result stage, and pool workers rebuilding plans from
+shipped proto IR — and it sees post-lowering trees (driver-inserted
+CoalesceBatches over IpcReader included), while the shipped proto stays
+vanilla (FusedStage needs no encoding). The pass runs ONCE per build, at
+the root: the recursion below uses ``_build`` so parent-aware fusion
+guards (a filter directly under an agg feeds the fused filter-agg kernel
+and must stay unfused) aren't lost by re-rooting the pass mid-tree."""
 
 from __future__ import annotations
 
@@ -12,42 +22,56 @@ from blaze_tpu.ops.base import Operator
 
 
 def build_operator(node: N.PlanNode) -> Operator:
+    from blaze_tpu.config import get_config
+    from blaze_tpu.ir.fusion import fuse_plan
+
+    conf = get_config()
+    if conf.fusion_enabled:
+        node = fuse_plan(node, conf)
+    return _build(node)
+
+
+def _build(node: N.PlanNode) -> Operator:
+    if isinstance(node, N.FusedStage):
+        from blaze_tpu.ops.fused import FusedStageExec
+
+        return FusedStageExec(_build(node.child), node)
     if isinstance(node, N.Projection):
         from blaze_tpu.ops.basic import ProjectExec
 
-        return ProjectExec(build_operator(node.child), node.exprs, node.names)
+        return ProjectExec(_build(node.child), node.exprs, node.names)
     if isinstance(node, N.Filter):
         from blaze_tpu.ops.basic import FilterExec
 
-        return FilterExec(build_operator(node.child), node.predicates)
+        return FilterExec(_build(node.child), node.predicates)
     if isinstance(node, N.Sort):
         from blaze_tpu.ops.sort import SortExec
 
-        return SortExec(build_operator(node.child), node.sort_orders, node.fetch_limit)
+        return SortExec(_build(node.child), node.sort_orders, node.fetch_limit)
     if isinstance(node, N.Limit):
         from blaze_tpu.ops.basic import LimitExec
 
-        return LimitExec(build_operator(node.child), node.limit)
+        return LimitExec(_build(node.child), node.limit)
     if isinstance(node, N.CoalesceBatches):
         from blaze_tpu.ops.basic import CoalesceBatchesExec
 
-        return CoalesceBatchesExec(build_operator(node.child), node.batch_size)
+        return CoalesceBatchesExec(_build(node.child), node.batch_size)
     if isinstance(node, N.RenameColumns):
         from blaze_tpu.ops.basic import RenameColumnsExec
 
-        return RenameColumnsExec(build_operator(node.child), node.renamed_names)
+        return RenameColumnsExec(_build(node.child), node.renamed_names)
     if isinstance(node, N.Debug):
         from blaze_tpu.ops.basic import DebugExec
 
-        return DebugExec(build_operator(node.child), node.debug_id)
+        return DebugExec(_build(node.child), node.debug_id)
     if isinstance(node, N.Expand):
         from blaze_tpu.ops.basic import ExpandExec
 
-        return ExpandExec(build_operator(node.child), node.projections, node.schema)
+        return ExpandExec(_build(node.child), node.projections, node.schema)
     if isinstance(node, N.Union):
         from blaze_tpu.ops.basic import UnionExec
 
-        return UnionExec([build_operator(c) for c in node.inputs],
+        return UnionExec([_build(c) for c in node.inputs],
                          node.num_partitions, node.in_partitions or None)
     if isinstance(node, N.EmptyPartitions):
         from blaze_tpu.ops.basic import EmptyPartitionsExec
@@ -56,42 +80,42 @@ def build_operator(node: N.PlanNode) -> Operator:
     if isinstance(node, N.Agg):
         from blaze_tpu.ops.agg import AggExec
 
-        return AggExec(build_operator(node.child), node.exec_mode, node.groupings,
+        return AggExec(_build(node.child), node.exec_mode, node.groupings,
                        node.aggs, node.supports_partial_skipping)
     if isinstance(node, N.Window):
         from blaze_tpu.ops.window import WindowExec
 
-        return WindowExec(build_operator(node.child), node.window_exprs,
+        return WindowExec(_build(node.child), node.window_exprs,
                           node.partition_spec, node.order_spec, node.group_limit,
                           node.output_window_cols)
     if isinstance(node, N.Generate):
         from blaze_tpu.ops.generate import GenerateExec
 
-        return GenerateExec(build_operator(node.child), node.generator,
+        return GenerateExec(_build(node.child), node.generator,
                             node.generator_args, node.required_child_output,
                             node.generator_output, node.outer, node.udtf)
     if isinstance(node, N.SortMergeJoin):
         from blaze_tpu.ops.joins.smj import SortMergeJoinExec
 
-        return SortMergeJoinExec(build_operator(node.left), build_operator(node.right),
+        return SortMergeJoinExec(_build(node.left), _build(node.right),
                                  node.on, node.join_type, node.sort_options,
                                  node.condition)
     if isinstance(node, N.HashJoin):
         from blaze_tpu.ops.joins.bhj import HashJoinExec
 
-        return HashJoinExec(build_operator(node.left), build_operator(node.right),
+        return HashJoinExec(_build(node.left), _build(node.right),
                             node.on, node.join_type, node.build_side,
                             node.condition)
     if isinstance(node, N.BroadcastJoin):
         from blaze_tpu.ops.joins.bhj import BroadcastJoinExec
 
-        return BroadcastJoinExec(build_operator(node.left), build_operator(node.right),
+        return BroadcastJoinExec(_build(node.left), _build(node.right),
                                  node.on, node.join_type, node.broadcast_side,
                                  node.cached_build_hash_map_id, node.condition)
     if isinstance(node, N.BroadcastJoinBuildHashMap):
         from blaze_tpu.ops.joins.bhj import BroadcastJoinBuildHashMapExec
 
-        return BroadcastJoinBuildHashMapExec(build_operator(node.child), node.keys)
+        return BroadcastJoinBuildHashMapExec(_build(node.child), node.keys)
     if isinstance(node, N.ParquetScan):
         from blaze_tpu.ops.parquet import ParquetScanExec
 
@@ -103,17 +127,17 @@ def build_operator(node: N.PlanNode) -> Operator:
     if isinstance(node, N.ParquetSink):
         from blaze_tpu.ops.parquet import ParquetSinkExec
 
-        return ParquetSinkExec(build_operator(node.child), node.fs_path,
+        return ParquetSinkExec(_build(node.child), node.fs_path,
                                node.num_dyn_parts, node.props)
     if isinstance(node, N.ShuffleWriter):
         from blaze_tpu.ops.shuffle.writer import ShuffleWriterExec
 
-        return ShuffleWriterExec(build_operator(node.child), node.partitioning,
+        return ShuffleWriterExec(_build(node.child), node.partitioning,
                                  node.output_data_file, node.output_index_file)
     if isinstance(node, N.RssShuffleWriter):
         from blaze_tpu.ops.shuffle.writer import RssShuffleWriterExec
 
-        return RssShuffleWriterExec(build_operator(node.child), node.partitioning,
+        return RssShuffleWriterExec(_build(node.child), node.partitioning,
                                     node.rss_writer_resource_id)
     if isinstance(node, N.IpcReader):
         from blaze_tpu.ops.shuffle.reader import IpcReaderExec
@@ -122,7 +146,7 @@ def build_operator(node: N.PlanNode) -> Operator:
     if isinstance(node, N.IpcWriter):
         from blaze_tpu.ops.shuffle.reader import IpcWriterExec
 
-        return IpcWriterExec(build_operator(node.child), node.consumer_resource_id)
+        return IpcWriterExec(_build(node.child), node.consumer_resource_id)
     if isinstance(node, N.FFIReader):
         from blaze_tpu.ops.shuffle.reader import FFIReaderExec
 

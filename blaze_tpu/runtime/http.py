@@ -51,6 +51,18 @@ from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
 
+def _query_record(q: dict) -> dict:
+    """One /debug/queries entry: the query record with its nested-tuple
+    plan shape replaced by indented outline lines (fused operators show as
+    "+ <Op> (fused)" pseudo-children under their FusedStageExec)."""
+    d = {k: v for k, v in q.items() if k != "shape"}
+    if q.get("shape"):
+        from blaze_tpu.obs.explain import shape_lines
+
+        d["plan"] = shape_lines(q["shape"])
+    return d
+
+
 class ProfilingService:
     _instance: Optional["ProfilingService"] = None
     _lock = threading.Lock()
@@ -150,14 +162,13 @@ class ProfilingService:
                             mg = q.get("mem_group") or ""
                             if mg.startswith("serve_"):
                                 continue  # already shown via the scheduler
-                            d = {k: v for k, v in q.items() if k != "shape"}
+                            d = _query_record(q)
                             d["elapsed_s"] = round(
                                 now - q.get("started_unix", now), 3)
                             body.append(d)
                         log = list(getattr(sess, "query_log", []) or [])
                         # plan shapes are nested tuples — render compactly
-                        body += [{k: v for k, v in q.items() if k != "shape"}
-                                 for q in log]
+                        body += [_query_record(q) for q in log]
                         self._send(json.dumps(body, indent=2, default=str))
                     elif url.path == "/serve/queries":
                         sched = self._scheduler()

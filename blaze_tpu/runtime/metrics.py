@@ -112,6 +112,15 @@ class MetricNode:
 #   ipc_decode_in_prefetch > 0       on shuffle-bearing plans: frame decode
 #                                    happens in the reader's worker pool,
 #                                    not on the consumer thread
+#   fused_stages > 0                 on plans with fusable narrow chains:
+#                                    whole-stage fusion engaged (fused_ops
+#                                    counts the operators it absorbed)
+#   jit_cache_misses ~ #shapes       fused closures compile once per
+#                                    (fingerprint, capacity bucket); misses
+#                                    growing with batch count is a
+#                                    recompile storm
+#   fused_fallback_batches == 0      fused stages executed their jitted
+#                                    closure, not the eager fallback
 TRIPWIRE_METRICS = (
     "split_batches",
     "split_gathers",
@@ -119,6 +128,11 @@ TRIPWIRE_METRICS = (
     "window_group_loops",
     "streamed_partitions",
     "ipc_decode_in_prefetch",
+    "fused_stages",
+    "fused_ops",
+    "jit_cache_hits",
+    "jit_cache_misses",
+    "fused_fallback_batches",
 )
 
 

@@ -131,6 +131,11 @@ def main():
                 "window_segments": trips["window_segments"],
                 "window_group_loops": trips["window_group_loops"],
                 "ipc_decode_in_prefetch": trips["ipc_decode_in_prefetch"],
+                "fused_stages": trips["fused_stages"],
+                "fused_ops": trips["fused_ops"],
+                "jit_cache_hits": trips["jit_cache_hits"],
+                "jit_cache_misses": trips["jit_cache_misses"],
+                "fused_fallback_batches": trips["fused_fallback_batches"],
                 "peak_mem_used": peak_used,
                 "peak_rss_mb": peak_rss_mb(),
             }

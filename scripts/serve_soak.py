@@ -168,6 +168,9 @@ def main():
         seq = iter(range(QUERIES))
 
         with Session() as sess:
+            from blaze_tpu.utils.device import DEVICE_STATS
+
+            DEVICE_STATS.reset()
             get_registry().reset_values()  # exact-match bookkeeping below
             svc = ProfilingService.start(sess)
             base = f"http://127.0.0.1:{svc.port}"
@@ -268,6 +271,14 @@ def main():
                 ProfilingService.stop()
 
             assert not scrape_errors, scrape_errors
+
+            # device + fusion counters next to the SLOs — the same
+            # kernel_stats shape bench records (DEVICE_STATS snapshot merged
+            # with the invariant tripwires, fused-stage jit cache included)
+            from blaze_tpu.runtime.metrics import tripwire_totals
+
+            out["kernel_stats"] = dict(DEVICE_STATS.snapshot(),
+                                       **tripwire_totals(sess.metrics))
 
             # -- latency SLOs from the scraped histograms ------------------
             def hist_ms(name, **labels):
