@@ -166,7 +166,8 @@ def _col(name):
 
 def _two_stage_agg(child, keys, aggs, nparts):
     partial = N.Agg(child, E.AggExecMode.HASH_AGG, keys, [
-        N.AggColumn(agg, E.AggMode.PARTIAL, name) for name, agg, _dt in aggs])
+        N.AggColumn(agg, E.AggMode.PARTIAL, name) for name, agg, _dt in aggs],
+        supports_partial_skipping=True)
     ex = N.ShuffleExchange(partial, N.HashPartitioning(
         [e for _, e in keys], nparts))
     return N.Agg(ex, E.AggExecMode.HASH_AGG, keys, [

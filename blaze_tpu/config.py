@@ -205,6 +205,35 @@ class Config:
     # rounded ranges). Ranges beyond this fall back to the sort kernel.
     dense_agg_max_buckets: int = 65536
 
+    # Radix-partitioned grouped aggregation: the high-cardinality extension
+    # of dense_agg. Packed integer keys are bucketed by their high code bits
+    # and deduped/accumulated with one scatter pass into a slot table whose
+    # size is the product of the per-key rounded ranges — far past
+    # dense_agg_max_buckets, bounded by radix_agg_max_slots. Replaces the
+    # O(n log n) sort segmentation for wide key ranges (q67-class ~570k
+    # groups) on both the partial and the merge side. None = auto: ON when
+    # the stage's effective backend is the CPU (same probe-sync tradeoff as
+    # dense_agg). True/False force it.
+    radix_agg: Optional[bool] = None
+
+    # Upper bound on the radix slot-table size (product of per-key rounded
+    # ranges). Key spaces beyond this fall back to the sort kernel.
+    radix_agg_max_slots: int = 1 << 22
+
+    # Number of radix buckets (power of two). Buckets partition the packed
+    # key code by its high bits; the per-bucket (rows, groups) histogram
+    # feeds the partial-skipping heuristic and the Perfetto skew view.
+    radix_agg_buckets: int = 256
+
+    # Ship dictionary codes + dictionaries through the shuffle instead of
+    # decoded values: partial-agg output keeps var-width group keys
+    # dictionary-encoded, the serde registers each dictionary once per
+    # (writer stream, dict) pair, and the final AggTable's _gid_of_values
+    # cache translates each incoming dictionary once instead of
+    # re-interning every row. False restores the decode-at-the-boundary
+    # path.
+    codes_shuffle: bool = True
+
     # Query serving layer (serve/scheduler.py): concurrency slots, queue
     # bounds, and admission control. A query is admitted only when the
     # MemManager's headroom covers its estimated footprint; a full queue or

@@ -601,7 +601,11 @@ class ColumnarBatch:
                 if len({a.type for a in arrs}) > 1:
                     # mixed dictionary/plain encodings cannot concat raw
                     arrs = [decode_dictionary(a, c0.dtype) for a in arrs]
-                arr = pa.concat_arrays(arrs)
+                try:
+                    arr = pa.concat_arrays(arrs)
+                except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+                    # dictionary unification fallback (older arrow builds)
+                    arr = pa.chunked_array(arrs).combine_chunks()
                 cols[i] = HostColumn(c0.dtype, arr)
         return ColumnarBatch(schema, cols, total)
 

@@ -502,3 +502,74 @@ def concat_planes(per_field_datas: List[Tuple[jax.Array, ...]],
         tuple(tuple(p) for p in per_field_datas),
         tuple(tuple(p) for p in per_field_valids),
         jnp.asarray(idx), jnp.int64(total))
+
+
+# -- radix key partitioning ----------------------------------------------------
+# Traced primitives shared by the dense-bucket and radix-partitioned hash
+# aggregation kernels (ops/agg_device): integer group keys pack into ONE
+# int64 slot code from per-key (base, pow2 size) strides, and the code's
+# high bits are the radix bucket id — so dedup, scatter-accumulate, AND the
+# per-bucket skew histogram all come out of the same scatter pass. These run
+# INSIDE jitted kernels; sizes/strides are static, bases are traced.
+
+
+def radix_strides(sizes: Sequence[int]) -> Tuple[int, ...]:
+    """Row-major mixed-radix strides for per-key bucket sizes (the LAST key
+    varies fastest, matching the dense-agg slot layout)."""
+    strides = []
+    acc = 1
+    for s in reversed(sizes):
+        strides.append(acc)
+        acc *= s
+    return tuple(reversed(strides))
+
+
+def radix_pack(key_data, key_valid, exists, bases, sizes, strides):
+    """Traced: pack per-key integer planes into one slot code (int32 seg).
+
+    Per key, code 0 is the null bucket and 1..size-1 map base..base+size-2;
+    per-key codes combine mixed-radix via ``strides``. ``bases`` is a traced
+    int64 vector so one compiled kernel serves every batch of a stream.
+    Returns (seg, fits): padding rows route to the prod(sizes) sentinel
+    slot; ``fits`` flips False when any existing valid key fell outside its
+    range. The in-range test is overflow-safe: ``diff`` wraps when
+    |key - base| exceeds 2^63, which could land a far-away key inside
+    [0, size) and silently mis-bucket it — requiring d64 >= base AND
+    diff >= 0 rejects both the wrapped case (wrapped diff is negative when
+    d64 >= base) and key == base-1 (which would collide with the null
+    bucket at code 0)."""
+    S = 1
+    for s in sizes:
+        S *= s
+    cap = exists.shape[0]
+    seg = jnp.zeros(cap, jnp.int64)
+    fits = jnp.bool_(True)
+    for i, (d, v) in enumerate(zip(key_data, key_valid)):
+        d64 = d.astype(jnp.int64)
+        diff = d64 - bases[i]  # wrapping int64
+        code = jnp.where(v, diff + jnp.int64(1), jnp.int64(0))
+        infit = (d64 >= bases[i]) & (diff >= 0) & (diff < sizes[i] - 1)
+        fits = fits & jnp.all(jnp.where(exists & v, infit, True))
+        seg = seg + jnp.clip(code, 0, sizes[i] - 1) * strides[i]
+    return jnp.where(exists, seg, S).astype(jnp.int32), fits
+
+
+def radix_bucket_shift(S: int, nbuck: int) -> Tuple[int, int]:
+    """(shift, effective bucket count): a slot code's high bits select its
+    radix bucket. S and nbuck are powers of two; nbuck clamps to S."""
+    nb = min(nbuck, S)
+    return (S // nb).bit_length() - 1, nb
+
+
+def radix_histogram(seg, exists, present, S: int, nbuck: int):
+    """Traced per-bucket (rows, groups) histogram from one partial pass:
+    ``seg`` routes each existing row to its slot (sentinel S for padding,
+    dropped here), ``present`` marks occupied slots. This is the skew
+    signal the partial-skipping heuristic and the Perfetto trace consume."""
+    shift, nb = radix_bucket_shift(S, nbuck)
+    rows = jnp.zeros(nb, jnp.int64).at[seg.astype(jnp.int64) >> shift].add(
+        exists.astype(jnp.int64), mode="drop")
+    iota_s = jnp.arange(S, dtype=jnp.int64) >> shift
+    groups = jnp.zeros(nb, jnp.int64).at[iota_s].add(
+        present.astype(jnp.int64), mode="drop")
+    return rows, groups

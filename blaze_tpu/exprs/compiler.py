@@ -1105,3 +1105,26 @@ def build_fused_closure(input_schema: T.Schema, steps):
         return tuple(outs), tuple(counts)
 
     return fused_chain
+
+
+def trace_fused_steps(input_schema: T.Schema, steps, cols, live, cap: int):
+    """Trace a SINGLE-GROUP fused chain (project/filter/rename steps — no
+    expand) over already-traced columns, for absorbing the chain into a
+    downstream kernel (the partial agg): the same step semantics as
+    build_fused_closure, but the caller owns compaction — filters only
+    narrow the live mask and rows stay in place. Returns (columns, live)
+    over the chain's output schema."""
+    schemas = fused_chain_schemas(input_schema, steps)
+    for si, st in enumerate(steps):
+        kind = st[0]
+        schema = schemas[si]
+        tb = TraceBatch(schema, cols, cap, live)
+        if kind == "project":
+            cols = ExprEvaluator(list(st[1]), schema).evaluate_traced(tb)
+        elif kind == "filter":
+            live = ExprEvaluator(list(st[1]), schema).evaluate_predicate(tb)
+        elif kind == "rename":
+            pass
+        else:
+            raise ExprError(f"fused step {kind!r} cannot be absorbed")
+    return cols, live

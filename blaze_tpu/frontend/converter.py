@@ -365,9 +365,15 @@ class SparkPlanConverter:
             rid = (t.field("resultId") or {}).get("id")
             if rid is not None and mode in final_modes:
                 out_scope[rid] = rname
-        plan = N.Agg(child, exec_mode, groupings, aggs)
         partial_stage = any(a.mode in (E.AggMode.PARTIAL, E.AggMode.PARTIAL_MERGE)
                             for a in aggs)
+        # partial hash-agg stages may adaptively skip aggregation when the
+        # observed per-bucket cardinality says partials are not reducing
+        # (reference: Spark sets this from its own partial-agg heuristics)
+        skippable = (exec_mode == E.AggExecMode.HASH_AGG and bool(aggs) and
+                     all(a.mode == E.AggMode.PARTIAL for a in aggs))
+        plan = N.Agg(child, exec_mode, groupings, aggs,
+                     supports_partial_skipping=skippable)
         rtrees = decode_field_trees(node.field("resultExpressions"))
         if rtrees and not partial_stage:
             # final stage: resultExpressions is a real projection over

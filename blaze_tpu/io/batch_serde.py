@@ -35,8 +35,100 @@ from blaze_tpu.ir.serde import schema_from_json, schema_to_json
 
 _MAGIC = b"BTB1"
 
+_TM_CODES = None
 
-def serialize_batch(batch, transpose: Optional[bool] = None) -> bytes:
+
+def _codes_counter():
+    global _TM_CODES
+    if _TM_CODES is None:
+        from blaze_tpu.obs.telemetry import get_registry
+
+        _TM_CODES = get_registry().counter(
+            "blaze_agg_codes_shuffle_bytes",
+            "bytes shipped as dictionary codes instead of decoded values")
+    return _TM_CODES
+
+
+def dict_identity(dictionary: pa.Array) -> tuple:
+    """Stable identity of a dictionary's backing MEMORY. ``take``/``slice``
+    of a dictionary column produce fresh python wrappers around the same
+    dictionary buffers, so ``id()`` misses exactly where sharing matters
+    (per-partition sub-batches of one bucketized batch); buffer addresses
+    don't. Safe only while a reference to some wrapper is held (the
+    registry entry holds one), which pins the buffers against reuse."""
+    return tuple(
+        (b.address, b.size) for b in dictionary.buffers() if b is not None
+    ) + (len(dictionary), str(dictionary.type))
+
+
+class DictEncodeContext:
+    """Per-stream dictionary ref registry (code-carrying shuffle).
+
+    Dictionary-encoded host columns serialize as their CODES in the main
+    IPC block plus a stream-scoped dictionary reference: the first frame
+    using a dictionary carries it (once), later frames of the same stream
+    reference it by number. The registry is keyed by the dictionary's
+    backing-buffer identity — the agg table's partial emission shares one
+    dictionary across all its sliced/bucketized batches, so a map task's
+    keys cross the exchange as one dictionary plus int codes per batch.
+    """
+
+    def __init__(self):
+        self.refs = {}  # dict_identity -> (dictionary, ref)
+        self.next_ref = 0
+        self.codes_bytes = 0  # bytes shipped as codes+dicts vs decoded
+
+
+class DictDecodeContext:
+    """Per-stream ref -> dictionary registry on the read side. Decoded
+    dictionaries are reused BY OBJECT across every frame that references
+    them, so the final agg table's ``_gid_of_values`` identity cache
+    translates each incoming dictionary exactly once per stream."""
+
+    def __init__(self):
+        self.refs = {}  # ref -> pa.Array
+
+
+def _maybe_dict_ref(arr, meta: dict, ctx: DictEncodeContext, new_dicts,
+                    n: int):
+    """Swap a dictionary column for (codes, ref) when profitable."""
+    if isinstance(arr, pa.ChunkedArray):
+        if arr.num_chunks != 1:
+            return arr, meta  # multi-chunk: dictionaries differ per chunk
+        arr = arr.chunk(0)
+    if not isinstance(arr, pa.DictionaryArray):
+        return arr, meta
+    d = arr.dictionary
+    if dict_identity(d) not in ctx.refs and len(d) > max(4096, 8 * n):
+        # oversized shared dictionary (e.g. a whole-file dict behind a
+        # heavily filtered batch): re-encode compactly per frame instead
+        # of shipping the big dictionary once per stream. The threshold is
+        # deliberately loose — a registered dictionary costs nothing on
+        # later frames, and an agg emission's dictionary spans all reducer
+        # frames sliced from it (len(d) ~ fan_out * n is the normal case,
+        # not a pathology) — so only a dictionary dwarfing its first frame
+        # is pruned.
+        try:
+            arr = arr.cast(arr.type.value_type).dictionary_encode()
+        except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+            pass
+        return arr, meta
+    dkey = dict_identity(d)
+    ent = ctx.refs.get(dkey)
+    if ent is not None:
+        ref = ent[1]
+    else:
+        ref = ctx.next_ref
+        ctx.next_ref += 1
+        ctx.refs[dkey] = (d, ref)  # holding d pins the buffer addresses
+        new_dicts.append((ref, d))
+    meta = dict(meta, dict_ref=ref)
+    ctx.codes_bytes += max(n, 1) * max(arr.type.index_type.bit_width // 8, 1)
+    return arr.indices, meta
+
+
+def serialize_batch(batch, transpose: Optional[bool] = None,
+                    dict_ctx: Optional[DictEncodeContext] = None) -> bytes:
     """One batch (ColumnarBatch or HostBatch) -> uncompressed payload bytes.
     A HostBatch serializes with zero device traffic (the shuffle writer pulls
     once per input batch, then routes rows host-side)."""
@@ -61,6 +153,7 @@ def serialize_batch(batch, transpose: Optional[bool] = None) -> bytes:
     cols_meta = []
     host_cols = []
     host_idx = []
+    new_dicts: List[tuple] = []  # (ref, dictionary) first seen this frame
     for i in range(len(batch.schema)):
         if pulled[i] is not None:
             data = np.ascontiguousarray(pulled[i][0])
@@ -79,8 +172,12 @@ def serialize_batch(batch, transpose: Optional[bool] = None) -> bytes:
             cols_meta.append({"kind": "dev", "transposed": bool(transpose and data.dtype.itemsize > 1)})
         else:
             host_idx.append(i)
-            host_cols.append(host_arrays[i])
-            cols_meta.append({"kind": "host"})
+            arr = host_arrays[i]
+            meta = {"kind": "host"}
+            if dict_ctx is not None:
+                arr, meta = _maybe_dict_ref(arr, meta, dict_ctx, new_dicts, n)
+            host_cols.append(arr)
+            cols_meta.append(meta)
     if host_cols:
         sink = io.BytesIO()
         arrays = [a.combine_chunks() if isinstance(a, pa.ChunkedArray) else a
@@ -97,21 +194,35 @@ def serialize_batch(batch, transpose: Optional[bool] = None) -> bytes:
         ipc_bytes = sink.getvalue()
     else:
         ipc_bytes = b""
-    header = json.dumps(
-        {"schema": schema_to_json(batch.schema), "num_rows": n, "cols": cols_meta,
-         "ipc_len": len(ipc_bytes)}
-    ).encode()
+    dict_streams: List[tuple] = []
+    for ref, d in new_dicts:
+        sink = io.BytesIO()
+        dschema = pa.schema([pa.field("d", d.type)])
+        with pa.ipc.new_stream(sink, dschema) as w:
+            w.write_batch(pa.RecordBatch.from_arrays([d], schema=dschema))
+        db = sink.getvalue()
+        dict_streams.append((ref, db))
+        dict_ctx.codes_bytes += len(db)
+    hdr = {"schema": schema_to_json(batch.schema), "num_rows": n,
+           "cols": cols_meta, "ipc_len": len(ipc_bytes)}
+    if dict_streams:
+        hdr["dicts"] = [{"ref": r, "len": len(b)} for r, b in dict_streams]
+    header = json.dumps(hdr).encode()
     out = io.BytesIO()
     out.write(struct.pack("<I", len(header)))
     out.write(header)
     out.write(ipc_bytes)
+    for _r, b in dict_streams:
+        out.write(b)
     for b in buffers:
         out.write(struct.pack("<Q", len(b)))
         out.write(b)
     return out.getvalue()
 
 
-def deserialize_batch(payload: bytes) -> ColumnarBatch:
+def deserialize_batch(payload: bytes,
+                      dict_ctx: Optional[DictDecodeContext] = None
+                      ) -> ColumnarBatch:
     cfg = get_config()
     buf = memoryview(payload)
     (hlen,) = struct.unpack_from("<I", buf, 0)
@@ -127,6 +238,14 @@ def deserialize_batch(payload: bytes) -> ColumnarBatch:
         rb = reader.read_next_batch()
         host_arrays = list(rb.columns)  # positional, matches "host" meta order
     pos += ipc_len
+    dict_refs = dict_ctx.refs if dict_ctx is not None else {}
+    for dm in header.get("dicts", ()):
+        dbuf = pa.py_buffer(bytes(buf[pos : pos + dm["len"]]))
+        pos += dm["len"]
+        darr = pa.ipc.open_stream(dbuf).read_next_batch().column(0)
+        if isinstance(darr, pa.ChunkedArray):
+            darr = darr.combine_chunks()
+        dict_refs[dm["ref"]] = darr
 
     def read_buf():
         nonlocal pos
@@ -160,16 +279,33 @@ def deserialize_batch(payload: bytes) -> ColumnarBatch:
             dev_items.append((f.dtype, data, validity))
             dev_slots.append(i)
         else:
-            cols[i] = HostColumn(f.dtype, host_arrays[next_host])
+            arr = host_arrays[next_host]
             next_host += 1
+            ref = meta.get("dict_ref")
+            if ref is not None:
+                d = dict_refs.get(ref)
+                if d is None:
+                    raise RuntimeError(
+                        f"frame references dictionary {ref} but no decode "
+                        "context carries it (out-of-order decode?)")
+                if isinstance(arr, pa.ChunkedArray):
+                    arr = arr.combine_chunks()
+                arr = pa.DictionaryArray.from_arrays(arr, d)
+            cols[i] = HostColumn(f.dtype, arr)
     # all device planes of the batch ride one batched device_put
     for slot, col in zip(dev_slots, device_columns(dev_items, cap)):
         cols[slot] = col
     return ColumnarBatch(schema, cols, n)
 
 
-_FRAME_FMT = "<4sIQQ"  # magic, flags (0=raw, 1=zstd, 2=lz4, 3=zlib), compressed len, raw len
+_FRAME_FMT = "<4sIQQ"  # magic, flags, compressed len, raw len
 _FRAME_LEN = struct.calcsize(_FRAME_FMT)
+# flags: low nibble = codec (0=raw, 1=zstd, 2=lz4, 3=zlib); bit 0x10 marks
+# a frame that DEFINES a new stream dictionary — readers with a decode
+# worker pool must decode such frames in stream order (inline) so the
+# dictionary is registered before any pooled frame references it
+FRAME_DICT_DEF = 0x10
+_CODEC_MASK = 0x0F
 
 
 def _lz4_compress(payload: bytes):
@@ -279,15 +415,23 @@ class BatchWriter:
     the native library when built (native/src/blaze_native.cc), else via the
     python zstandard binding."""
 
-    def __init__(self, fileobj: BinaryIO, codec: Optional[str] = None):
+    def __init__(self, fileobj: BinaryIO, codec: Optional[str] = None,
+                 dict_refs: bool = False):
         cfg = get_config()
         self.f = fileobj
         self.codec = codec or cfg.shuffle_compression_codec
         self.level = cfg.zstd_level
         self.bytes_written = 0
+        self.dict_ctx = DictEncodeContext() if dict_refs else None
+
+    @property
+    def codes_bytes(self) -> int:
+        return self.dict_ctx.codes_bytes if self.dict_ctx is not None else 0
 
     def write_batch(self, batch: ColumnarBatch):
-        payload = serialize_batch(batch)
+        refs_before = self.dict_ctx.next_ref if self.dict_ctx else 0
+        codes_before = self.codes_bytes
+        payload = serialize_batch(batch, dict_ctx=self.dict_ctx)
         raw_len = len(payload)
         flags = 0
         if self.codec == "lz4":
@@ -298,6 +442,10 @@ class BatchWriter:
                 payload, flags = self._zstd_or_zlib(payload)
         elif self.codec != "none":
             payload, flags = self._zstd_or_zlib(payload)
+        if self.dict_ctx is not None and self.dict_ctx.next_ref > refs_before:
+            flags |= FRAME_DICT_DEF
+        if self.codes_bytes > codes_before:
+            _codes_counter().inc(self.codes_bytes - codes_before)
         frame = struct.pack(_FRAME_FMT, _MAGIC, flags, len(payload), raw_len)
         self.f.write(frame)
         self.f.write(payload)
@@ -328,23 +476,29 @@ def read_frames(fileobj) -> Iterator[tuple]:
         yield flags, fileobj.read(plen), raw_len
 
 
-def decode_frame(flags: int, payload: bytes, raw_len: int) -> ColumnarBatch:
-    """Decompress + deserialize one frame (thread-safe)."""
-    if flags == 2:
+def decode_frame(flags: int, payload: bytes, raw_len: int,
+                 dict_ctx: Optional[DictDecodeContext] = None
+                 ) -> ColumnarBatch:
+    """Decompress + deserialize one frame (thread-safe for frames without
+    the FRAME_DICT_DEF flag; dict-defining frames mutate dict_ctx and must
+    decode in stream order)."""
+    codec = flags & _CODEC_MASK
+    if codec == 2:
         payload = _lz4_decompress(payload, raw_len)
-    elif flags == 1:
+    elif codec == 1:
         payload = _zstd_decompress(payload, raw_len)
-    elif flags == 3:
+    elif codec == 3:
         import zlib
 
         payload = zlib.decompress(payload)
-    return deserialize_batch(payload)
+    return deserialize_batch(payload, dict_ctx=dict_ctx)
 
 
 class BatchReader:
     def __init__(self, fileobj: BinaryIO):
         self.f = fileobj
+        self.dict_ctx = DictDecodeContext()
 
     def __iter__(self) -> Iterator[ColumnarBatch]:
         for flags, payload, raw_len in read_frames(self.f):
-            yield decode_frame(flags, payload, raw_len)
+            yield decode_frame(flags, payload, raw_len, self.dict_ctx)

@@ -34,6 +34,23 @@ from blaze_tpu.runtime.memmgr import MemConsumer, SpillFile
 
 _KEY_COL = "#aggkey"
 
+_TM_REINTERN = None
+
+
+def _reintern_counter():
+    """Registry counter for rows whose var-width keys arrived at a merge
+    table DECODED (no dictionary) and had to be re-encoded per batch — the
+    exact cost the code-carrying shuffle exists to remove. Healthy value
+    with ``codes_shuffle`` on: 0."""
+    global _TM_REINTERN
+    if _TM_REINTERN is None:
+        from blaze_tpu.obs.telemetry import get_registry
+
+        _TM_REINTERN = get_registry().counter(
+            "blaze_agg_reintern_rows",
+            "rows re-interned from decoded var-width keys at a merge table")
+    return _TM_REINTERN
+
 
 class AggExec(Operator):
     def __init__(self, child: Operator, exec_mode: E.AggExecMode,
@@ -202,13 +219,42 @@ class AggExec(Operator):
                 source = child_op.children[0]
                 fused_preds = child_op.predicates
                 src_metrics = src_metrics.child(0)
+            # a whole-stage-fused chain directly below the (possibly
+            # peeled) filter folds UPWARD into the agg kernel: the scan's
+            # project/filter/rename steps trace into the same jitted
+            # computation as the partial agg, so scan→project→filter→
+            # partial-agg is ONE device call per batch with no
+            # materialized intermediate
+            from blaze_tpu.ops.fused import FusedStageExec, _FusedSegment
+            from blaze_tpu.utils.device import is_device_dtype as _isdev2
+
+            fused_steps = None
+            fused_in_schema = None
+            if fuse_ok and isinstance(source, FusedStageExec) and \
+                    len(source.pipeline) == 1 and \
+                    isinstance(source.pipeline[0], _FusedSegment):
+                seg = source.pipeline[0]
+                if all(st[0] in ("project", "filter", "rename")
+                       for st in seg.steps) and \
+                        all(_isdev2(f.dtype)
+                            for f in seg.in_schema.fields):
+                    fused_steps = seg.steps
+                    fused_in_schema = seg.in_schema
+                    # record the stage's own metrics from this side —
+                    # its _execute never runs once absorbed
+                    metrics.add("fused_stages", 1)
+                    metrics.add("fused_ops", len(source.node.ops))
+                    source = source.children[0]
+                    src_metrics = src_metrics.child(0)
             # unique-single-key inner BroadcastJoins directly under the
             # (possibly peeled) filter fuse too — CHAINED: a star query's
             # stacked dim joins all trace into the one agg kernel, probing
-            # dim tables inline without materializing any joined rows
+            # dim tables inline without materializing any joined rows.
+            # (not combined with an absorbed step chain: joins below the
+            # chain would probe pre-projection rows)
             fused_joins = []
             join_src = None
-            while fuse_ok:
+            while fuse_ok and fused_steps is None:
                 spec, loaded_bmap = self._try_fuse_join(
                     source, partition, ctx, src_metrics)
                 if spec is None:
@@ -229,7 +275,10 @@ class AggExec(Operator):
                 self, child_schema, fused_predicates=fused_preds,
                 conf=ctx.conf,
                 # peeled outer-first; the kernel chains inner-first
-                fused_join=list(reversed(fused_joins)))
+                fused_join=list(reversed(fused_joins)),
+                fused_steps=fused_steps,
+                fused_input_schema=fused_in_schema,
+                metrics=metrics)
             if join_src is not None:
                 src_iter = join_src
             else:
@@ -245,18 +294,43 @@ class AggExec(Operator):
             # stops (and batches flow through) once it exceeds the merge
             # budget or cardinality stays near-unique (partial-skipping
             # philosophy — merging near-unique partials is wasted work).
+            # adaptive partial skipping on the device path: the radix
+            # partial pass reports a per-bucket (rows, groups) histogram
+            # per batch; once the bucket-summed cardinality estimate says
+            # partials are not reducing, remaining batches route through
+            # the passthrough kernel (singleton groups, no dedup/sort).
+            # passthrough has no trace support, so fused preds/joins/steps
+            # keep the skipper off — the work they saved already paid.
+            skipper = _PartialSkipper(self, ctx) if (
+                self.supports_partial_skipping
+                and self.is_partial_output
+                and ctx.conf.partial_agg_skipping_enable
+                and not agger._needs_trace()
+            ) else None
             staged: List[ColumnarBatch] = []
             staged_bytes = 0
             staged_rows = 0
             input_rows = 0
             gave_up = False
+            skipping = False
             for batch in src_iter:
                 input_rows += batch.num_rows
+                if skipping:
+                    out = agger.passthrough(batch)
+                    metrics.add("partial_skipped_batches", 1)
+                    if out is not None and out.num_rows:
+                        yield out
+                    continue
                 # self-time lands in elapsed_compute_time_ns via Operator.execute
                 out = agger.process(batch)
+                if skipper is not None:
+                    if agger.last_bucket_stats is not None:
+                        skipper.observe_buckets(*agger.last_bucket_stats)
+                    if skipper.should_skip():
+                        skipping = True
                 if out is None or not out.num_rows:
                     continue
-                if gave_up:
+                if gave_up or skipping:
                     yield out
                     continue
                 staged.append(out)
@@ -274,7 +348,9 @@ class AggExec(Operator):
                                                       supports_device_merge)
 
                 if supports_device_merge(merge_op, self.schema):
-                    staged = DeviceMergeAgger(merge_op, self.schema).run(staged)
+                    staged = DeviceMergeAgger(
+                        merge_op, self.schema, conf=ctx.conf,
+                        metrics=metrics).run(staged)
                     metrics.add("partials_consolidated", 1)
             for o in staged:
                 if o.num_rows:
@@ -300,7 +376,8 @@ class AggExec(Operator):
                         too_big = True
                         break
                 if not too_big:
-                    agger = DeviceMergeAgger(self, child_schema)
+                    agger = DeviceMergeAgger(self, child_schema,
+                                             conf=ctx.conf, metrics=metrics)
                     outs = agger.run(staged)
                     metrics.add("device_merge_batches", len(staged))
                     for out in outs:
@@ -429,12 +506,38 @@ def _partial_arg_schema(a: E.AggExpr, child_schema: T.Schema, pos: int):
 
 
 class _PartialSkipper:
+    """Adaptive partial-skipping decision (reference: agg_table.rs).
+
+    Two signal sources, best available wins:
+
+    - Radix bucket stats (device path): the radix partial pass emits a
+      per-bucket (rows, groups) histogram for every batch. Summing
+      ``min(groups, rows)`` per bucket across batches approximates the
+      rows a per-batch partial would EMIT — exactly the quantity the
+      skip decision trades against streaming rows through untouched. A
+      whole-table ratio hides skew: one hot bucket with heavy
+      duplication reads as "high cardinality" when averaged against a
+      long tail of near-unique buckets, and vice versa.
+    - Whole-table ratio (host table path, or device path before any
+      radix batch ran): ``num_slots / rows_processed``, the legacy
+      signal.
+    """
+
     def __init__(self, op: AggExec, ctx: ExecContext):
         self.min_rows = ctx.conf.partial_agg_skipping_min_rows
         self.ratio = ctx.conf.partial_agg_skipping_ratio
+        self._rows = 0  # rows observed via bucket histograms
+        self._est = 0   # estimated rows a per-batch partial would emit
 
-    def should_skip(self, table: "AggTable") -> bool:
-        if table.rows_processed < self.min_rows:
+    def observe_buckets(self, bucket_rows, bucket_groups) -> None:
+        """Accumulate one batch's per-bucket (rows, groups) histogram."""
+        self._rows += int(bucket_rows.sum())
+        self._est += int(np.minimum(bucket_groups, bucket_rows).sum())
+
+    def should_skip(self, table: Optional["AggTable"] = None) -> bool:
+        if self._rows >= self.min_rows:
+            return self._est / max(self._rows, 1) > self.ratio
+        if table is None or table.rows_processed < self.min_rows:
             return False
         return table.num_slots / max(table.rows_processed, 1) > self.ratio
 
@@ -621,6 +724,17 @@ class AggTable(MemConsumer):
         was_dict = pa.types.is_dictionary(arr.type)
         try:
             if not was_dict:
+                if self.op.input_is_partial and (
+                        pa.types.is_string(arr.type)
+                        or pa.types.is_large_string(arr.type)
+                        or pa.types.is_binary(arr.type)
+                        or pa.types.is_large_binary(arr.type)):
+                    # tripwire: decoded VAR-WIDTH keys crossing the exchange
+                    # mean the code-carrying shuffle got bypassed somewhere
+                    # upstream (fixed-width keys routed through this plane
+                    # are fine — they carry no dictionary to lose)
+                    self.metrics.add("agg_reintern_rows", n)
+                    _reintern_counter().inc(n)
                 arr = arr.dictionary_encode()
             # cache only REUSED dictionaries (pre-encoded file/IPC dicts);
             # self-encoded ones are seen exactly once and caching them
@@ -646,11 +760,19 @@ class AggTable(MemConsumer):
 
     def _gid_of_values(self, dictionary, cache: bool = True) -> np.ndarray:
         """Table-stable int64 id per dictionary VALUE (None -> -1); reused
-        dictionaries translate once (cached by identity), so repeated
-        batches over one file dictionary cost a single gather."""
+        dictionaries translate once (cached by backing-buffer identity:
+        deserialized shuffle frames and file readers hand out fresh python
+        wrappers around ONE shared dictionary, so an ``id()`` key would
+        miss every batch). Repeated batches over one shuffle-stream or
+        file dictionary cost a single gather — the code-carrying
+        exchange's "translate once per (map, dict) pair"."""
+        dkey = None
         if cache:
-            ent = self._dict_gid_cache.get(id(dictionary))
-            if ent is not None and ent[0] is dictionary:
+            from blaze_tpu.io.batch_serde import dict_identity
+
+            dkey = dict_identity(dictionary)
+            ent = self._dict_gid_cache.get(dkey)
+            if ent is not None:
                 return ent[1]
         vals = dictionary.to_pylist()
         gids = np.empty(len(vals), np.int64)
@@ -670,7 +792,8 @@ class AggTable(MemConsumer):
                     v, (str, bytes)) else 16
             gids[i] = g
         if cache:
-            self._dict_gid_cache[id(dictionary)] = (dictionary, gids)
+            # holding the dictionary pins its buffer addresses for the key
+            self._dict_gid_cache[dkey] = (dictionary, gids)
         return gids
 
     def _intern_keys_pyloop(self, cols: List[Column], n: int) -> np.ndarray:
@@ -815,7 +938,8 @@ class AggTable(MemConsumer):
 
     # -- output ---------------------------------------------------------------
 
-    def _key_columns(self, order: Optional[np.ndarray]) -> List[Column]:
+    def _key_columns(self, order: Optional[np.ndarray],
+                     dict_encode: bool = False) -> List[Column]:
         cols = []
         schema = self.op.schema
         for ci in range(len(self.op.groupings)):
@@ -823,7 +947,20 @@ class AggTable(MemConsumer):
             if order is not None:
                 vals = [vals[i] for i in order]
             dt = schema[ci].dtype
-            cols.append(HostColumn(dt, pa.array(vals, type=T.to_arrow_type(dt))))
+            at = T.to_arrow_type(dt)
+            arr = pa.array(vals, type=at)
+            if dict_encode and (pa.types.is_string(at) or
+                                pa.types.is_large_string(at) or
+                                pa.types.is_binary(at) or
+                                pa.types.is_large_binary(at)):
+                # code-carrying shuffle: shuffle-bound partial output keeps
+                # var-width keys dictionary-encoded. All batches sliced off
+                # this emission share ONE dictionary object, so the writer
+                # serializes it once per stream and the FINAL table
+                # translates it once (_gid_of_values identity cache) —
+                # per-batch re-interning of decoded values disappears
+                arr = arr.dictionary_encode()
+            cols.append(HostColumn(dt, arr))
         return cols
 
     def _partial_batches(self, sort_by_key: bool, include_key: bool
@@ -842,7 +979,10 @@ class AggTable(MemConsumer):
         if sort_by_key:
             order = np.argsort(np.array(self.slot_keys, dtype=object), kind="stable")
             order = np.asarray(order, dtype=np.int64)
-        key_cols = self._key_columns(order)
+        key_cols = self._key_columns(
+            order,
+            dict_encode=(partial and not include_key
+                         and self.ctx.conf.codes_shuffle))
         agg_cols: List[Column] = []
         for a, fn, st in zip(self.op.aggs, self.fns, self.states):
             if partial:

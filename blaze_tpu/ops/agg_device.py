@@ -26,11 +26,26 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from blaze_tpu.core import kernels as K
 from blaze_tpu.core.batch import ColumnarBatch, DeviceColumn
 from blaze_tpu.exprs.compiler import ExprEvaluator, _broadcast
 from blaze_tpu.ir import exprs as E
 from blaze_tpu.ir import types as T
 from blaze_tpu.utils.device import is_device_dtype
+
+_TM_RADIX = None
+
+
+def _radix_counter():
+    # lazy: registry import stays off the module-import path
+    global _TM_RADIX
+    if _TM_RADIX is None:
+        from blaze_tpu.obs.telemetry import get_registry
+
+        _TM_RADIX = get_registry().counter(
+            "blaze_agg_radix_buckets_total",
+            "radix buckets scanned by partitioned agg kernel passes")
+    return _TM_RADIX
 
 _DEVICE_AGG_FNS = (E.AggFunction.SUM, E.AggFunction.COUNT, E.AggFunction.AVG,
                    E.AggFunction.MIN, E.AggFunction.MAX)
@@ -333,7 +348,8 @@ class DevicePartialAgger:
     of a compaction round trip plus the kernel."""
 
     def __init__(self, op, child_schema: T.Schema, fused_predicates=None,
-                 conf=None, fused_join=None):
+                 conf=None, fused_join=None, fused_steps=None,
+                 fused_input_schema=None, metrics=None):
         from blaze_tpu.config import get_config
 
         self.op = op
@@ -348,12 +364,25 @@ class DevicePartialAgger:
             self.fused_joins = [fused_join]
         else:
             self.fused_joins = list(fused_join)
+        # an absorbed upstream fused-stage chain (project/filter/rename
+        # steps): batches arrive with fused_input_schema and the steps are
+        # traced INTO the kernel ahead of the predicates, so
+        # scan->project->filter->partial-agg is one jitted computation
+        self.fused_steps = tuple(fused_steps) if fused_steps else ()
+        self.input_schema = fused_input_schema if self.fused_steps \
+            else child_schema
+        self.metrics = metrics
         self.conf = conf or get_config()
         self._fused_cache = {}
-        # dense-bucket path state: None = eligibility undecided; False =
-        # ineligible/disabled; (bases, sizes, out_cap) = active plan
+        # dense/radix bucket path state: _dense_ok/_radix_ok None =
+        # eligibility undecided, False = ineligible/disabled; _bucket_state
+        # is the active plan ("dense"|"radix", bases, sizes, out_cap)
         self._dense_ok = None
-        self._dense_state = None
+        self._radix_ok = None
+        self._bucket_state = None
+        # per-radix-pass (rows, groups) numpy histograms, consumed by the
+        # partial-skipping heuristic between process() calls
+        self.last_bucket_stats = None
         self.group_ev = ExprEvaluator([e for _, e in op.groupings], child_schema)
         self.agg_evs = [
             ExprEvaluator(list(a.agg.args), child_schema) if a.agg.args else None
@@ -506,12 +535,25 @@ class DevicePartialAgger:
                                           pflat if tb is None else tb)
                 mask = hit if mask is None else (mask & hit)
         else:
-            schema = self.child_schema
+            schema = self.input_schema
             cols, _ = _rebuild_cols(schema, flat)
             tb = ColumnarBatch(schema, cols, num_rows)
             # inline, NOT tb.row_exists_mask(): that helper caches in a
             # module lru_cache a traced call would poison
             mask = jnp.arange(tb.capacity, dtype=jnp.int64) < num_rows
+        if self.fused_steps:
+            # absorbed upstream chain: project/filter/rename steps trace
+            # over the chain's input schema, narrowing the live mask in
+            # place (no mid-chain compaction — same discipline as
+            # build_fused_closure); the result batch carries the agg's
+            # child schema
+            from blaze_tpu.exprs.compiler import trace_fused_steps
+
+            cols, mask = trace_fused_steps(self.input_schema,
+                                           self.fused_steps,
+                                           list(tb.columns), mask,
+                                           tb.capacity)
+            tb = ColumnarBatch(self.child_schema, cols, num_rows)
         if self.fused_predicates:
             # fresh evaluator per trace: its CSE cache must hold tracers
             # of THIS trace only
@@ -564,12 +606,23 @@ class DevicePartialAgger:
         self._fused_cache[cap_key] = fn
         return fn
 
+    def _needs_trace(self) -> bool:
+        """Does per-batch processing go through the jitted fused kernel
+        (joins, predicates, or an absorbed step chain traced in)?"""
+        return (self.fused_predicates is not None or bool(self.fused_joins)
+                or bool(self.fused_steps))
+
     def _structural_key(self) -> str:
         if getattr(self, "_skey", None) is None:
             from blaze_tpu.ir.serde import expr_to_json
 
             parts = [expr_to_json(p) for p in (self.fused_predicates or ())]
             parts += [s.structural_key() for s in self.fused_joins]
+            if self.fused_steps:
+                from blaze_tpu.ir.fusion import fused_fingerprint
+
+                parts.append("steps:" + fused_fingerprint(
+                    self.input_schema, self.fused_steps))
             parts += [f"{n}:{expr_to_json(e)}" for n, e in self.op.groupings]
             parts += [f"{a.name}:{a.mode.value}:{expr_to_json(a.agg)}"
                       for a in self.op.aggs]
@@ -581,6 +634,13 @@ class DevicePartialAgger:
     def _flat(self, batch: ColumnarBatch):
         return _flatten_cols(batch)
 
+    def _int_keys(self) -> bool:
+        for _, e in self.op.groupings:
+            ndt = E.infer_type(e, self.child_schema).np_dtype
+            if ndt is None or not np.issubdtype(np.dtype(ndt), np.integer):
+                return False
+        return True
+
     def _dense_enabled(self) -> bool:
         """Integer-keyed partial aggs may use the dense-bucket kernel; auto
         mode gates on the CPU backend (the range probe costs one extra sync
@@ -591,14 +651,21 @@ class DevicePartialAgger:
                 from blaze_tpu.runtime import placement
 
                 da = placement.backend_is_cpu_hint()
-            ok = bool(da)
-            for _, e in self.op.groupings:
-                ndt = E.infer_type(e, self.child_schema).np_dtype
-                if ndt is None or not np.issubdtype(np.dtype(ndt), np.integer):
-                    ok = False
-                    break
-            self._dense_ok = ok
+            self._dense_ok = bool(da) and self._int_keys()
         return self._dense_ok
+
+    def _radix_enabled(self) -> bool:
+        """Radix-partitioned kernel eligibility: the dense path's
+        high-cardinality extension, same key/backend gates, bounded by
+        radix_agg_max_slots instead of dense_agg_max_buckets."""
+        if self._radix_ok is None:
+            ra = self.conf.radix_agg
+            if ra is None:
+                from blaze_tpu.runtime import placement
+
+                ra = placement.backend_is_cpu_hint()
+            self._radix_ok = bool(ra) and self._int_keys()
+        return self._radix_ok
 
     def _probe_eager(self, batch: ColumnarBatch):
         """Range probe for the unfused path: evaluates keys eagerly (the
@@ -652,66 +719,58 @@ class DevicePartialAgger:
             _FUSED_KERNELS[key] = fn
         return fn
 
-    def _plan_dense(self, probe: np.ndarray, capacity: int, prev):
-        """(bases, sizes, out_cap) from probed key ranges, unioned with the
-        previous plan on overflow so re-bucketed batches keep fitting. Sizes
-        round to powers of two to bound kernel recompiles. None when the
-        bucket table would exceed the configured cap."""
-        bases, sizes, S = [], [], 1
-        for i, (anyv, kmin, kmax) in enumerate(probe):
-            if not anyv:
-                if prev is not None:
-                    # no valid keys observed: keep the previous anchor
-                    # rather than dragging the union toward [0, 0]
-                    lo = int(prev[0][i])
-                    hi = lo + prev[1][i] - 2
-                else:
-                    # No valid keys and nothing to anchor to: planning now
-                    # would pin an artificial [0, 0] anchor that a later
-                    # overflow unions with the real key range, potentially
-                    # blowing past the bucket cap and disabling the dense
-                    # path for the whole stream. Defer so the next batch
-                    # re-probes with real keys.
-                    return _DEFER_PLAN
-            else:
-                lo, hi = int(kmin), int(kmax)
-                if prev is not None:
-                    plo = int(prev[0][i])
-                    phi = plo + prev[1][i] - 2
-                    lo, hi = min(lo, plo), max(hi, phi)
-            size = 2
-            while size < hi - lo + 2:
-                size <<= 1
-            bases.append(lo)
-            sizes.append(size)
-            S *= size
-        if S > min(self.conf.dense_agg_max_buckets, capacity):
-            return None
-        out_cap = self.conf.capacity_for(min(S, capacity))
-        return tuple(bases), tuple(sizes), out_cap
+    def _plan_table(self, probe: np.ndarray, capacity: int, prev,
+                    max_slots: int):
+        return _plan_slot_table(probe, capacity, prev, max_slots, self.conf)
 
-    def _dense_call(self, batch: ColumnarBatch, bases, sizes, out_cap):
+    def _plan_bucketed(self, probe: np.ndarray, capacity: int, prev):
+        """Pick the scatter-table plan for this stream: dense when the key
+        space fits the small-table cap, else radix-partitioned up to
+        radix_agg_max_slots. Returns ("dense"|"radix", bases, sizes,
+        out_cap), _DEFER_PLAN, or None (sort fallback)."""
+        if self._dense_enabled():
+            st = self._plan_table(
+                probe, capacity, prev,
+                min(self.conf.dense_agg_max_buckets, capacity))
+            if st is _DEFER_PLAN:
+                return _DEFER_PLAN
+            if st is not None:
+                return ("dense",) + st
+        if self._radix_enabled():
+            st = self._plan_table(probe, capacity, prev,
+                                  self.conf.radix_agg_max_slots)
+            if st is _DEFER_PLAN:
+                return _DEFER_PLAN
+            if st is not None:
+                return ("radix",) + st
+        return None
+
+    def _dense_call(self, batch: ColumnarBatch, bases, sizes, out_cap,
+                    nbuck: int = 0):
         bases_arr = jnp.asarray(np.asarray(bases, np.int64))
-        if self.fused_predicates is not None or self.fused_joins:
+        if self._needs_trace():
             cap_key = self._cap_key(batch)
-            key = ("dense", self._structural_key(), cap_key, sizes, out_cap)
+            key = ("dense", self._structural_key(), cap_key, sizes, out_cap,
+                   nbuck)
             fn = _FUSED_KERNELS.get(key)
             if fn is None:
                 agger = self._trace_clone()
 
                 def fused(num_rows, b, *flat):
                     tb, mask = agger._trace_tb_mask(num_rows, flat)
-                    return agger._flow_dense(tb, mask, b, sizes, out_cap)
+                    return agger._flow_dense(tb, mask, b, sizes, out_cap,
+                                             nbuck)
 
                 fn = jax.jit(fused)
                 _FUSED_KERNELS[key] = fn
             return fn(jnp.int64(batch.num_rows), bases_arr,
                       *self._jit_flat(batch))
         return self._flow_dense(batch, batch.row_exists_mask(), bases_arr,
-                                sizes, out_cap)
+                                sizes, out_cap, nbuck)
 
-    def _flow_dense(self, batch: ColumnarBatch, exists, bases, sizes, out_cap):
-        """_flow twin routing to the dense-bucket kernel."""
+    def _flow_dense(self, batch: ColumnarBatch, exists, bases, sizes,
+                    out_cap, nbuck: int = 0):
+        """_flow twin routing to the dense/radix bucket kernel."""
         self.group_ev._reset_cse(batch)
         for ev in self.agg_evs:
             if ev is not None:
@@ -728,7 +787,7 @@ class DevicePartialAgger:
             tuple(str(d.dtype) for d in key_data), tuple(self.specs),
             tuple("wide3" if isinstance(a[0], tuple) else str(a[0].dtype)
                   for a in args), batch.capacity,
-            sizes, out_cap)
+            sizes, out_cap, nbuck)
         flat = []
         for d, v in zip(key_data, key_valid):
             flat += [d, v]
@@ -737,40 +796,66 @@ class DevicePartialAgger:
         return kernel(exists, bases, *flat)
 
     def _try_dense(self, batch: ColumnarBatch):
-        """Dense-path orchestration: probe on first use, run the specialized
-        kernel, re-probe + widen once on range overflow. Returns (outs,
-        num_groups) or None to fall back to the sort kernel."""
-        if not self._dense_enabled():
+        """Dense/radix-path orchestration: probe on first use, run the
+        specialized scatter kernel, re-probe + widen once on range overflow.
+        Returns (outs, num_groups) or None to fall back to the sort
+        kernel. Radix passes additionally publish the per-bucket (rows,
+        groups) histogram through ``last_bucket_stats``."""
+        self.last_bucket_stats = None
+        if not (self._dense_enabled() or self._radix_enabled()):
             return None
-        st = self._dense_state
+        st = self._bucket_state
         prev = None
         for _ in range(2):
             if st is None:
-                if self.fused_predicates is not None or self.fused_joins:
+                if self._needs_trace():
                     pr = np.asarray(self._probe_fn(batch)(
                         jnp.int64(batch.num_rows), *self._jit_flat(batch)))
                 else:
                     pr = np.asarray(self._probe_eager(batch))
-                st = self._plan_dense(pr, batch.capacity, prev)
+                st = self._plan_bucketed(pr, batch.capacity, prev)
                 if st is _DEFER_PLAN:
                     # no valid keys in this batch to anchor a plan: sort
                     # fallback for this batch, re-probe on the next one
-                    self._dense_state = None
+                    self._bucket_state = None
                     return None
                 if st is None:
-                    # observed range too wide for the table cap: stop
+                    # observed range too wide for even the radix cap: stop
                     # probing for the rest of this stream
                     self._dense_ok = False
-                    self._dense_state = None
+                    self._radix_ok = False
+                    self._bucket_state = None
                     return None
-                self._dense_state = st
-            outs = self._dense_call(batch, *st)
+                self._bucket_state = st
+            table, bases, sizes, out_cap = st
+            nbuck = self.conf.radix_agg_buckets if table == "radix" else 0
+            outs = self._dense_call(batch, bases, sizes, out_cap, nbuck)
             num_groups = int(outs[0])  # sync; -1 flags range overflow
             if num_groups >= 0:
+                if nbuck:
+                    self._note_radix(outs, sizes, nbuck)
+                    outs = outs[:-2]
                 return outs, num_groups
-            prev, st = st, None
-        self._dense_state = None
+            prev, st = (bases, sizes), None
+        self._bucket_state = None
         return None
+
+    def _note_radix(self, outs, sizes, nbuck: int):
+        """Publish one radix pass's bucket histogram: skipper input,
+        tripwire counter, and (trace-gated) the Perfetto skew view."""
+        rows = np.asarray(outs[-2])
+        groups = np.asarray(outs[-1])
+        self.last_bucket_stats = (rows, groups)
+        if self.metrics is not None:
+            self.metrics.add("agg_radix_buckets", len(rows))
+        _radix_counter().inc(len(rows))
+        from blaze_tpu.obs.tracer import TRACER
+
+        if TRACER.active:
+            TRACER.instant(
+                "radix_bucket_histogram", "agg",
+                args={"buckets": len(rows), "sizes": list(sizes),
+                      "rows": rows.tolist(), "groups": groups.tolist()})
 
     def process(self, batch: ColumnarBatch) -> Optional[ColumnarBatch]:
         import time as _time
@@ -801,12 +886,37 @@ class DevicePartialAgger:
             if num_groups == 0:
                 return None
             return self._assemble(outs, num_groups)
+        if self.fused_steps and not self._steps_eligible(batch):
+            # non-flattenable chain input: run the absorbed steps for real
+            # (the fused stage's eager fallback), then the eager agg flow
+            from blaze_tpu.ops.fused import eager_steps
+
+            parts = []
+            for sb in eager_steps(self.fused_steps, self.input_schema,
+                                  batch):
+                if sb.num_rows == 0:
+                    continue
+                t0 = _time.perf_counter()
+                exists = sb.row_exists_mask()
+                if self.fused_predicates:
+                    exists = exists & ExprEvaluator(
+                        list(self.fused_predicates),
+                        self.child_schema).evaluate_predicate(sb)
+                outs = self._flow(sb, exists)
+                num_groups = int(outs[0])
+                DEVICE_STATS.add_kernel(_time.perf_counter() - t0)
+                if num_groups:
+                    parts.append(self._assemble(outs, num_groups))
+            if not parts:
+                return None
+            return parts[0] if len(parts) == 1 else \
+                ColumnarBatch.concat(parts, self.op.schema)
         t0 = _time.perf_counter()
         dense = self._try_dense(batch)
         if dense is not None:
             outs, num_groups = dense
         else:
-            if self.fused_predicates is not None or self.fused_joins:
+            if self._needs_trace():
                 outs = self._fused_fn(batch)(jnp.int64(n),
                                              *self._jit_flat(batch))
             else:
@@ -816,6 +926,53 @@ class DevicePartialAgger:
         if num_groups == 0:
             return None
         return self._assemble(outs, num_groups)
+
+    def _steps_eligible(self, batch: ColumnarBatch) -> bool:
+        return all(isinstance(c, DeviceColumn) or _is_wide_dec(f.dtype)
+                   for c, f in zip(batch.columns, batch.schema.fields))
+
+    def passthrough(self, batch: ColumnarBatch) -> Optional[ColumnarBatch]:
+        """Skipped-partial fast path: one singleton partial-state group per
+        input row, no dedup, no sort, no probe. Used once the per-bucket
+        cardinality heuristic decides partial aggregation is not reducing
+        (near-unique keys) — the FINAL stage merges singleton states
+        exactly like any other partials, so results are identical. Only
+        valid without fused joins/predicates/steps (the caller gates)."""
+        n = batch.num_rows
+        if n == 0:
+            return None
+        import time as _time
+
+        from blaze_tpu.utils.device import DEVICE_STATS
+
+        t0 = _time.perf_counter()
+        exists = batch.row_exists_mask()
+        self.group_ev._reset_cse(batch)
+        for ev in self.agg_evs:
+            if ev is not None:
+                ev._reset_cse(batch)
+        key_data, key_valid = [], []
+        for _, e in self.op.groupings:
+            d, val = _broadcast(
+                self.group_ev._to_dev(self.group_ev._eval(e, batch), batch),
+                batch)
+            key_data.append(d)
+            key_valid.append(val & exists)
+        args = self._eval_args(batch, exists)
+        kernel = _passthrough_kernel(
+            tuple(str(d.dtype) for d in key_data), tuple(self.specs),
+            tuple("wide3" if isinstance(a[0], tuple) else str(a[0].dtype)
+                  for a in args), batch.capacity)
+        flat = []
+        for d, v in zip(key_data, key_valid):
+            flat += [d, v]
+        for d, v in args:
+            flat += ([*d, v] if isinstance(d, tuple) else [d, v])
+        outs = kernel(exists, *flat)
+        DEVICE_STATS.add_kernel(_time.perf_counter() - t0)
+        # rows stay in place (exists is a prefix mask), so the group count
+        # is the batch's row count — no device sync at all
+        return self._assemble(outs, n)
 
     def _assemble(self, outs, num_groups: int) -> ColumnarBatch:
         pos = 1
@@ -870,6 +1027,47 @@ class DevicePartialAgger:
                     out_valid_mask))
                 ci += 4
         return ColumnarBatch(schema, cols, num_groups)
+
+
+def _plan_slot_table(probe: np.ndarray, capacity: int, prev,
+                     max_slots: int, conf):
+    """(bases, sizes, out_cap) from probed key ranges, unioned with the
+    previous plan on overflow so re-bucketed batches keep fitting. Sizes
+    round to powers of two to bound kernel recompiles. None when the slot
+    table would exceed ``max_slots``; shared by the partial aggers and the
+    radix merge."""
+    bases, sizes, S = [], [], 1
+    for i, (anyv, kmin, kmax) in enumerate(probe):
+        if not anyv:
+            if prev is not None:
+                # no valid keys observed: keep the previous anchor
+                # rather than dragging the union toward [0, 0]
+                lo = int(prev[0][i])
+                hi = lo + prev[1][i] - 2
+            else:
+                # No valid keys and nothing to anchor to: planning now
+                # would pin an artificial [0, 0] anchor that a later
+                # overflow unions with the real key range, potentially
+                # blowing past the bucket cap and disabling the dense
+                # path for the whole stream. Defer so the next batch
+                # re-probes with real keys.
+                return _DEFER_PLAN
+        else:
+            lo, hi = int(kmin), int(kmax)
+            if prev is not None:
+                plo = int(prev[0][i])
+                phi = plo + prev[1][i] - 2
+                lo, hi = min(lo, plo), max(hi, phi)
+        size = 2
+        while size < hi - lo + 2:
+            size <<= 1
+        bases.append(lo)
+        sizes.append(size)
+        S *= size
+    if S > max_slots:
+        return None
+    out_cap = conf.capacity_for(min(S, capacity))
+    return tuple(bases), tuple(sizes), out_cap
 
 
 def _canonical_keys(key_data, key_valid):
@@ -1060,7 +1258,8 @@ def _reduce_aggs(specs, args, seg, nseg_total):
 def _dense_partial_kernel(key_dtypes: Tuple[str, ...],
                           specs: Tuple[Tuple[str, int, str], ...],
                           arg_dtypes: Tuple[str, ...], capacity: int,
-                          sizes: Tuple[int, ...], out_cap: int):
+                          sizes: Tuple[int, ...], out_cap: int,
+                          nbuck: int = 0):
     """Dense-bucket partial kernel: integer group keys whose observed range
     fits a small table scatter straight into ``prod(sizes)`` segment slots —
     no sort, no capacity-sized tables (the TPU analogue of the reference's
@@ -1069,17 +1268,19 @@ def _dense_partial_kernel(key_dtypes: Tuple[str, ...],
     kernel serves every batch of the stream; a key outside its range flips
     the fits flag and the host falls back for that batch. Output arrays are
     ``out_cap``-sized (the compact group bucket), shrinking every downstream
-    consumer of the partial batch."""
+    consumer of the partial batch.
+
+    With ``nbuck`` > 0 this is the RADIX-partitioned variant: the slot
+    table may be much larger than dense_agg_max_buckets (bounded by
+    radix_agg_max_slots), the packed code's high bits are the radix bucket
+    id, and the kernel appends the per-bucket (rows, groups) histogram to
+    its outputs — the cardinality signal the partial-skipping heuristic
+    and the Perfetto skew view consume."""
     nk = len(key_dtypes)
     S = 1
     for s in sizes:
         S *= s
-    strides = []
-    acc = 1
-    for s in reversed(sizes):
-        strides.append(acc)
-        acc *= s
-    strides = tuple(reversed(strides))
+    strides = K.radix_strides(sizes)
 
     def kernel(exists, bases, *flat):
         key_data = [flat[2 * i] for i in range(nk)]
@@ -1094,23 +1295,8 @@ def _dense_partial_kernel(key_dtypes: Tuple[str, ...],
             else:
                 args.append((flat[pos], flat[pos + 1] & exists))
                 pos += 2
-        seg = jnp.zeros(capacity, jnp.int64)
-        fits = jnp.bool_(True)
-        for i, (d, v) in enumerate(zip(key_data, key_valid)):
-            d64 = d.astype(jnp.int64)
-            # code 0 = null key; 1..size-1 = base..base+size-2
-            diff = d64 - bases[i]  # wrapping int64
-            code = jnp.where(v, diff + jnp.int64(1), jnp.int64(0))
-            # Overflow-safe in-range test: `diff` wraps when |key - base|
-            # exceeds 2^63, which could land a far-away key inside
-            # [0, size) and silently mis-bucket it. Requiring d64 >= base
-            # AND diff >= 0 rejects both the wrapped case (wrapped diff is
-            # negative when d64 >= base) and key == base-1 (which would
-            # collide with the null bucket at code 0).
-            infit = (d64 >= bases[i]) & (diff >= 0) & (diff < sizes[i] - 1)
-            fits = fits & jnp.all(jnp.where(exists & v, infit, True))
-            seg = seg + jnp.clip(code, 0, sizes[i] - 1) * strides[i]
-        seg = jnp.where(exists, seg, S).astype(jnp.int32)
+        seg, fits = K.radix_pack(key_data, key_valid, exists, bases,
+                                 sizes, strides)
         outs = _reduce_aggs(specs, args, seg, S)
         present = jnp.zeros(S, bool).at[seg].max(exists, mode="drop")
         num_groups = jnp.sum(present)
@@ -1134,6 +1320,156 @@ def _dense_partial_kernel(key_dtypes: Tuple[str, ...],
             results.append(compact(code_b > 0) & out_valid)
         for entry in outs:
             for a in entry[1:]:
+                results.append(compact(a))
+        if nbuck:
+            brows, bgroups = K.radix_histogram(seg, exists, present, S,
+                                               nbuck)
+            results += [brows, bgroups]
+        return tuple(results)
+
+    return jax.jit(kernel)
+
+
+def _merge_reduce(kinds, states, seg, CAP):
+    """Per-aggregate partial-STATE merges shared by the sort-path and radix
+    merge kernels. ``states[i]`` is aggregate i's list of already-masked
+    (data, valid) state-column pairs aligned with ``kinds``; rows route to
+    ``seg`` (out-of-range segments drop), so it works for ANY seg mapping —
+    sorted segment ids or direct radix slot codes. One output tuple of
+    merged state arrays (length ``CAP``) per aggregate."""
+    outs = []
+    for kind, scols in zip(kinds, states):
+        if kind in ("sum2", "avg2"):
+            (ld, lv), (hd, _hv), (sd, sv) = scols
+            m = lv & sd.astype(bool) & sv
+            slo = jnp.zeros(CAP, jnp.int64).at[seg].add(
+                jnp.where(m, ld, jnp.int64(0)), mode="drop")
+            shi = jnp.zeros(CAP, jnp.int64).at[seg].add(
+                jnp.where(m, hd, jnp.int64(0)), mode="drop")
+            carry = slo >> 32
+            slo, shi = slo & jnp.int64(0xFFFFFFFF), shi + carry
+            if kind == "avg2":
+                scnt = jnp.zeros(CAP, jnp.int64).at[seg].add(
+                    jnp.where(m, sd, jnp.int64(0)), mode="drop")
+                outs.append((slo, shi, scnt))
+            else:
+                shas = jnp.zeros(CAP, bool).at[seg].max(m, mode="drop")
+                outs.append((slo, shi, shas))
+        elif kind in ("sum3", "avg3"):
+            # three-limb wide-decimal sums: per-limb segment adds with
+            # the shared carry renormalization (aggfns._limb3_renorm)
+            from blaze_tpu.ops.aggfns import _limb3_renorm
+
+            (d0, v0l), (d1, _v1), (d2, _v2), (sd, sv) = scols
+            m = v0l & sd.astype(bool) & sv
+            s0 = jnp.zeros(CAP, jnp.int64).at[seg].add(
+                jnp.where(m, d0, jnp.int64(0)), mode="drop")
+            s1 = jnp.zeros(CAP, jnp.int64).at[seg].add(
+                jnp.where(m, d1, jnp.int64(0)), mode="drop")
+            s2 = jnp.zeros(CAP, jnp.int64).at[seg].add(
+                jnp.where(m, d2, jnp.int64(0)), mode="drop")
+            s0, s1, s2 = _limb3_renorm(s0, s1, s2)
+            if kind == "avg3":
+                scnt = jnp.zeros(CAP, jnp.int64).at[seg].add(
+                    jnp.where(m, sd, jnp.int64(0)), mode="drop")
+                outs.append((s0, s1, s2, scnt))
+            else:
+                shas = jnp.zeros(CAP, bool).at[seg].max(m, mode="drop")
+                outs.append((s0, s1, s2, shas))
+        elif kind in ("minw", "maxw"):
+            # shared lexicographic segment extreme (_segment_lex3)
+            (d0, v0l), (d1, _v1), (d2, _v2), (hd, hv) = scols
+            m = v0l & hd.astype(bool) & hv
+            outs.append(_segment_lex3(d0, d1, d2, m, seg, CAP,
+                                      kind == "maxw"))
+        elif kind == "sum":
+            (sd, sv), (hd, hv) = scols
+            m = sv & hd.astype(bool) & hv
+            ssum = jnp.zeros(CAP, sd.dtype).at[seg].add(
+                jnp.where(m, sd, jnp.zeros((), sd.dtype)), mode="drop")
+            shas = jnp.zeros(CAP, bool).at[seg].max(m, mode="drop")
+            outs.append((ssum, shas))
+        elif kind == "count":
+            (cd, cv), = scols
+            scnt = jnp.zeros(CAP, jnp.int64).at[seg].add(
+                jnp.where(cv, cd, 0), mode="drop")
+            outs.append((scnt,))
+        elif kind == "avg":
+            (sd, sv), (cd, cv) = scols
+            ssum = jnp.zeros(CAP, sd.dtype).at[seg].add(
+                jnp.where(sv, sd, jnp.zeros((), sd.dtype)), mode="drop")
+            scnt = jnp.zeros(CAP, jnp.int64).at[seg].add(
+                jnp.where(cv, cd, 0), mode="drop")
+            outs.append((ssum, scnt))
+        else:  # min / max
+            (vd, vv), (hd, hv) = scols
+            m = vv & hd.astype(bool) & hv
+            if jnp.issubdtype(vd.dtype, jnp.floating):
+                sent = jnp.array(jnp.inf if kind == "min" else -jnp.inf,
+                                 vd.dtype)
+            else:
+                info = jnp.iinfo(vd.dtype)
+                sent = jnp.array(info.max if kind == "min" else info.min,
+                                 vd.dtype)
+            x = jnp.where(m, vd, sent)
+            acc = jnp.full(CAP, sent, vd.dtype)
+            acc = acc.at[seg].min(x, mode="drop") if kind == "min" else \
+                acc.at[seg].max(x, mode="drop")
+            shas = jnp.zeros(CAP, bool).at[seg].max(m, mode="drop")
+            outs.append((acc, shas))
+    return outs
+
+
+@functools.lru_cache(maxsize=256)
+def _radix_merge_kernel(key_dtypes: Tuple[str, ...], kinds: Tuple[str, ...],
+                        state_dtypes: Tuple[Tuple[str, ...], ...],
+                        capacity: int, sizes: Tuple[int, ...], out_cap: int):
+    """Radix merge kernel: FINAL/PARTIAL_MERGE over integer keys whose
+    probed range fits a radix slot table. Rows scatter their partial states
+    straight into ``prod(sizes)`` slots via the packed key code — replacing
+    the O(n log n) lax.sort segmentation that dominated the q67 profile
+    (one ~2M-row sort merge) with one linear scatter pass. Keys reconstruct
+    arithmetically from the slot index; outputs are ``out_cap``-sized."""
+    nk = len(key_dtypes)
+    S = 1
+    for s in sizes:
+        S *= s
+    strides = K.radix_strides(sizes)
+
+    def kernel(exists, bases, *flat):
+        key_data = [flat[2 * i] for i in range(nk)]
+        key_valid = [flat[2 * i + 1] & exists for i in range(nk)]
+        pos = 2 * nk
+        states = []
+        for dts in state_dtypes:
+            cols = []
+            for _ in dts:
+                cols.append((flat[pos], flat[pos + 1] & exists))
+                pos += 2
+            states.append(cols)
+        seg, fits = K.radix_pack(key_data, key_valid, exists, bases,
+                                 sizes, strides)
+        outs = _merge_reduce(kinds, states, seg, S)
+        present = jnp.zeros(S, bool).at[seg].max(exists, mode="drop")
+        num_groups = jnp.sum(present)
+        cpos = jnp.cumsum(present) - 1
+        scat = jnp.where(present, cpos, out_cap).astype(jnp.int32)
+
+        def compact(x):
+            return jnp.zeros((out_cap,), x.dtype).at[scat].set(x, mode="drop")
+
+        out_valid = jnp.arange(out_cap, dtype=jnp.int32) < num_groups
+        results = [jnp.where(fits, num_groups.astype(jnp.int64),
+                             jnp.int64(-1)), out_valid]
+        iota_s = jnp.arange(S, dtype=jnp.int64)
+        for i, kdt in enumerate(key_dtypes):
+            code_b = (iota_s // strides[i]) % sizes[i]
+            kdata = (bases[i] + code_b - 1).astype(jnp.dtype(kdt))
+            results.append(jnp.where(out_valid, compact(kdata),
+                                     jnp.zeros((), jnp.dtype(kdt))))
+            results.append(compact(code_b > 0) & out_valid)
+        for group in outs:
+            for a in group:
                 results.append(compact(a))
         return tuple(results)
 
@@ -1168,87 +1504,11 @@ def _merge_kernel(key_dtypes: Tuple[str, ...], kinds: Tuple[str, ...],
         s_exists = exists[order]
         s_keys = [(d[order], v[order]) for d, v in zip(key_data, key_valid)]
         CAP = capacity
-        outs = []
-        for kind, cols in zip(kinds, states):
-            scols = [(d[order], v[order] & s_exists) for d, v in cols]
-            if kind in ("sum2", "avg2"):
-                (ld, lv), (hd, _hv), (sd, sv) = scols
-                m = lv & sd.astype(bool) & sv
-                slo = jnp.zeros(CAP, jnp.int64).at[seg].add(
-                    jnp.where(m, ld, jnp.int64(0)), mode="drop")
-                shi = jnp.zeros(CAP, jnp.int64).at[seg].add(
-                    jnp.where(m, hd, jnp.int64(0)), mode="drop")
-                carry = slo >> 32
-                slo, shi = slo & jnp.int64(0xFFFFFFFF), shi + carry
-                if kind == "avg2":
-                    scnt = jnp.zeros(CAP, jnp.int64).at[seg].add(
-                        jnp.where(m, sd, jnp.int64(0)), mode="drop")
-                    outs.append((slo, shi, scnt))
-                else:
-                    shas = jnp.zeros(CAP, bool).at[seg].max(m, mode="drop")
-                    outs.append((slo, shi, shas))
-            elif kind in ("sum3", "avg3"):
-                # three-limb wide-decimal sums: per-limb segment adds with
-                # the shared carry renormalization (aggfns._limb3_renorm)
-                from blaze_tpu.ops.aggfns import _limb3_renorm
-
-                (d0, v0l), (d1, _v1), (d2, _v2), (sd, sv) = scols
-                m = v0l & sd.astype(bool) & sv
-                s0 = jnp.zeros(CAP, jnp.int64).at[seg].add(
-                    jnp.where(m, d0, jnp.int64(0)), mode="drop")
-                s1 = jnp.zeros(CAP, jnp.int64).at[seg].add(
-                    jnp.where(m, d1, jnp.int64(0)), mode="drop")
-                s2 = jnp.zeros(CAP, jnp.int64).at[seg].add(
-                    jnp.where(m, d2, jnp.int64(0)), mode="drop")
-                s0, s1, s2 = _limb3_renorm(s0, s1, s2)
-                if kind == "avg3":
-                    scnt = jnp.zeros(CAP, jnp.int64).at[seg].add(
-                        jnp.where(m, sd, jnp.int64(0)), mode="drop")
-                    outs.append((s0, s1, s2, scnt))
-                else:
-                    shas = jnp.zeros(CAP, bool).at[seg].max(m, mode="drop")
-                    outs.append((s0, s1, s2, shas))
-            elif kind in ("minw", "maxw"):
-                # shared lexicographic segment extreme (_segment_lex3)
-                (d0, v0l), (d1, _v1), (d2, _v2), (hd, hv) = scols
-                m = v0l & hd.astype(bool) & hv
-                outs.append(_segment_lex3(d0, d1, d2, m, seg, CAP,
-                                          kind == "maxw"))
-            elif kind == "sum":
-                (sd, sv), (hd, hv) = scols
-                m = sv & hd.astype(bool) & hv
-                ssum = jnp.zeros(CAP, sd.dtype).at[seg].add(
-                    jnp.where(m, sd, jnp.zeros((), sd.dtype)), mode="drop")
-                shas = jnp.zeros(CAP, bool).at[seg].max(m, mode="drop")
-                outs.append((ssum, shas))
-            elif kind == "count":
-                (cd, cv), = scols
-                scnt = jnp.zeros(CAP, jnp.int64).at[seg].add(
-                    jnp.where(cv, cd, 0), mode="drop")
-                outs.append((scnt,))
-            elif kind == "avg":
-                (sd, sv), (cd, cv) = scols
-                ssum = jnp.zeros(CAP, sd.dtype).at[seg].add(
-                    jnp.where(sv, sd, jnp.zeros((), sd.dtype)), mode="drop")
-                scnt = jnp.zeros(CAP, jnp.int64).at[seg].add(
-                    jnp.where(cv, cd, 0), mode="drop")
-                outs.append((ssum, scnt))
-            else:  # min / max
-                (vd, vv), (hd, hv) = scols
-                m = vv & hd.astype(bool) & hv
-                if jnp.issubdtype(vd.dtype, jnp.floating):
-                    sent = jnp.array(jnp.inf if kind == "min" else -jnp.inf,
-                                     vd.dtype)
-                else:
-                    info = jnp.iinfo(vd.dtype)
-                    sent = jnp.array(info.max if kind == "min" else info.min,
-                                     vd.dtype)
-                x = jnp.where(m, vd, sent)
-                acc = jnp.full(CAP, sent, vd.dtype)
-                acc = acc.at[seg].min(x, mode="drop") if kind == "min" else \
-                    acc.at[seg].max(x, mode="drop")
-                shas = jnp.zeros(CAP, bool).at[seg].max(m, mode="drop")
-                outs.append((acc, shas))
+        outs = _merge_reduce(
+            kinds,
+            [[(d[order], v[order] & s_exists) for d, v in cols]
+             for cols in states],
+            seg, CAP)
         # compact present segments to the front (cumsum+scatter, no 2nd sort)
         first_idx = jnp.full(CAP, capacity - 1, jnp.int32).at[seg].min(
             iota, mode="drop")
@@ -1310,9 +1570,13 @@ class DeviceMergeAgger:
               E.AggFunction.AVG: "avg", E.AggFunction.MIN: "min",
               E.AggFunction.MAX: "max"}
 
-    def __init__(self, op, child_schema: T.Schema):
+    def __init__(self, op, child_schema: T.Schema, conf=None, metrics=None):
+        from blaze_tpu.config import get_config
+
         self.op = op
         self.child_schema = child_schema
+        self.conf = conf or get_config()
+        self.metrics = metrics
         self.fns = op._make_fns(child_schema)
 
         def kind_of(a, fn):
@@ -1354,13 +1618,31 @@ class DeviceMergeAgger:
                 dts.append(str(col.data.dtype))
                 pos += 1
             state_dtypes.append(tuple(dts))
-        kernel = _merge_kernel(tuple(key_dtypes), self.kinds,
-                               tuple(state_dtypes), big.capacity)
-        outs = kernel(exists, *flat)
-        num_groups = int(outs[0])
+        capacity = big.capacity
+        outs = None
+        radix = self._radix_plan(flat, exists, key_dtypes, capacity)
+        if radix is not None:
+            bases, sizes, out_cap = radix
+            kernel = _radix_merge_kernel(
+                tuple(key_dtypes), self.kinds, tuple(state_dtypes),
+                capacity, sizes, out_cap)
+            outs = kernel(exists, jnp.asarray(np.asarray(bases, np.int64)),
+                          *flat)
+            num_groups = int(outs[0])
+            if num_groups < 0:
+                # probe/pack disagreement (shouldn't happen: the plan comes
+                # from a probe over this very data) — sort fallback
+                outs = None
+            else:
+                capacity = out_cap
+                self._note_radix(sizes)
+        if outs is None:
+            kernel = _merge_kernel(tuple(key_dtypes), self.kinds,
+                                   tuple(state_dtypes), big.capacity)
+            outs = kernel(exists, *flat)
+            num_groups = int(outs[0])
         if num_groups == 0:
             return []
-        capacity = big.capacity
         out_valid = outs[1]
         cols: List[DeviceColumn] = []
         p = 2
@@ -1381,6 +1663,85 @@ class DeviceMergeAgger:
             else:
                 cols.extend(fn.state_columns(state, num_groups, capacity))
         return [ColumnarBatch(out_schema, cols, num_groups)]
+
+    def _radix_plan(self, flat, exists, key_dtypes, capacity):
+        """Probe key ranges over the concatenated input (one small sync)
+        and plan a radix slot table; None routes to the sort-path merge.
+        Gated like the partial radix path: conf.radix_agg (auto = CPU
+        backend hint) and integer keys only."""
+        ra = self.conf.radix_agg
+        if ra is None:
+            from blaze_tpu.runtime import placement
+
+            ra = placement.backend_is_cpu_hint()
+        if not ra or not key_dtypes:
+            return None
+        if not all(np.issubdtype(np.dtype(dt), np.integer)
+                   for dt in key_dtypes):
+            return None
+        info = jnp.iinfo(jnp.int64)
+        rows = []
+        for i in range(len(key_dtypes)):
+            d64 = flat[2 * i].astype(jnp.int64)
+            v = flat[2 * i + 1]  # already masked with exists by run()
+            rows.append(jnp.stack([
+                jnp.any(v).astype(jnp.int64),
+                jnp.min(jnp.where(v, d64, info.max)),
+                jnp.max(jnp.where(v, d64, info.min))]))
+        pr = np.asarray(jnp.stack(rows))
+        st = _plan_slot_table(pr, capacity, None,
+                              self.conf.radix_agg_max_slots, self.conf)
+        if st is _DEFER_PLAN or st is None:
+            return None
+        return st
+
+    def _note_radix(self, sizes):
+        S = 1
+        for s in sizes:
+            S *= s
+        nbuck = K.radix_bucket_shift(S, self.conf.radix_agg_buckets)[1]
+        if self.metrics is not None:
+            self.metrics.add("agg_radix_buckets", nbuck)
+        _radix_counter().inc(nbuck)
+
+
+@functools.lru_cache(maxsize=256)
+def _passthrough_kernel(key_dtypes: Tuple[str, ...],
+                        specs: Tuple[Tuple[str, int, str], ...],
+                        arg_dtypes: Tuple[str, ...], capacity: int):
+    """Singleton-state kernel for skipped partials: every existing row is
+    its own group (seg = iota), so _reduce_aggs degenerates to elementwise
+    state construction — keys and states stay in place, no sort, no
+    scatter contention, no group-count sync."""
+    nk = len(key_dtypes)
+
+    def kernel(exists, *flat):
+        key_data = [flat[2 * i] for i in range(nk)]
+        key_valid = [flat[2 * i + 1] for i in range(nk)]
+        args = []
+        pos = 2 * nk
+        for (kind, _r, _d) in specs:
+            if kind in _WIDE_KINDS:
+                args.append(((flat[pos], flat[pos + 1], flat[pos + 2]),
+                             flat[pos + 3] & exists))
+                pos += 4
+            else:
+                args.append((flat[pos], flat[pos + 1] & exists))
+                pos += 2
+        iota = jnp.arange(capacity, dtype=jnp.int32)
+        seg = jnp.where(exists, iota, jnp.int32(capacity))
+        outs = _reduce_aggs(specs, args, seg, capacity)
+        num_groups = jnp.sum(exists)
+        results = [num_groups, exists]
+        for d, v in zip(key_data, key_valid):
+            results.append(jnp.where(v, d, jnp.zeros((), d.dtype)))
+            results.append(v)
+        for entry in outs:
+            for a in entry[1:]:
+                results.append(a)
+        return tuple(results)
+
+    return jax.jit(kernel)
 
 
 @functools.lru_cache(maxsize=256)

@@ -196,47 +196,54 @@ class FusedStageExec(Operator):
     # -- eager fallback (unfused semantics, per batch) -------------------------
 
     def _eager_steps(self, seg: _FusedSegment, batch: ColumnarBatch):
-        from blaze_tpu.core import kernels
+        yield from eager_steps(seg.steps, seg.in_schema, batch)
 
-        schemas = fused_chain_schemas(seg.in_schema, seg.steps)
-        batches = [batch]
-        for si, st in enumerate(seg.steps):
-            kind = st[0]
-            schema_in = schemas[si]
-            schema_out = schemas[si + 1]
-            nxt: List[ColumnarBatch] = []
-            for b in batches:
-                if kind == "project":
-                    ev = ExprEvaluator(list(st[1]), schema_in)
+
+def eager_steps(steps, in_schema, batch: ColumnarBatch):
+    """Unfused per-batch execution of a fused step chain — the fused
+    stage's fallback, also used by the partial agg when it absorbed a chain
+    whose batch turns out not to be jit-flattenable."""
+    from blaze_tpu.core import kernels
+
+    schemas = fused_chain_schemas(in_schema, steps)
+    batches = [batch]
+    for si, st in enumerate(steps):
+        kind = st[0]
+        schema_in = schemas[si]
+        schema_out = schemas[si + 1]
+        nxt: List[ColumnarBatch] = []
+        for b in batches:
+            if kind == "project":
+                ev = ExprEvaluator(list(st[1]), schema_in)
+                nxt.append(ColumnarBatch(
+                    schema_out, ev.evaluate(b), b.num_rows))
+            elif kind == "filter":
+                ev = ExprEvaluator(list(st[1]), schema_in)
+                mask = ev.evaluate_predicate(b)
+                if all(isinstance(c, DeviceColumn) for c in b.columns):
+                    count, datas, valids = kernels.compact_planes(
+                        [c.data for c in b.columns],
+                        [c.validity for c in b.columns], mask)
+                    if count == 0:
+                        continue
+                    if count == b.num_rows:
+                        nxt.append(b)
+                    else:
+                        nxt.append(ColumnarBatch(b.schema, [
+                            DeviceColumn(c.dtype, d, v) for c, d, v in
+                            zip(b.columns, datas, valids)], count))
+                else:
+                    indices = np.nonzero(np.asarray(mask))[0]
+                    if len(indices) == 0:
+                        continue
+                    nxt.append(b if len(indices) == b.num_rows
+                               else b.take(indices))
+            elif kind == "rename":
+                nxt.append(b.rename(list(st[1])))
+            else:  # expand
+                for proj in st[1]:
+                    ev = ExprEvaluator(list(proj), schema_in)
                     nxt.append(ColumnarBatch(
                         schema_out, ev.evaluate(b), b.num_rows))
-                elif kind == "filter":
-                    ev = ExprEvaluator(list(st[1]), schema_in)
-                    mask = ev.evaluate_predicate(b)
-                    if all(isinstance(c, DeviceColumn) for c in b.columns):
-                        count, datas, valids = kernels.compact_planes(
-                            [c.data for c in b.columns],
-                            [c.validity for c in b.columns], mask)
-                        if count == 0:
-                            continue
-                        if count == b.num_rows:
-                            nxt.append(b)
-                        else:
-                            nxt.append(ColumnarBatch(b.schema, [
-                                DeviceColumn(c.dtype, d, v) for c, d, v in
-                                zip(b.columns, datas, valids)], count))
-                    else:
-                        indices = np.nonzero(np.asarray(mask))[0]
-                        if len(indices) == 0:
-                            continue
-                        nxt.append(b if len(indices) == b.num_rows
-                                   else b.take(indices))
-                elif kind == "rename":
-                    nxt.append(b.rename(list(st[1])))
-                else:  # expand
-                    for proj in st[1]:
-                        ev = ExprEvaluator(list(proj), schema_in)
-                        nxt.append(ColumnarBatch(
-                            schema_out, ev.evaluate(b), b.num_rows))
-            batches = nxt
-        yield from batches
+        batches = nxt
+    yield from batches

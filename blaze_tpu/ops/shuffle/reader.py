@@ -46,8 +46,11 @@ class IpcReaderExec(Operator):
         import threading
         from concurrent.futures import Future, ThreadPoolExecutor
 
-        from blaze_tpu.io.batch_serde import decode_frame, read_frames
+        from blaze_tpu.io.batch_serde import (FRAME_DICT_DEF,
+                                              DictDecodeContext,
+                                              decode_frame, read_frames)
 
+        dict_ctx = DictDecodeContext()
         provider = ctx.resources[self.resource_id]
         blocks: Iterable = provider(partition) if callable(provider) else provider
         # the queue holds FUTURES in frame order: frame reads stay sequential
@@ -69,7 +72,7 @@ class IpcReaderExec(Operator):
             return False
 
         def _decode(flags, payload, raw_len):
-            batch = decode_frame(flags, payload, raw_len)
+            batch = decode_frame(flags, payload, raw_len, dict_ctx)
             metrics.add("ipc_decode_in_prefetch", 1)
             return batch
 
@@ -86,12 +89,31 @@ class IpcReaderExec(Operator):
             trace = TRACER.active
             t0 = time.perf_counter_ns()
             nblocks = 0
+            pending = []  # in-flight pooled decodes since the last barrier
             try:
                 for block in blocks:
                     nblocks += 1
                     stream = _open_block(block)
                     for frame in read_frames(stream):
-                        if not _put(pool.submit(_decode, *frame)):
+                        if frame[0] & FRAME_DICT_DEF:
+                            # dictionary-defining frame: decode INLINE in
+                            # stream order, with a barrier first — a spilled
+                            # stream segment restarts ref numbering, so a
+                            # redefined ref must not swap under a pooled
+                            # decode still holding the previous binding
+                            for fu in pending:
+                                try:
+                                    fu.result()
+                                except BaseException:
+                                    pass  # surfaced via the queue
+                            pending = []
+                            if not _put(_decode(*frame)):
+                                return
+                            continue
+                        fu = pool.submit(_decode, *frame)
+                        pending = [f for f in pending if not f.done()]
+                        pending.append(fu)
+                        if not _put(fu):
                             return
                 _put(SENTINEL)
             except BaseException as exc:

@@ -42,20 +42,26 @@ _TM_WRITE_SECS = get_registry().histogram(
 class _PartitionStreams:
     """In-memory per-partition frame buffers."""
 
-    def __init__(self, num_partitions: int, codec: str):
+    def __init__(self, num_partitions: int, codec: str,
+                 dict_refs: bool = False):
         self.bufs: List[Optional[io.BytesIO]] = [None] * num_partitions
         self.writers: List[Optional[BatchWriter]] = [None] * num_partitions
         self.codec = codec
+        self.dict_refs = dict_refs
         self.nbytes = 0
+        self.codes_bytes = 0
 
     def write(self, pid: int, batch: ColumnarBatch):
         w = self.writers[pid]
         if w is None:
             self.bufs[pid] = io.BytesIO()
-            w = self.writers[pid] = BatchWriter(self.bufs[pid], codec=self.codec)
+            w = self.writers[pid] = BatchWriter(
+                self.bufs[pid], codec=self.codec, dict_refs=self.dict_refs)
         before = w.bytes_written
+        cbefore = w.codes_bytes
         w.write_batch(batch)
         self.nbytes += w.bytes_written - before
+        self.codes_bytes += w.codes_bytes - cbefore
 
     def payloads(self):
         for pid, buf in enumerate(self.bufs):
@@ -105,7 +111,7 @@ class _WriterState(MemConsumer):
         self.metrics = metrics
         self.repart = repart
         self.n = repart.num_partitions
-        self.streams = _PartitionStreams(self.n, ctx.conf.shuffle_compression_codec)
+        self.streams = self._new_streams()
         # spills: list of (SpillFile-backed raw file, per-partition (off, len))
         self.spills = []
         # small-batch coalescing: aggregations and joins can emit thousands
@@ -114,6 +120,10 @@ class _WriterState(MemConsumer):
         self._pending: List[ColumnarBatch] = []
         self._pending_rows = 0
         self._coalesce_min = min(ctx.conf.batch_size, _COALESCE_MIN_ROWS)
+
+    def _new_streams(self) -> _PartitionStreams:
+        return _PartitionStreams(self.n, self.ctx.conf.shuffle_compression_codec,
+                                 dict_refs=self.ctx.conf.codes_shuffle)
 
     def insert(self, batch: ColumnarBatch):
         self._pending.append(batch)
@@ -130,6 +140,7 @@ class _WriterState(MemConsumer):
         self._pending_rows = 0
         b0, g0 = self.repart.split_batches, self.repart.split_gathers
         t0 = self.repart.split_time_ns
+        c0 = self.streams.codes_bytes
         for pid, sub in self.repart.bucketize_host(batch):
             self.streams.write(pid, sub)
         # hot-path invariant surfaced for soak/tests: one row gather per
@@ -137,6 +148,8 @@ class _WriterState(MemConsumer):
         self.metrics.add("split_batches", self.repart.split_batches - b0)
         self.metrics.add("split_gathers", self.repart.split_gathers - g0)
         self.metrics.add("repartition_time_ns", self.repart.split_time_ns - t0)
+        if self.streams.codes_bytes > c0:
+            self.metrics.add("codes_shuffle_bytes", self.streams.codes_bytes - c0)
         self.update_mem_used(self.streams.nbytes)
 
     def spill(self) -> int:
@@ -154,7 +167,7 @@ class _WriterState(MemConsumer):
         self.metrics.add("spill_count", 1)
         self.metrics.add("spilled_bytes", sum(l for _, l in index.values()))
         self.spills.append((spill, index))
-        self.streams = _PartitionStreams(self.n, self.ctx.conf.shuffle_compression_codec)
+        self.streams = self._new_streams()
         return freed
 
     def finish(self):
@@ -196,7 +209,7 @@ class _WriterState(MemConsumer):
         os.replace(itmp, self.op.output_index_file)
         self.metrics.add("data_size", int(offsets[self.n]))
         _TM_WRITE_BYTES.observe(int(offsets[self.n]))
-        self.streams = _PartitionStreams(self.n, self.ctx.conf.shuffle_compression_codec)
+        self.streams = self._new_streams()
 
     def release(self):
         for spill, _ in self.spills:
@@ -235,7 +248,11 @@ class RssShuffleWriterExec(Operator):
             t0 = repart.split_time_ns
             for pid, sub in repart.bucketize_host(batch):
                 buf = io.BytesIO()
-                BatchWriter(buf, codec=codec).write_batch(sub)
+                bw = BatchWriter(buf, codec=codec,
+                                 dict_refs=ctx.conf.codes_shuffle)
+                bw.write_batch(sub)
+                if bw.codes_bytes:
+                    metrics.add("codes_shuffle_bytes", bw.codes_bytes)
                 writer.write(pid, buf.getvalue())
             metrics.add("split_batches", repart.split_batches - b0)
             metrics.add("split_gathers", repart.split_gathers - g0)

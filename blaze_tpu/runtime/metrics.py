@@ -121,6 +121,15 @@ class MetricNode:
 #                                    recompile storm
 #   fused_fallback_batches == 0      fused stages executed their jitted
 #                                    closure, not the eager fallback
+#   agg_reintern_rows == 0           var-width agg keys cross the exchange
+#                                    as dictionary codes; merge tables never
+#                                    re-encode decoded values per batch
+#   agg_radix_buckets > 0            on high-cardinality int-keyed aggs:
+#                                    the radix-partitioned device kernel ran
+#                                    (counts buckets scanned per pass)
+#   codes_shuffle_bytes              bytes shipped as codes+dictionaries by
+#                                    the code-carrying shuffle (0 on plans
+#                                    without dictionary columns)
 TRIPWIRE_METRICS = (
     "split_batches",
     "split_gathers",
@@ -133,6 +142,9 @@ TRIPWIRE_METRICS = (
     "jit_cache_hits",
     "jit_cache_misses",
     "fused_fallback_batches",
+    "agg_reintern_rows",
+    "agg_radix_buckets",
+    "codes_shuffle_bytes",
 )
 
 

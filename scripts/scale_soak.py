@@ -136,13 +136,16 @@ def main():
                 "jit_cache_hits": trips["jit_cache_hits"],
                 "jit_cache_misses": trips["jit_cache_misses"],
                 "fused_fallback_batches": trips["fused_fallback_batches"],
+                "agg_reintern_rows": trips["agg_reintern_rows"],
+                "agg_radix_buckets": trips["agg_radix_buckets"],
+                "codes_shuffle_bytes": trips["codes_shuffle_bytes"],
                 "peak_mem_used": peak_used,
                 "peak_rss_mb": peak_rss_mb(),
             }
             print(json.dumps({name: out["shapes"][name]}), flush=True)
 
     soak_path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "SOAK_r07.json")
+        os.path.abspath(__file__))), "SOAK_r08.json")
     if "tpcds" not in os.environ.get("SOAK_PHASES", "shapes,tpcds"):
         out["peak_rss_mb"] = peak_rss_mb()
         # keep a previous run's tpcds section (phase-scoped reruns merge)
@@ -194,6 +197,9 @@ def main():
                 table = sess.execute_to_table(res.plan)
                 spills = sess.metrics.total("spill_count")
                 spill_bytes = sess.metrics.total("spilled_bytes")
+                from blaze_tpu.runtime.metrics import tripwire_totals
+
+                trips = tripwire_totals(sess.metrics)
                 if PROFILE_DIR:
                     from blaze_tpu.obs import TRACER, dump_profile
 
@@ -212,13 +218,16 @@ def main():
                 "wall_s": round(wall, 1), "rows_out": len(got),
                 "spill_count": int(spills),
                 "spilled_bytes": int(spill_bytes),
+                "agg_reintern_rows": trips["agg_reintern_rows"],
+                "agg_radix_buckets": trips["agg_radix_buckets"],
+                "codes_shuffle_bytes": trips["codes_shuffle_bytes"],
                 "peak_rss_mb": peak_rss_mb(),
             }
             print(json.dumps({name: out["tpcds"][name]}), flush=True)
     out["peak_rss_mb"] = peak_rss_mb()
     print(json.dumps(out))
     with open(os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "SOAK_r07.json"), "w") as f:
+            os.path.abspath(__file__))), "SOAK_r08.json"), "w") as f:
         json.dump(out, f, indent=1)
 
 

@@ -56,8 +56,9 @@ def _batch(ks, vs):
 def test_dense_engages_and_anchors_far_from_zero():
     agger = _agger()
     out = agger.process(_batch([9_000_001, 9_000_002] * 50, [1] * 100))
-    assert agger._dense_state is not None, "dense plan expected"
-    bases, sizes, out_cap = agger._dense_state
+    assert agger._bucket_state is not None, "dense plan expected"
+    kind, bases, sizes, out_cap = agger._bucket_state
+    assert kind == "dense"
     assert bases == (9_000_001,) and sizes[0] <= 4
     got = out.to_arrow().to_pydict()
     assert sorted(got["k1"]) == [9_000_001, 9_000_002]
@@ -69,17 +70,33 @@ def test_range_overflow_widens_within_budget():
     o1 = agger.process(_batch([5, 6, 7] * 100, [1] * 300))
     o2 = agger.process(_batch([50, 51] * 100, [2] * 200))
     assert o1.num_rows == 3 and o2.num_rows == 2
-    assert agger._dense_state is not None, "union 5..51 fits: dense stays"
+    assert agger._bucket_state is not None, "union 5..51 fits: dense stays"
+    assert agger._bucket_state[0] == "dense"
     assert sorted(o2.to_arrow().to_pydict()["s#sum"]) == [200, 200]
 
 
-def test_range_overflow_beyond_cap_falls_back_correctly():
+def test_range_overflow_beyond_dense_cap_goes_radix():
     agger = _agger()
     o1 = agger.process(_batch([5, 6, 7] * 100, [1] * 300))
-    # union with 10005.. would need 16k buckets > batch capacity: dense
-    # disables, the sort kernel takes over, results stay exact
+    assert agger._bucket_state[0] == "dense"
+    # union with 10005.. needs 16k slots > batch capacity: the dense plan
+    # overflows and the re-plan lands on the radix table, results stay exact
     o2 = agger.process(_batch([10005, 10006] * 100, [2] * 200))
-    assert agger._dense_ok is False
+    assert agger._bucket_state is not None
+    assert agger._bucket_state[0] == "radix"
+    assert sorted(o2.to_arrow().to_pydict()["s#sum"]) == [200, 200]
+    assert o1.num_rows == 3
+
+
+def test_range_overflow_beyond_radix_cap_falls_back_correctly():
+    agger = _agger()
+    o1 = agger.process(_batch([5, 6, 7] * 100, [1] * 300))
+    # union with 9_000_005.. would need ~9M slots > radix_agg_max_slots
+    # (4M): every scatter table disables, the sort kernel takes over,
+    # results stay exact
+    o2 = agger.process(_batch([9_000_005, 9_000_006] * 100, [2] * 200))
+    assert agger._dense_ok is False and agger._radix_ok is False
+    assert agger._bucket_state is None
     assert sorted(o2.to_arrow().to_pydict()["s#sum"]) == [200, 200]
     assert o1.num_rows == 3
 
@@ -87,11 +104,11 @@ def test_range_overflow_beyond_cap_falls_back_correctly():
 def test_all_null_key_batch_keeps_anchor():
     agger = _agger()
     agger.process(_batch([9_000_001, 9_000_002] * 50, [1] * 100))
-    st = agger._dense_state
+    st = agger._bucket_state
     onull = agger.process(_batch([None] * 64, [3] * 64))
     assert onull.num_rows == 1  # the null-key group
     assert onull.to_arrow().to_pydict()["s#sum"] == [192]
-    assert agger._dense_state == st, "all-null probe must not move the anchor"
+    assert agger._bucket_state == st, "all-null probe must not move the anchor"
 
 
 def test_non_integer_keys_decline_dense(tmp_path):
@@ -171,11 +188,11 @@ def test_first_batch_no_valid_keys_defers_plan():
     o1 = agger.process(_batch([None] * 64, [3] * 64))
     assert o1.num_rows == 1  # null-key group, via the sort fallback
     assert o1.to_arrow().to_pydict()["s#sum"] == [192]
-    assert agger._dense_state is None, "no plan should be pinned"
+    assert agger._bucket_state is None, "no plan should be pinned"
     assert agger._dense_ok is not False, "dense path must stay available"
     o2 = agger.process(_batch([9_000_001, 9_000_002] * 50, [1] * 100))
-    assert agger._dense_state is not None, "dense plan expected on real keys"
-    bases, sizes, _ = agger._dense_state
+    assert agger._bucket_state is not None, "dense plan expected on real keys"
+    _, bases, sizes, _ = agger._bucket_state
     assert bases == (9_000_001,), "anchor must come from the real keys"
     assert sorted(o2.to_arrow().to_pydict()["s#sum"]) == [50, 50]
 
@@ -185,7 +202,7 @@ def test_key_just_below_anchor_does_not_merge_into_null_group():
     range test; it must instead flip the fits flag and re-plan."""
     agger = _agger()
     agger.process(_batch([10, 11] * 50, [1] * 100))
-    assert agger._dense_state is not None
+    assert agger._bucket_state is not None
     o2 = agger.process(_batch([9] * 100, [2] * 100))
     got = o2.to_arrow().to_pydict()
     assert got["k1"] == [9], "key 9 must survive as a real (non-null) group"

@@ -1,0 +1,153 @@
+"""Radix-partitioned device hash aggregation (high-cardinality engine).
+
+Covers the radix partial kernel and radix merge against the sort-path
+oracle, the bucket-histogram-driven partial skipper, and the quick-tier
+guards: a 100k-group device-agg smoke and ``agg_reintern_rows == 0`` on
+the q67 bench shape (int keys never round-trip through host interning)."""
+
+import collections
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from blaze_tpu.config import config_override
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import nodes as N
+from blaze_tpu.ops.agg import AggExec, _PartialSkipper
+from blaze_tpu.ops.base import ExecContext
+from blaze_tpu.runtime.metrics import MetricNode, tripwire_totals
+from tests.util import mem_scan
+
+F = E.AggFunction
+M = E.AggMode
+HASH = E.AggExecMode.HASH_AGG
+
+
+def col(n):
+    return E.Column(n)
+
+
+def _two_stage(scan, keys, skipping=False):
+    partial = AggExec(scan, HASH, [(k, col(k)) for k in keys], [
+        N.AggColumn(E.AggExpr(F.SUM, [col("v")]), M.PARTIAL, "s"),
+        N.AggColumn(E.AggExpr(F.COUNT, [col("v")]), M.PARTIAL, "c"),
+    ], supports_partial_skipping=skipping)
+    return AggExec(partial, HASH, [(k, col(k)) for k in keys], [
+        N.AggColumn(E.AggExpr(F.SUM, [col("v")]), M.FINAL, "s"),
+        N.AggColumn(E.AggExpr(F.COUNT, [col("v")]), M.FINAL, "c"),
+    ])
+
+
+def _collect(op, metrics=None):
+    ctx = ExecContext()
+    out = collections.defaultdict(list)
+    for b in op.execute(0, ctx, metrics):
+        for k, v in b.to_arrow().to_pydict().items():
+            out[k].extend(v)
+    return out
+
+
+def _oracle(a, b, v):
+    s = collections.defaultdict(int)
+    c = collections.defaultdict(int)
+    for ka, kb, kv in zip(a, b, v):
+        s[(ka, kb)] += kv
+        c[(ka, kb)] += 1
+    return s, c
+
+
+def _check(out, es, ec):
+    got_s = dict(zip(zip(out["a"], out["b"]), out["s"]))
+    got_c = dict(zip(zip(out["a"], out["b"]), out["c"]))
+    assert got_s == dict(es)
+    assert got_c == dict(ec)
+
+
+def _hicard_scan(n=300_000, ka=2000, kb=100, num_batches=12, seed=5):
+    # slot space ka.pow2 * kb.pow2 = 2048 * 128 > dense_agg_max_buckets,
+    # so the bucketed planner must take the radix branch
+    rng = np.random.default_rng(seed)
+    a = rng.integers(0, ka, n)
+    b = rng.integers(0, kb, n)
+    v = rng.integers(0, 100, n)
+    scan = mem_scan({
+        "a": pa.array(a, type=pa.int64()),
+        "b": pa.array(b, type=pa.int64()),
+        "v": pa.array(v, type=pa.int64()),
+    }, num_batches=num_batches)
+    return scan, a.tolist(), b.tolist(), v.tolist()
+
+
+@pytest.mark.quick
+def test_radix_100k_group_smoke():
+    """~190k groups through partial + radix merge, exact vs a host oracle."""
+    scan, a, b, v = _hicard_scan()
+    es, ec = _oracle(a, b, v)
+    assert len(es) > 100_000
+    root = MetricNode("root")
+    with config_override(radix_agg=True):
+        out = _collect(_two_stage(scan, ["a", "b"]), root)
+    _check(out, es, ec)
+    assert tripwire_totals(root)["agg_radix_buckets"] > 0
+
+
+def test_radix_matches_sort_path():
+    scan, a, b, v = _hicard_scan(n=60_000, seed=9)
+    es, ec = _oracle(a, b, v)
+    with config_override(radix_agg=True):
+        radix = _collect(_two_stage(scan, ["a", "b"]))
+    with config_override(radix_agg=False, dense_agg=False):
+        host = _collect(_two_stage(scan, ["a", "b"]))
+    _check(radix, es, ec)
+    _check(host, es, ec)
+
+
+@pytest.mark.quick
+def test_q67_shape_no_reintern():
+    """q67 bench shape (int composite keys, near-unique): keys stay device
+    codes end to end — zero rows re-interned at the merge table."""
+    scan, a, b, v = _hicard_scan(n=100_000, ka=2000, kb=400, num_batches=8,
+                                 seed=67)
+    es, ec = _oracle(a, b, v)
+    root = MetricNode("root")
+    with config_override(radix_agg=True):
+        out = _collect(_two_stage(scan, ["a", "b"], skipping=True), root)
+    _check(out, es, ec)
+    tw = tripwire_totals(root)
+    assert tw["agg_reintern_rows"] == 0
+    assert tw["agg_radix_buckets"] > 0
+
+
+def test_partial_skipping_near_unique_keys():
+    """Near-unique keys flip the skipper; passthrough batches still merge
+    to the exact answer."""
+    scan, a, b, v = _hicard_scan(n=120_000, ka=2000, kb=400, num_batches=10,
+                                 seed=3)
+    es, ec = _oracle(a, b, v)
+    root = MetricNode("root")
+    with config_override(radix_agg=True, partial_agg_skipping_min_rows=20_000):
+        out = _collect(_two_stage(scan, ["a", "b"], skipping=True), root)
+    _check(out, es, ec)
+    assert root.total("partial_skipped_batches") > 0
+
+
+def test_partial_skipper_bucket_histograms():
+    """The skipper decides from observed per-bucket cardinality, not the
+    whole-table slot ratio."""
+    ctx = ExecContext()
+    with config_override(partial_agg_skipping_min_rows=10_000,
+                         partial_agg_skipping_ratio=0.9):
+        sk = _PartialSkipper(None, ExecContext())
+        # low cardinality: many rows per bucket collapse to few groups
+        sk.observe_buckets(np.full(256, 60, np.int64), np.full(256, 5, np.int64))
+        assert not sk.should_skip()
+        sk2 = _PartialSkipper(None, ExecContext())
+        # near-unique: groups ~ rows in every bucket
+        sk2.observe_buckets(np.full(256, 60, np.int64),
+                            np.full(256, 59, np.int64))
+        assert sk2.should_skip()
+        sk3 = _PartialSkipper(None, ExecContext())
+        # under min_rows with no table to fall back on: never skip
+        sk3.observe_buckets(np.full(4, 10, np.int64), np.full(4, 10, np.int64))
+        assert not sk3.should_skip()
