@@ -83,6 +83,33 @@ class Config:
     task_max_retries: int = 2
     task_retry_backoff_s: float = 0.2
 
+    # Fault tolerance for the worker-process pool (runtime/cluster.py) —
+    # the standalone analogue of Spark's executor blacklisting + stage
+    # abort thresholds:
+    #   fault_max_worker_deaths   circuit breaker: more deaths than this
+    #                             within ONE map stage aborts the stage with
+    #                             a typed WorkerPoolBroken (retryable at the
+    #                             serve layer) instead of retrying forever.
+    #   fault_exclusion_ttl_s     a worker slot whose process died is
+    #                             excluded from pulling new tasks for this
+    #                             long (its respawned process gets a cooling
+    #                             period; at least one eligible worker is
+    #                             always kept so a stage can make progress).
+    #   fault_respawn_backoff_s   base of the exponential backoff between a
+    #                             worker slot's consecutive respawns.
+    #   fault_heartbeat_interval_s  supervisor liveness-probe period: worker
+    #                             deaths are noticed between stages, not
+    #                             only when a mid-task recv fails.
+    fault_max_worker_deaths: int = 4
+    fault_exclusion_ttl_s: float = 30.0
+    fault_respawn_backoff_s: float = 0.2
+    fault_heartbeat_interval_s: float = 0.5
+
+    # Reduce-side verification of map-output footers: the cheap length +
+    # magic check always runs; True additionally recomputes the payload
+    # crc32 on every open (paranoid mode for chaos soaks/tests).
+    shuffle_verify_checksum: bool = False
+
     # Device HBM budget for resident batch data (bytes). None = ask the device.
     hbm_budget: Optional[int] = None
 

@@ -306,6 +306,11 @@ _FRAME_LEN = struct.calcsize(_FRAME_FMT)
 # dictionary is registered before any pooled frame references it
 FRAME_DICT_DEF = 0x10
 _CODEC_MASK = 0x0F
+# Map-output commit footer magic (runtime/recovery.py appends the footer
+# after the last partition segment of a shuffle data file). Defined here so
+# whole-file frame iteration can treat it as a clean end-of-stream without
+# importing the runtime layer.
+MAP_FOOTER_MAGIC = b"BZF1"
 
 
 def _lz4_compress(payload: bytes):
@@ -471,6 +476,8 @@ def read_frames(fileobj) -> Iterator[tuple]:
         head = fileobj.read(_FRAME_LEN)
         if not head:
             return
+        if head[:4] == MAP_FOOTER_MAGIC:
+            return  # committed map output's trailing footer, not a frame
         magic, flags, plen, raw_len = struct.unpack(_FRAME_FMT, head)
         assert magic == _MAGIC, f"bad frame magic {magic!r}"
         yield flags, fileobj.read(plen), raw_len

@@ -86,7 +86,7 @@ def _conf(conf):
 def record_incident(kind: str, label: str, error: Optional[BaseException] = None,
                     session=None, scheduler_state: Optional[dict] = None,
                     handle=None, query: Optional[dict] = None,
-                    conf=None) -> Optional[str]:
+                    conf=None, extra: Optional[dict] = None) -> Optional[str]:
     """Write one forensic bundle for a terminal query outcome; returns the
     incident id, or None when disabled/failed. NEVER raises — forensics must
     not take down the failure path it is documenting."""
@@ -114,6 +114,9 @@ def record_incident(kind: str, label: str, error: Optional[BaseException] = None
             "spans": TRACER.ring_snapshot(last=256),
             "tracer_dropped": TRACER.dropped,
         }
+        if extra:
+            # caller-specific context (e.g. worker_lost: wid/pid/exit code)
+            bundle["extra"] = extra
         if error is not None:
             bundle["error"] = {
                 "type": type(error).__name__,

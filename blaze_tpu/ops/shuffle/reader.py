@@ -158,7 +158,14 @@ class IpcReaderExec(Operator):
 def _open_block(block):
     if isinstance(block, tuple) and block and block[0] == "file_segment":
         _, path, offset, length = block
-        f = open(path, "rb")
+        try:
+            f = open(path, "rb")
+        except FileNotFoundError:
+            # typed fetch failure: the driver's lineage recovery recomputes
+            # the named map output instead of failing the query
+            from blaze_tpu.runtime.recovery import ShuffleOutputMissing
+
+            raise ShuffleOutputMissing(path, "missing")
         f.seek(offset)
         return _SegmentReader(f, length)
     if isinstance(block, tuple) and block and block[0] == "bytes":

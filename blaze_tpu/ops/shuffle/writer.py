@@ -179,33 +179,50 @@ class _WriterState(MemConsumer):
     def _finish_files(self):
         """Merge in-memory + spilled per-partition segments into the final
         data file (partition-major) and write the offset index. BOTH files
-        publish via per-attempt unique tmp paths + atomic os.replace:
-        concurrent attempts of the same task (retry races, straggler
-        speculation) each write their own staging files and the completed
-        publishes are whole-file swaps — deterministic map output makes
-        either winner equivalent."""
+        publish via per-attempt unique tmp paths + fsync + atomic
+        os.replace, and the data file carries a trailing length/crc32
+        footer (runtime/recovery.py): concurrent attempts of the same task
+        (retry races, straggler speculation) each write their own staging
+        files, completed publishes are whole-file swaps, and a worker
+        killed mid-write can never leave a footer-valid torn file — the
+        reader verifies the footer and treats a torn file as missing,
+        triggering lineage recompute instead of silently short rows."""
         import uuid
+        import zlib
+
+        from blaze_tpu.runtime.recovery import pack_footer
 
         attempt = uuid.uuid4().hex
         mem = {pid: payload for pid, payload in self.streams.payloads()}
         offsets = np.zeros(self.n + 1, dtype=np.int64)
         tmp = f"{self.op.output_data_file}.tmp.{attempt}"
         os.makedirs(os.path.dirname(tmp) or ".", exist_ok=True)
+        crc = 0
         with open(tmp, "wb") as out:
+            def _write(b: bytes):
+                nonlocal crc
+                crc = zlib.crc32(b, crc)
+                out.write(b)
+
             for pid in range(self.n):
                 offsets[pid] = out.tell()
                 for spill, index in self.spills:
                     if pid in index:
                         off, ln = index[pid]
                         spill._file.seek(off)
-                        out.write(spill._file.read(ln))
+                        _write(spill._file.read(ln))
                 if pid in mem:
-                    out.write(mem[pid])
+                    _write(mem[pid])
             offsets[self.n] = out.tell()
+            out.write(pack_footer(int(offsets[self.n]), crc))
+            out.flush()
+            os.fsync(out.fileno())
         os.replace(tmp, self.op.output_data_file)
         itmp = f"{self.op.output_index_file}.tmp.{attempt}"
         with open(itmp, "wb") as idx:
             idx.write(offsets.astype("<i8").tobytes())
+            idx.flush()
+            os.fsync(idx.fileno())
         os.replace(itmp, self.op.output_index_file)
         self.metrics.add("data_size", int(offsets[self.n]))
         _TM_WRITE_BYTES.observe(int(offsets[self.n]))
@@ -284,10 +301,16 @@ class FileSegmentBlockProvider:
         self.indexes = [(path, np.asarray(offsets)) for path, offsets in indexes]
 
     def __call__(self, reducer: int):
+        from blaze_tpu.runtime.recovery import check_map_output
+
         blocks = []
-        for data, offsets in self.indexes:
+        for m, (data, offsets) in enumerate(self.indexes):
             start, end = int(offsets[reducer]), int(offsets[reducer + 1])
             if end > start:
+                # footer check per served map file: a deleted/torn upstream
+                # output surfaces as ShuffleOutputMissing (with stage+map
+                # lineage coordinates) before any segment is decoded
+                check_map_output(data, offsets=offsets, map_id=m)
                 blocks.append(("file_segment", data, start, end - start))
         return blocks
 

@@ -215,14 +215,23 @@ class ProfilingService:
                                            status=408)
                                 return
                             except BaseException as exc:
-                                from blaze_tpu.serve import Overloaded
+                                from blaze_tpu.serve import (Overloaded,
+                                                             QueryRetryable)
 
-                                self._send(json.dumps(
-                                    {"error": type(exc).__name__,
-                                     "reason": str(exc),
-                                     "state": h.state}),
-                                    status=503 if isinstance(exc, Overloaded)
-                                    else 500)
+                                body = {"error": type(exc).__name__,
+                                        "reason": str(exc),
+                                        "state": h.state}
+                                if isinstance(exc, QueryRetryable):
+                                    # infrastructure loss: safe to resubmit;
+                                    # forensics at /debug/incidents/<id>
+                                    body["retryable"] = True
+                                    body["incident_id"] = exc.incident_id
+                                    status = 503
+                                elif isinstance(exc, Overloaded):
+                                    status = 503
+                                else:
+                                    status = 500
+                                self._send(json.dumps(body), status=status)
                                 return
                             self._send(json.dumps(
                                 {"qid": qid, "rows": table.num_rows,

@@ -1,0 +1,258 @@
+"""Stage-lineage recovery: map-output integrity + lost-output recompute.
+
+The reference engine leans on Spark for this entire story — a failed native
+stage falls back to the JVM and Spark's lineage-based task re-execution
+recomputes lost map outputs from the persisted shuffle files (PAPER.md §JNI
+fallback, SURVEY.md §5.4). The standalone driver has no JVM to fall back
+to, so the same contract is provided natively:
+
+- **Commit footer**: every committed ``map_<m>.data`` file ends with a
+  20-byte footer (magic, payload length, crc32) written before the atomic
+  rename. A killed worker can therefore never publish a torn file that a
+  reduce task silently reads — a file without a valid footer is treated
+  exactly like a missing file.
+- **``ShuffleOutputMissing``**: the typed fetch-failure. Raised by the
+  block providers / reader when a map output is absent or fails
+  verification; carries the stage id and map ids so the driver can
+  recompute precisely those tasks. Subclasses ``OSError`` on purpose:
+  ``Session._run_tasks`` classifies OSError as transient, never as a
+  deterministic failure (the Spark analogue is FetchFailedException being
+  handled by the DAGScheduler, not the task retry budget).
+- **``StageLineage``**: the driver-side map-output registry for one stage —
+  output paths, a verification check, and a ``recompute(map_ids)`` closure
+  that re-runs just the named map tasks in-driver. ``Session`` registers
+  one per shuffle map stage and walks them (recursively, for missing
+  upstream inputs of the recompute itself) on fetch failure.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import struct
+import threading
+import zlib
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from blaze_tpu.io.batch_serde import MAP_FOOTER_MAGIC
+from blaze_tpu.obs.telemetry import get_registry
+
+log = logging.getLogger("blaze_tpu.recovery")
+
+# footer: magic, payload length (== index offsets[-1]), crc32 of payload
+_FOOTER_FMT = "<4sQI4x"  # 4x pad keeps the footer 8-byte aligned (20 bytes)
+FOOTER_LEN = struct.calcsize(_FOOTER_FMT)
+
+_TM_STAGES_RECOVERED = get_registry().counter(
+    "blaze_cluster_stages_recovered_total",
+    "stages whose lost/torn map outputs were recomputed from lineage")
+_TM_MAPS_RECOMPUTED = get_registry().counter(
+    "blaze_cluster_maps_recomputed_total",
+    "individual map tasks re-run by lineage recovery")
+
+
+def pack_footer(payload_len: int, crc: int) -> bytes:
+    return struct.pack(_FOOTER_FMT, MAP_FOOTER_MAGIC, payload_len,
+                       crc & 0xFFFFFFFF)
+
+
+class ShuffleOutputMissing(OSError):
+    """A reduce-side fetch found a map output missing or torn. OSError
+    subclass: transient for the generic retry classifier, and specifically
+    recognized by the driver's lineage-recovery hooks."""
+
+    def __init__(self, path: str, reason: str,
+                 stage: Optional[int] = None,
+                 maps: Optional[Iterable[int]] = None):
+        self.path = path
+        self.reason = reason
+        if stage is None or maps is None:
+            p_stage, p_map = _parse_output_path(path)
+            stage = stage if stage is not None else p_stage
+            maps = maps if maps is not None else (
+                [p_map] if p_map is not None else [])
+        self.stage = stage
+        self.maps = sorted(set(int(m) for m in (maps or [])))
+        super().__init__(
+            f"shuffle output {path} {reason} "
+            f"(stage {stage}, maps {self.maps})")
+
+
+def _parse_output_path(path: str) -> Tuple[Optional[int], Optional[int]]:
+    """(stage, map) from the canonical shuffle_<s>/map_<m>.data layout."""
+    import re
+
+    m = re.search(r"shuffle_(\d+)[/\\]map_(\d+)\.(?:data|index)$", path)
+    if m is None:
+        return None, None
+    return int(m.group(1)), int(m.group(2))
+
+
+def verify_map_output(data_path: str, index_path: Optional[str] = None,
+                      full: bool = False) -> Optional[str]:
+    """None when the committed map output checks out, else a reason string.
+    The cheap check is one stat + one 20-byte read: footer magic present,
+    recorded payload length consistent with the file size (and with the
+    index's final offset when given). ``full`` additionally recomputes the
+    payload crc32 — the paranoid mode chaos tests enable."""
+    try:
+        size = os.path.getsize(data_path)
+    except OSError:
+        return "missing"
+    if size < FOOTER_LEN:
+        return f"truncated ({size} bytes, no room for footer)"
+    try:
+        with open(data_path, "rb") as f:
+            f.seek(size - FOOTER_LEN)
+            magic, payload_len, crc = struct.unpack(
+                _FOOTER_FMT, f.read(FOOTER_LEN))
+            if magic != MAP_FOOTER_MAGIC:
+                return f"bad footer magic {magic!r}"
+            if payload_len != size - FOOTER_LEN:
+                return (f"footer payload length {payload_len} != "
+                        f"{size - FOOTER_LEN} on disk")
+            if full:
+                f.seek(0)
+                got = 0
+                remaining = payload_len
+                while remaining:
+                    chunk = f.read(min(1 << 20, remaining))
+                    if not chunk:
+                        return "short read during crc verification"
+                    got = zlib.crc32(chunk, got)
+                    remaining -= len(chunk)
+                if got & 0xFFFFFFFF != crc:
+                    return f"crc mismatch ({got & 0xFFFFFFFF:#x} != {crc:#x})"
+    except OSError as exc:
+        return f"unreadable ({exc})"
+    if index_path is not None:
+        try:
+            isize = os.path.getsize(index_path)
+        except OSError:
+            return "index missing"
+        if isize < 16:  # at least [start, end] int64 offsets
+            return f"index truncated ({isize} bytes)"
+    return None
+
+
+def check_map_output(data_path: str, offsets=None, full: Optional[bool] = None,
+                     stage: Optional[int] = None,
+                     map_id: Optional[int] = None):
+    """Raise ``ShuffleOutputMissing`` unless ``data_path`` is a committed,
+    footer-verified map output whose payload matches the index's final
+    offset. Block providers call this before serving segments."""
+    if full is None:
+        from blaze_tpu.config import get_config
+
+        full = get_config().shuffle_verify_checksum
+    reason = verify_map_output(data_path, full=full)
+    if reason is None and offsets is not None and len(offsets):
+        expect = int(offsets[-1]) + FOOTER_LEN
+        size = os.path.getsize(data_path)
+        if size != expect:
+            reason = f"size {size} != index end {expect}"
+    if reason is not None:
+        raise ShuffleOutputMissing(
+            data_path, reason, stage=stage,
+            maps=[map_id] if map_id is not None else None)
+
+
+class StageLineage:
+    """Map-output registry for one shuffle map stage: where each map's
+    output lives, and how to recompute a subset of maps in-driver. The
+    recompute closure re-runs the stage's recorded ShuffleWriter task for
+    each named map (always on driver threads — re-entering the worker pool
+    from a recovery callback would deadlock a stage already being served)."""
+
+    def __init__(self, stage: int, num_maps: int,
+                 paths_for: Callable[[int], Tuple[str, str]],
+                 run_map: Callable[[int], object]):
+        self.stage = stage
+        self.num_maps = num_maps
+        self.paths_for = paths_for
+        self._run_map = run_map
+        self._mu = threading.Lock()
+        self.recomputed_maps = 0
+
+    def missing(self) -> List[int]:
+        """Maps whose committed output currently fails verification."""
+        out = []
+        for m in range(self.num_maps):
+            data, _index = self.paths_for(m)
+            if verify_map_output(data) is not None:
+                out.append(m)
+        return out
+
+    def recompute(self, map_ids: Iterable[int]) -> List[int]:
+        """Re-run the named map tasks; returns the maps actually re-run.
+        Serialized per stage so concurrent reduce tasks hitting the same
+        lost output recompute it once — the second caller re-verifies under
+        the lock and finds the output already republished."""
+        ran = []
+        with self._mu:
+            for m in sorted(set(int(m) for m in map_ids)):
+                if not 0 <= m < self.num_maps:
+                    continue
+                data, _index = self.paths_for(m)
+                if verify_map_output(data) is None:
+                    continue  # another thread already recomputed it
+                log.warning("recomputing stage %d map %d from lineage",
+                            self.stage, m)
+                self._run_map(m)
+                check_map_output(data, stage=self.stage, map_id=m)
+                ran.append(m)
+                self.recomputed_maps += 1
+        if ran:
+            _TM_MAPS_RECOMPUTED.inc(len(ran))
+            _TM_STAGES_RECOVERED.inc()
+        return ran
+
+
+class LineageRegistry:
+    """Session-level stage -> StageLineage map (stage ids are unique per
+    session, so queries never collide). Entries are pruned when their
+    query's shuffle dirs are released."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._stages: Dict[int, StageLineage] = {}
+
+    def register(self, lineage: StageLineage):
+        with self._mu:
+            self._stages[lineage.stage] = lineage
+
+    def get(self, stage: Optional[int]) -> Optional[StageLineage]:
+        if stage is None:
+            return None
+        with self._mu:
+            return self._stages.get(stage)
+
+    def prune(self, stages: Iterable[int]):
+        with self._mu:
+            for s in stages:
+                self._stages.pop(s, None)
+
+    def clear(self):
+        with self._mu:
+            self._stages.clear()
+
+    def recover(self, exc: ShuffleOutputMissing, depth: int = 0):
+        """Walk lineage and recompute the outputs ``exc`` names. When the
+        recompute itself hits a missing UPSTREAM output (its input stage's
+        files also died), recurse one level up, then retry — the standalone
+        equivalent of the DAGScheduler resubmitting ancestor stages. Raises
+        the original error when no lineage covers the stage (e.g. the files
+        belonged to an already-released query)."""
+        if depth > 4:
+            raise exc
+        lineage = self.get(exc.stage)
+        if lineage is None:
+            raise exc
+        maps = exc.maps or lineage.missing()
+        try:
+            lineage.recompute(maps)
+        except ShuffleOutputMissing as upstream:
+            if upstream.stage == exc.stage:
+                raise
+            self.recover(upstream, depth + 1)
+            lineage.recompute(maps)

@@ -63,6 +63,8 @@ class _SubsetBlockProvider:
         self.subset_applies = subset_applies
 
     def __call__(self, p: int):
+        from blaze_tpu.runtime.recovery import check_map_output
+
         reducer, subset = self.parts[p]
         maps = subset if (self.subset_applies and subset is not None) \
             else range(len(self.indexes))
@@ -71,6 +73,7 @@ class _SubsetBlockProvider:
             data, offsets = self.indexes[m]
             start, end = int(offsets[reducer]), int(offsets[reducer + 1])
             if end > start:
+                check_map_output(data, offsets=offsets, map_id=m)
                 blocks.append(("file_segment", data, start, end - start))
         return blocks
 
@@ -87,11 +90,14 @@ class _CoalescedBlockProvider:
         self.groups = groups
 
     def __call__(self, p: int):
+        from blaze_tpu.runtime.recovery import check_map_output
+
         blocks = []
         for r in self.groups[p]:
-            for data, offsets in self.indexes:
+            for m, (data, offsets) in enumerate(self.indexes):
                 start, end = int(offsets[r]), int(offsets[r + 1])
                 if end > start:
+                    check_map_output(data, offsets=offsets, map_id=m)
                     blocks.append(("file_segment", data, start, end - start))
         return blocks
 
@@ -153,7 +159,13 @@ class Session:
         if num_worker_processes > 0:
             from blaze_tpu.runtime.cluster import WorkerPool
 
-            self.pool = WorkerPool(num_worker_processes)
+            self.pool = WorkerPool(num_worker_processes, conf=self.conf)
+        # stage -> StageLineage: how to recompute any map output this
+        # session still serves (runtime/recovery.py); reduce-side fetch
+        # failures walk this instead of failing the query
+        from blaze_tpu.runtime.recovery import LineageRegistry
+
+        self._lineage = LineageRegistry()
         self.resources = {}
         self._ids = itertools.count()
         self._stage_ids = itertools.count()
@@ -325,13 +337,35 @@ class Session:
             return False
 
         def produce(p: int):
-            try:
-                for b in run_partition_stream(p):
-                    if not _put(queues[p], b):
-                        return  # consumer stopped early
-                _put(queues[p], DONE)
-            except BaseException as exc:
-                _put(queues[p], exc)
+            from blaze_tpu.runtime.recovery import ShuffleOutputMissing
+
+            emitted = 0
+            recoveries = 0
+            while True:
+                try:
+                    for b in run_partition_stream(p):
+                        if not _put(queues[p], b):
+                            return  # consumer stopped early
+                        emitted += 1
+                    _put(queues[p], DONE)
+                    return
+                except ShuffleOutputMissing as exc:
+                    # reduce-side fetch failure in the FINAL stage: recover
+                    # the upstream map outputs and restart this partition's
+                    # stream — but only while zero batches were emitted
+                    # (restarting a half-consumed stream would duplicate rows)
+                    recoveries += 1
+                    if emitted or recoveries > 2:
+                        _put(queues[p], exc)
+                        return
+                    try:
+                        self._lineage.recover(exc)
+                    except BaseException as exc2:
+                        _put(queues[p], exc2)
+                        return
+                except BaseException as exc:
+                    _put(queues[p], exc)
+                    return
 
         rows_out = 0
         state = "done"
@@ -399,6 +433,10 @@ class Session:
         so a nonzero reclaim here is surfaced as a metric, not silence)."""
         import shutil
 
+        # lineage first: once the shuffle dirs go, these stages' outputs are
+        # unrecoverable by design — recovery must say so, not recompute into
+        # a deleted directory
+        self._lineage.prune(qrun.stage_meta.keys())
         for d in qrun.shuffle_dirs:
             shutil.rmtree(d, ignore_errors=True)
         for rid in qrun.resource_ids:
@@ -421,6 +459,7 @@ class Session:
         if self.pool is not None:
             self.pool.close()
             self.pool = None
+        self._lineage.clear()
         self.resources.clear()
         shutil.rmtree(self.work_dir, ignore_errors=True)
 
@@ -632,6 +671,41 @@ class Session:
             return (os.path.join(shuffle_dir, f"map_{m}.data"),
                     os.path.join(shuffle_dir, f"map_{m}.index"))
 
+        # the driver-side map task, hoisted out of the in-driver branch: it
+        # is ALSO the stage's lineage recompute closure — when a later fetch
+        # finds map m's output missing/torn, recovery re-runs exactly this,
+        # in-driver (never back on the pool: recovery can fire from a pool
+        # serve thread, and run_tasks is not re-entrant)
+        where_cell: List[str] = []
+
+        def run_map(m: int):
+            from blaze_tpu.ops.shuffle.writer import ShuffleWriterExec
+            from blaze_tpu.runtime import placement
+            from blaze_tpu.utils.logutil import clear_task_context, set_task_context
+
+            if not where_cell:
+                where_cell.append(
+                    self._decide_placement(node.child, f"stage_{stage}"))
+            data, index = paths_for(m)
+            writer = ShuffleWriterExec(child_op, node.partitioning, data, index)
+            ctx = self._make_ctx(m, stage)
+            task_metrics = self.metrics.named_child(f"stage_{stage}").named_child(f"map_{m}")
+            set_task_context(stage, m)
+            try:
+                with placement.placed(where_cell[0]), \
+                        TRACER.span("task", "task",
+                                    {"stage": stage, "map": m}):
+                    for _ in writer.execute(m, ctx, task_metrics):
+                        pass
+            finally:
+                clear_task_context()
+            return data, index
+
+        from blaze_tpu.runtime.recovery import StageLineage
+
+        lineage = StageLineage(stage, num_maps, paths_for, run_map)
+        self._lineage.register(lineage)
+
         with TRACER.span(f"stage_{stage}", "stage",
                          {"kind": "shuffle_map", "num_maps": num_maps}):
             outputs = None
@@ -639,29 +713,14 @@ class Session:
                 outputs = self._run_map_stage_on_pool(node, stage, num_maps,
                                                       paths_for)
             if outputs is None:
-                where = self._decide_placement(node.child, f"stage_{stage}")
-
-                def run_map(m: int):
-                    from blaze_tpu.ops.shuffle.writer import ShuffleWriterExec
-                    from blaze_tpu.runtime import placement
-                    from blaze_tpu.utils.logutil import clear_task_context, set_task_context
-
-                    data, index = paths_for(m)
-                    writer = ShuffleWriterExec(child_op, node.partitioning, data, index)
-                    ctx = self._make_ctx(m, stage)
-                    task_metrics = self.metrics.named_child(f"stage_{stage}").named_child(f"map_{m}")
-                    set_task_context(stage, m)
-                    try:
-                        with placement.placed(where), \
-                                TRACER.span("task", "task",
-                                            {"stage": stage, "map": m}):
-                            for _ in writer.execute(m, ctx, task_metrics):
-                                pass
-                    finally:
-                        clear_task_context()
-                    return data, index
-
                 outputs = self._run_tasks(run_map, range(num_maps))
+            # post-stage sweep: a worker that died between its reply and
+            # now (or a crashed attempt whose retry the pool routed around)
+            # must leave every committed output verifiable before reducers
+            # start — recompute any map whose footer check fails
+            missing = lineage.missing()
+            if missing:
+                lineage.recompute(missing)
 
         return stage, [(data, read_index_file(index)) for data, index in outputs]
 
@@ -1049,9 +1108,27 @@ class Session:
         # stage resources (shuffle block indexes, broadcast chunks) go to
         # each worker ONCE, not inside every task message
         qrun = self._qrun()
+
+        def on_task_error(reply):
+            # a worker hit a missing/torn upstream map output: recompute it
+            # from lineage (in-driver) and tell the pool to requeue the task
+            if reply.get("error_kind") != "shuffle_missing":
+                return False
+            from blaze_tpu.runtime.recovery import ShuffleOutputMissing
+
+            exc = ShuffleOutputMissing(
+                "(reported by worker)", "missing",
+                stage=reply.get("stage"), maps=reply.get("maps"))
+            try:
+                self._lineage.recover(exc)
+                return True
+            except Exception:
+                return False  # unrecoverable: let the retry budget decide
+
         replies = self.pool.run_tasks(
             msgs, shared=resources,
-            cancel=qrun.token if qrun is not None else None)
+            cancel=qrun.token if qrun is not None else None,
+            on_task_error=on_task_error)
         stage_metrics = self.metrics.named_child(f"stage_{stage}")
         for m, r in enumerate(replies):
             stage_metrics.named_child(f"map_{m}").merge_dict(
@@ -1204,10 +1281,25 @@ class Session:
                 self._tls.qrun = prev
 
         def run_with_retry(p):
+            from blaze_tpu.runtime.recovery import ShuffleOutputMissing
+
             attempt = 0
+            recoveries = 0
             while True:
                 try:
                     return run_task(p)
+                except ShuffleOutputMissing as exc:
+                    # fetch failure, not a task failure: recompute the named
+                    # upstream map outputs from lineage, then retry — its own
+                    # (small) bound, separate from the retry budget
+                    recoveries += 1
+                    self.metrics.add("task_retries", 1)
+                    if recoveries > 3:
+                        self.metrics.add("task_failures", 1)
+                        raise
+                    log.warning("task %s lost upstream shuffle output (%s); "
+                                "recovering from lineage", p, exc)
+                    self._lineage.recover(exc)  # re-raises if unrecoverable
                 except TaskCancelled:
                     # cancellation is not a failure: no retry, no backoff —
                     # surface immediately so sibling tasks stop too

@@ -116,6 +116,14 @@ def main(sock_path: str):
         except BaseException as exc:  # report, keep serving
             reply = {"ok": False, "error": f"{type(exc).__name__}: {exc}",
                      "traceback": traceback.format_exc()}
+            from blaze_tpu.runtime.recovery import ShuffleOutputMissing
+
+            if isinstance(exc, ShuffleOutputMissing):
+                # structured fetch failure: the driver's lineage recovery
+                # recomputes the named maps and re-queues this task
+                reply["error_kind"] = "shuffle_missing"
+                reply["stage"] = exc.stage
+                reply["maps"] = exc.maps
         send_msg(sock, reply)
 
 

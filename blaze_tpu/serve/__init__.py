@@ -9,8 +9,8 @@ admission, and sheds excess load with a typed ``Overloaded`` error.
 """
 
 from blaze_tpu.serve.scheduler import (Overloaded, QueryHandle,
-                                       QueryScheduler,
+                                       QueryRetryable, QueryScheduler,
                                        estimate_plan_memory)
 
-__all__ = ["Overloaded", "QueryHandle", "QueryScheduler",
+__all__ = ["Overloaded", "QueryHandle", "QueryRetryable", "QueryScheduler",
            "estimate_plan_memory"]

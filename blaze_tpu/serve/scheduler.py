@@ -53,6 +53,21 @@ class Overloaded(RuntimeError):
         self.reason = reason
 
 
+class QueryRetryable(RuntimeError):
+    """Typed infrastructure-loss error: the query failed because worker
+    processes died (task retry budget exhausted or the pool's circuit
+    breaker opened), NOT because the query is wrong — a client may safely
+    resubmit. Carries the flight-recorder incident bundle id
+    (``/debug/incidents/<incident_id>``) for forensics."""
+
+    retryable = True
+
+    def __init__(self, reason: str, incident_id: Optional[str] = None):
+        super().__init__(reason)
+        self.reason = reason
+        self.incident_id = incident_id
+
+
 # operators that hold per-task state proportional to their input (the spill
 # consumers): the admission estimate counts these
 _STATEFUL = (N.Sort, N.Agg, N.Window, N.SortMergeJoin, N.HashJoin,
@@ -109,6 +124,7 @@ class QueryHandle:
         self.admitted_at: Optional[float] = None
         self.finished_at: Optional[float] = None
         self._done = threading.Event()
+        self._released = False  # admission reservation dropped exactly once
 
     def cancel(self, reason: str = "cancelled by client"):
         self.token.cancel(reason)
@@ -376,9 +392,12 @@ class QueryScheduler:
         finally:
             # leak backstop: Session releases the group on cancel/failure,
             # but the RESERVATION made at admission must go even when the
-            # query never reached execute()
+            # query never reached execute(). Guarded so the slot/memory
+            # release happens exactly once per handle even if a future code
+            # path reaches this finally twice.
             mm = MemManager._instance
-            if mm is not None:
+            if mm is not None and not h._released:
+                h._released = True
                 mm.release_group(h.mem_group)
             with self._cv:
                 h.error = err
@@ -401,7 +420,17 @@ class QueryScheduler:
                 self._tm_e2e.labels(outcome=outcome).observe(
                     h.finished_at - h.submitted_at)
                 if state != "done":
-                    self._record_incident(h, outcome, err, scheduler_state)
+                    iid = self._record_incident(h, outcome, err,
+                                                scheduler_state)
+                    if state == "failed" and self._is_worker_loss(err):
+                        # infrastructure loss, not a query bug: hand the
+                        # client a typed retryable error carrying the
+                        # incident bundle id (set BEFORE _done fires so
+                        # every waiter sees the wrapped form)
+                        wrapped = QueryRetryable(
+                            f"worker loss: {err}", incident_id=iid)
+                        wrapped.__cause__ = err
+                        h.error = wrapped
             finally:
                 h._done.set()
 
@@ -441,17 +470,25 @@ class QueryScheduler:
             return "deadline"
         return state
 
+    @staticmethod
+    def _is_worker_loss(err: Optional[BaseException]) -> bool:
+        from blaze_tpu.runtime.cluster import TaskFailed
+
+        # WorkerPoolBroken subclasses TaskFailed: both mean worker
+        # processes died under the query, never that the plan is wrong
+        return isinstance(err, TaskFailed)
+
     def _record_incident(self, h: QueryHandle, outcome: str,
                          err: Optional[BaseException],
                          scheduler_state: Optional[dict],
-                         query: Optional[dict] = None):
+                         query: Optional[dict] = None) -> Optional[str]:
         from blaze_tpu.obs import dump as _dump
 
-        _dump.record_incident(outcome, h.label, error=err,
-                              session=self.session,
-                              scheduler_state=scheduler_state,
-                              handle=h, query=query,
-                              conf=self.session.conf)
+        return _dump.record_incident(outcome, h.label, error=err,
+                                     session=self.session,
+                                     scheduler_state=scheduler_state,
+                                     handle=h, query=query,
+                                     conf=self.session.conf)
 
     def _retire_locked(self, h: QueryHandle):
         self._finished.append(h.qid)

@@ -107,11 +107,15 @@ def system_zstd() -> Optional[ctypes.CDLL]:
     with _lock:
         if _sys_zstd_tried:
             return _sys_zstd
-        _sys_zstd_tried = True
-        import ctypes.util
-
-        name = ctypes.util.find_library("zstd") or "libzstd.so.1"
         try:
+            import ctypes.util
+
+            # find_library shells out (gcc/ldconfig) and can take hundreds
+            # of ms: _sys_zstd_tried must only flip True AFTER the load
+            # attempt settles, or the unlocked fast path above hands
+            # concurrent first callers a spurious None — a decode pool
+            # racing here would misread "no zstd" and fail valid frames
+            name = ctypes.util.find_library("zstd") or "libzstd.so.1"
             l = ctypes.CDLL(name)
             l.ZSTD_compressBound.restype = ctypes.c_size_t
             l.ZSTD_compressBound.argtypes = [ctypes.c_size_t]
@@ -127,6 +131,7 @@ def system_zstd() -> Optional[ctypes.CDLL]:
             _sys_zstd = l
         except (OSError, AttributeError):
             _sys_zstd = None
+        _sys_zstd_tried = True
         return _sys_zstd
 
 

@@ -231,5 +231,251 @@ def main():
         json.dump(out, f, indent=1)
 
 
+def _pctl(vals, q):
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    return s[min(len(s) - 1, int(q * len(s)))]
+
+
+def _write_chaos_section(section: str, data: dict) -> str:
+    """Merge one section into CHAOS_r01.json at the repo root (the scale and
+    serve chaos runs each own a section; reruns replace only their own)."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "CHAOS_r01.json")
+    try:
+        with open(path) as f:
+            out = json.load(f)
+    except (OSError, ValueError):
+        out = {}
+    out[section] = data
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    return path
+
+
+def chaos_main(kill_every_s: float):
+    """Chaos soak (--chaos-kill-every): run the three shuffle-bearing shapes
+    repeatedly against a 2-worker pool while a ChaosMonkey hard-kills a
+    random worker every ``kill_every_s`` seconds, then gate on
+
+      * zero wrong results (every query bit-identical to the in-driver oracle),
+      * zero leaked memory-manager bytes,
+      * worker deaths observed and every kill with an incident bundle,
+      * >= 1 stage recovered from persisted shuffle outputs (a map output is
+        deleted mid-query on a fixed cadence in BOTH phases, so the latency
+        populations stay comparable),
+      * chaos-phase p99 <= 3x the no-chaos baseline p99.
+
+    The full evidence lands in CHAOS_r01.json (section "scale") BEFORE the
+    gates are asserted, so a failing run still leaves its forensics behind.
+    Env: CHAOS_ROWS (200_000), CHAOS_ITERS (12).
+    """
+    import glob
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from blaze_tpu.config import Config
+    from blaze_tpu.ir import exprs as E
+    from blaze_tpu.ir import nodes as N
+    from blaze_tpu.ir import types as T
+    from blaze_tpu.obs.dump import list_incidents
+    from blaze_tpu.obs.telemetry import get_registry
+    from blaze_tpu.ops.parquet import scan_node_for_files
+    from blaze_tpu.runtime.cluster import ChaosMonkey
+    from blaze_tpu.runtime.memmgr import MemManager
+    from blaze_tpu.runtime.session import Session, _QueryRun
+
+    rows = int(os.environ.get("CHAOS_ROWS", 200_000))
+    iters = int(os.environ.get("CHAOS_ITERS", 12))
+
+    COUNTERS = ("blaze_cluster_worker_deaths_total",
+                "blaze_cluster_tasks_retried_total",
+                "blaze_cluster_stages_recovered_total",
+                "blaze_cluster_maps_recomputed_total",
+                "blaze_chaos_kills_total")
+
+    def counters() -> dict:
+        snap = get_registry().to_raw()
+        out = {}
+        for name in COUNTERS:
+            series = snap.get(name, {}).get("series", [])
+            out[name] = series[0]["value"] if series else 0
+        return out
+
+    def agg_by(col, reducers):
+        def mk(paths):
+            scan = scan_node_for_files(paths, num_partitions=4)
+            ex = N.ShuffleExchange(
+                scan, N.HashPartitioning([E.Column(col)], reducers))
+            return N.Agg(ex, E.AggExecMode.HASH_AGG, [(col, E.Column(col))], [
+                N.AggColumn(E.AggExpr(E.AggFunction.SUM, [E.Column("paid")],
+                                      T.I64), E.AggMode.COMPLETE, "total")])
+        return mk
+
+    def sort_top(paths):
+        scan = scan_node_for_files(paths, num_partitions=4)
+        orders = [E.SortOrder(E.Column("paid"), ascending=False),
+                  E.SortOrder(E.Column("item"))]
+        ex = N.ShuffleExchange(scan, N.SinglePartitioning(1))
+        return N.Limit(N.Sort(ex, orders), 500)
+
+    shapes = [("agg_store", agg_by("store", 4)),
+              ("agg_item", agg_by("item", 8)),
+              ("sort_top", sort_top)]
+
+    def canon(table):
+        d = table.to_pydict()
+        return sorted(zip(*d.values())) if d else []
+
+    import tempfile
+
+    section = {"kill_every_s": kill_every_s, "rows": rows, "iters": iters,
+               "phases": {}}
+    with tempfile.TemporaryDirectory(prefix="blaze_chaos_") as tmpdir:
+        rng = np.random.default_rng(11)
+        paths = []
+        for p in range(2):
+            n = rows // 2
+            tbl = pa.table({
+                "store": pa.array(rng.integers(1, 41, n), type=pa.int64()),
+                "item": pa.array(rng.integers(1, 201, n), type=pa.int64()),
+                "paid": pa.array(rng.integers(0, 10_000, n), type=pa.int64()),
+            })
+            path = os.path.join(tmpdir, f"chaos_{p}.parquet")
+            pq.write_table(tbl, path)
+            paths.append(path)
+
+        # in-driver oracle: the answers every clustered run must reproduce
+        # bit-identically, worker deaths or not
+        with Session() as s_local:
+            oracle = {name: canon(s_local.execute_to_table(mk(paths)))
+                      for name, mk in shapes}
+
+        def run_phase(with_chaos: bool) -> dict:
+            MemManager.reset()
+            conf = Config(incident_dir=os.path.join(
+                tmpdir, "incidents_chaos" if with_chaos else "incidents_base"))
+            lats, wrong, injected = [], [], 0
+            c0 = counters()
+            with Session(conf=conf, num_worker_processes=2) as sess:
+                monkey = None
+                if with_chaos:
+                    monkey = ChaosMonkey(sess.pool, kill_every_s,
+                                         seed=11).start()
+                try:
+                    for it in range(iters):
+                        for name, mk in shapes:
+                            t0 = time.perf_counter()
+                            if name == "agg_store" and it % 3 == 2:
+                                # deterministic lineage exercise: lower (runs
+                                # the map stage), delete one committed map
+                                # output, then execute — the reduce MUST
+                                # recover via lineage recompute
+                                before = set(glob.glob(os.path.join(
+                                    sess.work_dir, "shuffle_*",
+                                    "map_*.data")))
+                                qrun = _QueryRun(0)
+                                sess._tls.qrun = qrun
+                                lowered = sess._lower(mk(paths))
+                                sess._tls.qrun = None
+                                fresh = sorted(
+                                    f for f in glob.glob(os.path.join(
+                                        sess.work_dir, "shuffle_*",
+                                        "map_*.data")) if f not in before)
+                                if fresh:
+                                    # the largest output: an empty map (a
+                                    # scan range with no rows writes just
+                                    # the footer) wouldn't exercise anything
+                                    os.remove(max(fresh,
+                                                  key=os.path.getsize))
+                                    injected += 1
+                                got = canon(sess.execute_to_table(lowered))
+                            else:
+                                got = canon(sess.execute_to_table(mk(paths)))
+                            lats.append(time.perf_counter() - t0)
+                            if got != oracle[name]:
+                                wrong.append({"iter": it, "shape": name})
+                        print(json.dumps({
+                            "phase": "chaos" if with_chaos else "baseline",
+                            "iter": it, "p99_s": round(_pctl(lats, 0.99), 3),
+                            "wrong": len(wrong)}), flush=True)
+                finally:
+                    if monkey is not None:
+                        monkey.stop()
+                        # grace: the heartbeat supervisor notices a kill that
+                        # landed between the last query and stop()
+                        time.sleep(2.0)
+                kills = list(monkey.kills) if monkey else []
+                leaked_metric = int(sess.metrics.total(
+                    "query_leaked_mem_reclaimed"))
+                mm = MemManager._instance
+                stats = mm.stats() if mm is not None else {"used": 0,
+                                                           "reservations": {}}
+                incidents = [i for i in list_incidents(conf)
+                             if i["kind"] == "worker_lost"]
+            c1 = counters()
+            return {
+                "lat_s": [round(v, 4) for v in lats],
+                "p50_s": round(_pctl(lats, 0.50), 4),
+                "p99_s": round(_pctl(lats, 0.99), 4),
+                "queries": len(lats),
+                "wrong_results": wrong,
+                "injected_missing_maps": injected,
+                "kills_injected": len(kills),
+                "kills": kills,
+                "incident_bundles_worker_lost": len(incidents),
+                "leaked_mem_reclaimed": leaked_metric,
+                "mem_used_after": int(stats["used"]),
+                "mem_reservations_after": list(stats["reservations"]),
+                "counters_delta": {k: c1[k] - c0[k] for k in COUNTERS},
+            }
+
+        section["phases"]["baseline"] = base = run_phase(with_chaos=False)
+        section["phases"]["chaos"] = chaos = run_phase(with_chaos=True)
+
+    d = chaos["counters_delta"]
+    section["gates"] = gates = {
+        "wrong_results": len(base["wrong_results"])
+        + len(chaos["wrong_results"]),
+        "leaked_bytes": base["leaked_mem_reclaimed"] + base["mem_used_after"]
+        + chaos["leaked_mem_reclaimed"] + chaos["mem_used_after"],
+        "worker_deaths_total": d["blaze_cluster_worker_deaths_total"],
+        "stages_recovered_total": d["blaze_cluster_stages_recovered_total"],
+        "maps_recomputed_total": d["blaze_cluster_maps_recomputed_total"],
+        "kills_injected": chaos["kills_injected"],
+        "incident_bundles": chaos["incident_bundles_worker_lost"],
+        "p99_no_chaos_s": base["p99_s"],
+        "p99_chaos_s": chaos["p99_s"],
+        "p99_inflation": round(chaos["p99_s"] / max(base["p99_s"], 1e-9), 2),
+    }
+    path = _write_chaos_section("scale", section)
+    print(json.dumps({"gates": gates, "artifact": path}), flush=True)
+
+    # evidence is on disk; now enforce the gates
+    assert gates["wrong_results"] == 0, gates
+    assert gates["leaked_bytes"] == 0, gates
+    assert gates["worker_deaths_total"] > 0, gates
+    assert gates["stages_recovered_total"] >= 1, gates
+    assert gates["maps_recomputed_total"] >= 1, gates
+    assert gates["kills_injected"] > 0, gates
+    assert gates["incident_bundles"] >= gates["kills_injected"], gates
+    assert gates["p99_chaos_s"] <= 3.0 * gates["p99_no_chaos_s"], gates
+    print("CHAOS SOAK (scale) PASSED", flush=True)
+
+
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--chaos-kill-every", type=float, metavar="N",
+                    help="chaos mode: hard-kill a random worker every N "
+                         "seconds and gate on recovery (CHAOS_r01.json) "
+                         "instead of running the scale soak")
+    args = ap.parse_args()
+    if args.chaos_kill_every:
+        chaos_main(args.chaos_kill_every)
+    else:
+        main()

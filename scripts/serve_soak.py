@@ -376,5 +376,266 @@ def main():
     print(f"\nwrote {dst}")
 
 
+def chaos_main(kill_every_s: float):
+    """Serve chaos soak (--chaos-kill-every): clients hammer a 2-worker
+    clustered scheduler while a ChaosMonkey hard-kills a random worker every
+    ``kill_every_s`` seconds. Worker loss mid-query is absorbed by task retry
+    + respawn; a query that exhausts its retry budget surfaces as the typed
+    ``QueryRetryable`` (incident id attached) and the client RESUBMITS it.
+    Gates: zero wrong results, zero hard failures, zero leaked memory bytes,
+    worker deaths observed with incident bundles retrievable over HTTP at
+    ``/debug/incidents``, chaos p99 <= 3x the no-chaos baseline p99. Evidence
+    merges into CHAOS_r01.json (section "serve") BEFORE gates are asserted.
+    Env: CHAOS_ROWS (200_000), CHAOS_QUERIES (24), CHAOS_CLIENTS (4).
+    """
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from blaze_tpu.config import Config, set_config
+    from blaze_tpu.ir import exprs as E
+    from blaze_tpu.ir import nodes as N
+    from blaze_tpu.ir import types as T
+    from blaze_tpu.obs.telemetry import get_registry
+    from blaze_tpu.ops.parquet import scan_node_for_files
+    from blaze_tpu.runtime.cluster import ChaosMonkey
+    from blaze_tpu.runtime.http import ProfilingService
+    from blaze_tpu.runtime.memmgr import MemManager
+    from blaze_tpu.runtime.session import Session
+    from blaze_tpu.serve import Overloaded, QueryRetryable, QueryScheduler
+    from scale_soak import _pctl, _write_chaos_section
+
+    F, M, HASH = E.AggFunction, E.AggMode, E.AggExecMode.HASH_AGG
+    rows = int(os.environ.get("CHAOS_ROWS", 200_000))
+    queries = int(os.environ.get("CHAOS_QUERIES", 24))
+    clients = int(os.environ.get("CHAOS_CLIENTS", 4))
+
+    COUNTERS = ("blaze_cluster_worker_deaths_total",
+                "blaze_cluster_tasks_retried_total",
+                "blaze_cluster_stages_recovered_total",
+                "blaze_cluster_maps_recomputed_total",
+                "blaze_chaos_kills_total")
+
+    def counters() -> dict:
+        snap = get_registry().to_raw()
+        out = {}
+        for name in COUNTERS:
+            series = snap.get(name, {}).get("series", [])
+            out[name] = series[0]["value"] if series else 0
+        return out
+
+    section = {"kill_every_s": kill_every_s, "rows": rows,
+               "queries": queries, "clients": clients, "phases": {}}
+    with tempfile.TemporaryDirectory(prefix="blaze_serve_chaos_") as tmpdir:
+        rng = random.Random(11)
+        path = os.path.join(tmpdir, "store_sales.parquet")
+        pq.write_table(pa.table({
+            "ss_store_sk": [rng.randrange(12) for _ in range(rows)],
+            "ss_item_sk": [rng.randrange(2000) for _ in range(rows)],
+            "ss_net_paid": [rng.randrange(1, 50_000) for _ in range(rows)],
+        }), path)
+
+        def scan():
+            return scan_node_for_files([path], num_partitions=4)
+
+        def agg_plan():
+            g = [("ss_store_sk", E.Column("ss_store_sk"))]
+            partial = N.Agg(scan(), HASH, g, [N.AggColumn(
+                E.AggExpr(F.SUM, [E.Column("ss_net_paid")], T.I64),
+                M.PARTIAL, "paid")])
+            ex = N.ShuffleExchange(
+                partial, N.HashPartitioning([E.Column("ss_store_sk")], 4))
+            return N.Agg(ex, HASH, g, [N.AggColumn(
+                E.AggExpr(F.SUM, [E.Column("ss_net_paid")], T.I64),
+                M.FINAL, "paid")])
+
+        def sort_plan():
+            ex = N.ShuffleExchange(scan(), N.SinglePartitioning(1))
+            srt = N.Sort(ex, [E.SortOrder(E.Column("ss_net_paid"),
+                                          ascending=False)])
+            return N.Limit(srt, 1000)
+
+        def window_plan():
+            ex = N.ShuffleExchange(
+                scan(), N.HashPartitioning([E.Column("ss_store_sk")], 4))
+            return N.Window(
+                ex,
+                [N.WindowExpr(kind="rank", name="rnk")],
+                [E.Column("ss_store_sk")],
+                [E.SortOrder(E.Column("ss_net_paid"), ascending=False)])
+
+        def canon_rows(table):
+            d = table.to_pydict()
+            return sorted(zip(*d.values())) if d else []
+
+        def canon_sort(table):
+            # ties at the limit boundary make the exact top-1000 row set
+            # attempt-dependent; the sort-key multiset is deterministic
+            return sorted(table["ss_net_paid"].to_pylist())
+
+        shapes = [("agg", agg_plan, 12 << 20, canon_rows),
+                  ("sort", sort_plan, 24 << 20, canon_sort),
+                  ("window", window_plan, 24 << 20, canon_rows)]
+
+        with Session() as s_local:
+            oracle = {name: cn(s_local.execute_to_table(mk()))
+                      for name, mk, _e, cn in shapes}
+
+        def run_phase(with_chaos: bool) -> dict:
+            MemManager.reset()
+            conf = Config(
+                memory_total=BUDGET_MB << 20, memory_fraction=1.0,
+                mem_wait_timeout_s=5.0,
+                incident_dir=os.path.join(
+                    tmpdir,
+                    "incidents_chaos" if with_chaos else "incidents_base"))
+            set_config(conf)
+            lats, wrong, hard_failures, retryable_ids = [], [], [], []
+            tallies = {"completed": 0, "resubmits": 0, "gave_up": 0}
+            mu = threading.Lock()
+            seq = iter(range(queries))
+            http_incidents, http_bundle = [], None
+            with Session(conf=conf, num_worker_processes=2) as sess:
+                svc = ProfilingService.start(sess) if with_chaos else None
+                monkey = ChaosMonkey(sess.pool, kill_every_s,
+                                     seed=13).start() if with_chaos else None
+                try:
+                    with QueryScheduler(sess, max_concurrent=2, max_queue=8,
+                                        queue_timeout_s=60.0) as sched:
+                        def client(cid):
+                            rngc = random.Random(200 + cid)
+                            while True:
+                                with mu:
+                                    i = next(seq, None)
+                                if i is None:
+                                    return
+                                name, mk, est, cn = shapes[i % len(shapes)]
+                                t0 = time.perf_counter()
+                                got = None
+                                for _attempt in range(5):
+                                    try:
+                                        h = sched.submit(
+                                            mk(), mem_estimate=est,
+                                            label=f"{name}_{i}")
+                                        got = h.result(timeout=300)
+                                        break
+                                    except Overloaded:
+                                        time.sleep(rngc.uniform(0.05, 0.2))
+                                    except QueryRetryable as exc:
+                                        # the typed retryable contract: the
+                                        # client just resubmits
+                                        with mu:
+                                            tallies["resubmits"] += 1
+                                            if exc.incident_id:
+                                                retryable_ids.append(
+                                                    exc.incident_id)
+                                    except BaseException as exc:
+                                        with mu:
+                                            hard_failures.append(
+                                                f"{name}_{i}: "
+                                                f"{type(exc).__name__}: "
+                                                f"{exc}")
+                                        return
+                                with mu:
+                                    if got is None:
+                                        tallies["gave_up"] += 1
+                                        return
+                                    tallies["completed"] += 1
+                                    lats.append(time.perf_counter() - t0)
+                                    if cn(got) != oracle[name]:
+                                        wrong.append(
+                                            {"query": i, "shape": name})
+
+                        ts = [threading.Thread(target=client, args=(c,),
+                                               daemon=True)
+                              for c in range(clients)]
+                        for t in ts:
+                            t.start()
+                        for t in ts:
+                            t.join()
+                finally:
+                    if monkey is not None:
+                        monkey.stop()
+                        time.sleep(2.0)  # heartbeat grace for the last kill
+                    if svc is not None:
+                        # the ISSUE's contract: every killed worker's bundle
+                        # is retrievable over HTTP under /debug/incidents
+                        base_url = f"http://127.0.0.1:{svc.port}"
+                        all_inc = json.loads(_get(base_url,
+                                                  "/debug/incidents"))
+                        http_incidents = [b for b in all_inc
+                                          if b["kind"] == "worker_lost"]
+                        if http_incidents:
+                            http_bundle = json.loads(_get(
+                                base_url, "/debug/incidents/"
+                                f"{http_incidents[0]['id']}"))
+                        ProfilingService.stop()
+                kills = list(monkey.kills) if monkey else []
+                mm = MemManager._instance
+                leaked = int(mm.used) if mm is not None else 0
+            return {
+                "lat_s": [round(v, 4) for v in lats],
+                "p50_s": round(_pctl(lats, 0.50), 4),
+                "p99_s": round(_pctl(lats, 0.99), 4),
+                **tallies,
+                "wrong_results": wrong,
+                "hard_failures": hard_failures,
+                "retryable_incident_ids": retryable_ids,
+                "kills_injected": len(kills),
+                "kills": kills,
+                "incident_bundles_worker_lost": len(http_incidents),
+                "bundle_has_wid": bool(http_bundle
+                                       and "wid" in http_bundle["extra"]),
+                "leaked_mem": leaked,
+            }
+
+        section["phases"]["baseline"] = base = run_phase(with_chaos=False)
+        c1 = counters()
+        section["phases"]["chaos"] = chaos = run_phase(with_chaos=True)
+        c2 = counters()
+        section["counters_delta_chaos"] = {k: c2[k] - c1[k] for k in COUNTERS}
+
+    d = section["counters_delta_chaos"]
+    section["gates"] = gates = {
+        "wrong_results": len(base["wrong_results"])
+        + len(chaos["wrong_results"]),
+        "hard_failures": len(base["hard_failures"])
+        + len(chaos["hard_failures"]),
+        "gave_up": base["gave_up"] + chaos["gave_up"],
+        "leaked_bytes": base["leaked_mem"] + chaos["leaked_mem"],
+        "worker_deaths_total": d["blaze_cluster_worker_deaths_total"],
+        "kills_injected": chaos["kills_injected"],
+        "incident_bundles": chaos["incident_bundles_worker_lost"],
+        "p99_no_chaos_s": base["p99_s"],
+        "p99_chaos_s": chaos["p99_s"],
+        "p99_inflation": round(chaos["p99_s"] / max(base["p99_s"], 1e-9), 2),
+    }
+    path = _write_chaos_section("serve", section)
+    print(json.dumps({"gates": gates, "artifact": path}), flush=True)
+
+    assert gates["wrong_results"] == 0, gates
+    assert gates["hard_failures"] == 0, (gates,
+                                         chaos["hard_failures"],
+                                         base["hard_failures"])
+    assert gates["gave_up"] == 0, gates
+    assert gates["leaked_bytes"] == 0, gates
+    assert gates["worker_deaths_total"] > 0, gates
+    assert gates["kills_injected"] > 0, gates
+    assert gates["incident_bundles"] >= gates["kills_injected"], gates
+    assert chaos["bundle_has_wid"], "bundle must identify the lost worker"
+    assert gates["p99_chaos_s"] <= 3.0 * gates["p99_no_chaos_s"], gates
+    print("CHAOS SOAK (serve) PASSED", flush=True)
+
+
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--chaos-kill-every", type=float, metavar="N",
+                    help="chaos mode: hard-kill a random worker every N "
+                         "seconds under serving load and gate on recovery "
+                         "(CHAOS_r01.json) instead of the plain serve soak")
+    args = ap.parse_args()
+    if args.chaos_kill_every:
+        chaos_main(args.chaos_kill_every)
+    else:
+        main()

@@ -82,7 +82,12 @@ def test_shuffle_write_read_roundtrip(tmp_path):
     assert out == []
     offsets = read_index_file(indexf)
     assert len(offsets) == 5
-    assert offsets[-1] == os.path.getsize(dataf)
+    # the payload ends at the last offset; the atomic-commit footer
+    # (length + crc32, runtime/recovery.py) rides after it
+    from blaze_tpu.runtime.recovery import FOOTER_LEN, verify_map_output
+
+    assert offsets[-1] == os.path.getsize(dataf) - FOOTER_LEN
+    assert verify_map_output(dataf, indexf, full=True) is None
 
     ctx = ExecContext()
     got_rows = 0

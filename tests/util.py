@@ -63,3 +63,17 @@ class CrashOnce:
                 f.write("attempt")
             os._exit(9)
         return x
+
+
+class CrashAlways:
+    """Worker-crash fixture UDF: hard-kills the hosting WORKER process on
+    every call (retry-budget exhaustion tests). Guarded by an env var the
+    driver process never sets on itself, so in-driver fallback attempts
+    survive and only pool workers die."""
+
+    def __call__(self, x):
+        import os
+
+        if os.environ.get("BLAZE_WORKER_PLATFORM") is not None:
+            os._exit(9)
+        raise RuntimeError("CrashAlways ran outside a pool worker")
