@@ -261,6 +261,33 @@ class Config:
     # path.
     codes_shuffle: bool = True
 
+    # Zero-copy data plane (io/shm_segments.py, runtime/segments.py): same-
+    # process exchanges pass ColumnarBatch references through an in-memory
+    # segment registry (no serde at all); same-host shuffles commit raw
+    # offset-indexed column planes into mmap-able segment files under
+    # /dev/shm (spill-dir fallback) that readers map instead of decoding.
+    # Cross-network / RSS paths keep the classic IPC serde automatically.
+    # False restores the serialize-everything path (escape hatch,
+    # test-guarded for bit-identical results).
+    zero_copy_shuffle: bool = True
+
+    # Force one tier for tests: None = negotiate from placement
+    # (pool-less -> "process", local pool -> "shm"); "process" | "shm" |
+    # "ipc" pin the tier. "process" with a worker pool degrades to "shm"
+    # (batch references cannot cross process boundaries).
+    zero_copy_tier: Optional[str] = None
+
+    # Directory for shm-tier segment files. None = /dev/shm when writable
+    # with at least shm_min_free_bytes free, else the session work dir
+    # (plain disk — mmap still works, just without the tmpfs win).
+    shm_dir: Optional[str] = None
+    shm_min_free_bytes: int = 256 << 20
+
+    # Budget for process-tier in-memory staged partitions per map task;
+    # beyond it (or under memmgr spill pressure) the writer degrades to the
+    # shm/raw file path for that map output.
+    zero_copy_mem_segment_max_bytes: int = 256 << 20
+
     # Query serving layer (serve/scheduler.py): concurrency slots, queue
     # bounds, and admission control. A query is admitted only when the
     # MemManager's headroom covers its estimated footprint; a full queue or

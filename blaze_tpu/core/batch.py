@@ -335,6 +335,43 @@ def device_columns(items, capacity: int) -> List["DeviceColumn"]:
     ]
 
 
+def device_columns_mapped(items, capacity: int, num_rows: int,
+                          mapped: bool = True) -> List["DeviceColumn"]:
+    """Upload columns whose planes are ALREADY capacity-length views over a
+    raw shuffle frame (zero-copy data plane): no zeroed staging buffer, no
+    copyto, no dtype fix-up — the mapped (possibly readonly) numpy views go
+    straight into one batched ``jax.device_put``. Validity-less columns get
+    the device row-exists mask. ``mapped=True`` books the bytes as
+    DEVICE_STATS mapped (buffers entering jax with the host staging copy
+    elided), NOT as to_device transfer — the audit split satellite 3 asks
+    for; pass False for raw frames read off plain (unmapped) streams."""
+    from blaze_tpu.utils.device import DEVICE_STATS
+
+    bufs: List[np.ndarray] = []
+    plan = []  # (dt, data_slot, valid_slot_or_None)
+    for dt, data, validity in items:
+        assert len(data) == capacity, (len(data), capacity)
+        plan.append((dt, len(bufs),
+                     len(bufs) + 1 if validity is not None else None))
+        bufs.append(data)
+        if validity is not None:
+            bufs.append(validity)
+    if not bufs:
+        return []
+    dev = jax.device_put(bufs)
+    nbytes = sum(b.nbytes for b in bufs)
+    if mapped:
+        DEVICE_STATS.add_mapped(nbytes)
+    else:
+        DEVICE_STATS.add_to_device(nbytes)
+    return [
+        DeviceColumn(dt, dev[di],
+                     dev[vi] if vi is not None
+                     else _row_mask(capacity, num_rows))
+        for dt, di, vi in plan
+    ]
+
+
 def _arrow_to_column(arr: pa.Array, dt: T.DataType, capacity: int) -> Column:
     from blaze_tpu.utils.device import is_device_dtype
 

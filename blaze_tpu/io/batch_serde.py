@@ -220,11 +220,11 @@ def serialize_batch(batch, transpose: Optional[bool] = None,
     return out.getvalue()
 
 
-def deserialize_batch(payload: bytes,
+def deserialize_batch(payload,
                       dict_ctx: Optional[DictDecodeContext] = None
                       ) -> ColumnarBatch:
     cfg = get_config()
-    buf = memoryview(payload)
+    buf = payload if isinstance(payload, memoryview) else memoryview(payload)
     (hlen,) = struct.unpack_from("<I", buf, 0)
     header = json.loads(bytes(buf[4 : 4 + hlen]).decode())
     pos = 4 + hlen
@@ -234,13 +234,16 @@ def deserialize_batch(payload: bytes,
     ipc_len = header["ipc_len"]
     host_arrays: List[pa.Array] = []
     if ipc_len:
-        reader = pa.ipc.open_stream(pa.py_buffer(bytes(buf[pos : pos + ipc_len])))
+        # py_buffer over the view, not bytes(): arrow reads IPC in place, so
+        # an uncompressed frame served off an mmap'd segment decodes with no
+        # payload copy at all (the consumer's refs pin the source buffer)
+        reader = pa.ipc.open_stream(pa.py_buffer(buf[pos : pos + ipc_len]))
         rb = reader.read_next_batch()
         host_arrays = list(rb.columns)  # positional, matches "host" meta order
     pos += ipc_len
     dict_refs = dict_ctx.refs if dict_ctx is not None else {}
     for dm in header.get("dicts", ()):
-        dbuf = pa.py_buffer(bytes(buf[pos : pos + dm["len"]]))
+        dbuf = pa.py_buffer(buf[pos : pos + dm["len"]])
         pos += dm["len"]
         darr = pa.ipc.open_stream(dbuf).read_next_batch().column(0)
         if isinstance(darr, pa.ChunkedArray):
@@ -248,10 +251,12 @@ def deserialize_batch(payload: bytes,
         dict_refs[dm["ref"]] = darr
 
     def read_buf():
+        # memoryview slice, not bytes(): plane decode below views it via
+        # np.frombuffer, which keeps the view (and its source) alive
         nonlocal pos
         (blen,) = struct.unpack_from("<Q", buf, pos)
         pos += 8
-        b = bytes(buf[pos : pos + blen])
+        b = buf[pos : pos + blen]
         pos += blen
         return b
 
@@ -298,6 +303,191 @@ def deserialize_batch(payload: bytes,
     return ColumnarBatch(schema, cols, n)
 
 
+def serialize_batch_raw(batch,
+                        dict_ctx: Optional[DictEncodeContext] = None
+                        ) -> bytes:
+    """One batch -> RAW mappable payload (zero-copy data plane, tier shm).
+
+    Layout: u32 header-json length, header json, arrow-IPC host block,
+    stream-dictionary blocks, zero pad to the 64-aligned planes block, then
+    per fixed-width column a CAPACITY-length little-endian data plane (zero
+    tail past num_rows) and, only for columns with nulls, a raw bool
+    validity plane — each plane at a 64-aligned offset recorded in the
+    header RELATIVE to the planes-block start. The planes-block start is
+    not recorded: readers recompute it from the prefix lengths, so the
+    header never depends on its own encoded size. Host columns keep the
+    exact classic IPC + dictionary-ref machinery (codes shuffle included).
+    The payload is padded so header+payload is a RAW_ALIGN multiple."""
+    from blaze_tpu.core.batch import HostBatch
+
+    n = batch.num_rows
+    cap = get_config().capacity_for(n)
+    if isinstance(batch, HostBatch):
+        pulled = [it if isinstance(it, tuple) else None for it in batch.items]
+        host_arrays = {i: it for i, it in enumerate(batch.items)
+                       if not isinstance(it, tuple)}
+    else:
+        from blaze_tpu.utils.device import pull_columns
+
+        pulled = pull_columns(batch.columns, n)
+        host_arrays = {i: c.to_arrow(n) for i, c in enumerate(batch.columns)
+                       if pulled[i] is None}
+    planes: List[tuple] = []  # (rel_off, np buffer)
+    cols_meta = []
+    host_cols = []
+    host_idx = []
+    new_dicts: List[tuple] = []
+    rel = 0
+    for i in range(len(batch.schema)):
+        f = batch.schema[i]
+        if pulled[i] is not None:
+            data, validity = pulled[i]
+            npdt = f.dtype.np_dtype
+            buf = np.zeros(cap, dtype=npdt)
+            np.copyto(buf[:n], data, casting="unsafe")
+            meta = {"kind": "dev", "off": rel}
+            planes.append((rel, buf))
+            rel = _align_up(rel + buf.nbytes)
+            if validity is not None and not validity.all():
+                # padded tail stays validity=False, data=0 — the engine-wide
+                # padding discipline, preserved bit-for-bit through the map
+                vbuf = np.zeros(cap, dtype=bool)
+                vbuf[:n] = validity
+                np.copyto(buf[:n], np.where(validity, data,
+                                            np.zeros((), npdt)),
+                          casting="unsafe")
+                meta["voff"] = rel
+                planes.append((rel, vbuf))
+                rel = _align_up(rel + vbuf.nbytes)
+            cols_meta.append(meta)
+        else:
+            host_idx.append(i)
+            arr = host_arrays[i]
+            meta = {"kind": "host"}
+            if dict_ctx is not None:
+                arr, meta = _maybe_dict_ref(arr, meta, dict_ctx, new_dicts, n)
+            host_cols.append(arr)
+            cols_meta.append(meta)
+    if host_cols:
+        sink = io.BytesIO()
+        arrays = [a.combine_chunks() if isinstance(a, pa.ChunkedArray) else a
+                  for a in host_cols]
+        hschema = pa.schema(
+            [pa.field(f"h{k}", arrays[k].type) for k in range(len(host_idx))]
+        )
+        rb = pa.RecordBatch.from_arrays(arrays, schema=hschema)
+        with pa.ipc.new_stream(sink, hschema) as w:
+            w.write_batch(rb)
+        ipc_bytes = sink.getvalue()
+    else:
+        ipc_bytes = b""
+    dict_streams: List[tuple] = []
+    for ref, d in new_dicts:
+        sink = io.BytesIO()
+        dschema = pa.schema([pa.field("d", d.type)])
+        with pa.ipc.new_stream(sink, dschema) as w:
+            w.write_batch(pa.RecordBatch.from_arrays([d], schema=dschema))
+        db = sink.getvalue()
+        dict_streams.append((ref, db))
+        dict_ctx.codes_bytes += len(db)
+    hdr = {"schema": schema_to_json(batch.schema), "num_rows": n, "cap": cap,
+           "cols": cols_meta, "ipc_len": len(ipc_bytes)}
+    if dict_streams:
+        hdr["dicts"] = [{"ref": r, "len": len(b)} for r, b in dict_streams]
+    header = json.dumps(hdr).encode()
+    prefix = 4 + len(header) + len(ipc_bytes) + sum(
+        len(b) for _r, b in dict_streams)
+    # payload-relative planes-block start: chosen so the ABSOLUTE offset
+    # (frame header + planes_start) is RAW_ALIGN-aligned when the frame
+    # itself starts aligned (guaranteed by the whole-frame padding below)
+    planes_start = _align_up(_FRAME_LEN + prefix) - _FRAME_LEN
+    end = planes_start + rel
+    total = _align_up(_FRAME_LEN + end) - _FRAME_LEN
+    out = bytearray(total)
+    struct.pack_into("<I", out, 0, len(header))
+    pos = 4
+    out[pos : pos + len(header)] = header
+    pos += len(header)
+    out[pos : pos + len(ipc_bytes)] = ipc_bytes
+    pos += len(ipc_bytes)
+    for _r, b in dict_streams:
+        out[pos : pos + len(b)] = b
+        pos += len(b)
+    for off, buf in planes:
+        raw = buf.view(np.uint8).reshape(-1).data if buf.flags.c_contiguous \
+            else np.ascontiguousarray(buf).view(np.uint8).reshape(-1).data
+        out[planes_start + off : planes_start + off + buf.nbytes] = raw
+    return bytes(out)
+
+
+def deserialize_batch_raw(payload,
+                          dict_ctx: Optional[DictDecodeContext] = None,
+                          mapped: bool = False) -> ColumnarBatch:
+    """Construct a batch OVER a raw frame payload: fixed-width planes become
+    numpy views into the payload (no decode, no copy — the views pin the
+    source mmap/bytes), uploaded in one batched device_put. ``mapped=True``
+    counts the plane bytes as DEVICE_STATS mapped rather than transferred
+    (the reader sets it for streams served off an mmap'd segment)."""
+    buf = payload if isinstance(payload, memoryview) else memoryview(payload)
+    (jlen,) = struct.unpack_from("<I", buf, 0)
+    header = json.loads(bytes(buf[4 : 4 + jlen]).decode())
+    schema = schema_from_json(header["schema"])
+    n = header["num_rows"]
+    cap = header["cap"]
+    ipc_len = header["ipc_len"]
+    pos = 4 + jlen
+    host_arrays: List[pa.Array] = []
+    if ipc_len:
+        reader = pa.ipc.open_stream(pa.py_buffer(buf[pos : pos + ipc_len]))
+        host_arrays = list(reader.read_next_batch().columns)
+    pos += ipc_len
+    dict_refs = dict_ctx.refs if dict_ctx is not None else {}
+    for dm in header.get("dicts", ()):
+        dbuf = pa.py_buffer(buf[pos : pos + dm["len"]])
+        pos += dm["len"]
+        darr = pa.ipc.open_stream(dbuf).read_next_batch().column(0)
+        if isinstance(darr, pa.ChunkedArray):
+            darr = darr.combine_chunks()
+        dict_refs[dm["ref"]] = darr
+    planes_start = _align_up(_FRAME_LEN + pos) - _FRAME_LEN
+    from blaze_tpu.core.batch import device_columns_mapped
+
+    cols: List = [None] * len(header["cols"])
+    next_host = 0
+    dev_items, dev_slots = [], []
+    for i, meta in enumerate(header["cols"]):
+        f = schema[i]
+        if meta["kind"] == "dev":
+            npdt = f.dtype.np_dtype
+            data = np.frombuffer(buf, dtype=npdt, count=cap,
+                                 offset=planes_start + meta["off"])
+            voff = meta.get("voff")
+            validity = np.frombuffer(buf, dtype=np.bool_, count=cap,
+                                     offset=planes_start + voff) \
+                if voff is not None else None
+            dev_items.append((f.dtype, data, validity))
+            dev_slots.append(i)
+        else:
+            arr = host_arrays[next_host]
+            next_host += 1
+            ref = meta.get("dict_ref")
+            if ref is not None:
+                d = dict_refs.get(ref)
+                if d is None:
+                    raise RuntimeError(
+                        f"frame references dictionary {ref} but no decode "
+                        "context carries it (out-of-order decode?)")
+                if isinstance(arr, pa.ChunkedArray):
+                    arr = arr.combine_chunks()
+                arr = pa.DictionaryArray.from_arrays(arr, d)
+            cols[i] = HostColumn(f.dtype, arr)
+    for slot, col in zip(dev_slots,
+                         device_columns_mapped(dev_items, cap, n,
+                                               mapped=mapped)):
+        cols[slot] = col
+    return ColumnarBatch(schema, cols, n)
+
+
 _FRAME_FMT = "<4sIQQ"  # magic, flags, compressed len, raw len
 _FRAME_LEN = struct.calcsize(_FRAME_FMT)
 # flags: low nibble = codec (0=raw, 1=zstd, 2=lz4, 3=zlib); bit 0x10 marks
@@ -305,7 +495,23 @@ _FRAME_LEN = struct.calcsize(_FRAME_FMT)
 # worker pool must decode such frames in stream order (inline) so the
 # dictionary is registered before any pooled frame references it
 FRAME_DICT_DEF = 0x10
+# bit 0x20 marks a RAW mappable frame (zero-copy data plane): uncompressed
+# payload whose fixed-width planes sit at aligned offsets AT CAPACITY
+# LENGTH, so a reader constructs numpy views straight over the (mmap'd)
+# payload and hands them to jax with no decode and no staging copy
+FRAME_RAW_BATCH = 0x20
 _CODEC_MASK = 0x0F
+
+# Raw-frame plane alignment. Every raw frame's total size (header +
+# payload) is padded to a multiple of RAW_ALIGN, so frame starts — and
+# therefore plane offsets — stay 64-byte aligned across arbitrary
+# concatenation (partition segments, spill merges). Alignment is a numpy /
+# dlpack performance nicety only; correctness never depends on it.
+RAW_ALIGN = 64
+
+
+def _align_up(x: int, a: int = RAW_ALIGN) -> int:
+    return (x + a - 1) & ~(a - 1)
 # Map-output commit footer magic (runtime/recovery.py appends the footer
 # after the last partition segment of a shuffle data file). Defined here so
 # whole-file frame iteration can treat it as a clean end-of-stream without
@@ -421,13 +627,17 @@ class BatchWriter:
     python zstandard binding."""
 
     def __init__(self, fileobj: BinaryIO, codec: Optional[str] = None,
-                 dict_refs: bool = False):
+                 dict_refs: bool = False, raw: bool = False):
         cfg = get_config()
         self.f = fileobj
         self.codec = codec or cfg.shuffle_compression_codec
         self.level = cfg.zstd_level
         self.bytes_written = 0
         self.dict_ctx = DictEncodeContext() if dict_refs else None
+        # raw=True emits FRAME_RAW_BATCH mappable frames (zero-copy data
+        # plane) instead of compressed serde frames; both flavors share the
+        # frame envelope, so spill merges / footers / read_frames are common
+        self.raw = raw
 
     @property
     def codes_bytes(self) -> int:
@@ -436,17 +646,22 @@ class BatchWriter:
     def write_batch(self, batch: ColumnarBatch):
         refs_before = self.dict_ctx.next_ref if self.dict_ctx else 0
         codes_before = self.codes_bytes
-        payload = serialize_batch(batch, dict_ctx=self.dict_ctx)
-        raw_len = len(payload)
-        flags = 0
-        if self.codec == "lz4":
-            out = _lz4_compress(payload)
-            if out is not None:
-                payload, flags = out, 2
-            else:  # liblz4 missing: degrade to zstd, stay readable
+        if self.raw:
+            payload = serialize_batch_raw(batch, dict_ctx=self.dict_ctx)
+            raw_len = len(payload)
+            flags = FRAME_RAW_BATCH
+        else:
+            payload = serialize_batch(batch, dict_ctx=self.dict_ctx)
+            raw_len = len(payload)
+            flags = 0
+            if self.codec == "lz4":
+                out = _lz4_compress(payload)
+                if out is not None:
+                    payload, flags = out, 2
+                else:  # liblz4 missing: degrade to zstd, stay readable
+                    payload, flags = self._zstd_or_zlib(payload)
+            elif self.codec != "none":
                 payload, flags = self._zstd_or_zlib(payload)
-        elif self.codec != "none":
-            payload, flags = self._zstd_or_zlib(payload)
         if self.dict_ctx is not None and self.dict_ctx.next_ref > refs_before:
             flags |= FRAME_DICT_DEF
         if self.codes_bytes > codes_before:
@@ -483,12 +698,16 @@ def read_frames(fileobj) -> Iterator[tuple]:
         yield flags, fileobj.read(plen), raw_len
 
 
-def decode_frame(flags: int, payload: bytes, raw_len: int,
-                 dict_ctx: Optional[DictDecodeContext] = None
-                 ) -> ColumnarBatch:
+def decode_frame(flags: int, payload, raw_len: int,
+                 dict_ctx: Optional[DictDecodeContext] = None,
+                 mapped: bool = False) -> ColumnarBatch:
     """Decompress + deserialize one frame (thread-safe for frames without
     the FRAME_DICT_DEF flag; dict-defining frames mutate dict_ctx and must
-    decode in stream order)."""
+    decode in stream order). ``mapped`` tags a raw frame served off an
+    mmap'd segment for the DEVICE_STATS mapped-vs-copied split."""
+    if flags & FRAME_RAW_BATCH:
+        return deserialize_batch_raw(payload, dict_ctx=dict_ctx,
+                                     mapped=mapped)
     codec = flags & _CODEC_MASK
     if codec == 2:
         payload = _lz4_decompress(payload, raw_len)

@@ -1,0 +1,117 @@
+"""Shared-memory segment plumbing for the zero-copy data plane.
+
+The shm tier of the zero-copy shuffle (config.zero_copy_shuffle) commits
+map outputs as RAW mappable frames (io/batch_serde.serialize_batch_raw)
+into segment files under a tmpfs root — ``/dev/shm`` when it is writable
+and has headroom, the session work dir otherwise (plain disk: ``mmap``
+still works, only the tmpfs page-cache win is lost). Readers ``mmap`` the
+committed files and construct batches over the mapped memory; nothing
+here changes the commit protocol (atomic tmp+rename, crc32 footer) or the
+lineage semantics (a torn/missing segment still raises
+``ShuffleOutputMissing`` through runtime/recovery.py).
+
+Lifetime discipline: a mapping is NEVER closed explicitly — decoded
+batches hold numpy/arrow views into it, and closing an ``mmap`` with live
+buffer exports raises ``BufferError``. Instead the mapping dies by
+refcount once every view does, and files are unlinked as soon as their
+query releases (unlink-while-mapped is safe on POSIX: pages live until
+the last mapping drops). The leak surface the soaks gate on is therefore
+directory entries under the session's ``blaze_tpu_shm_*`` root, not
+mapped pages.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import threading
+import weakref
+from typing import Optional
+
+SHM_DEFAULT_DIR = "/dev/shm"
+# Session shm roots are mkdtemp'd with this prefix so soaks can assert no
+# roots outlive their session (the /dev/shm leak gate).
+SHM_ROOT_PREFIX = "blaze_tpu_shm_"
+
+
+def choose_shm_root(shm_dir: Optional[str], min_free_bytes: int
+                    ) -> Optional[str]:
+    """Directory to host shm segment files, or None to fall back to the
+    session work dir. An explicit ``shm_dir`` wins unconditionally (tests
+    point it at throwaway paths); otherwise /dev/shm is used only when it
+    is a writable directory with at least ``min_free_bytes`` free."""
+    if shm_dir is not None:
+        return shm_dir
+    d = SHM_DEFAULT_DIR
+    if not os.path.isdir(d) or not os.access(d, os.W_OK):
+        return None
+    try:
+        st = os.statvfs(d)
+        if st.f_bavail * st.f_frsize < min_free_bytes:
+            return None
+    except OSError:
+        return None
+    return d
+
+
+class MappedFile:
+    """One mmap'd committed shuffle data file. Holds the whole-file mapping;
+    segment views slice it. The fd is closed immediately (the mapping keeps
+    the file alive); the mapping itself is released by GC once the last
+    exported view dies."""
+
+    __slots__ = ("path", "size", "_mm", "__weakref__")
+
+    def __init__(self, path: str):
+        self.path = path
+        with open(path, "rb") as f:
+            self.size = os.fstat(f.fileno()).st_size
+            self._mm = mmap.mmap(f.fileno(), self.size,
+                                 access=mmap.ACCESS_READ) \
+                if self.size else None
+
+    def view(self, start: int, length: int) -> memoryview:
+        if self._mm is None:
+            return memoryview(b"")
+        return memoryview(self._mm)[start : start + length]
+
+
+# path -> MappedFile, weakly held: one mapping serves every segment of a
+# map output while any reader still references it; dead entries vanish
+# with their last view. Re-mapping a since-replaced file is harmless —
+# recovery republishes under the same path via atomic rename, and the old
+# mapping keeps serving the old (complete, footer-verified) bytes.
+_MAPPED: "weakref.WeakValueDictionary[str, MappedFile]" = \
+    weakref.WeakValueDictionary()
+_MAPPED_MU = threading.Lock()
+
+
+def open_mapped(path: str) -> MappedFile:
+    with _MAPPED_MU:
+        mf = _MAPPED.get(path)
+        if mf is None:
+            mf = MappedFile(path)
+            _MAPPED[path] = mf
+    return mf
+
+
+class MappedSegmentStream:
+    """File-like over a mapped byte range whose ``read()`` returns
+    memoryview SLICES — zero copy, and each slice pins the mapping. Ducks
+    enough of the stream protocol for ``read_frames``; ``mapped`` flags the
+    reader to account decoded plane bytes as mapped, not transferred."""
+
+    mapped = True
+
+    __slots__ = ("_v", "_pos")
+
+    def __init__(self, view: memoryview):
+        self._v = view
+        self._pos = 0
+
+    def read(self, n: int = -1) -> memoryview:
+        if n is None or n < 0:
+            n = len(self._v) - self._pos
+        out = self._v[self._pos : self._pos + n]
+        self._pos += len(out)
+        return out

@@ -24,6 +24,12 @@ from blaze_tpu.ops.base import Operator
 _TM_FETCH_SECS = get_registry().histogram(
     "blaze_shuffle_fetch_seconds",
     "prefetch-side wall time fetching+decoding one partition's blocks")
+_TM_SHM_MAPPED = get_registry().counter(
+    "blaze_shuffle_shm_mapped_bytes",
+    "frame payload bytes served to readers from mmap'd shuffle segments")
+_TM_ELIDED = get_registry().counter(
+    "blaze_shuffle_serde_elided_total",
+    "batches exchanged as in-process references with serde skipped")
 
 
 class IpcReaderExec(Operator):
@@ -71,9 +77,23 @@ class IpcReaderExec(Operator):
                     continue
             return False
 
-        def _decode(flags, payload, raw_len):
-            batch = decode_frame(flags, payload, raw_len, dict_ctx)
+        use_mmap = bool(ctx.conf.zero_copy_shuffle
+                        and ctx.conf.zero_copy_tier != "ipc")
+
+        def _decode(flags, payload, raw_len, mapped=False):
+            batch = decode_frame(flags, payload, raw_len, dict_ctx,
+                                 mapped=mapped)
             metrics.add("ipc_decode_in_prefetch", 1)
+            return batch
+
+        def _materialize(ref):
+            # process-tier block: the batch reference crossed the exchange
+            # with serde skipped entirely; only the device upload remains
+            # (collect-path references are already ColumnarBatch — nothing
+            # left to do but count them)
+            batch = ref.to_columnar() if hasattr(ref, "to_columnar") else ref
+            metrics.add("serde_elided_batches", 1)
+            _TM_ELIDED.inc()
             return batch
 
         pool = ThreadPoolExecutor(max_workers=self._DECODE_WORKERS,
@@ -93,8 +113,24 @@ class IpcReaderExec(Operator):
             try:
                 for block in blocks:
                     nblocks += 1
-                    stream = _open_block(block)
+                    if isinstance(block, tuple) and block \
+                            and block[0] == "batches":
+                        # in-process segment references (zero-copy process
+                        # tier): materialize on the decode pool so device
+                        # upload overlaps downstream compute like decode does
+                        for hb in block[1]:
+                            fu = pool.submit(_materialize, hb)
+                            pending = [f for f in pending if not f.done()]
+                            pending.append(fu)
+                            if not _put(fu):
+                                return
+                        continue
+                    stream = _open_block(block, use_mmap=use_mmap)
+                    mapped = getattr(stream, "mapped", False)
                     for frame in read_frames(stream):
+                        if mapped:
+                            metrics.add("shm_bytes_mapped", len(frame[1]))
+                            _TM_SHM_MAPPED.inc(len(frame[1]))
                         if frame[0] & FRAME_DICT_DEF:
                             # dictionary-defining frame: decode INLINE in
                             # stream order, with a barrier first — a spilled
@@ -107,10 +143,10 @@ class IpcReaderExec(Operator):
                                 except BaseException:
                                     pass  # surfaced via the queue
                             pending = []
-                            if not _put(_decode(*frame)):
+                            if not _put(_decode(*frame, mapped=mapped)):
                                 return
                             continue
-                        fu = pool.submit(_decode, *frame)
+                        fu = pool.submit(_decode, *frame, mapped=mapped)
                         pending = [f for f in pending if not f.done()]
                         pending.append(fu)
                         if not _put(fu):
@@ -155,9 +191,25 @@ class IpcReaderExec(Operator):
             pool.shutdown(wait=False)
 
 
-def _open_block(block):
+def _open_block(block, use_mmap: bool = False):
     if isinstance(block, tuple) and block and block[0] == "file_segment":
         _, path, offset, length = block
+        if use_mmap:
+            # zero-copy plane: map the committed file and serve memoryview
+            # slices — raw frames become numpy views over the mapping, and
+            # even classic frames decode without per-buffer copies. The
+            # mapping outlives an unlink (POSIX) and is freed by refcount
+            # once every decoded batch's views die.
+            from blaze_tpu.io.shm_segments import (MappedSegmentStream,
+                                                   open_mapped)
+
+            try:
+                mf = open_mapped(path)
+            except OSError:
+                from blaze_tpu.runtime.recovery import ShuffleOutputMissing
+
+                raise ShuffleOutputMissing(path, "missing")
+            return MappedSegmentStream(mf.view(offset, length))
         try:
             f = open(path, "rb")
         except FileNotFoundError:
@@ -209,7 +261,9 @@ class IpcWriterExec(Operator):
 
         for batch in self.execute_child(0, partition, ctx, metrics):
             buf = io.BytesIO()
-            BatchWriter(buf, codec=ctx.conf.shuffle_compression_codec).write_batch(batch)
+            bw = BatchWriter(buf, codec=ctx.conf.shuffle_compression_codec)
+            bw.write_batch(batch)
+            metrics.add("shuffle_bytes_serialized", bw.bytes_written)
             consumer.write(buf.getvalue())
         return
         yield  # pragma: no cover

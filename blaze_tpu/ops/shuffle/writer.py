@@ -37,30 +37,41 @@ _TM_WRITE_BYTES = get_registry().histogram(
     "blaze_shuffle_write_size_bytes", "bytes per committed map output file")
 _TM_WRITE_SECS = get_registry().histogram(
     "blaze_shuffle_write_seconds", "wall time of the final merge+publish")
+_TM_SERIALIZED = get_registry().counter(
+    "blaze_shuffle_serialized_bytes",
+    "bytes pushed through the classic IPC serde on shuffle-write paths "
+    "(~0 on same-host runs with the zero-copy data plane)")
 
 
 class _PartitionStreams:
-    """In-memory per-partition frame buffers."""
+    """In-memory per-partition frame buffers. ``raw=True`` (zero-copy shm
+    tier) emits mappable raw frames instead of compressed serde frames —
+    the spill/merge/footer plumbing downstream is format-agnostic."""
 
     def __init__(self, num_partitions: int, codec: str,
-                 dict_refs: bool = False):
+                 dict_refs: bool = False, raw: bool = False):
         self.bufs: List[Optional[io.BytesIO]] = [None] * num_partitions
         self.writers: List[Optional[BatchWriter]] = [None] * num_partitions
         self.codec = codec
         self.dict_refs = dict_refs
+        self.raw = raw
         self.nbytes = 0
         self.codes_bytes = 0
+        self.serialized_bytes = 0  # classic-serde bytes only (tripwire)
 
     def write(self, pid: int, batch: ColumnarBatch):
         w = self.writers[pid]
         if w is None:
             self.bufs[pid] = io.BytesIO()
             w = self.writers[pid] = BatchWriter(
-                self.bufs[pid], codec=self.codec, dict_refs=self.dict_refs)
+                self.bufs[pid], codec=self.codec, dict_refs=self.dict_refs,
+                raw=self.raw)
         before = w.bytes_written
         cbefore = w.codes_bytes
         w.write_batch(batch)
         self.nbytes += w.bytes_written - before
+        if not self.raw:
+            self.serialized_bytes += w.bytes_written - before
         self.codes_bytes += w.codes_bytes - cbefore
 
     def payloads(self):
@@ -72,18 +83,25 @@ class _PartitionStreams:
 class ShuffleWriterExec(Operator):
     """Writes the child's output into (data_file, index_file); emits no
     batches (the driver/session records the map output, as Spark's
-    MapStatus commit does)."""
+    MapStatus commit does).
+
+    ``mem_sink`` (zero-copy process tier, driver-only: never shipped to a
+    worker pool) is a ``(MemSegmentRegistry, stage_id)`` pair — staged
+    partitions commit as in-process batch REFERENCES, the data file
+    becomes a footer-only lineage marker, and the index keeps logical
+    staged sizes so AQE coalescing/skew sizing still sees real bytes."""
 
     def __init__(self, child: Operator, partitioning, output_data_file: str,
-                 output_index_file: str):
+                 output_index_file: str, mem_sink=None):
         self.partitioning = partitioning
         self.output_data_file = output_data_file
         self.output_index_file = output_index_file
+        self.mem_sink = mem_sink
         super().__init__(child.schema, [child])
 
     def _execute(self, partition, ctx, metrics):
         repart = create_repartitioner(self.partitioning, self.children[0].schema)
-        state = _WriterState(self, ctx, metrics, repart)
+        state = _WriterState(self, ctx, metrics, repart, map_id=partition)
         ctx.mem.register(state)
         try:
             # self-time lands in elapsed_compute_time_ns via Operator.execute
@@ -104,13 +122,25 @@ class ShuffleWriterExec(Operator):
 
 class _WriterState(MemConsumer):
     def __init__(self, op: ShuffleWriterExec, ctx: ExecContext, metrics,
-                 repart: Repartitioner):
+                 repart: Repartitioner, map_id: int = 0):
         super().__init__("ShuffleWriter", spillable=True)
         self.op = op
         self.ctx = ctx
         self.metrics = metrics
         self.repart = repart
+        self.map_id = map_id
         self.n = repart.num_partitions
+        # raw mappable frames whenever the zero-copy plane is on and not
+        # pinned to the ipc tier — decided purely from conf so driver
+        # threads and pool workers of one run agree on the file format
+        self.raw = bool(ctx.conf.zero_copy_shuffle
+                        and ctx.conf.zero_copy_tier != "ipc")
+        # process tier: stage bucketized sub-batch REFERENCES per reducer
+        # instead of any frames at all; degrades to the file path on memory
+        # pressure (spill) or past the mem-segment budget
+        self.mem_sink = op.mem_sink
+        self._mem_parts = {} if self.mem_sink is not None else None
+        self._mem_bytes = 0
         self.streams = self._new_streams()
         # spills: list of (SpillFile-backed raw file, per-partition (off, len))
         self.spills = []
@@ -123,7 +153,8 @@ class _WriterState(MemConsumer):
 
     def _new_streams(self) -> _PartitionStreams:
         return _PartitionStreams(self.n, self.ctx.conf.shuffle_compression_codec,
-                                 dict_refs=self.ctx.conf.codes_shuffle)
+                                 dict_refs=self.ctx.conf.codes_shuffle,
+                                 raw=self.raw)
 
     def insert(self, batch: ColumnarBatch):
         self._pending.append(batch)
@@ -141,8 +172,16 @@ class _WriterState(MemConsumer):
         b0, g0 = self.repart.split_batches, self.repart.split_gathers
         t0 = self.repart.split_time_ns
         c0 = self.streams.codes_bytes
+        s0 = self.streams.serialized_bytes
         for pid, sub in self.repart.bucketize_host(batch):
-            self.streams.write(pid, sub)
+            if self._mem_parts is not None:
+                self._mem_parts.setdefault(pid, []).append(sub)
+                self._mem_bytes += _host_batch_nbytes(sub)
+            else:
+                self.streams.write(pid, sub)
+        if self._mem_parts is not None and self._mem_bytes > \
+                self.ctx.conf.zero_copy_mem_segment_max_bytes:
+            self._mem_degrade()
         # hot-path invariant surfaced for soak/tests: one row gather per
         # split batch, never a per-partition take loop
         self.metrics.add("split_batches", self.repart.split_batches - b0)
@@ -150,9 +189,31 @@ class _WriterState(MemConsumer):
         self.metrics.add("repartition_time_ns", self.repart.split_time_ns - t0)
         if self.streams.codes_bytes > c0:
             self.metrics.add("codes_shuffle_bytes", self.streams.codes_bytes - c0)
-        self.update_mem_used(self.streams.nbytes)
+        if self.streams.serialized_bytes > s0:
+            self.metrics.add("shuffle_bytes_serialized",
+                             self.streams.serialized_bytes - s0)
+            _TM_SERIALIZED.inc(self.streams.serialized_bytes - s0)
+        self.update_mem_used(self._mem_bytes + self.streams.nbytes)
+
+    def _mem_degrade(self):
+        """Leave the process tier for this map output: route the staged
+        batch references through the (raw or classic) frame streams and
+        continue as an ordinary file-backed write."""
+        parts, self._mem_parts = self._mem_parts, None
+        self._mem_bytes = 0
+        s0 = self.streams.serialized_bytes
+        for pid in sorted(parts):
+            for sub in parts[pid]:
+                self.streams.write(pid, sub)
+        if self.streams.serialized_bytes > s0:
+            self.metrics.add("shuffle_bytes_serialized",
+                             self.streams.serialized_bytes - s0)
+            _TM_SERIALIZED.inc(self.streams.serialized_bytes - s0)
 
     def spill(self) -> int:
+        if self._mem_parts is not None and self._mem_bytes:
+            # memory pressure: staged references become spillable frames
+            self._mem_degrade()
         if not self.streams.nbytes:
             return 0
         freed = self.streams.nbytes
@@ -171,10 +232,54 @@ class _WriterState(MemConsumer):
         return freed
 
     def finish(self):
-        """Merge in-memory + spilled per-partition segments into the final
-        data file (see below)."""
+        """Publish the map output: process-tier registry commit when every
+        staged partition is still held by reference, else the ordinary
+        merge of in-memory + spilled frame segments into the data file."""
         self.flush_pending()
-        self._finish_files()
+        if self._mem_parts is not None and not self.spills \
+                and not self.streams.nbytes:
+            self._finish_mem()
+        else:
+            if self._mem_parts is not None:
+                self._mem_degrade()
+            self._finish_files()
+
+    def _finish_mem(self):
+        """Process-tier commit: publish the staged batch references to the
+        mem segment registry, plus a footer-only marker data file (passes
+        ``verify_map_output``, so lineage sweeps and chaos deletion keep
+        operating on files — recompute re-runs this map and republishes
+        both) and an index of LOGICAL staged sizes so AQE coalescing and
+        skew sizing still see real bytes."""
+        import uuid
+
+        from blaze_tpu.runtime.recovery import pack_footer
+
+        registry, stage = self.op.mem_sink
+        parts = self._mem_parts
+        offsets = np.zeros(self.n + 1, dtype=np.int64)
+        for pid in range(self.n):
+            offsets[pid + 1] = offsets[pid] + sum(
+                _host_batch_nbytes(b) for b in parts.get(pid, ()))
+        registry.commit(stage, self.map_id, parts, int(offsets[self.n]))
+        attempt = uuid.uuid4().hex
+        tmp = f"{self.op.output_data_file}.tmp.{attempt}"
+        os.makedirs(os.path.dirname(tmp) or ".", exist_ok=True)
+        with open(tmp, "wb") as out:
+            out.write(pack_footer(0, 0))
+            out.flush()
+            os.fsync(out.fileno())
+        os.replace(tmp, self.op.output_data_file)
+        itmp = f"{self.op.output_index_file}.tmp.{attempt}"
+        with open(itmp, "wb") as idx:
+            idx.write(offsets.astype("<i8").tobytes())
+            idx.flush()
+            os.fsync(idx.fileno())
+        os.replace(itmp, self.op.output_index_file)
+        self.metrics.add("data_size", int(offsets[self.n]))
+        _TM_WRITE_BYTES.observe(int(offsets[self.n]))
+        self._mem_parts = {}
+        self._mem_bytes = 0
 
     def _finish_files(self):
         """Merge in-memory + spilled per-partition segments into the final
@@ -234,6 +339,19 @@ class _WriterState(MemConsumer):
         self.spills = []
 
 
+def _host_batch_nbytes(hb) -> int:
+    """Logical staged size of a HostBatch's planes/arrays — what the
+    process tier books against its budget and records in the logical
+    index (stands in for serialized size in AQE's advisory math)."""
+    total = 0
+    for it in hb.items:
+        if isinstance(it, tuple):
+            total += it[0].nbytes + it[1].nbytes
+        else:
+            total += it.nbytes
+    return total
+
+
 def read_index_file(path: str) -> np.ndarray:
     with open(path, "rb") as f:
         return np.frombuffer(f.read(), dtype="<i8")
@@ -270,6 +388,9 @@ class RssShuffleWriterExec(Operator):
                 bw.write_batch(sub)
                 if bw.codes_bytes:
                     metrics.add("codes_shuffle_bytes", bw.codes_bytes)
+                # RSS always serializes (cross-network path keeps IPC serde)
+                metrics.add("shuffle_bytes_serialized", bw.bytes_written)
+                _TM_SERIALIZED.inc(bw.bytes_written)
                 writer.write(pid, buf.getvalue())
             metrics.add("split_batches", repart.split_batches - b0)
             metrics.add("split_gathers", repart.split_gathers - g0)

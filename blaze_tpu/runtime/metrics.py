@@ -130,6 +130,15 @@ class MetricNode:
 #   codes_shuffle_bytes              bytes shipped as codes+dictionaries by
 #                                    the code-carrying shuffle (0 on plans
 #                                    without dictionary columns)
+#   shuffle_bytes_serialized         bytes pushed through the classic IPC
+#                                    serde on shuffle-write paths; ~0 on
+#                                    same-host runs with zero_copy_shuffle
+#                                    (raw segments replace serde frames)
+#   shm_bytes_mapped                 frame payload bytes served to readers
+#                                    from mmap'd shm segments (no decode)
+#   serde_elided_batches             batches exchanged as in-process
+#                                    references (process tier) with serde
+#                                    skipped entirely
 TRIPWIRE_METRICS = (
     "split_batches",
     "split_gathers",
@@ -145,6 +154,9 @@ TRIPWIRE_METRICS = (
     "agg_reintern_rows",
     "agg_radix_buckets",
     "codes_shuffle_bytes",
+    "shuffle_bytes_serialized",
+    "shm_bytes_mapped",
+    "serde_elided_batches",
 )
 
 

@@ -36,11 +36,12 @@ from blaze_tpu.obs.telemetry import configure_from as _telemetry_configure
 from blaze_tpu.obs.tracer import TRACER
 from blaze_tpu.obs.tracer import configure_from as _tracer_configure
 from blaze_tpu.ops.base import ExecContext, Operator, TaskContext
-from blaze_tpu.ops.shuffle.writer import (BytesBlockProvider,
-                                           FileSegmentBlockProvider,
+from blaze_tpu.ops.shuffle.writer import (FileSegmentBlockProvider,
                                            read_index_file)
 from blaze_tpu.runtime.executor import build_operator
 from blaze_tpu.runtime.metrics import MetricNode
+from blaze_tpu.runtime.segments import (MemSegmentBlockProvider,
+                                        MemSegmentRegistry)
 
 _TM_QUERIES = get_registry().counter(
     "blaze_session_queries_total", "queries finished, by terminal state")
@@ -102,6 +103,19 @@ class _CoalescedBlockProvider:
         return blocks
 
 
+class _BlockListProvider:
+    """Serves a fixed block list to every partition — the collect-path
+    sibling of ``BytesBlockProvider`` that can also carry ``("batches",
+    [...])`` reference blocks from the zero-copy process tier (those never
+    cross a process boundary: collect elision only engages pool-less)."""
+
+    def __init__(self, blocks):
+        self.blocks = list(blocks)
+
+    def __call__(self, partition: int):
+        return self.blocks
+
+
 class _QueryRun:
     """Driver-side state of ONE executing query: its cancel token, its
     MemManager reservation group, and everything that must be torn down if
@@ -146,6 +160,37 @@ class Session:
         self.conf = conf or get_config()
         self.work_dir = work_dir or tempfile.mkdtemp(prefix="blaze_tpu_session_")
         self.max_workers = max_workers or self.conf.num_io_threads
+        # zero-copy data plane: shuffle dirs live under a tmpfs root when
+        # the shm tier is reachable (io/shm_segments.choose_shm_root), so
+        # committed map outputs are mmap'able pages rather than disk blocks;
+        # mem_segments carries the process tier's in-memory references.
+        # shuffle_root is the directory the soaks glob for leaked segments.
+        from blaze_tpu.io.shm_segments import SHM_ROOT_PREFIX, choose_shm_root
+
+        self.mem_segments = MemSegmentRegistry()
+        self._shm_root = None
+        self._shm_finalizer = None
+        self.shuffle_root = self.work_dir
+        if self.conf.zero_copy_shuffle and self.conf.zero_copy_tier != "ipc":
+            base = choose_shm_root(self.conf.shm_dir,
+                                   self.conf.shm_min_free_bytes)
+            if base is not None:
+                try:
+                    os.makedirs(base, exist_ok=True)
+                    self._shm_root = tempfile.mkdtemp(
+                        prefix=SHM_ROOT_PREFIX, dir=base)
+                    self.shuffle_root = self._shm_root
+                    # tmpfs pages are RAM: a session that is GC'd or alive
+                    # at interpreter exit without close() must still give
+                    # its root back (close() detaches this)
+                    import shutil
+                    import weakref
+
+                    self._shm_finalizer = weakref.finalize(
+                        self, shutil.rmtree, self._shm_root,
+                        ignore_errors=True)
+                except OSError:
+                    self._shm_root = None  # tier falls back to the work dir
         if mesh is not None:
             assert len(mesh.axis_names) == 1, (
                 f"Session needs a 1-D mesh (one exchange axis), got "
@@ -437,6 +482,11 @@ class Session:
         # unrecoverable by design — recovery must say so, not recompute into
         # a deleted directory
         self._lineage.prune(qrun.stage_meta.keys())
+        # process-tier segments go with their stages: dropping the registry
+        # entries releases the staged batch references (readers that already
+        # hold them keep them alive — plain refcounting, same as mappings
+        # outliving their unlinked files)
+        self.mem_segments.release_stages(qrun.stage_meta.keys())
         for d in qrun.shuffle_dirs:
             shutil.rmtree(d, ignore_errors=True)
         for rid in qrun.resource_ids:
@@ -460,8 +510,13 @@ class Session:
             self.pool.close()
             self.pool = None
         self._lineage.clear()
+        self.mem_segments.clear()
         self.resources.clear()
         shutil.rmtree(self.work_dir, ignore_errors=True)
+        if self._shm_finalizer is not None:
+            # the /dev/shm root and everything under it: the soak leak gate
+            # asserts no blaze_tpu_shm_* roots outlive their session
+            self._shm_finalizer()
 
     def __enter__(self):
         return self
@@ -521,6 +576,25 @@ class Session:
             resources=self.resources,
             cancel_token=qrun.token if qrun is not None else None,
         )
+
+    def _shuffle_tier(self) -> str:
+        """Negotiate the zero-copy tier for this session's (writer, reader)
+        placement: ``process`` passes batch references through the in-memory
+        segment registry (consumer in the same process — serde skipped
+        entirely), ``shm`` commits raw mappable frames that readers mmap
+        (same host, decode skipped), ``ipc`` is the classic framed serde
+        (zero-copy off, or forced). A forced ``process`` degrades to ``shm``
+        under a worker pool — references cannot cross the process boundary;
+        mesh/RSS exchanges never reach this (they keep their own transports
+        and IPC serde)."""
+        conf = self.conf
+        if not conf.zero_copy_shuffle or conf.zero_copy_tier == "ipc":
+            return "ipc"
+        if self.pool is not None:
+            return "shm"
+        if conf.zero_copy_tier == "shm":
+            return "shm"
+        return "process"
 
     def _lower(self, node: N.PlanNode) -> N.PlanNode:
         self._check_op_enabled(node)
@@ -651,15 +725,19 @@ class Session:
             bounds.append(samples[min(len(samples) - 1, i * len(samples) // n)])
         return dataclasses.replace(part, bounds=bounds)
 
-    def _exec_map_stage(self, node: N.ShuffleExchange):
+    def _exec_map_stage(self, node: N.ShuffleExchange, mem_sink: bool = False):
         """Run one exchange's map side to files; returns (stage,
-        [(data_path, offsets)] per map)."""
+        [(data_path, offsets)] per map). ``mem_sink``: process-tier
+        zero-copy — map tasks commit staged batch references into the
+        session's segment registry (plus footer-only marker files so
+        lineage/chaos semantics stay file-shaped); only sound when the
+        reducers run in this same process."""
         stage = next(self._stage_ids)
         child_op = build_operator(node.child)
         num_maps = child_op.num_partitions()
         self._record_stage(stage, "shuffle_map", num_maps, child_op,
                            wrapper="ShuffleWriterExec")
-        shuffle_dir = os.path.join(self.work_dir, f"shuffle_{stage}")
+        shuffle_dir = os.path.join(self.shuffle_root, f"shuffle_{stage}")
         os.makedirs(shuffle_dir, exist_ok=True)
         qrun = self._qrun()
         if qrun is not None:
@@ -687,7 +765,9 @@ class Session:
                 where_cell.append(
                     self._decide_placement(node.child, f"stage_{stage}"))
             data, index = paths_for(m)
-            writer = ShuffleWriterExec(child_op, node.partitioning, data, index)
+            writer = ShuffleWriterExec(
+                child_op, node.partitioning, data, index,
+                mem_sink=(self.mem_segments, stage) if mem_sink else None)
             ctx = self._make_ctx(m, stage)
             task_metrics = self.metrics.named_child(f"stage_{stage}").named_child(f"map_{m}")
             set_task_context(stage, m)
@@ -738,7 +818,9 @@ class Session:
             # reader makes the same cut)
             return self._run_single_collect(node)
         num_reducers = node.partitioning.num_partitions
-        stage, indexes = self._exec_map_stage(node)
+        tier = self._shuffle_tier()
+        stage, indexes = self._exec_map_stage(node,
+                                              mem_sink=(tier == "process"))
         rid = f"shuffle_{stage}"
         groups = self._coalesce_reducers(indexes, num_reducers)
         if groups is not None:
@@ -746,8 +828,18 @@ class Session:
             # small reducers merge into one read task; sound because merging
             # WHOLE reducer partitions keeps every group/range confined to
             # one partition, and the _zip_ok guard blocks it under
-            # partition-zipping ancestors (joins/unions)
+            # partition-zipping ancestors (joins/unions). Mem-tier indexes
+            # carry LOGICAL offsets, so sizing works unchanged.
             self.metrics.add("coalesced_partitions", num_reducers - len(groups))
+        if tier == "process":
+            # reducers pull staged batch references straight from the
+            # registry; maps that degraded to files mid-write serve file
+            # segments transparently through the same provider
+            self._register_resource(rid, MemSegmentBlockProvider(
+                self.mem_segments, stage, indexes, groups=groups))
+            if groups is not None:
+                num_reducers = len(groups)
+        elif groups is not None:
             self._register_resource(rid, _CoalescedBlockProvider(indexes, groups))
             num_reducers = len(groups)
         else:
@@ -1149,19 +1241,24 @@ class Session:
                                       *paths_for(m)))
         return [paths_for(m) for m in range(num_maps)] if ok else None
 
-    def _collect_child_chunks(self, child, stage: int,
-                              prefix: str) -> List[bytes]:
-        """Stream every child partition through IpcWriter into in-memory
-        chunks. RETRY-SAFE: each task attempt writes into its OWN bucket
-        and only a SUCCESSFUL attempt's bucket is committed, so a task
-        that died mid-stream and was retried contributes exactly one
-        attempt's chunks (the file-shuffle path gets the same guarantee
-        from its atomic tmp-file rename)."""
+    def _collect_child_chunks(self, child, stage: int, prefix: str,
+                              elide: bool = False) -> list:
+        """Stream every child partition into in-memory blocks — through
+        IpcWriter chunks classically, or (``elide``, the zero-copy process
+        tier) as plain batch REFERENCES with serde skipped: the one reducer
+        runs in this same process, so framing+compressing+decoding the
+        collect was pure overhead. An elided map that outgrows the mem
+        budget degrades itself back to IPC chunks mid-stream. RETRY-SAFE
+        either way: each task attempt stages into its OWN bucket and only a
+        SUCCESSFUL attempt's bucket is committed, so a task that died
+        mid-stream and was retried contributes exactly one attempt's output
+        (the file-shuffle path gets the same guarantee from its atomic
+        tmp-file rename)."""
         child_op = build_operator(child)
         num_maps = child_op.num_partitions()
         self._record_stage(stage, f"{prefix}_collect", num_maps, child_op,
-                           wrapper="IpcWriterExec")
-        committed: Dict[int, List[bytes]] = {}
+                           wrapper=None if elide else "IpcWriterExec")
+        committed: Dict[int, tuple] = {}  # m -> ("batches"|"bytes", items)
         lock = threading.Lock()
         where = self._decide_placement(child, f"stage_{stage}")
 
@@ -1171,6 +1268,55 @@ class Session:
 
             def write(self, b: bytes):
                 self.parts.append(b)
+
+        def run_map_elided(m: int):
+            import io as _io
+
+            from blaze_tpu.io.batch_serde import BatchWriter
+            from blaze_tpu.ops.shuffle.writer import _TM_SERIALIZED
+            from blaze_tpu.runtime import placement
+            from blaze_tpu.utils.logutil import (clear_task_context,
+                                                 set_task_context)
+
+            ctx = self._make_ctx(m, stage)
+            task_metrics = self.metrics.named_child(
+                f"stage_{stage}").named_child(f"map_{m}")
+            staged: list = []
+            staged_bytes = 0
+            degraded = False
+            budget = self.conf.zero_copy_mem_segment_max_bytes
+
+            def serialize(batch) -> bytes:
+                buf = _io.BytesIO()
+                bw = BatchWriter(buf,
+                                 codec=self.conf.shuffle_compression_codec)
+                bw.write_batch(batch)
+                task_metrics.add("shuffle_bytes_serialized", bw.bytes_written)
+                _TM_SERIALIZED.inc(bw.bytes_written)
+                return buf.getvalue()
+
+            set_task_context(stage, m)
+            try:
+                with placement.placed(where), \
+                        TRACER.span("task", "task",
+                                    {"stage": stage, "map": m}):
+                    for b in child_op.execute(m, ctx, task_metrics):
+                        if degraded:
+                            staged.append(serialize(b))
+                            continue
+                        staged.append(b)
+                        staged_bytes += b.nbytes()
+                        if staged_bytes > budget:
+                            # past the reference budget: re-route THIS
+                            # attempt's staged refs through serde and keep
+                            # serializing — determinism holds (same batches,
+                            # same order), only the transport changes
+                            degraded = True
+                            staged = [serialize(x) for x in staged]
+            finally:
+                clear_task_context()
+            with lock:  # commit: only reached when the attempt succeeded
+                committed[m] = ("bytes" if degraded else "batches", staged)
 
         def run_map(m: int):
             from blaze_tpu.ops.shuffle.reader import IpcWriterExec
@@ -1195,10 +1341,11 @@ class Session:
             finally:
                 clear_task_context()
             with lock:  # commit: only reached when the attempt succeeded
-                committed[m] = bucket.parts
+                committed[m] = ("bytes", bucket.parts)
 
         try:
-            self._run_tasks(run_map, range(num_maps))
+            self._run_tasks(run_map_elided if elide else run_map,
+                            range(num_maps))
         finally:
             # drop every attempt's consumer bucket from the resource map
             # (success or failure): the buckets hold whole map outputs, and
@@ -1211,16 +1358,26 @@ class Session:
         # sorts resolve ties positionally, and the file-shuffle path reads
         # maps in index order — the collect path must be just as
         # deterministic run to run
-        return [p for m in sorted(committed) for p in committed[m]]
+        blocks = []
+        for m in sorted(committed):
+            kind, items = committed[m]
+            if kind == "batches":
+                if items:
+                    blocks.append(("batches", items))
+            else:
+                blocks.extend(("bytes", b) for b in items)
+        return blocks
 
     def _run_single_collect(self, node: N.ShuffleExchange) -> N.PlanNode:
         """SinglePartitioning exchange without a worker pool: the child's
         partitions stream through IpcWriter into in-memory chunks served to
         the one reducer — no files, no index, same batch bytes."""
         stage = next(self._stage_ids)
-        chunks = self._collect_child_chunks(node.child, stage, "single")
+        blocks = self._collect_child_chunks(
+            node.child, stage, "single",
+            elide=self._shuffle_tier() == "process")
         rid = f"single_{stage}"
-        self._register_resource(rid, BytesBlockProvider(chunks))
+        self._register_resource(rid, _BlockListProvider(blocks))
         return N.CoalesceBatches(
             N.IpcReader(schema=node.child.output_schema, resource_id=rid,
                         num_partitions=1),
@@ -1232,9 +1389,11 @@ class Session:
         (reference: NativeBroadcastExchangeBase.relationFuture + Spark
         TorrentBroadcast of the IPC byte arrays)."""
         stage = next(self._stage_ids)
-        chunks = self._collect_child_chunks(node.child, stage, "broadcast")
+        blocks = self._collect_child_chunks(
+            node.child, stage, "broadcast",
+            elide=self._shuffle_tier() == "process")
         rid = f"broadcast_{stage}"
-        self._register_resource(rid, BytesBlockProvider(chunks))
+        self._register_resource(rid, _BlockListProvider(blocks))
         return N.IpcReader(schema=node.child.output_schema, resource_id=rid,
                            num_partitions=1)
 

@@ -39,6 +39,8 @@ class DeviceStats:
             self.to_device_bytes = 0
             self.kernel_calls = 0
             self.kernel_time_s = 0.0
+            self.mapped_calls = 0
+            self.mapped_bytes = 0
 
     def add_to_host(self, nbytes: int):
         with self._mu:
@@ -49,6 +51,15 @@ class DeviceStats:
         with self._mu:
             self.to_device_calls += 1
             self.to_device_bytes += int(nbytes)
+
+    def add_mapped(self, nbytes: int):
+        """Bytes entering device arrays from MAPPED shuffle segments —
+        buffers handed to jax straight off an mmap/registry view with no
+        intermediate host staging copy (zero-copy tiers). Kept separate
+        from to_device_bytes so artifacts distinguish mapped vs copied."""
+        with self._mu:
+            self.mapped_calls += 1
+            self.mapped_bytes += int(nbytes)
 
     def add_kernel(self, seconds: float):
         with self._mu:
@@ -64,6 +75,8 @@ class DeviceStats:
                 "to_device_bytes": self.to_device_bytes,
                 "kernel_calls": self.kernel_calls,
                 "kernel_time_s": round(self.kernel_time_s, 6),
+                "mapped_calls": self.mapped_calls,
+                "mapped_bytes": self.mapped_bytes,
             }
 
 

@@ -46,6 +46,15 @@ def peak_rss_mb() -> int:
     return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
 
 
+def shm_roots(baseline=()) -> list:
+    """blaze_tpu_shm_* roots in /dev/shm beyond ``baseline`` — the
+    zero-copy plane's leak surface (segment files are unlink-safe while
+    mapped, so directory entries are what a leak looks like)."""
+    import glob
+
+    return sorted(set(glob.glob("/dev/shm/blaze_tpu_shm_*")) - set(baseline))
+
+
 def main():
     import bench  # repo-root bench.py (shapes, generators, oracles)
     from blaze_tpu.config import Config, set_config
@@ -56,6 +65,7 @@ def main():
                       mem_wait_timeout_s=5.0))
     out = {"rows": ROWS, "partitions": PARTS, "budget_mb": BUDGET_MB,
            "shapes": {}, "tpcds": {}}
+    shm0 = shm_roots()  # roots that predate this run are not ours to gate
     with tempfile.TemporaryDirectory(prefix="blaze_soak_") as tmpdir:
         t0 = time.perf_counter()
         paths = bench.make_data(tmpdir)
@@ -139,15 +149,21 @@ def main():
                 "agg_reintern_rows": trips["agg_reintern_rows"],
                 "agg_radix_buckets": trips["agg_radix_buckets"],
                 "codes_shuffle_bytes": trips["codes_shuffle_bytes"],
+                "shuffle_bytes_serialized": trips["shuffle_bytes_serialized"],
+                "shm_bytes_mapped": trips["shm_bytes_mapped"],
+                "serde_elided_batches": trips["serde_elided_batches"],
                 "peak_mem_used": peak_used,
                 "peak_rss_mb": peak_rss_mb(),
             }
             print(json.dumps({name: out["shapes"][name]}), flush=True)
 
     soak_path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "SOAK_r08.json")
+        os.path.abspath(__file__))), "SOAK_r09.json")
     if "tpcds" not in os.environ.get("SOAK_PHASES", "shapes,tpcds"):
         out["peak_rss_mb"] = peak_rss_mb()
+        leaked = shm_roots(shm0)
+        out["shm_segments_leaked"] = len(leaked)
+        assert not leaked, f"/dev/shm leak: {leaked}"
         # keep a previous run's tpcds section (phase-scoped reruns merge)
         try:
             with open(soak_path) as f:
@@ -221,14 +237,20 @@ def main():
                 "agg_reintern_rows": trips["agg_reintern_rows"],
                 "agg_radix_buckets": trips["agg_radix_buckets"],
                 "codes_shuffle_bytes": trips["codes_shuffle_bytes"],
+                "shuffle_bytes_serialized": trips["shuffle_bytes_serialized"],
+                "shm_bytes_mapped": trips["shm_bytes_mapped"],
+                "serde_elided_batches": trips["serde_elided_batches"],
                 "peak_rss_mb": peak_rss_mb(),
             }
             print(json.dumps({name: out["tpcds"][name]}), flush=True)
     out["peak_rss_mb"] = peak_rss_mb()
+    leaked = shm_roots(shm0)
+    out["shm_segments_leaked"] = len(leaked)
     print(json.dumps(out))
     with open(os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "SOAK_r08.json"), "w") as f:
+            os.path.abspath(__file__))), "SOAK_r09.json"), "w") as f:
         json.dump(out, f, indent=1)
+    assert not leaked, f"/dev/shm leak: {leaked}"
 
 
 def _pctl(vals, q):
@@ -360,6 +382,7 @@ def chaos_main(kill_every_s: float):
                 tmpdir, "incidents_chaos" if with_chaos else "incidents_base"))
             lats, wrong, injected = [], [], 0
             c0 = counters()
+            shm0 = shm_roots()
             with Session(conf=conf, num_worker_processes=2) as sess:
                 monkey = None
                 if with_chaos:
@@ -375,7 +398,7 @@ def chaos_main(kill_every_s: float):
                                 # output, then execute — the reduce MUST
                                 # recover via lineage recompute
                                 before = set(glob.glob(os.path.join(
-                                    sess.work_dir, "shuffle_*",
+                                    sess.shuffle_root, "shuffle_*",
                                     "map_*.data")))
                                 qrun = _QueryRun(0)
                                 sess._tls.qrun = qrun
@@ -383,7 +406,7 @@ def chaos_main(kill_every_s: float):
                                 sess._tls.qrun = None
                                 fresh = sorted(
                                     f for f in glob.glob(os.path.join(
-                                        sess.work_dir, "shuffle_*",
+                                        sess.shuffle_root, "shuffle_*",
                                         "map_*.data")) if f not in before)
                                 if fresh:
                                     # the largest output: an empty map (a
@@ -409,6 +432,9 @@ def chaos_main(kill_every_s: float):
                         # landed between the last query and stop()
                         time.sleep(2.0)
                 kills = list(monkey.kills) if monkey else []
+                from blaze_tpu.runtime.metrics import tripwire_totals
+
+                trips = tripwire_totals(sess.metrics)
                 leaked_metric = int(sess.metrics.total(
                     "query_leaked_mem_reclaimed"))
                 mm = MemManager._instance
@@ -431,6 +457,13 @@ def chaos_main(kill_every_s: float):
                 "mem_used_after": int(stats["used"]),
                 "mem_reservations_after": list(stats["reservations"]),
                 "counters_delta": {k: c1[k] - c0[k] for k in COUNTERS},
+                # zero-copy tripwires: pool mode negotiates the shm tier, so
+                # mapped bytes must flow and shm roots must not outlive the
+                # session even with workers dying mid-query
+                "shuffle_bytes_serialized": trips["shuffle_bytes_serialized"],
+                "shm_bytes_mapped": trips["shm_bytes_mapped"],
+                "serde_elided_batches": trips["serde_elided_batches"],
+                "shm_segments_leaked": len(shm_roots(shm0)),
             }
 
         section["phases"]["baseline"] = base = run_phase(with_chaos=False)
@@ -442,6 +475,8 @@ def chaos_main(kill_every_s: float):
         + len(chaos["wrong_results"]),
         "leaked_bytes": base["leaked_mem_reclaimed"] + base["mem_used_after"]
         + chaos["leaked_mem_reclaimed"] + chaos["mem_used_after"],
+        "shm_segments_leaked": base["shm_segments_leaked"]
+        + chaos["shm_segments_leaked"],
         "worker_deaths_total": d["blaze_cluster_worker_deaths_total"],
         "stages_recovered_total": d["blaze_cluster_stages_recovered_total"],
         "maps_recomputed_total": d["blaze_cluster_maps_recomputed_total"],
@@ -457,6 +492,7 @@ def chaos_main(kill_every_s: float):
     # evidence is on disk; now enforce the gates
     assert gates["wrong_results"] == 0, gates
     assert gates["leaked_bytes"] == 0, gates
+    assert gates["shm_segments_leaked"] == 0, gates
     assert gates["worker_deaths_total"] > 0, gates
     assert gates["stages_recovered_total"] >= 1, gates
     assert gates["maps_recomputed_total"] >= 1, gates

@@ -75,6 +75,14 @@ def _counter(raw_registry, name, **labels):
     return 0
 
 
+def shm_roots(baseline=()):
+    """Zero-copy shm roots currently present, minus a baseline snapshot —
+    sessions must unlink theirs at close, so any delta is a leak."""
+    import glob
+
+    return sorted(set(glob.glob("/dev/shm/blaze_tpu_shm_*")) - set(baseline))
+
+
 def main():
     import pyarrow as pa
     import pyarrow.parquet as pq
@@ -167,6 +175,7 @@ def main():
         mu = threading.Lock()
         seq = iter(range(QUERIES))
 
+        shm0 = shm_roots()
         with Session() as sess:
             from blaze_tpu.utils.device import DEVICE_STATS
 
@@ -363,6 +372,7 @@ def main():
             "spill_count": mm.spill_count if mm else 0,
             "peak_mem_used": mm.peak_used if mm else None,
             "leaked_mem": mm.used if mm else 0,
+            "shm_segments_leaked": len(shm_roots(shm0)),
             "wall_s": round(time.perf_counter() - t_all, 2),
         })
 
@@ -373,6 +383,7 @@ def main():
     print(json.dumps(out, indent=2, default=str))
     assert counts["failed"] == 0, "soak had hard failures"
     assert out["leaked_mem"] == 0, "memory leaked across queries"
+    assert out["shm_segments_leaked"] == 0, "/dev/shm segment roots leaked"
     print(f"\nwrote {dst}")
 
 
@@ -494,6 +505,7 @@ def chaos_main(kill_every_s: float):
             mu = threading.Lock()
             seq = iter(range(queries))
             http_incidents, http_bundle = [], None
+            shm0 = shm_roots()
             with Session(conf=conf, num_worker_processes=2) as sess:
                 svc = ProfilingService.start(sess) if with_chaos else None
                 monkey = ChaosMonkey(sess.pool, kill_every_s,
@@ -586,6 +598,7 @@ def chaos_main(kill_every_s: float):
                 "bundle_has_wid": bool(http_bundle
                                        and "wid" in http_bundle["extra"]),
                 "leaked_mem": leaked,
+                "shm_segments_leaked": len(shm_roots(shm0)),
             }
 
         section["phases"]["baseline"] = base = run_phase(with_chaos=False)
@@ -602,6 +615,8 @@ def chaos_main(kill_every_s: float):
         + len(chaos["hard_failures"]),
         "gave_up": base["gave_up"] + chaos["gave_up"],
         "leaked_bytes": base["leaked_mem"] + chaos["leaked_mem"],
+        "shm_segments_leaked": base["shm_segments_leaked"]
+        + chaos["shm_segments_leaked"],
         "worker_deaths_total": d["blaze_cluster_worker_deaths_total"],
         "kills_injected": chaos["kills_injected"],
         "incident_bundles": chaos["incident_bundles_worker_lost"],
@@ -618,6 +633,7 @@ def chaos_main(kill_every_s: float):
                                          base["hard_failures"])
     assert gates["gave_up"] == 0, gates
     assert gates["leaked_bytes"] == 0, gates
+    assert gates["shm_segments_leaked"] == 0, gates
     assert gates["worker_deaths_total"] > 0, gates
     assert gates["kills_injected"] > 0, gates
     assert gates["incident_bundles"] >= gates["kills_injected"], gates

@@ -66,13 +66,16 @@ def test_map_output_footer_verifies(data_files, tmp_path):
                                             ShuffleOutputMissing,
                                             verify_map_output)
 
-    with Session() as sess:
+    # force the shm tier: this test pokes committed map FILES, and the
+    # pool-less default (zero-copy process tier) commits in-memory segments
+    # with footer-only marker files instead
+    with Session(conf=Config(zero_copy_tier="shm")) as sess:
         qrun = _QueryRun(0)
         sess._tls.qrun = qrun
         sess._lower(_agg_plan(data_files))
         sess._tls.qrun = None
         datafiles = sorted(glob.glob(
-            os.path.join(sess.work_dir, "shuffle_*", "map_*.data")))
+            os.path.join(sess.shuffle_root, "shuffle_*", "map_*.data")))
         assert datafiles, "map stage must have committed outputs"
         for f in datafiles:
             assert verify_map_output(f) is None
@@ -109,19 +112,22 @@ def test_missing_and_torn_map_recompute(data_files):
     lineage recompute of exactly those maps instead of failing the query."""
     from blaze_tpu.obs.telemetry import get_registry
 
-    with Session() as sess:
+    # shm tier for the same reason as above: deleting/truncating committed
+    # map files is the scenario under test, so the maps must write real
+    # data files, not process-tier markers
+    with Session(conf=Config(zero_copy_tier="shm")) as sess:
         oracle = _sorted_rows(sess.execute_to_table(
             _agg_plan(data_files)).to_pydict())
 
         def lower_and_files(plan):
             before = set(glob.glob(
-                os.path.join(sess.work_dir, "shuffle_*", "map_*.data")))
+                os.path.join(sess.shuffle_root, "shuffle_*", "map_*.data")))
             qrun = _QueryRun(0)
             sess._tls.qrun = qrun
             lowered = sess._lower(plan)
             sess._tls.qrun = None
             after = sorted(glob.glob(
-                os.path.join(sess.work_dir, "shuffle_*", "map_*.data")))
+                os.path.join(sess.shuffle_root, "shuffle_*", "map_*.data")))
             return lowered, [f for f in after if f not in before]
 
         def recovered_count():

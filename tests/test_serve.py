@@ -149,6 +149,7 @@ def test_deadline_cancels_multisecond_plan_and_reclaims():
             assert wall < 5.0, f"cancel took {wall:.1f}s on a ~10s plan"
         # shuffle dirs deleted, every MemConsumer unregistered
         assert os.listdir(sess.work_dir) == []
+        assert os.listdir(sess.shuffle_root) == []
         assert MemManager._instance is not None
         assert MemManager._instance.used == 0
 
@@ -168,11 +169,12 @@ def test_mid_stage_cancel_cleans_shuffle_dirs_and_memory():
             while h.state != "running" and time.monotonic() < deadline:
                 time.sleep(0.01)
             time.sleep(0.3)  # ...and mid-stage (a few batches in)
-            assert os.listdir(sess.work_dir), "map stage never started"
+            assert os.listdir(sess.shuffle_root), "map stage never started"
             h.cancel("test cancel")
             with pytest.raises(QueryCancelled):
                 h.result(timeout=30)
-        assert os.listdir(sess.work_dir) == [], \
+        assert os.listdir(sess.work_dir) == [] \
+            and os.listdir(sess.shuffle_root) == [], \
             "cancelled query left shuffle dirs behind"
         assert MemManager._instance.used == 0, \
             "cancelled query left MemConsumers registered"
@@ -204,6 +206,7 @@ def test_failed_query_cleans_shuffle_dirs():
         log = sess.query_log[-1]
         assert log["state"] == "failed"
         assert os.listdir(sess.work_dir) == []
+        assert os.listdir(sess.shuffle_root) == []
         assert MemManager._instance.used == 0
 
 
