@@ -498,12 +498,13 @@ def run_engine(paths, plan_fn=plan_q01):
     with Session(conf=conf) as sess:
         out = sess.execute_to_table(plan_fn(paths))
         trips = tripwire_totals(sess.metrics)
+        profile = sess.profile()
         if profile_dir:
             from blaze_tpu.obs import TRACER, dump_profile
 
             dump_profile(sess, profile_dir, plan_fn.__name__)
             TRACER.reset()
-    return time.perf_counter() - t0, out, trips
+    return time.perf_counter() - t0, out, trips, profile
 
 
 def load_dfs(paths):
@@ -605,7 +606,7 @@ def main():
         for name, plan_fn, _oracle_fn, _acero_fn, check_fn, _t in SHAPES:
             run_engine(paths, plan_fn)  # warmup compiles the shape's kernels
             DEVICE_STATS.reset()
-            engine_s, out, trips = run_engine(paths, plan_fn)
+            engine_s, out, trips, profile = run_engine(paths, plan_fn)
             dev = DEVICE_STATS.snapshot()
             check_fn(out, oracles[name])  # correctness gate before numbers
             rl = roofline_model(name)
@@ -631,6 +632,22 @@ def main():
                             "device_time_fraction": round(
                                 min(dev["kernel_time_s"] / engine_s, 1.0), 3)
                             if engine_s and on_accel else 0.0}
+            if profile is not None:
+                # compact stats-plane view (full profile lives in the store,
+                # GET /debug/profiles/<fingerprint>): per-stage partition
+                # shape + skew, per-operator est-vs-actual + device share
+                shapes[name]["profile"] = {
+                    "fingerprint": profile["fingerprint"],
+                    "device_time_fraction": profile["device_time_fraction"],
+                    "stages": [{k: s.get(k) for k in (
+                        "stage", "kind", "partitions", "total_bytes",
+                        "total_rows", "partition_skew_ratio", "skew",
+                        "device_time_fraction")} for s in profile["stages"]],
+                    "operators": [{k: o.get(k) for k in (
+                        "op", "est_rows", "actual_rows",
+                        "device_time_fraction")}
+                        for o in profile["operators"]],
+                }
             total += engine_s
         arrow_total, arrow_shapes = run_arrow_baseline(paths)
         for name, _p, _o, _a, _c, _t in SHAPES:

@@ -187,6 +187,19 @@ class Config:
     )
     incident_max_bundles: int = 64
 
+    # Query stats plane (obs/stats.py): per-stage partition sizes, key-skew
+    # summaries, estimated-vs-actual cardinalities, residency and recovery
+    # events, folded into a QueryProfile on query completion. Profiles are
+    # keyed by the canonical plan fingerprint and persisted to
+    # profile_store_dir (capped at profile_store_max, oldest-mtime deleted
+    # first; <= 0 disables persistence), served at GET /debug/profiles.
+    stats_enabled: bool = True
+    profile_store_dir: str = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "BLAZE_TPU_PROFILE_STORE", "/tmp/blaze_tpu_profiles")
+    )
+    profile_store_max: int = 128
+
     # Number of host worker threads for IO/decode and task overlap
     # (reference: tokio worker threads conf). On the tunneled-TPU backend
     # threads mostly overlap device round trips, not CPU.

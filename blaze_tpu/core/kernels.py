@@ -70,10 +70,13 @@ def _dispatch(fn, *args, **kw):
             cache0 = fn._cache_size()
         except Exception:
             cache0 = -1
+    DEVICE_STATS.kernel_begin()
     t0 = time.perf_counter()
-    out = fn(*args, **kw)
-    dt = time.perf_counter() - t0
-    DEVICE_STATS.add_kernel(dt)
+    try:
+        out = fn(*args, **kw)
+    finally:
+        dt = time.perf_counter() - t0
+        DEVICE_STATS.kernel_end()
     if trace or track:
         compiled = False
         if cache0 >= 0:
@@ -110,10 +113,13 @@ def fused_dispatch(fn, *args):
         cache0 = fn._cache_size()
     except Exception:
         cache0 = -1
+    DEVICE_STATS.kernel_begin()
     t0 = time.perf_counter()
-    out = fn(*args)
-    dt = time.perf_counter() - t0
-    DEVICE_STATS.add_kernel(dt)
+    try:
+        out = fn(*args)
+    finally:
+        dt = time.perf_counter() - t0
+        DEVICE_STATS.kernel_end()
     compiled = False
     if cache0 >= 0:
         try:

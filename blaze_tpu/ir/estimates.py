@@ -1,0 +1,138 @@
+"""Build-time cardinality estimates over IR plans.
+
+The stats plane (obs/stats.py) records estimated-vs-actual rows per
+operator: the *actual* side comes from executor ``output_rows`` metrics,
+the *estimate* side comes from this walk — textbook selectivity factors
+seeded by file sizes at the scan leaves (bytes x placement's
+``DECODE_EXPANSION`` / an assumed row width). The point is not accuracy,
+it is a stable baseline an AQE pass (ROADMAP item 4) can diff observed
+cardinalities against: a Filter estimated at 25% that passes 99% of rows
+is a re-planning signal regardless of either number's absolute error.
+
+Estimates deliberately live on the LOGICAL (pre-lowering) plan: exchange
+plumbing inserted later (ShuffleWriter / IpcReader / CoalesceBatches) has
+no cardinality semantics of its own and pairs to no estimate.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from blaze_tpu.ir import nodes as N
+
+# leaves with unknowable cardinality (BatchSource, FFI readers, empty
+# file-group scans) get a neutral default so downstream factors still
+# produce ordered, comparable numbers
+DEFAULT_SOURCE_ROWS = 1000
+FILTER_SELECTIVITY = 0.25
+AGG_REDUCTION = 0.1
+GENERATE_EXPANSION = 2.0
+ROW_WIDTH_BYTES = 8  # per column, uncompressed-decoded
+
+# executor class names strip the Exec suffix and lowercase; the one
+# divergence from the IR node names is Projection -> ProjectExec
+_ALIASES = {"project": "projection"}
+
+
+def normalize_op_name(name: str) -> str:
+    """Fold an executor ("ProjectExec") or IR ("Projection") class name to
+    the shared lowercase key est-vs-actual pairing matches on."""
+    if name.endswith("Exec"):
+        name = name[:-4]
+    name = name.lower()
+    return _ALIASES.get(name, name)
+
+
+def _scan_rows(node) -> int:
+    from blaze_tpu.runtime.placement import DECODE_EXPANSION
+
+    total = 0
+    try:
+        for fg in node.conf.file_groups:
+            for f in fg.files:
+                total += int(getattr(f, "size", 0) or 0)
+    except Exception:
+        total = 0
+    if total <= 0:
+        return DEFAULT_SOURCE_ROWS
+    try:
+        width = ROW_WIDTH_BYTES * max(1, len(node.output_schema.fields))
+    except Exception:
+        width = ROW_WIDTH_BYTES
+    return max(1, int(total * DECODE_EXPANSION / width))
+
+
+def _narrow_factor(node, rows: int) -> int:
+    """Row-count effect of one narrow op — shared between standalone nodes
+    and the chains a FusedStage absorbed."""
+    if isinstance(node, N.Filter):
+        return max(1, int(rows * FILTER_SELECTIVITY))
+    if isinstance(node, N.Limit):
+        return min(rows, int(node.limit)) if node.limit else rows
+    return rows
+
+
+def _node_rows(node, kids: List[int]) -> int:
+    first = kids[0] if kids else 0
+    if isinstance(node, (N.ParquetScan, N.OrcScan)):
+        return _scan_rows(node)
+    if isinstance(node, (N.Filter, N.Limit)):
+        return _narrow_factor(node, first)
+    if isinstance(node, N.Agg):
+        if getattr(node, "input_is_partial", False):
+            # the partial stage already took the cardinality cut; the final
+            # merge only dedups across partitions
+            return max(1, first)
+        return max(1, int(first * AGG_REDUCTION))
+    if isinstance(node, N.Sort):
+        fl = node.fetch_limit
+        return min(first, int(fl)) if fl else first
+    if isinstance(node, N.Expand):
+        return first * max(1, len(node.projections))
+    if isinstance(node, N.Generate):
+        return max(1, int(first * GENERATE_EXPANSION))
+    if isinstance(node, N.Union):
+        return sum(kids)
+    if isinstance(node, (N.HashJoin, N.SortMergeJoin, N.BroadcastJoin)):
+        return max(kids) if kids else 0
+    if isinstance(node, N.FusedStage):
+        rows = first
+        for op in reversed(getattr(node, "ops", ()) or ()):
+            rows = _narrow_factor(op, rows)
+        return rows
+    if not kids:
+        return DEFAULT_SOURCE_ROWS
+    return first
+
+
+def estimate_plan(plan: N.PlanNode) -> List[dict]:
+    """Pre-order ``[{"op": <normalized name>, "est_rows": int}]`` for every
+    node of the plan. Never raises — a node the walk chokes on estimates
+    as its first child's rows."""
+    memo: Dict[int, int] = {}
+
+    def est(node) -> int:
+        key = id(node)
+        if key in memo:
+            return memo[key]
+        try:
+            kids = [est(c) for c in node.children()]
+            rows = int(_node_rows(node, kids))
+        except Exception:
+            rows = DEFAULT_SOURCE_ROWS
+        memo[key] = rows
+        return rows
+
+    records: List[dict] = []
+
+    def walk(node):
+        records.append({"op": normalize_op_name(type(node).__name__),
+                        "est_rows": est(node)})
+        try:
+            for c in node.children():
+                walk(c)
+        except Exception:
+            pass
+
+    walk(plan)
+    return records

@@ -137,6 +137,15 @@ def _node_line(name: str, node: Optional[MetricNode]) -> str:
         parts.append(f"mem_spill[count={mem_spills}"
                      f" size={fmt_bytes(mem_spill_size)}"
                      f" time={fmt_ns(mem_spill_time)}]")
+    # shuffle writers record per-reducer row counts (stats plane feed);
+    # summarize instead of printing one key per partition
+    part_rows = sorted(values.pop(k) for k in list(values)
+                       if k.startswith("part_rows_"))
+    if part_rows:
+        mid = part_rows[len(part_rows) // 2]
+        parts.append(f"part_rows[n={len(part_rows)}"
+                     f" total={sum(part_rows)}"
+                     f" max={part_rows[-1]} med={mid}]")
     for k in sorted(values):
         v = values[k]
         parts.append(f"{k[:-8]}={fmt_ns(v)}" if k.endswith("_time_ns")
@@ -172,10 +181,17 @@ def render_explain_analyze(query: dict, session_metrics: MetricNode) -> str:
     result_parts = [p for p in result_parts if p is not None]
     merged = merge_partition_metrics(result_parts) if result_parts else None
     lines.extend(render_annotated_tree(query["shape"], merged))
+    stats = query.get("stats") or {}
+    stage_stats = {s.get("stage"): s for s in stats.get("stages") or []}
     for stage in query["stages"]:
         sid = stage["id"]
         lines.append(f"-- Stage {sid} [{stage['kind']}]"
                      f" ({stage['num_tasks']} task(s)) --")
+        srec = stage_stats.get(sid)
+        if srec is not None:
+            from blaze_tpu.obs.stats import stage_summary_line
+
+            lines.append("   " + stage_summary_line(srec))
         stage_node = session_metrics.get_named(f"stage_{sid}")
         task_parts = []
         if stage_node is not None:
@@ -184,4 +200,15 @@ def render_explain_analyze(query: dict, session_metrics: MetricNode) -> str:
             task_parts = [p for p in task_parts if p is not None]
         merged = merge_partition_metrics(task_parts) if task_parts else None
         lines.extend(render_annotated_tree(stage["shape"], merged))
+    ops = stats.get("operators") or []
+    paired = [o for o in ops if o.get("est_rows") is not None]
+    if paired:
+        # the AQE signal: ordered estimate-vs-observed cardinalities
+        lines.append("-- Cardinality (estimated vs actual) --")
+        for o in paired:
+            frac = o.get("device_time_fraction", 0.0)
+            lines.append(
+                f"   {o['op']}: est={o['est_rows']}"
+                f" actual={o['actual_rows']}"
+                f" device_frac={frac:.2f}")
     return "\n".join(lines)

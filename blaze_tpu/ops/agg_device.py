@@ -849,6 +849,9 @@ class DevicePartialAgger:
         if self.metrics is not None:
             self.metrics.add("agg_radix_buckets", len(rows))
         _radix_counter().inc(len(rows))
+        from blaze_tpu.obs.stats import STATS_HUB
+
+        STATS_HUB.note_radix(rows, groups)
         from blaze_tpu.obs.tracer import TRACER
 
         if TRACER.active:
@@ -858,8 +861,6 @@ class DevicePartialAgger:
                       "rows": rows.tolist(), "groups": groups.tolist()})
 
     def process(self, batch: ColumnarBatch) -> Optional[ColumnarBatch]:
-        import time as _time
-
         from blaze_tpu.utils.device import DEVICE_STATS
 
         n = batch.num_rows
@@ -874,15 +875,14 @@ class DevicePartialAgger:
                 jb = spec.materialize(jb, spec.metrics)
                 if jb is None or jb.num_rows == 0:
                     return None
-            t0 = _time.perf_counter()
-            exists = jb.row_exists_mask()
-            if self.fused_predicates:
-                exists = ExprEvaluator(
-                    list(self.fused_predicates),
-                    self.child_schema).evaluate_predicate(jb)
-            outs = self._flow(jb, exists)
-            num_groups = int(outs[0])
-            DEVICE_STATS.add_kernel(_time.perf_counter() - t0)
+            with DEVICE_STATS.kernel_span():
+                exists = jb.row_exists_mask()
+                if self.fused_predicates:
+                    exists = ExprEvaluator(
+                        list(self.fused_predicates),
+                        self.child_schema).evaluate_predicate(jb)
+                outs = self._flow(jb, exists)
+                num_groups = int(outs[0])
             if num_groups == 0:
                 return None
             return self._assemble(outs, num_groups)
@@ -896,33 +896,32 @@ class DevicePartialAgger:
                                   batch):
                 if sb.num_rows == 0:
                     continue
-                t0 = _time.perf_counter()
-                exists = sb.row_exists_mask()
-                if self.fused_predicates:
-                    exists = exists & ExprEvaluator(
-                        list(self.fused_predicates),
-                        self.child_schema).evaluate_predicate(sb)
-                outs = self._flow(sb, exists)
-                num_groups = int(outs[0])
-                DEVICE_STATS.add_kernel(_time.perf_counter() - t0)
+                with DEVICE_STATS.kernel_span():
+                    exists = sb.row_exists_mask()
+                    if self.fused_predicates:
+                        exists = exists & ExprEvaluator(
+                            list(self.fused_predicates),
+                            self.child_schema).evaluate_predicate(sb)
+                    outs = self._flow(sb, exists)
+                    num_groups = int(outs[0])
                 if num_groups:
                     parts.append(self._assemble(outs, num_groups))
             if not parts:
                 return None
             return parts[0] if len(parts) == 1 else \
                 ColumnarBatch.concat(parts, self.op.schema)
-        t0 = _time.perf_counter()
-        dense = self._try_dense(batch)
-        if dense is not None:
-            outs, num_groups = dense
-        else:
-            if self._needs_trace():
-                outs = self._fused_fn(batch)(jnp.int64(n),
-                                             *self._jit_flat(batch))
+        with DEVICE_STATS.kernel_span():
+            dense = self._try_dense(batch)
+            if dense is not None:
+                outs, num_groups = dense
             else:
-                outs = self._flow(batch, batch.row_exists_mask())
-            num_groups = int(outs[0])  # the sync point: kernel completes here
-        DEVICE_STATS.add_kernel(_time.perf_counter() - t0)
+                if self._needs_trace():
+                    outs = self._fused_fn(batch)(jnp.int64(n),
+                                                 *self._jit_flat(batch))
+                else:
+                    outs = self._flow(batch, batch.row_exists_mask())
+                # the sync point: kernel completes here
+                num_groups = int(outs[0])
         if num_groups == 0:
             return None
         return self._assemble(outs, num_groups)
@@ -941,35 +940,33 @@ class DevicePartialAgger:
         n = batch.num_rows
         if n == 0:
             return None
-        import time as _time
-
         from blaze_tpu.utils.device import DEVICE_STATS
 
-        t0 = _time.perf_counter()
-        exists = batch.row_exists_mask()
-        self.group_ev._reset_cse(batch)
-        for ev in self.agg_evs:
-            if ev is not None:
-                ev._reset_cse(batch)
-        key_data, key_valid = [], []
-        for _, e in self.op.groupings:
-            d, val = _broadcast(
-                self.group_ev._to_dev(self.group_ev._eval(e, batch), batch),
-                batch)
-            key_data.append(d)
-            key_valid.append(val & exists)
-        args = self._eval_args(batch, exists)
-        kernel = _passthrough_kernel(
-            tuple(str(d.dtype) for d in key_data), tuple(self.specs),
-            tuple("wide3" if isinstance(a[0], tuple) else str(a[0].dtype)
-                  for a in args), batch.capacity)
-        flat = []
-        for d, v in zip(key_data, key_valid):
-            flat += [d, v]
-        for d, v in args:
-            flat += ([*d, v] if isinstance(d, tuple) else [d, v])
-        outs = kernel(exists, *flat)
-        DEVICE_STATS.add_kernel(_time.perf_counter() - t0)
+        with DEVICE_STATS.kernel_span():
+            exists = batch.row_exists_mask()
+            self.group_ev._reset_cse(batch)
+            for ev in self.agg_evs:
+                if ev is not None:
+                    ev._reset_cse(batch)
+            key_data, key_valid = [], []
+            for _, e in self.op.groupings:
+                d, val = _broadcast(
+                    self.group_ev._to_dev(self.group_ev._eval(e, batch),
+                                          batch),
+                    batch)
+                key_data.append(d)
+                key_valid.append(val & exists)
+            args = self._eval_args(batch, exists)
+            kernel = _passthrough_kernel(
+                tuple(str(d.dtype) for d in key_data), tuple(self.specs),
+                tuple("wide3" if isinstance(a[0], tuple) else str(a[0].dtype)
+                      for a in args), batch.capacity)
+            flat = []
+            for d, v in zip(key_data, key_valid):
+                flat += [d, v]
+            for d, v in args:
+                flat += ([*d, v] if isinstance(d, tuple) else [d, v])
+            outs = kernel(exists, *flat)
         # rows stay in place (exists is a prefix mask), so the group count
         # is the batch's row count — no device sync at all
         return self._assemble(outs, n)
@@ -1703,6 +1700,10 @@ class DeviceMergeAgger:
         if self.metrics is not None:
             self.metrics.add("agg_radix_buckets", nbuck)
         _radix_counter().inc(nbuck)
+        # no per-bucket histogram on this path; still counts as a pass
+        from blaze_tpu.obs.stats import STATS_HUB
+
+        STATS_HUB.note_radix((), ())
 
 
 @functools.lru_cache(maxsize=256)

@@ -241,8 +241,6 @@ class _HashJoinBase(Operator):
         bb = bmap.batch
         if not all(isinstance(c, DeviceColumn) for c in bb.columns):
             return NotImplemented
-        import time as _time
-
         import jax.numpy as jnp
 
         from blaze_tpu.utils.device import DEVICE_STATS
@@ -261,11 +259,10 @@ class _HashJoinBase(Operator):
             flat += [c.data, c.validity]
         for c in bb.columns:
             flat += [c.data, c.validity]
-        t0 = _time.perf_counter()
-        outs = kernel(bmap._dev_cell[0], jnp.int64(batch.num_rows),
-                      cols[0].data, cols[0].validity, *flat)
-        count = int(outs[0])  # sync point
-        DEVICE_STATS.add_kernel(_time.perf_counter() - t0)
+        with DEVICE_STATS.kernel_span():
+            outs = kernel(bmap._dev_cell[0], jnp.int64(batch.num_rows),
+                          cols[0].data, cols[0].validity, *flat)
+            count = int(outs[0])  # sync point
         metrics.add("device_inner_batches", 1)
         # The probe itself ran on device inside the fused kernel; count it
         # under device_probe_batches too so the metric stays meaningful for

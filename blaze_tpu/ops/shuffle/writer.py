@@ -173,12 +173,23 @@ class _WriterState(MemConsumer):
         t0 = self.repart.split_time_ns
         c0 = self.streams.codes_bytes
         s0 = self.streams.serialized_bytes
+        from blaze_tpu.obs.stats import STATS_HUB
+
+        part_rows = {} if STATS_HUB.enabled else None
         for pid, sub in self.repart.bucketize_host(batch):
+            if part_rows is not None:
+                part_rows[pid] = part_rows.get(pid, 0) + sub.num_rows
             if self._mem_parts is not None:
                 self._mem_parts.setdefault(pid, []).append(sub)
                 self._mem_bytes += _host_batch_nbytes(sub)
             else:
                 self.streams.write(pid, sub)
+        if part_rows:
+            # per-reducer row counts for the stats plane (one metric key per
+            # partition; the plane folds these into partition_rows and
+            # explain summarizes them, so the tree never renders raw lists)
+            for pid, rows in part_rows.items():
+                self.metrics.add(f"part_rows_{pid}", rows)
         if self._mem_parts is not None and self._mem_bytes > \
                 self.ctx.conf.zero_copy_mem_segment_max_bytes:
             self._mem_degrade()
@@ -379,9 +390,13 @@ class RssShuffleWriterExec(Operator):
         pending_rows = 0
 
         def _push(batch):
+            from blaze_tpu.obs.stats import STATS_HUB
+
             b0, g0 = repart.split_batches, repart.split_gathers
             t0 = repart.split_time_ns
             for pid, sub in repart.bucketize_host(batch):
+                if STATS_HUB.enabled:
+                    metrics.add(f"part_rows_{pid}", sub.num_rows)
                 buf = io.BytesIO()
                 bw = BatchWriter(buf, codec=codec,
                                  dict_refs=ctx.conf.codes_shuffle)

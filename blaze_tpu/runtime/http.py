@@ -55,11 +55,17 @@ def _query_record(q: dict) -> dict:
     """One /debug/queries entry: the query record with its nested-tuple
     plan shape replaced by indented outline lines (fused operators show as
     "+ <Op> (fused)" pseudo-children under their FusedStageExec)."""
-    d = {k: v for k, v in q.items() if k != "shape"}
+    d = {k: v for k, v in q.items() if k not in ("shape", "stats")}
     if q.get("shape"):
         from blaze_tpu.obs.explain import shape_lines
 
         d["plan"] = shape_lines(q["shape"])
+    stats = q.get("stats")
+    if stats and stats.get("stages"):
+        from blaze_tpu.obs.stats import stage_summary_line
+
+        d["stage_stats"] = [stage_summary_line(s) for s in stats["stages"]]
+        d["fingerprint"] = stats.get("fingerprint")
     return d
 
 
@@ -141,6 +147,30 @@ class ProfilingService:
                                 status=404)
                         else:
                             self._send(json.dumps(bundle, indent=2,
+                                                  default=str))
+                    elif url.path == "/debug/profiles":
+                        from blaze_tpu.obs.stats import list_profiles
+
+                        sess = getattr(self.server, "blaze_session", None)
+                        conf = getattr(sess, "conf", None)
+                        self._send(json.dumps(list_profiles(conf), indent=2))
+                    elif url.path.startswith("/debug/profiles/"):
+                        from blaze_tpu.obs.stats import load_profile
+
+                        sess = getattr(self.server, "blaze_session", None)
+                        conf = getattr(sess, "conf", None)
+                        fp = url.path[len("/debug/profiles/"):]
+                        # in-memory first: a fresh profile may not have hit
+                        # the store yet (or the store dir was cleaned)
+                        profile = (getattr(sess, "profiles", {}) or {}).get(fp) \
+                            if sess is not None else None
+                        if profile is None:
+                            profile = load_profile(fp, conf)
+                        if profile is None:
+                            self._send(json.dumps(
+                                {"error": f"no profile {fp!r}"}), status=404)
+                        else:
+                            self._send(json.dumps(profile, indent=2,
                                                   default=str))
                     elif url.path == "/debug/trace":
                         from blaze_tpu.obs.tracer import TRACER

@@ -15,6 +15,7 @@ directly, exactly like the reference."""
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import itertools
 import os
@@ -31,6 +32,9 @@ from blaze_tpu.core.batch import ColumnarBatch
 from blaze_tpu.ir import nodes as N
 from blaze_tpu.ir import types as T
 from blaze_tpu.obs.explain import op_shape, render_explain_analyze
+from blaze_tpu.obs.stats import STATS_HUB, StatsPlane
+from blaze_tpu.obs.stats import configure as _stats_configure
+from blaze_tpu.obs.stats import save_profile as _save_profile
 from blaze_tpu.obs.telemetry import get_registry
 from blaze_tpu.obs.telemetry import configure_from as _telemetry_configure
 from blaze_tpu.obs.tracer import TRACER
@@ -124,7 +128,7 @@ class _QueryRun:
     driver threads can't interleave each other's stages (re-entrancy)."""
 
     __slots__ = ("qid", "token", "mem_group", "label", "stage_meta",
-                 "shuffle_dirs", "resource_ids")
+                 "shuffle_dirs", "resource_ids", "stats")
 
     def __init__(self, qid: int, token=None, mem_group: Optional[str] = None,
                  label: Optional[str] = None):
@@ -135,6 +139,7 @@ class _QueryRun:
         self.stage_meta: Dict[int, dict] = {}
         self.shuffle_dirs: List[str] = []
         self.resource_ids: List[str] = []
+        self.stats = None  # obs.stats.StatsPlane when conf.stats_enabled
 
 
 class Session:
@@ -219,6 +224,10 @@ class Session:
         # records consumed by explain_analyze, /debug/trace, /debug/queries
         _tracer_configure(self.conf)
         _telemetry_configure(self.conf)
+        _stats_configure(self.conf)
+        # last observed QueryProfile per plan fingerprint (obs/stats.py);
+        # the in-memory face of the on-disk profile store
+        self.profiles: Dict[str, dict] = {}
         self._query_ids = itertools.count()
         self._stage_meta: Dict[int, dict] = {}
         self.query_log: List[dict] = []  # last _QUERY_LOG_MAX finished queries
@@ -281,6 +290,16 @@ class Session:
             query["rows"] = rows
             query["wall_s"] = dur_ns / 1e9
             query["state"] = state
+            if qrun.stats is not None:
+                # fold the stats plane into the record BEFORE it enters the
+                # query log; completed queries also persist their profile
+                # under the plan fingerprint (obs/stats.py store)
+                profile = qrun.stats.finalize_into(query, self.metrics, state)
+                if profile is not None and state == "done":
+                    self.profiles[profile["fingerprint"]] = profile
+                    while len(self.profiles) > 2 * self._QUERY_LOG_MAX:
+                        self.profiles.pop(next(iter(self.profiles)))
+                    _save_profile(profile, self.conf)
             with self._qlog_mu:
                 self.inflight.pop(qid, None)
                 self.query_log.append(query)
@@ -318,6 +337,11 @@ class Session:
                 from blaze_tpu.ir.optimizer import prune_plan
 
                 plan = prune_plan(plan)
+            if self.conf.stats_enabled:
+                try:
+                    qrun.stats = StatsPlane(plan, self.conf)
+                except Exception:
+                    qrun.stats = None
             # map stages run EAGERLY during lowering, so by the time the
             # final operator exists every stage this query ran is in
             # qrun.stage_meta (query-scoped: concurrent queries don't see
@@ -346,8 +370,10 @@ class Session:
 
             ctx = self._make_ctx(p, qrun=qrun)
             set_task_context(0, p)
+            scope = (STATS_HUB.scoped(qrun.stats.scope_key(StatsPlane.RESULT_STAGE))
+                     if qrun.stats is not None else contextlib.nullcontext())
             try:
-                with placement.placed(where), \
+                with placement.placed(where), scope, \
                         ctx.mem.group_scope(qrun.mem_group):
                     yield from op.execute(p, ctx,
                                           self.metrics.named_child(f"result_{p}"))
@@ -400,6 +426,10 @@ class Session:
                     # stream — but only while zero batches were emitted
                     # (restarting a half-consumed stream would duplicate rows)
                     recoveries += 1
+                    if qrun.stats is not None:
+                        qrun.stats.note_recovery(
+                            "result_stream_recovery",
+                            stage=getattr(exc, "stage", None), detail=exc)
                     if emitted or recoveries > 2:
                         _put(queues[p], exc)
                         return
@@ -469,6 +499,26 @@ class Session:
         for _ in self.execute(plan):
             pass
         return render_explain_analyze(self.query_log[-1], self.metrics)
+
+    def profile(self, q=None) -> Optional[dict]:
+        """Last observed QueryProfile (obs/stats.py) for ``q``: a plan (its
+        fingerprint is computed), a fingerprint string, a query record from
+        ``query_log``/``inflight``, or None for the most recent finished
+        query. Falls back to the on-disk profile store for fingerprints
+        this session has not run itself."""
+        from blaze_tpu.obs.stats import load_profile, plan_fingerprint
+
+        if q is None:
+            with self._qlog_mu:
+                for rec in self.query_log[::-1]:
+                    if rec.get("stats"):
+                        return rec["stats"]
+            return None
+        if isinstance(q, dict):
+            return q.get("stats")
+        fp = q if isinstance(q, str) else plan_fingerprint(q)
+        hit = self.profiles.get(fp)
+        return hit if hit is not None else load_profile(fp, self.conf)
 
     def _release_query(self, qrun: _QueryRun):
         """Tear one query's intermediates down NOW instead of at session
@@ -770,9 +820,12 @@ class Session:
                 mem_sink=(self.mem_segments, stage) if mem_sink else None)
             ctx = self._make_ctx(m, stage)
             task_metrics = self.metrics.named_child(f"stage_{stage}").named_child(f"map_{m}")
+            scope = (STATS_HUB.scoped(qrun.stats.scope_key(stage))
+                     if qrun is not None and qrun.stats is not None
+                     else contextlib.nullcontext())
             set_task_context(stage, m)
             try:
-                with placement.placed(where_cell[0]), \
+                with placement.placed(where_cell[0]), scope, \
                         TRACER.span("task", "task",
                                     {"stage": stage, "map": m}):
                     for _ in writer.execute(m, ctx, task_metrics):
@@ -802,7 +855,16 @@ class Session:
             if missing:
                 lineage.recompute(missing)
 
-        return stage, [(data, read_index_file(index)) for data, index in outputs]
+        indexes = [(data, read_index_file(index)) for data, index in outputs]
+        if qrun is not None and qrun.stats is not None:
+            # mem_sink=False in a process-tier session (skew-join map
+            # stages) still writes files, so the label degrades to ipc/shm
+            tier = "process" if mem_sink else (
+                "shm" if self._shuffle_tier() == "shm" else "ipc")
+            qrun.stats.on_map_stage(stage, f"shuffle_map/{tier}", num_maps,
+                                    node.partitioning.num_partitions,
+                                    indexes=indexes)
+        return stage, indexes
 
     def _run_shuffle_map_stage(self, node: N.ShuffleExchange) -> N.PlanNode:
         """Execute the map side (one ShuffleWriter task per child partition)
@@ -1047,9 +1109,13 @@ class Session:
                 ctx = self._make_ctx(m, stage)
                 task_metrics = self.metrics.named_child(
                     f"stage_{stage}").named_child(f"map_{m}")
+                qr = self._qrun()
+                scope = (STATS_HUB.scoped(qr.stats.scope_key(stage))
+                         if qr is not None and qr.stats is not None
+                         else contextlib.nullcontext())
                 set_task_context(stage, m)
                 try:
-                    with placement.placed(where), \
+                    with placement.placed(where), scope, \
                             TRACER.span("task", "task",
                                         {"stage": stage, "map": m}):
                         for _ in writer.execute(m, ctx, task_metrics):
@@ -1058,6 +1124,12 @@ class Session:
                     clear_task_context()
 
             self._run_tasks(run_map, range(num_maps))
+
+        qrun = self._qrun()
+        if qrun is not None and qrun.stats is not None:
+            # push shuffle writes no index files: partition rows still come
+            # from part_rows_* metrics; bytes stay per-stage totals
+            qrun.stats.on_map_stage(stage, "rss_map", num_maps, num_reducers)
 
         rid = f"rss_shuffle_{stage}"
         if shuffle_client is not None:
@@ -1126,6 +1198,9 @@ class Session:
                 clear_task_context()
 
         outputs = self._run_tasks(run_map, range(num_maps))
+        qrun = self._qrun()
+        if qrun is not None and qrun.stats is not None:
+            qrun.stats.on_map_stage(stage, "mesh_map", num_maps, num_reducers)
 
         # fold map partitions onto the n mesh slots (round-robin)
         shard_batches: List[Optional[ColumnarBatch]] = [None] * n
@@ -1211,6 +1286,9 @@ class Session:
             exc = ShuffleOutputMissing(
                 "(reported by worker)", "missing",
                 stage=reply.get("stage"), maps=reply.get("maps"))
+            if qrun is not None and qrun.stats is not None:
+                qrun.stats.note_recovery("worker_fetch_recovery",
+                                         stage=reply.get("stage"), detail=exc)
             try:
                 self._lineage.recover(exc)
                 return True
@@ -1225,6 +1303,10 @@ class Session:
         for m, r in enumerate(replies):
             stage_metrics.named_child(f"map_{m}").merge_dict(
                 r.get("metrics") or {})
+            # worker-side stats (drained hub records) merge like telemetry
+            # deltas: folded into the plane's per-stage skew accumulators
+            if qrun is not None and qrun.stats is not None and r.get("stats"):
+                qrun.stats.merge_task_stats(stage, r["stats"])
             # worker-process spans ride back with the task result; re-base
             # them into the driver timeline (wall epochs anchor the shift)
             tr = r.get("trace")
@@ -1261,6 +1343,12 @@ class Session:
         committed: Dict[int, tuple] = {}  # m -> ("batches"|"bytes", items)
         lock = threading.Lock()
         where = self._decide_placement(child, f"stage_{stage}")
+        qrun = self._qrun()
+
+        def _stats_scope():
+            return (STATS_HUB.scoped(qrun.stats.scope_key(stage))
+                    if qrun is not None and qrun.stats is not None
+                    else contextlib.nullcontext())
 
         class _Bucket:
             def __init__(self):
@@ -1297,7 +1385,7 @@ class Session:
 
             set_task_context(stage, m)
             try:
-                with placement.placed(where), \
+                with placement.placed(where), _stats_scope(), \
                         TRACER.span("task", "task",
                                     {"stage": stage, "map": m}):
                     for b in child_op.execute(m, ctx, task_metrics):
@@ -1333,7 +1421,7 @@ class Session:
                 f"stage_{stage}").named_child(f"map_{m}")
             set_task_context(stage, m)
             try:
-                with placement.placed(where), \
+                with placement.placed(where), _stats_scope(), \
                         TRACER.span("task", "task",
                                     {"stage": stage, "map": m}):
                     for _ in writer.execute(m, ctx, task_metrics):
@@ -1366,6 +1454,9 @@ class Session:
                     blocks.append(("batches", items))
             else:
                 blocks.extend(("bytes", b) for b in items)
+        if qrun is not None and qrun.stats is not None:
+            qrun.stats.on_collect_stage(stage, f"{prefix}_collect", num_maps,
+                                        blocks)
         return blocks
 
     def _run_single_collect(self, node: N.ShuffleExchange) -> N.PlanNode:
@@ -1453,6 +1544,10 @@ class Session:
                     # (small) bound, separate from the retry budget
                     recoveries += 1
                     self.metrics.add("task_retries", 1)
+                    if qrun is not None and qrun.stats is not None:
+                        qrun.stats.note_recovery(
+                            "task_fetch_recovery",
+                            stage=getattr(exc, "stage", None), detail=exc)
                     if recoveries > 3:
                         self.metrics.add("task_failures", 1)
                         raise

@@ -25,10 +25,24 @@ class DeviceStats:
     TPU-first analogue of the reference's pervasive ``elapsed_compute``
     discipline, execution_context.rs:705-730). Tracks device<->host transfer
     bytes/calls and jitted-kernel dispatches; surfaced at /debug/device and
-    in the bench output."""
+    in the bench output.
+
+    ``kernel_time_s`` is the UNION of all kernel-active intervals, not the
+    sum of per-dispatch durations: timed phases nest (agg_device wraps a
+    whole device pass that itself goes through ``kernels._dispatch``) and
+    parallel task threads overlap, so a plain sum exceeds wall-clock
+    (BENCH_r09 q01: 0.543s kernel vs 0.336s wall). ``kernel_begin``/
+    ``kernel_end`` keep a process-wide active count under the lock and add
+    elapsed time only when the count drops back to zero — nested and
+    overlapping spans count wall time once, so kernel_time_s <= wall by
+    construction. A per-thread depth additionally attributes each thread's
+    OUTERMOST span to the operator currently on the self-time stack
+    (``device_time_ns`` on its MetricNode — the per-operator device-time
+    signal the stats plane reports)."""
 
     def __init__(self):
         self._mu = threading.Lock()
+        self._tls = threading.local()
         self.reset()
 
     def reset(self):
@@ -41,6 +55,8 @@ class DeviceStats:
             self.kernel_time_s = 0.0
             self.mapped_calls = 0
             self.mapped_bytes = 0
+            self._active = 0
+            self._active_t0 = 0.0
 
     def add_to_host(self, nbytes: int):
         with self._mu:
@@ -61,10 +77,50 @@ class DeviceStats:
             self.mapped_calls += 1
             self.mapped_bytes += int(nbytes)
 
-    def add_kernel(self, seconds: float):
+    def kernel_begin(self):
+        import time
+
+        depth = getattr(self._tls, "depth", 0)
+        self._tls.depth = depth + 1
+        if depth == 0:
+            self._tls.t0 = time.perf_counter()
         with self._mu:
             self.kernel_calls += 1
-            self.kernel_time_s += seconds
+            if self._active == 0:
+                self._active_t0 = time.perf_counter()
+            self._active += 1
+
+    def kernel_end(self):
+        import time
+
+        now = time.perf_counter()
+        with self._mu:
+            # reset() between begin/end (bench resets between shapes) drops
+            # the open span rather than booking a negative/garbage interval
+            if self._active > 0:
+                self._active -= 1
+                if self._active == 0:
+                    self.kernel_time_s += now - self._active_t0
+        depth = getattr(self._tls, "depth", 1) - 1
+        self._tls.depth = depth
+        if depth == 0:
+            self._attribute(now - self._tls.t0)
+
+    def _attribute(self, seconds: float):
+        """Charge one thread-outermost kernel span to the operator currently
+        computing on this thread (ops/base._SELF_TIME stack top)."""
+        try:
+            from blaze_tpu.ops import base as _ops_base
+        except Exception:
+            return
+        stack = getattr(_ops_base._SELF_TIME, "stack", None)
+        if stack:
+            stack[-1][0].add("device_time_ns", int(seconds * 1e9))
+
+    def kernel_span(self) -> "_KernelSpan":
+        """Context manager form of kernel_begin/kernel_end for call sites
+        that time a whole device phase (agg flows, fused join probes)."""
+        return _KernelSpan(self)
 
     def snapshot(self) -> dict:
         with self._mu:
@@ -78,6 +134,21 @@ class DeviceStats:
                 "mapped_calls": self.mapped_calls,
                 "mapped_bytes": self.mapped_bytes,
             }
+
+
+class _KernelSpan:
+    __slots__ = ("_stats",)
+
+    def __init__(self, stats: DeviceStats):
+        self._stats = stats
+
+    def __enter__(self):
+        self._stats.kernel_begin()
+        return self
+
+    def __exit__(self, *exc):
+        self._stats.kernel_end()
+        return False
 
 
 DEVICE_STATS = DeviceStats()

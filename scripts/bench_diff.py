@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Diff two bench/soak artifacts and fail on regression.
+
+The repo accumulates BENCH_rNN.json / SOAK_rNN.json artifacts per round;
+until now comparing them was a by-eye job. This script makes the comparison
+mechanical so a round gate (or CI) can run::
+
+    python scripts/bench_diff.py BENCH_r09.json BENCH_r10.json
+
+and get exit 1 iff the candidate regressed against the base:
+
+- per-shape wall clock grew beyond ``--wall-tol`` (default 25% — bench
+  boxes are noisy; this catches step-function regressions, not jitter)
+- a zero-expected invariant tripwire went nonzero in the candidate
+  (``window_group_loops``, ``fused_fallback_batches``, ``agg_reintern_rows``
+  — a silently-degraded fast path, regardless of timing)
+- ``shuffle_bytes_serialized`` grew beyond ``--bytes-tol`` (default 10%)
+  over the base: the zero-copy tiers (serde elision, shm hand-off) started
+  re-serializing shuffle traffic
+- ``kernel_time_s`` exceeds the shape's wall clock in the candidate but
+  not in the base: the union-of-intervals kernel timer guarantees
+  ``kernel_time_s <= wall`` by construction, so a NEW violation means the
+  timer is double-counting again (pre-fix artifacts like BENCH_r09 carry
+  the old double-counted numbers; a self-diff of those must stay clean)
+
+Both BENCH artifacts (``shapes.<q>.value`` + ``kernel_stats``) and SOAK
+artifacts (``shapes.<q>.wall_s`` with tripwires inline) are understood;
+shapes present in only one artifact are reported but not failed (new
+shapes are growth, not regression).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+# tripwires that must be 0 in every healthy run (runtime/metrics.py keeps
+# the authoritative list; these are the subset whose nonzero value means a
+# degraded fast path rather than a workload property)
+ZERO_EXPECTED = ("window_group_loops", "fused_fallback_batches",
+                 "agg_reintern_rows")
+
+
+def _shape_wall(rec: dict):
+    for key in ("value", "wall_s"):
+        if key in rec:
+            return float(rec[key])
+    return None
+
+
+def _shape_counters(rec: dict) -> dict:
+    # BENCH nests tripwires under kernel_stats; SOAK inlines them
+    return rec.get("kernel_stats") or rec
+
+
+def diff_artifacts(base: dict, cand: dict, wall_tol: float = 0.25,
+                   bytes_tol: float = 0.10) -> List[str]:
+    """Return regression descriptions (empty == candidate is no worse)."""
+    regressions: List[str] = []
+    base_shapes = base.get("shapes") or {}
+    cand_shapes = cand.get("shapes") or {}
+    for name, crec in sorted(cand_shapes.items()):
+        brec = base_shapes.get(name)
+        cwall = _shape_wall(crec)
+        ctr = _shape_counters(crec)
+
+        for t in ZERO_EXPECTED:
+            if int(ctr.get(t, 0) or 0) != 0:
+                regressions.append(
+                    f"{name}: zero-expected tripwire {t}={ctr[t]}")
+
+        kt = ctr.get("kernel_time_s")
+        if kt is not None and cwall is not None and float(kt) > cwall:
+            bctr0 = _shape_counters(brec) if brec is not None else {}
+            bkt = bctr0.get("kernel_time_s")
+            bwall0 = _shape_wall(brec) if brec is not None else None
+            base_broken = (bkt is not None and bwall0 is not None
+                           and float(bkt) > bwall0)
+            if not base_broken:
+                regressions.append(
+                    f"{name}: kernel_time_s {kt} > wall {cwall}"
+                    " (union timer invariant broken)")
+            else:
+                print(f"  {name}: kernel_time_s > wall in BOTH artifacts"
+                      " (pre-fix base), not treated as regression")
+
+        if brec is None:
+            print(f"  {name}: new shape (no base), skipped comparison")
+            continue
+        bwall = _shape_wall(brec)
+        if bwall and cwall is not None and cwall > bwall * (1 + wall_tol):
+            regressions.append(
+                f"{name}: wall {cwall}s vs base {bwall}s"
+                f" (+{(cwall / bwall - 1) * 100:.0f}% > {wall_tol * 100:.0f}%)")
+
+        bctr = _shape_counters(brec)
+        bser = int(bctr.get("shuffle_bytes_serialized", 0) or 0)
+        cser = int(ctr.get("shuffle_bytes_serialized", 0) or 0)
+        # +4KB absolute slack: a base of 0 must not fail on any nonzero
+        if cser > bser * (1 + bytes_tol) + 4096:
+            regressions.append(
+                f"{name}: shuffle_bytes_serialized {cser} vs base {bser}"
+                " (zero-copy tier regression)")
+    return regressions
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("base", help="base artifact (BENCH/SOAK json)")
+    ap.add_argument("cand", help="candidate artifact to gate")
+    ap.add_argument("--wall-tol", type=float, default=0.25,
+                    help="per-shape wall-clock growth tolerance (frac)")
+    ap.add_argument("--bytes-tol", type=float, default=0.10,
+                    help="shuffle_bytes_serialized growth tolerance (frac)")
+    args = ap.parse_args(argv)
+    with open(args.base) as f:
+        base = json.load(f)
+    with open(args.cand) as f:
+        cand = json.load(f)
+    print(f"diffing {args.cand} against {args.base}")
+    regressions = diff_artifacts(base, cand, args.wall_tol, args.bytes_tol)
+    if regressions:
+        print(f"REGRESSIONS ({len(regressions)}):")
+        for r in regressions:
+            print(f"  - {r}")
+        return 1
+    print("ok: candidate is no worse than base")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

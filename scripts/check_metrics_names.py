@@ -10,7 +10,11 @@ Walks every registration call site (``<reg>.counter("...")`` /
    runtime — this catches names on paths tests never execute);
 2. no two call sites register the same name via different instrument types
    (the runtime would raise on whichever loses the import race; the lint
-   reports both locations deterministically).
+   reports both locations deterministically);
+3. every field the stats plane emits into QueryProfile JSON
+   (``obs.stats.ALL_PROFILE_FIELDS``) is snake_case — profiles are an
+   external artifact surface (HTTP, bench records, the on-disk store), so
+   field names are API.
 
 Tests are deliberately NOT scanned: they register intentionally-bad names
 to assert the runtime validation. Standalone: exits 1 with a report on any
@@ -85,6 +89,39 @@ def run_lint(root: str = REPO):
             seen.setdefault(name, (method, where))
     if count == 0:
         violations.append("no registrations found — scan roots wrong?")
+    violations.extend(check_profile_fields())
+    return violations
+
+
+def check_profile_fields():
+    """Validate the stats plane's QueryProfile field names: snake_case,
+    no duplicates within one record schema."""
+    import re
+
+    try:
+        from blaze_tpu.obs import stats
+    except Exception as exc:  # import must not take the lint down
+        return [f"obs.stats unimportable: {exc}"]
+    snake = re.compile(r"^[a-z][a-z0-9_]*$")
+    violations = []
+    schemas = [
+        ("PROFILE_FIELDS", stats.PROFILE_FIELDS),
+        ("STAGE_FIELDS", stats.STAGE_FIELDS),
+        ("OPERATOR_FIELDS", stats.OPERATOR_FIELDS),
+        ("SKEW_FIELDS", stats.SKEW_FIELDS),
+        ("RESIDENCY_FIELDS", stats.RESIDENCY_FIELDS),
+        ("SPILL_FIELDS", stats.SPILL_FIELDS),
+        ("RECOVERY_FIELDS", stats.RECOVERY_FIELDS),
+    ]
+    for schema_name, fields in schemas:
+        if len(set(fields)) != len(fields):
+            violations.append(
+                f"obs/stats.py: duplicate field in {schema_name}")
+        for f in fields:
+            if not snake.match(f):
+                violations.append(
+                    f"obs/stats.py: {schema_name} field {f!r}"
+                    " is not snake_case")
     return violations
 
 

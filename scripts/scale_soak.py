@@ -123,6 +123,7 @@ def main():
                 from blaze_tpu.runtime.metrics import tripwire_totals
 
                 trips = tripwire_totals(sess.metrics)
+                profile = sess.profile()
                 if PROFILE_DIR:
                     from blaze_tpu.obs import TRACER, dump_profile
 
@@ -155,6 +156,17 @@ def main():
                 "peak_mem_used": peak_used,
                 "peak_rss_mb": peak_rss_mb(),
             }
+            if profile is not None:
+                # stats-plane summary: the skew + partition-shape numbers a
+                # soak diff (scripts/bench_diff.py) compares across runs
+                out["shapes"][name]["stats"] = {
+                    "fingerprint": profile["fingerprint"],
+                    "device_time_fraction": profile["device_time_fraction"],
+                    "stages": [{k: s.get(k) for k in (
+                        "stage", "kind", "partitions", "total_bytes",
+                        "total_rows", "partition_skew_ratio", "skew")}
+                        for s in profile["stages"]],
+                }
             print(json.dumps({name: out["shapes"][name]}), flush=True)
 
     soak_path = os.path.join(os.path.dirname(os.path.dirname(
@@ -216,6 +228,7 @@ def main():
                 from blaze_tpu.runtime.metrics import tripwire_totals
 
                 trips = tripwire_totals(sess.metrics)
+                profile = sess.profile()
                 if PROFILE_DIR:
                     from blaze_tpu.obs import TRACER, dump_profile
 
@@ -242,6 +255,15 @@ def main():
                 "serde_elided_batches": trips["serde_elided_batches"],
                 "peak_rss_mb": peak_rss_mb(),
             }
+            if profile is not None:
+                out["tpcds"][name]["stats"] = {
+                    "fingerprint": profile["fingerprint"],
+                    "device_time_fraction": profile["device_time_fraction"],
+                    "stages": [{k: s.get(k) for k in (
+                        "stage", "kind", "partitions", "total_bytes",
+                        "total_rows", "partition_skew_ratio", "skew")}
+                        for s in profile["stages"]],
+                }
             print(json.dumps({name: out["tpcds"][name]}), flush=True)
     out["peak_rss_mb"] = peak_rss_mb()
     leaked = shm_roots(shm0)

@@ -273,9 +273,15 @@ def main():
                         json.loads(_get(
                             base, f"/debug/incidents/{dl[0]['id']}"))
                         if dl else None)
+                    # stats plane: served queries leave fingerprint-keyed
+                    # profiles; the artifact keeps the index head as proof
+                    # the plane stays live under concurrency
+                    profiles = json.loads(_get(base, "/debug/profiles"))
 
                     out["peak_inflight"] = sched.peak_inflight
                     out["serve_metrics"] = sched.metrics.to_dict()
+                    out["query_profiles"] = {"count": len(profiles),
+                                             "head": profiles[:3]}
             finally:
                 ProfilingService.stop()
 
