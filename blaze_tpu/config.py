@@ -110,6 +110,24 @@ class Config:
     # crc32 on every open (paranoid mode for chaos soaks/tests).
     shuffle_verify_checksum: bool = False
 
+    # Failpoint fault injection (runtime/failpoints.py): ';'-separated
+    # arming spec, e.g. "shm.commit=enospc:every3;frame.decode=corrupt:x2".
+    # Ships to worker processes inside every task conf so injection reaches
+    # task code; BLAZE_TPU_FAILPOINTS overrides per-process. Empty = off.
+    failpoints: str = dataclasses.field(
+        default_factory=lambda: os.environ.get("BLAZE_TPU_FAILPOINTS", ""))
+    # Seed for the deterministic probability/corruption streams (each site
+    # derives its own sub-stream, so runs are reproducible).
+    failpoint_seed: int = 0
+
+    # Hard per-task wall-clock timeout on the worker pool, on top of
+    # speculation: when EVERY in-flight copy of a task (original and
+    # speculative) has been running longer than this, the workers holding
+    # them are marked suspect and recycled, the task is charged to the
+    # retry budget and rerouted. 0 disables (the default: timeouts are a
+    # chaos/serve policy, not a batch default).
+    task_timeout_s: float = 0.0
+
     # Device HBM budget for resident batch data (bytes). None = ask the device.
     hbm_budget: Optional[int] = None
 
@@ -313,6 +331,13 @@ class Config:
     # admission estimate floor when the plan-based estimate has no stateful
     # operators (scans/projections still buffer batches)
     serve_default_mem_estimate: int = 64 << 20
+    # Serve-layer auto-retry of transient (QueryRetryable-classified)
+    # failures: up to serve_retry_max re-executions with capped exponential
+    # backoff + jitter, spent only inside the query's remaining deadline
+    # budget. 0 disables and restores fail-to-client behavior.
+    serve_retry_max: int = 2
+    serve_retry_backoff_s: float = 0.25
+    serve_retry_backoff_max_s: float = 2.0
 
     # Adaptive device placement (runtime/placement.py — the TPU analogue of
     # the reference's removeInefficientConverts): "auto" runs each stage

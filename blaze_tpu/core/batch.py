@@ -158,8 +158,10 @@ class DeviceColumn(Column):
 
     @staticmethod
     def from_numpy(dt: T.DataType, data: np.ndarray, validity: Optional[np.ndarray], capacity: int) -> "DeviceColumn":
+        from blaze_tpu.runtime.failpoints import failpoint
         from blaze_tpu.utils.device import DEVICE_STATS
 
+        failpoint("device.put")
         n = len(data)
         if validity is None or validity.all():
             # null-free column: skip the validity upload entirely — the mask

@@ -54,6 +54,27 @@ def choose_shm_root(shm_dir: Optional[str], min_free_bytes: int
     return d
 
 
+def is_shm_path(path: str) -> bool:
+    """Does ``path`` live under a session shm root? (Roots are always
+    mkdtemp'd with SHM_ROOT_PREFIX, whatever base dir hosts them.)"""
+    return SHM_ROOT_PREFIX in path
+
+
+def shm_headroom_ok(path: str, need_bytes: int, min_free_bytes: int) -> bool:
+    """Per-commit free-space re-check: ``choose_shm_root`` only probes at
+    ROOT SELECTION, but /dev/shm is a shared, RAM-backed filesystem that
+    can fill while a session runs — so writers re-check before each segment
+    commit (same rule as selection: the commit plus the configured cushion
+    must fit) and degrade to the spill-dir tier up front instead of tearing
+    an mmap write mid-way. ``True`` on statvfs failure: let the write
+    itself surface the error."""
+    try:
+        st = os.statvfs(os.path.dirname(path) or ".")
+        return st.f_bavail * st.f_frsize >= need_bytes + min_free_bytes
+    except OSError:
+        return True
+
+
 class MappedFile:
     """One mmap'd committed shuffle data file. Holds the whole-file mapping;
     segment views slice it. The fd is closed immediately (the mapping keeps

@@ -117,6 +117,16 @@ def record_incident(kind: str, label: str, error: Optional[BaseException] = None
         if extra:
             # caller-specific context (e.g. worker_lost: wid/pid/exit code)
             bundle["extra"] = extra
+        try:
+            # chaos forensics: which injected faults had fired by the time
+            # this incident was recorded (empty dict when no failpoint
+            # armed/fired — omitted to keep bundles stable)
+            from blaze_tpu.runtime import failpoints
+            fp = failpoints.fired()
+            if fp:
+                bundle["failpoints"] = fp
+        except Exception:
+            pass
         if error is not None:
             bundle["error"] = {
                 "type": type(error).__name__,

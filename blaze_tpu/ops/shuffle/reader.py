@@ -80,9 +80,16 @@ class IpcReaderExec(Operator):
         use_mmap = bool(ctx.conf.zero_copy_shuffle
                         and ctx.conf.zero_copy_tier != "ipc")
 
-        def _decode(flags, payload, raw_len, mapped=False):
-            batch = decode_frame(flags, payload, raw_len, dict_ctx,
-                                 mapped=mapped)
+        def _decode(src_path, flags, payload, raw_len, mapped=False):
+            try:
+                batch = decode_frame(flags, payload, raw_len, dict_ctx,
+                                     mapped=mapped)
+            except Exception as exc:
+                # a frame that fails to decode out of a committed file is a
+                # corrupt/torn map output, not a task bug: surface it as the
+                # typed fetch failure so lineage RECOMPUTES the output
+                # instead of the decode error failing the query
+                raise _as_missing(exc, src_path) from exc
             metrics.add("ipc_decode_in_prefetch", 1)
             return batch
 
@@ -125,9 +132,25 @@ class IpcReaderExec(Operator):
                             if not _put(fu):
                                 return
                         continue
+                    src_path = block[1] if (isinstance(block, tuple)
+                                            and block
+                                            and block[0] == "file_segment") \
+                        else None
+                    from blaze_tpu.runtime.failpoints import failpoint
+
+                    failpoint("shuffle.fetch", src_path)
                     stream = _open_block(block, use_mmap=use_mmap)
                     mapped = getattr(stream, "mapped", False)
-                    for frame in read_frames(stream):
+                    frames = read_frames(stream)
+                    while True:
+                        try:
+                            frame = next(frames)
+                        except StopIteration:
+                            break
+                        except Exception as exc:
+                            # torn/corrupt frame structure (bad magic, short
+                            # read): a fetch failure, not a decode bug
+                            raise _as_missing(exc, src_path) from exc
                         if mapped:
                             metrics.add("shm_bytes_mapped", len(frame[1]))
                             _TM_SHM_MAPPED.inc(len(frame[1]))
@@ -143,10 +166,12 @@ class IpcReaderExec(Operator):
                                 except BaseException:
                                     pass  # surfaced via the queue
                             pending = []
-                            if not _put(_decode(*frame, mapped=mapped)):
+                            if not _put(_decode(src_path, *frame,
+                                                mapped=mapped)):
                                 return
                             continue
-                        fu = pool.submit(_decode, *frame, mapped=mapped)
+                        fu = pool.submit(_decode, src_path, *frame,
+                                         mapped=mapped)
                         pending = [f for f in pending if not f.done()]
                         pending.append(fu)
                         if not _put(fu):
@@ -189,6 +214,19 @@ class IpcReaderExec(Operator):
                     break
             t.join(timeout=5)
             pool.shutdown(wait=False)
+
+
+def _as_missing(exc: Exception, src_path):
+    """Classify a frame-read/decode failure from a file-backed segment as
+    the typed fetch failure (ShuffleOutputMissing -> lineage recompute).
+    Failures from in-memory blocks (broadcast chunks, process-tier refs)
+    have no lineage file to recompute and pass through unchanged."""
+    from blaze_tpu.runtime.recovery import ShuffleOutputMissing
+
+    if src_path is None or isinstance(exc, ShuffleOutputMissing):
+        return exc
+    return ShuffleOutputMissing(
+        src_path, f"corrupt frame ({type(exc).__name__}: {exc})")
 
 
 def _open_block(block, use_mmap: bool = False):

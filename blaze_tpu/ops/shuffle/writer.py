@@ -41,6 +41,11 @@ _TM_SERIALIZED = get_registry().counter(
     "blaze_shuffle_serialized_bytes",
     "bytes pushed through the classic IPC serde on shuffle-write paths "
     "(~0 on same-host runs with the zero-copy data plane)")
+_TM_TIER_DEGRADED = get_registry().counter(
+    "blaze_shuffle_tier_degraded_total",
+    "map outputs whose shm-tier commit ran out of tmpfs headroom and "
+    "degraded to the spill-dir tier (redirect marker + disk file) instead "
+    "of failing the query")
 
 
 class _PartitionStreams:
@@ -246,7 +251,10 @@ class _WriterState(MemConsumer):
         """Publish the map output: process-tier registry commit when every
         staged partition is still held by reference, else the ordinary
         merge of in-memory + spilled frame segments into the data file."""
+        from blaze_tpu.runtime.failpoints import failpoint
+
         self.flush_pending()
+        failpoint("map.commit")
         if self._mem_parts is not None and not self.spills \
                 and not self.streams.nbytes:
             self._finish_mem()
@@ -303,37 +311,84 @@ class _WriterState(MemConsumer):
         killed mid-write can never leave a footer-valid torn file — the
         reader verifies the footer and treats a torn file as missing,
         triggering lineage recompute instead of silently short rows."""
+        import errno
         import uuid
         import zlib
 
-        from blaze_tpu.runtime.recovery import pack_footer
+        from blaze_tpu.io import shm_segments as _shm
+        from blaze_tpu.runtime.failpoints import failpoint
+        from blaze_tpu.runtime.recovery import (FOOTER_LEN, pack_footer,
+                                                write_redirect)
 
         attempt = uuid.uuid4().hex
         mem = {pid: payload for pid, payload in self.streams.payloads()}
-        offsets = np.zeros(self.n + 1, dtype=np.int64)
-        tmp = f"{self.op.output_data_file}.tmp.{attempt}"
-        os.makedirs(os.path.dirname(tmp) or ".", exist_ok=True)
-        crc = 0
-        with open(tmp, "wb") as out:
-            def _write(b: bytes):
-                nonlocal crc
-                crc = zlib.crc32(b, crc)
-                out.write(b)
 
-            for pid in range(self.n):
-                offsets[pid] = out.tell()
-                for spill, index in self.spills:
-                    if pid in index:
-                        off, ln = index[pid]
-                        spill._file.seek(off)
-                        _write(spill._file.read(ln))
-                if pid in mem:
-                    _write(mem[pid])
-            offsets[self.n] = out.tell()
-            out.write(pack_footer(int(offsets[self.n]), crc))
-            out.flush()
-            os.fsync(out.fileno())
-        os.replace(tmp, self.op.output_data_file)
+        def _write_data(target: str) -> np.ndarray:
+            """Merge into ``target`` via tmp+fsync+atomic replace; the tmp
+            file is unlinked on ANY failure (on a filling /dev/shm the
+            partial bytes must be given back before the degrade path can
+            commit its redirect marker)."""
+            offsets = np.zeros(self.n + 1, dtype=np.int64)
+            tmp = f"{target}.tmp.{attempt}"
+            os.makedirs(os.path.dirname(tmp) or ".", exist_ok=True)
+            crc = 0
+            try:
+                with open(tmp, "wb") as out:
+                    def _write(b: bytes):
+                        nonlocal crc
+                        crc = zlib.crc32(b, crc)
+                        out.write(b)
+
+                    for pid in range(self.n):
+                        offsets[pid] = out.tell()
+                        for spill, index in self.spills:
+                            if pid in index:
+                                off, ln = index[pid]
+                                spill._file.seek(off)
+                                _write(spill._file.read(ln))
+                        if pid in mem:
+                            _write(mem[pid])
+                    offsets[self.n] = out.tell()
+                    out.write(pack_footer(int(offsets[self.n]), crc))
+                    out.flush()
+                    os.fsync(out.fileno())
+                os.replace(tmp, target)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+            return offsets
+
+        data_path = self.op.output_data_file
+        degrade = False
+        if _shm.is_shm_path(data_path):
+            # the shm tier checks headroom per-COMMIT (choose_shm_root only
+            # probed at root selection) and degrades this (writer, reader)
+            # pair to the spill-dir tier — up front when the cushion is
+            # gone, or on a mid-commit ENOSPC — instead of failing the query
+            need = sum(len(p) for p in mem.values()) + FOOTER_LEN + \
+                sum(ln for _, index in self.spills
+                    for _, ln in index.values())
+            try:
+                failpoint("shm.commit")
+                degrade = not _shm.shm_headroom_ok(
+                    data_path, need, self.ctx.conf.shm_min_free_bytes)
+                if not degrade:
+                    offsets = _write_data(data_path)
+            except OSError as exc:
+                if exc.errno != errno.ENOSPC:
+                    raise
+                degrade = True
+        else:
+            offsets = _write_data(data_path)
+        if degrade:
+            fallback = self._degrade_target()
+            offsets = _write_data(fallback)
+            write_redirect(data_path, fallback)
+            self.metrics.add("shuffle_tier_degraded", 1)
+            _TM_TIER_DEGRADED.inc()
         itmp = f"{self.op.output_index_file}.tmp.{attempt}"
         with open(itmp, "wb") as idx:
             idx.write(offsets.astype("<i8").tobytes())
@@ -343,6 +398,23 @@ class _WriterState(MemConsumer):
         self.metrics.add("data_size", int(offsets[self.n]))
         _TM_WRITE_BYTES.observe(int(offsets[self.n]))
         self.streams = self._new_streams()
+
+    def _degrade_target(self) -> str:
+        """Deterministic spill-dir home for a degraded map output: keyed by
+        the ORIGINAL path, so a lineage recompute that degrades again
+        atomically overwrites the same file instead of accreting copies."""
+        import zlib
+
+        orig = self.op.output_data_file
+        tag = zlib.crc32(orig.encode()) & 0xFFFFFFFF
+        d = os.path.join(self.ctx.conf.spill_dir, "degraded_shuffle")
+        os.makedirs(d, exist_ok=True)
+        # keep the shuffle_<stage>_map_<m> coordinates in the name so a
+        # fetch failure against the DEGRADED file still parses to lineage
+        # coordinates (recovery._parse_output_path accepts '_' separators)
+        stage_dir = os.path.basename(os.path.dirname(orig))
+        return os.path.join(
+            d, f"{tag:08x}_{stage_dir}_{os.path.basename(orig)}")
 
     def release(self):
         for spill, _ in self.spills:
@@ -445,9 +517,11 @@ class FileSegmentBlockProvider:
             if end > start:
                 # footer check per served map file: a deleted/torn upstream
                 # output surfaces as ShuffleOutputMissing (with stage+map
-                # lineage coordinates) before any segment is decoded
-                check_map_output(data, offsets=offsets, map_id=m)
-                blocks.append(("file_segment", data, start, end - start))
+                # lineage coordinates) before any segment is decoded; the
+                # check resolves degraded-output redirects, so segments are
+                # served from wherever the commit actually landed
+                resolved = check_map_output(data, offsets=offsets, map_id=m)
+                blocks.append(("file_segment", resolved, start, end - start))
         return blocks
 
 

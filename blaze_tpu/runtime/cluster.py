@@ -57,6 +57,9 @@ _TM_TASKS_RETRIED = get_registry().counter(
 _TM_CHAOS_KILLS = get_registry().counter(
     "blaze_chaos_kills_total",
     "worker processes hard-killed by chaos injection")
+_TM_TASKS_TIMED_OUT = get_registry().counter(
+    "blaze_cluster_tasks_timed_out_total",
+    "in-flight task attempts hard-cancelled after exceeding task_timeout_s")
 
 
 class TaskFailed(RuntimeError):
@@ -76,6 +79,15 @@ class _Worker:
         self.proc: Optional[subprocess.Popen] = None
         self.sock: Optional[socket.socket] = None
         self.in_flight = False
+        # (task, attempt, started_at) while a send/recv is outstanding —
+        # the hard-timeout monitor reads it to find hung attempts
+        self.current_task: Optional[tuple] = None
+        # attempts this PROCESS has answered (reset on every spawn): the
+        # hard-timeout monitor grants the first task of a fresh process a
+        # cold-start grace multiple of task_timeout_s, because it carries
+        # JIT compile/setup cost a steady-state bound would misread as a
+        # hang — killing every fresh respawn in a cascade
+        self.tasks_done_gen = 0
         # death bookkeeping: ``generation`` bumps on every (re)spawn and
         # ``dead_gen`` records the last generation whose death was noted —
         # the pair dedups the supervisor and the serve thread both
@@ -88,6 +100,9 @@ class _Worker:
         env = dict(os.environ)
         env.setdefault("BLAZE_WORKER_PLATFORM", "cpu")
         env.setdefault("JAX_PLATFORMS", "cpu")
+        # slot-stable failpoint stream salt (runtime/failpoints._salt):
+        # symmetric workers must not draw identical injection streams
+        env["BLAZE_TPU_FAILPOINT_SALT"] = str(self.wid + 1)
         overall = time.monotonic() + 120.0
         while True:
             self.proc = subprocess.Popen(
@@ -97,6 +112,7 @@ class _Worker:
             sock = self._accept_hello()
             if sock is not None:
                 self.sock = sock
+                self.tasks_done_gen = 0
                 return
             # the fresh process died before completing its hello (crashed
             # on import, OOM-killed, or chaos-killed mid-spawn): reap and
@@ -353,6 +369,7 @@ class WorkerPool:
         done = threading.Event()
         deaths_at_start = self.deaths_total
         recoveries: Dict[int, int] = {}  # task -> lineage-recovery requeues
+        timeout_s = float(getattr(self.conf, "task_timeout_s", 0.0) or 0.0)
 
         def push_shared(w: _Worker):
             if shared is not None:
@@ -440,9 +457,11 @@ class WorkerPool:
                     with out_mu:
                         outstanding[i] = (msg, time.monotonic())
                 w.in_flight = True
+                w.current_task = (i, attempt, time.monotonic())
                 try:
                     send_msg(w.sock, msg)
                     reply = recv_msg(w.sock)
+                    w.tasks_done_gen += 1
                 except (EOFError, OSError) as exc:
                     if done.is_set():
                         return  # stage over (e.g. channel reset); stand down
@@ -471,6 +490,7 @@ class WorkerPool:
                         return
                 finally:
                     w.in_flight = False
+                    w.current_task = None
                 if reply.get("ok"):
                     if w.wid not in healthy:
                         # a respawned slot that completes a task has proved
@@ -500,6 +520,15 @@ class WorkerPool:
                 else:
                     log.warning("task %d failed on worker %d: %s",
                                 i, w.wid, reply.get("error"))
+                    if reply.get("error_kind") == "spill_failed":
+                        # typed resource exhaustion: a retry would spill
+                        # into the same full disk from another worker —
+                        # fail the owning query fast and leave the
+                        # (healthy) fleet to the next query
+                        errors.append(
+                            f"task {i}: {reply.get('error', 'spill failed')}")
+                        done.set()
+                        continue
                     recovered = False
                     if on_task_error is not None and recoveries.get(i, 0) < 3:
                         try:
@@ -528,6 +557,43 @@ class WorkerPool:
             if cancel is not None and cancel.cancelled:
                 done.set()
                 break
+            if timeout_s > 0:
+                # hard per-task timeout ON TOP of speculation: speculation
+                # only helps when one copy is slow — when the original AND
+                # its speculative copy both hang, each attempt trips this
+                # monitor independently. There is no in-band way to
+                # interrupt a wedged task, so cancellation happens at the
+                # process level: the kill fails the serve thread's recv,
+                # which charges the retry budget (_retry_or_fail), reroutes
+                # the task, and marks the hung-but-heartbeating worker
+                # suspect via the death/exclusion path (_note_death).
+                now = time.monotonic()
+                for w in self.workers:
+                    cur = w.current_task
+                    if cur is None:
+                        continue
+                    ti, attempt, t0 = cur
+                    # cold-start grace: the first task of a fresh process
+                    # pays JIT compile/setup, which the steady-state bound
+                    # would misread as a hang (startup-probe vs liveness-
+                    # probe distinction)
+                    bound = timeout_s * (3.0 if w.tasks_done_gen == 0
+                                         else 1.0)
+                    if now - t0 < bound:
+                        continue
+                    w.current_task = None  # one kill per hung attempt
+                    _TM_TASKS_TIMED_OUT.inc()
+                    log.warning(
+                        "task %d (attempt %s) on worker %d exceeded "
+                        "task_timeout_s=%.1fs; killing the worker to "
+                        "cancel it", ti,
+                        "spec" if attempt == _SPECULATIVE else attempt,
+                        w.wid, timeout_s)
+                    try:
+                        self.kill_worker(w.wid)
+                    except Exception:
+                        log.warning("timeout kill of worker %d failed",
+                                    w.wid, exc_info=True)
             if not any(t.is_alive() for t in threads):
                 # every serve thread gave up (unrespawnable workers): fail
                 # the stage instead of waiting forever on an empty fleet
@@ -546,9 +612,25 @@ class WorkerPool:
             t.join(timeout=0.5 if cancelled else 15)
         # a serve thread still blocked in recv (losing speculative copy or
         # straggler original) would desynchronize this worker's
-        # request/reply channel for the NEXT stage — reset such workers
+        # request/reply channel for the NEXT stage. Poison the channel
+        # FIRST: shutdown() wakes a blocked recv with EOF immediately and
+        # the thread stands down through its done-is-set check — then join
+        # so the thread is provably gone, then replace the worker. The old
+        # socket object dies with the thread, so a leaked thread can never
+        # consume the next query's reply off the respawned channel.
         for w, t in zip(self.workers, threads):
             if t.is_alive() or getattr(w, "in_flight", False):
+                sock = w.sock
+                if sock is not None:
+                    try:
+                        sock.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                t.join(timeout=5)
+                if t.is_alive():
+                    log.error("serve thread for worker %d survived channel "
+                              "poisoning; worker will be replaced anyway",
+                              w.wid)
                 try:
                     self._reset_worker(w)
                 except Exception as exc:

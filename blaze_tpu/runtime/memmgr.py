@@ -49,6 +49,25 @@ def _register_pool_gauges():
         over(lambda mm: sum(mm._reservations.copy().values())))
 
 
+class SpillFailed(RuntimeError):
+    """A consumer's spill() raised (ENOSPC on the spill dir, injected
+    spill.write failpoint, a serde bug): the query that owns the consumer
+    cannot shed memory and must FAIL — but only that query. Typed so the
+    task-retry classifier fails fast (re-running a task against a full
+    spill disk burns the retry budget for nothing) and the worker/driver
+    stay healthy to serve other queries; an incident bundle is recorded at
+    the raise site."""
+
+    def __init__(self, consumer: str, group: Optional[str],
+                 cause: BaseException):
+        self.consumer = consumer
+        self.group = group
+        super().__init__(
+            f"spill failed for consumer {consumer!r}"
+            f"{f' (group {group})' if group else ''}: "
+            f"{type(cause).__name__}: {cause}")
+
+
 class MemConsumer:
     """Base for spillable operator state (reference: MemConsumer trait).
 
@@ -350,7 +369,24 @@ class MemManager:
                 with TRACER.span("spill", "spill",
                                  {"consumer": consumer.name,
                                   "mem_used": consumer.mem_used}):
-                    freed = consumer.spill()
+                    try:
+                        freed = consumer.spill()
+                    except Exception as exc:
+                        # degrade, don't die: a failed spill dooms THIS
+                        # query (it cannot shed memory) but nothing else —
+                        # type the error so retry classifiers fail fast and
+                        # leave forensics before unwinding
+                        err = SpillFailed(consumer.name, consumer.group, exc)
+                        try:
+                            from blaze_tpu.obs.dump import record_incident
+
+                            record_incident(
+                                "spill_failed", consumer.name, error=exc,
+                                extra={"group": consumer.group,
+                                       "mem_used": consumer.mem_used})
+                        except Exception:
+                            pass
+                        raise err from exc
                 spill_ns = time.perf_counter_ns() - t0
                 with self._cv:
                     self.spill_count += 1
@@ -383,7 +419,9 @@ class SpillFile:
         import uuid
 
         from blaze_tpu.io import fs as FS
+        from blaze_tpu.runtime.failpoints import failpoint
 
+        failpoint("spill.write")
         cfg = get_config()
         if FS.has_scheme(cfg.spill_dir):
             # remote spill dir (reference: spills routed through the JVM

@@ -139,6 +139,11 @@ class MetricNode:
 #   serde_elided_batches             batches exchanged as in-process
 #                                    references (process tier) with serde
 #                                    skipped entirely
+#   shuffle_tier_degraded            map outputs that fell back from the
+#                                    shm tier to the spill dir on ENOSPC
+#                                    (0 on healthy runs; > 0 proves the
+#                                    degrade path ran instead of the query
+#                                    failing)
 TRIPWIRE_METRICS = (
     "split_batches",
     "split_gathers",
@@ -157,6 +162,7 @@ TRIPWIRE_METRICS = (
     "shuffle_bytes_serialized",
     "shm_bytes_mapped",
     "serde_elided_batches",
+    "shuffle_tier_degraded",
 )
 
 

@@ -56,6 +56,54 @@ def pack_footer(payload_len: int, crc: int) -> bytes:
                        crc & 0xFFFFFFFF)
 
 
+# -- degraded-output redirects -------------------------------------------------
+#
+# When a shm-tier commit hits ENOSPC (a filling /dev/shm), the writer
+# re-commits the SAME payload under the spill dir and leaves a tiny marker
+# at the original path pointing there — the (writer, reader) pair degrades
+# to the disk tier for that one map output instead of failing the query.
+# Markers resolve transparently in verify/check below, so lineage sweeps,
+# block providers and readers all follow them without caring.
+
+REDIRECT_MAGIC = b"BTRD"
+_REDIRECT_MAX = 4096  # marker files are magic + one utf-8 path
+
+
+def write_redirect(marker_path: str, target: str):
+    """Atomically publish a redirect marker at ``marker_path``. The marker
+    is tiny, so it commits even on the nearly-full filesystem whose ENOSPC
+    caused the degrade (the partial tmp file was unlinked first)."""
+    blob = REDIRECT_MAGIC + target.encode("utf-8")
+    tmp = f"{marker_path}.tmp.redirect"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, marker_path)
+
+
+def read_redirect(path: str) -> Optional[str]:
+    """Target path when ``path`` is a redirect marker, else None."""
+    try:
+        size = os.path.getsize(path)
+        if size < len(REDIRECT_MAGIC) or size > _REDIRECT_MAX:
+            return None
+        with open(path, "rb") as f:
+            head = f.read(len(REDIRECT_MAGIC))
+            if head != REDIRECT_MAGIC:
+                return None
+            return f.read().decode("utf-8")
+    except OSError:
+        return None
+
+
+def resolve_map_output(path: str) -> str:
+    """Follow a degraded-output redirect (single hop: recompute overwrites
+    the marker and its deterministic target together, never chains)."""
+    target = read_redirect(path)
+    return target if target is not None else path
+
+
 class ShuffleOutputMissing(OSError):
     """A reduce-side fetch found a map output missing or torn. OSError
     subclass: transient for the generic retry classifier, and specifically
@@ -82,7 +130,9 @@ def _parse_output_path(path: str) -> Tuple[Optional[int], Optional[int]]:
     """(stage, map) from the canonical shuffle_<s>/map_<m>.data layout."""
     import re
 
-    m = re.search(r"shuffle_(\d+)[/\\]map_(\d+)\.(?:data|index)$", path)
+    # '/' for the canonical layout, '_' for degraded spill-dir copies whose
+    # flat name keeps the same coordinates (writer._degrade_target)
+    m = re.search(r"shuffle_(\d+)[/\\_]map_(\d+)\.(?:data|index)$", path)
     if m is None:
         return None, None
     return int(m.group(1)), int(m.group(2))
@@ -95,6 +145,7 @@ def verify_map_output(data_path: str, index_path: Optional[str] = None,
     recorded payload length consistent with the file size (and with the
     index's final offset when given). ``full`` additionally recomputes the
     payload crc32 — the paranoid mode chaos tests enable."""
+    data_path = resolve_map_output(data_path)
     try:
         size = os.path.getsize(data_path)
     except OSError:
@@ -137,24 +188,34 @@ def verify_map_output(data_path: str, index_path: Optional[str] = None,
 
 def check_map_output(data_path: str, offsets=None, full: Optional[bool] = None,
                      stage: Optional[int] = None,
-                     map_id: Optional[int] = None):
+                     map_id: Optional[int] = None) -> str:
     """Raise ``ShuffleOutputMissing`` unless ``data_path`` is a committed,
     footer-verified map output whose payload matches the index's final
-    offset. Block providers call this before serving segments."""
+    offset. Block providers call this before serving segments. Returns the
+    RESOLVED path (degraded outputs redirect to the spill dir), which is
+    the path segments must be served from."""
     if full is None:
         from blaze_tpu.config import get_config
 
         full = get_config().shuffle_verify_checksum
-    reason = verify_map_output(data_path, full=full)
+    resolved = resolve_map_output(data_path)
+    # chaos injection: the corrupt action flips a byte of the committed
+    # payload ON DISK here, before verification — paranoid mode (full crc)
+    # then detects it exactly like real bit rot and recovery recomputes
+    from blaze_tpu.runtime.failpoints import failpoint
+
+    failpoint("frame.decode", resolved)
+    reason = verify_map_output(resolved, full=full)
     if reason is None and offsets is not None and len(offsets):
         expect = int(offsets[-1]) + FOOTER_LEN
-        size = os.path.getsize(data_path)
+        size = os.path.getsize(resolved)
         if size != expect:
             reason = f"size {size} != index end {expect}"
     if reason is not None:
         raise ShuffleOutputMissing(
             data_path, reason, stage=stage,
             maps=[map_id] if map_id is not None else None)
+    return resolved
 
 
 class StageLineage:
@@ -174,12 +235,23 @@ class StageLineage:
         self._mu = threading.Lock()
         self.recomputed_maps = 0
 
+    @staticmethod
+    def _full() -> bool:
+        # recompute decisions must verify at the SAME paranoia level the
+        # readers check at: a crc-corrupted file has an intact footer, so a
+        # cheap-only pre-check would call it healthy, skip the recompute,
+        # and leave readers failing on it forever
+        from blaze_tpu.config import get_config
+
+        return get_config().shuffle_verify_checksum
+
     def missing(self) -> List[int]:
         """Maps whose committed output currently fails verification."""
+        full = self._full()
         out = []
         for m in range(self.num_maps):
             data, _index = self.paths_for(m)
-            if verify_map_output(data) is not None:
+            if verify_map_output(data, full=full) is not None:
                 out.append(m)
         return out
 
@@ -189,12 +261,13 @@ class StageLineage:
         lost output recompute it once — the second caller re-verifies under
         the lock and finds the output already republished."""
         ran = []
+        full = self._full()
         with self._mu:
             for m in sorted(set(int(m) for m in map_ids)):
                 if not 0 <= m < self.num_maps:
                     continue
                 data, _index = self.paths_for(m)
-                if verify_map_output(data) is None:
+                if verify_map_output(data, full=full) is None:
                     continue  # another thread already recomputed it
                 log.warning("recomputing stage %d map %d from lineage",
                             self.stage, m)

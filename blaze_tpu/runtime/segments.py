@@ -114,7 +114,7 @@ class MemSegmentBlockProvider:
             for r in pids:
                 start, end = int(offsets[r]), int(offsets[r + 1])
                 if end > start:
-                    check_map_output(data, offsets=offsets,
-                                     stage=self.stage, map_id=m)
+                    data = check_map_output(data, offsets=offsets,
+                                            stage=self.stage, map_id=m)
                     blocks.append(("file_segment", data, start, end - start))
         return blocks

@@ -78,7 +78,7 @@ class _SubsetBlockProvider:
             data, offsets = self.indexes[m]
             start, end = int(offsets[reducer]), int(offsets[reducer + 1])
             if end > start:
-                check_map_output(data, offsets=offsets, map_id=m)
+                data = check_map_output(data, offsets=offsets, map_id=m)
                 blocks.append(("file_segment", data, start, end - start))
         return blocks
 
@@ -102,7 +102,7 @@ class _CoalescedBlockProvider:
             for m, (data, offsets) in enumerate(self.indexes):
                 start, end = int(offsets[r]), int(offsets[r + 1])
                 if end > start:
-                    check_map_output(data, offsets=offsets, map_id=m)
+                    data = check_map_output(data, offsets=offsets, map_id=m)
                     blocks.append(("file_segment", data, start, end - start))
         return blocks
 
@@ -225,6 +225,13 @@ class Session:
         _tracer_configure(self.conf)
         _telemetry_configure(self.conf)
         _stats_configure(self.conf)
+        # fault injection: arm (or disarm) the DRIVER process from conf —
+        # workers arm themselves per task from the shipped conf, but
+        # in-driver task paths (process tier, lineage recompute, collect
+        # stages) only see sites armed here
+        from blaze_tpu.runtime import failpoints as _failpoints
+
+        _failpoints.arm_from(self.conf)
         # last observed QueryProfile per plan fingerprint (obs/stats.py);
         # the in-memory face of the on-disk profile store
         self.profiles: Dict[str, dict] = {}
@@ -538,6 +545,7 @@ class Session:
         # outliving their unlinked files)
         self.mem_segments.release_stages(qrun.stage_meta.keys())
         for d in qrun.shuffle_dirs:
+            self._unlink_degraded_outputs(d)
             shutil.rmtree(d, ignore_errors=True)
         for rid in qrun.resource_ids:
             self.resources.pop(rid, None)
@@ -549,6 +557,26 @@ class Session:
                 leaked = mm.release_group(qrun.mem_group)
                 if leaked:
                     self.metrics.add("query_leaked_mem_reclaimed", leaked)
+
+    @staticmethod
+    def _unlink_degraded_outputs(shuffle_dir: str):
+        """Map outputs that degraded off a filling shm root live in the
+        spill dir with only a redirect marker inside ``shuffle_dir`` — the
+        rmtree below removes the marker, so the target must be unlinked
+        first or it outlives the query (the disk-leak twin of the shm leak
+        gate). Head-sniffing every data file costs a few bytes per map and
+        only runs at release."""
+        import glob
+
+        from blaze_tpu.runtime.recovery import read_redirect
+
+        for marker in glob.glob(os.path.join(shuffle_dir, "map_*.data")):
+            target = read_redirect(marker)
+            if target is not None:
+                try:
+                    os.unlink(target)
+                except OSError:
+                    pass
 
     def close(self):
         """Remove shuffle files and release resources (a failed stage is
@@ -562,6 +590,12 @@ class Session:
         self._lineage.clear()
         self.mem_segments.clear()
         self.resources.clear()
+        import glob
+
+        for d in glob.glob(os.path.join(self.shuffle_root, "shuffle_*")):
+            # queries usually release their own dirs; this backstop covers
+            # still-live ones so their degraded spill-dir outputs go too
+            self._unlink_degraded_outputs(d)
         shutil.rmtree(self.work_dir, ignore_errors=True)
         if self._shm_finalizer is not None:
             # the /dev/shm root and everything under it: the soak leak gate
@@ -1531,6 +1565,7 @@ class Session:
                 self._tls.qrun = prev
 
         def run_with_retry(p):
+            from blaze_tpu.runtime.memmgr import SpillFailed
             from blaze_tpu.runtime.recovery import ShuffleOutputMissing
 
             attempt = 0
@@ -1558,6 +1593,13 @@ class Session:
                     # cancellation is not a failure: no retry, no backoff —
                     # surface immediately so sibling tasks stop too
                     self.metrics.add("task_cancelled", 1)
+                    raise
+                except SpillFailed:
+                    # the query cannot shed memory (spill disk full/broken):
+                    # re-running the task meets the same wall, so fail THIS
+                    # query fast without burning the retry budget — the
+                    # incident bundle was recorded at the raise site
+                    self.metrics.add("task_failures", 1)
                     raise
                 except self._DETERMINISTIC_ERRORS as exc:
                     import pyarrow as _pa

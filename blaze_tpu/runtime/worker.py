@@ -54,6 +54,15 @@ def run_task(msg: dict, shared: dict = None) -> dict:
         _tracer_configure(conf)
         _telemetry_configure(conf)
         STATS_HUB.configure_from(conf)
+        # fault injection must reach task code in THIS process, not just
+        # the driver: arm (or disarm) from the conf that shipped with the
+        # task, so a chaos soak's spec applies fleet-wide
+        from blaze_tpu.runtime import failpoints
+
+        failpoints.arm_from(conf)
+    from blaze_tpu.runtime.failpoints import failpoint
+
+    failpoint("worker.task")
     task, plan = task_definition_from_bytes(msg["task_bytes"])
     op = build_operator(plan)
     metrics = MetricNode("task")
@@ -123,6 +132,7 @@ def main(sock_path: str):
         except BaseException as exc:  # report, keep serving
             reply = {"ok": False, "error": f"{type(exc).__name__}: {exc}",
                      "traceback": traceback.format_exc()}
+            from blaze_tpu.runtime.memmgr import SpillFailed
             from blaze_tpu.runtime.recovery import ShuffleOutputMissing
 
             if isinstance(exc, ShuffleOutputMissing):
@@ -131,6 +141,12 @@ def main(sock_path: str):
                 reply["error_kind"] = "shuffle_missing"
                 reply["stage"] = exc.stage
                 reply["maps"] = exc.maps
+            elif isinstance(exc, SpillFailed):
+                # typed degradation: the owning QUERY must fail (it cannot
+                # shed memory), but this worker process stays healthy — the
+                # driver fails the stage fast instead of retrying into the
+                # same full spill disk
+                reply["error_kind"] = "spill_failed"
         send_msg(sock, reply)
 
 

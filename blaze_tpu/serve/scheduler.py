@@ -30,6 +30,7 @@ from __future__ import annotations
 import collections
 import heapq
 import itertools
+import random
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -125,6 +126,9 @@ class QueryHandle:
         self.finished_at: Optional[float] = None
         self._done = threading.Event()
         self._released = False  # admission reservation dropped exactly once
+        # in-scheduler auto-retry history: one record per transparent
+        # re-execution after a worker-loss failure
+        self.retries: List[dict] = []
 
     def cancel(self, reason: str = "cancelled by client"):
         self.token.cancel(reason)
@@ -156,6 +160,9 @@ class QueryHandle:
             d["error"] = f"{type(self.error).__name__}: {self.error}"
         if self.table is not None:
             d["rows"] = self.table.num_rows
+        if self.retries:
+            d["retries"] = len(self.retries)
+            d["retry_history"] = [dict(r) for r in self.retries]
         return d
 
 
@@ -202,6 +209,10 @@ class QueryScheduler:
         self._tm_rejected = reg.counter(
             "blaze_serve_rejected_total",
             "submit-time rejections (no handle created), by reason")
+        self._tm_retries = reg.counter(
+            "blaze_serve_retries_total",
+            "transparent in-scheduler re-executions after worker-loss "
+            "failures (the client never saw these attempts fail)")
         self._tm_queue_wait = reg.histogram(
             "blaze_serve_queue_wait_seconds",
             "submit-to-admission wait of admitted queries")
@@ -373,18 +384,55 @@ class QueryScheduler:
         h.state = "running"
         err: Optional[BaseException] = None
         state = "done"
+        conf = self.session.conf
         try:
-            h.token.check()
-            batches = [
-                b.to_arrow()
-                for b in self.session.execute(
-                    h.plan, cancel_token=h.token, mem_group=h.mem_group,
-                    release_on_finish=True, label=h.label)
-                if b.num_rows]
-            if batches:
-                h.table = pa.Table.from_batches(batches)
-            else:
-                h.table = T.schema_to_arrow(h.plan.output_schema).empty_table()
+            while True:
+                try:
+                    h.token.check()
+                    batches = [
+                        b.to_arrow()
+                        for b in self.session.execute(
+                            h.plan, cancel_token=h.token,
+                            mem_group=h.mem_group,
+                            release_on_finish=True, label=h.label)
+                        if b.num_rows]
+                    if batches:
+                        h.table = pa.Table.from_batches(batches)
+                    else:
+                        h.table = T.schema_to_arrow(
+                            h.plan.output_schema).empty_table()
+                    break
+                except TaskCancelled:
+                    raise
+                except BaseException as exc:
+                    delay = self._retry_delay_s(h, exc, conf)
+                    if delay is None:
+                        raise
+                    # transparent auto-retry: worker loss is the serving
+                    # layer's problem, not the client's. The backoff
+                    # (capped exponential + jitter) spends the query's own
+                    # remaining deadline budget, so a retried query can
+                    # still miss its deadline but never overstays it; the
+                    # client only sees QueryRetryable once every
+                    # in-scheduler attempt is exhausted.
+                    h.retries.append({
+                        "attempt": len(h.retries) + 1,
+                        "error": f"{type(exc).__name__}: {exc}"[:300],
+                        "backoff_s": round(delay, 3),
+                        "elapsed_s": round(
+                            time.monotonic() - h.submitted_at, 3)})
+                    self._tm_retries.inc()
+                    self.metrics.add("query_retries", 1)
+                    # reset the admission reservation to exactly one share
+                    # (Session dropped the group when the attempt failed)
+                    mm = MemManager._instance
+                    if mm is not None:
+                        mm.release_group(h.mem_group)
+                        mm.reserve_group(h.mem_group, h.mem_estimate)
+                    end = time.monotonic() + delay
+                    while time.monotonic() < end and not h.token.cancelled:
+                        time.sleep(
+                            min(0.05, max(0.0, end - time.monotonic())))
         except TaskCancelled as exc:  # QueryCancelled included
             err, state = exc, "cancelled"
         except BaseException as exc:
@@ -419,6 +467,8 @@ class QueryScheduler:
                 self._tm_run.observe(h.finished_at - h.admitted_at)
                 self._tm_e2e.labels(outcome=outcome).observe(
                     h.finished_at - h.submitted_at)
+                if state == "done" and h.retries:
+                    self._stamp_retries(h)
                 if state != "done":
                     iid = self._record_incident(h, outcome, err,
                                                 scheduler_state)
@@ -469,6 +519,46 @@ class QueryScheduler:
                 or "deadline" in (h.token.reason or "").lower()):
             return "deadline"
         return state
+
+    def _retry_delay_s(self, h: QueryHandle, exc: BaseException,
+                       conf) -> Optional[float]:
+        """Backoff before the next in-scheduler attempt, or None when the
+        error must surface instead: not an infrastructure loss, retry
+        budget spent, cancelled, or too little deadline budget left for
+        the backoff plus a plausible re-execution."""
+        if not self._is_worker_loss(exc) or h.token.cancelled:
+            return None
+        k = len(h.retries)
+        if k >= conf.serve_retry_max:
+            return None
+        delay = min(conf.serve_retry_backoff_s * (2 ** k),
+                    conf.serve_retry_backoff_max_s)
+        delay *= 0.5 + random.random() / 2  # jitter: 50-100% of the cap
+        if h.token.deadline is not None:
+            # a retry only makes sense when, after sleeping out the
+            # backoff, at least one prior attempt's average runtime still
+            # fits before the deadline fires
+            spent = time.monotonic() - (h.admitted_at or h.submitted_at)
+            remaining = h.token.deadline - time.monotonic()
+            if remaining < delay + max(spent / (k + 1), 0.05):
+                return None
+        return delay
+
+    def _stamp_retries(self, h: QueryHandle):
+        """Write the serve-layer retry history into the query's stored
+        profile (the fingerprint-keyed store): a plan shape that only
+        completes under retry shows that in its last-observed stats."""
+        try:
+            from blaze_tpu.obs.stats import plan_fingerprint, save_profile
+
+            fp = plan_fingerprint(h.plan)
+            prof = self.session.profiles.get(fp)
+            if prof is None:
+                return
+            prof["serve_retries"] = [dict(r) for r in h.retries]
+            save_profile(prof, self.session.conf)
+        except Exception:
+            pass
 
     @staticmethod
     def _is_worker_loss(err: Optional[BaseException]) -> bool:

@@ -27,6 +27,24 @@ Both BENCH artifacts (``shapes.<q>.value`` + ``kernel_stats``) and SOAK
 artifacts (``shapes.<q>.wall_s`` with tripwires inline) are understood;
 shapes present in only one artifact are reported but not failed (new
 shapes are growth, not regression).
+
+``--chaos`` switches to the CHAOS_rNN.json matrix schema (PR 12's
+``--chaos-spec`` soaks) and gates on fault-injection semantics instead::
+
+    python scripts/bench_diff.py --chaos CHAOS_r02.json CHAOS_r03.json
+
+- correctness/leak fields (wrong results, leaked bytes/segments, hard
+  failures, client-visible retryables, gave-up queries) must be 0 in the
+  candidate — absolute, not relative;
+- a mode's injection EVIDENCE counter (kill -> worker deaths, hang ->
+  tasks timed out, enospc -> shuffle_tier_degraded, corrupt ->
+  maps_recomputed) must not drop to zero when the base proves it fired:
+  a refactor that silently unhooks a failpoint site still "passes" every
+  latency gate, and this is the check that catches it;
+- per-mode p99 inflation over the in-artifact baseline must stay within
+  ``--inflation-tol`` of the base's AND under the 2.0x hard ceiling;
+- a mode covered by the base must still be covered by the candidate, and
+  the serve section's auto-retry proof must stay present and correct.
 """
 
 from __future__ import annotations
@@ -106,6 +124,74 @@ def diff_artifacts(base: dict, cand: dict, wall_tol: float = 0.25,
     return regressions
 
 
+# chaos-matrix fields that must be 0 in every candidate, wherever present
+CHAOS_ZERO = ("wrong_results", "leaked_bytes", "shm_segments_leaked",
+              "hard_failures", "client_visible_retryable", "gave_up")
+# per-mode proof that the injection actually reached its target
+CHAOS_EVIDENCE = {"kill": ("worker_deaths", "kills_injected"),
+                  "hang": ("tasks_timed_out",),
+                  "enospc": ("shuffle_tier_degraded",),
+                  "corrupt": ("maps_recomputed",)}
+
+
+def diff_chaos(base: dict, cand: dict,
+               inflation_tol: float = 0.25) -> List[str]:
+    """Regressions between two CHAOS_rNN.json matrices (empty == clean)."""
+    regressions: List[str] = []
+    for sec_name, csec in sorted(cand.items()):
+        bsec = base.get(sec_name) or {}
+        cmodes = (csec.get("gates") or {}).get("modes") or {}
+        bmodes = (bsec.get("gates") or {}).get("modes") or {}
+        if not cmodes:
+            print(f"  {sec_name}: no gates.modes (pre-matrix artifact?),"
+                  " skipped")
+            continue
+        for mode in sorted(bmodes):
+            if mode not in cmodes:
+                regressions.append(
+                    f"{sec_name}/{mode}: mode covered by base but absent "
+                    f"from candidate (injection coverage loss)")
+        for mode, cg in sorted(cmodes.items()):
+            for field in CHAOS_ZERO:
+                if int(cg.get(field, 0) or 0) != 0:
+                    regressions.append(
+                        f"{sec_name}/{mode}: {field}={cg[field]} (must "
+                        f"be 0 under injection)")
+            bg = bmodes.get(mode)
+            for field in CHAOS_EVIDENCE.get(mode, ()):
+                if bg is not None and int(bg.get(field, 0) or 0) > 0 \
+                        and int(cg.get(field, 0) or 0) == 0:
+                    regressions.append(
+                        f"{sec_name}/{mode}: {field} fell to 0 (base "
+                        f"{bg[field]}) — injection no longer reaches "
+                        f"its target")
+            cinf = cg.get("p99_inflation")
+            if cinf is not None:
+                if float(cinf) > 2.0:
+                    regressions.append(
+                        f"{sec_name}/{mode}: p99_inflation {cinf} over "
+                        f"the 2.0x hard ceiling")
+                binf = (bg or {}).get("p99_inflation")
+                if binf is not None and \
+                        float(cinf) > float(binf) + inflation_tol:
+                    regressions.append(
+                        f"{sec_name}/{mode}: p99_inflation {cinf} vs "
+                        f"base {binf} (+>{inflation_tol})")
+            if bg is None:
+                print(f"  {sec_name}/{mode}: new mode (no base), zero/"
+                      f"ceiling gates only")
+        cgates = csec.get("gates") or {}
+        if "retry_proof_serve_retries" in ((bsec.get("gates")) or {}):
+            if not cgates.get("retry_proof_correct") \
+                    or int(cgates.get("retry_proof_serve_retries", 0)
+                           or 0) < 1:
+                regressions.append(
+                    f"{sec_name}: serve auto-retry proof regressed "
+                    f"(correct={cgates.get('retry_proof_correct')}, "
+                    f"retries={cgates.get('retry_proof_serve_retries')})")
+    return regressions
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("base", help="base artifact (BENCH/SOAK json)")
@@ -114,13 +200,21 @@ def main(argv=None) -> int:
                     help="per-shape wall-clock growth tolerance (frac)")
     ap.add_argument("--bytes-tol", type=float, default=0.10,
                     help="shuffle_bytes_serialized growth tolerance (frac)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="diff CHAOS_rNN.json injection matrices instead")
+    ap.add_argument("--inflation-tol", type=float, default=0.25,
+                    help="--chaos: p99_inflation growth tolerance (abs)")
     args = ap.parse_args(argv)
     with open(args.base) as f:
         base = json.load(f)
     with open(args.cand) as f:
         cand = json.load(f)
     print(f"diffing {args.cand} against {args.base}")
-    regressions = diff_artifacts(base, cand, args.wall_tol, args.bytes_tol)
+    if args.chaos:
+        regressions = diff_chaos(base, cand, args.inflation_tol)
+    else:
+        regressions = diff_artifacts(base, cand, args.wall_tol,
+                                     args.bytes_tol)
     if regressions:
         print(f"REGRESSIONS ({len(regressions)}):")
         for r in regressions:

@@ -1,0 +1,118 @@
+#!/usr/bin/env python3
+"""Static lint for failpoint injection sites.
+
+Walks every ``failpoint("...")`` call site (bare name or attribute form,
+literal first argument) under ``blaze_tpu/`` and ``scripts/`` and enforces:
+
+1. every site name is registered in ``runtime.failpoints.SITES`` — the
+   registry is CLOSED, so a typo'd site silently never fires and a chaos
+   spec naming it raises only at arm time; this catches both statically;
+2. site names are ``<area>.<name>`` with snake_case segments (sites are
+   part of the chaos-spec surface, so names are API);
+3. every registered site has at least one call site — a SITES entry whose
+   hook was refactored away is dead spec surface that arms successfully
+   but can never fire;
+4. at least one call site exists at all (scan-root tripwire, mirroring
+   check_metrics_names.py).
+
+Tests are deliberately NOT scanned: they call failpoint() with made-up
+names to assert the no-rule fast path. Standalone: exits 1 with a report
+on any violation. Also run by ``tests/test_failpoints.py`` in the quick
+tier.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCAN_DIRS = ("blaze_tpu", "scripts")
+SITE_RE = re.compile(r"^[a-z][a-z0-9_]*\.[a-z][a-z0-9_]*$")
+
+
+def _called_name(func) -> str:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+def iter_call_sites(root: str):
+    """Yield (relpath, lineno, site) for literal-name failpoint() calls."""
+    for scan in SCAN_DIRS:
+        base = os.path.join(root, scan)
+        for dirpath, _dirnames, filenames in os.walk(base):
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                with open(path) as f:
+                    try:
+                        tree = ast.parse(f.read(), filename=path)
+                    except SyntaxError as exc:
+                        yield (os.path.relpath(path, root),
+                               exc.lineno or 0, f"<syntax: {exc}>")
+                        continue
+                for node in ast.walk(tree):
+                    if not (isinstance(node, ast.Call)
+                            and _called_name(node.func) == "failpoint"
+                            and node.args
+                            and isinstance(node.args[0], ast.Constant)
+                            and isinstance(node.args[0].value, str)):
+                        continue
+                    yield (os.path.relpath(path, root), node.lineno,
+                           node.args[0].value)
+
+
+def run_lint(root: str = REPO):
+    """Returns a list of violation strings (empty = clean)."""
+    sys.path.insert(0, root)
+    from blaze_tpu.runtime.failpoints import SITES
+
+    violations = []
+    used = set()
+    count = 0
+    for path, lineno, site in iter_call_sites(root):
+        where = f"{path}:{lineno}"
+        if site.startswith("<syntax:"):
+            violations.append(f"{where}: unparseable: {site}")
+            continue
+        count += 1
+        used.add(site)
+        if site not in SITES:
+            violations.append(
+                f"{where}: failpoint site {site!r} not in "
+                f"runtime.failpoints.SITES (registered: "
+                f"{', '.join(SITES)})")
+        if not SITE_RE.match(site):
+            violations.append(
+                f"{where}: failpoint site {site!r} is not "
+                f"<area>.<name> snake.dotted form")
+    for site in SITES:
+        if site not in used:
+            violations.append(
+                f"runtime/failpoints.py: SITES entry {site!r} has no "
+                f"failpoint() call site — dead injection surface")
+    if count == 0:
+        violations.append("no failpoint() call sites found — "
+                          "scan roots wrong?")
+    return violations
+
+
+def main() -> int:
+    violations = run_lint()
+    if violations:
+        print(f"check_failpoints: {len(violations)} violation(s)")
+        for v in violations:
+            print(f"  {v}")
+        return 1
+    print("check_failpoints: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -282,11 +282,12 @@ def _pctl(vals, q):
     return s[min(len(s) - 1, int(q * len(s)))]
 
 
-def _write_chaos_section(section: str, data: dict) -> str:
-    """Merge one section into CHAOS_r01.json at the repo root (the scale and
-    serve chaos runs each own a section; reruns replace only their own)."""
+def _write_chaos_section(section: str, data: dict,
+                         fname: str = "CHAOS_r01.json") -> str:
+    """Merge one section into a chaos artifact at the repo root (the scale
+    and serve chaos runs each own a section; reruns replace only their own)."""
     path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "CHAOS_r01.json")
+        os.path.abspath(__file__))), fname)
     try:
         with open(path) as f:
             out = json.load(f)
@@ -524,6 +525,278 @@ def chaos_main(kill_every_s: float):
     print("CHAOS SOAK (scale) PASSED", flush=True)
 
 
+CHAOS_MODES = ("kill", "hang", "enospc", "corrupt")
+
+
+def parse_chaos_spec(spec: str) -> dict:
+    """``kill:N,hang:N,enospc:N,corrupt:N`` -> ordered {mode: N}. N means
+    seconds-between-kills for ``kill`` and a failpoint every-N trigger for
+    the other three. Any subset of modes is allowed; unknown modes fail."""
+    modes = {}
+    for entry in (spec or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        mode, _, val = entry.partition(":")
+        if mode not in CHAOS_MODES:
+            raise SystemExit(
+                f"--chaos-spec: unknown mode {mode!r} "
+                f"(one of {', '.join(CHAOS_MODES)})")
+        try:
+            modes[mode] = float(val) if val else 1.0
+        except ValueError:
+            raise SystemExit(f"--chaos-spec: bad value in {entry!r}")
+    if not modes:
+        raise SystemExit("--chaos-spec: empty spec")
+    return modes
+
+
+def chaos_mode_conf_kwargs(mode: str, n: float, seed: int = 3044) -> dict:
+    """Config field overrides that arm one injection mode (``kill`` uses a
+    ChaosMonkey, not a failpoint, so it contributes none). Shared by the
+    scale and serve soaks so both matrices inject identically."""
+    if mode == "hang":
+        # hang far past the hard timeout: every firing MUST be cancelled by
+        # the task_timeout_s monitor, never by the hang expiring. N means
+        # "one in N task entries hangs": a probability trigger (an every-N
+        # counter would tick in near-lockstep on symmetric workers), drawn
+        # from the slot-salted streams so only one worker of the pair
+        # hangs and the retry lands on a WARM survivor. The default seed's
+        # slot-1 stream fires once at draw ~26 — inside both soaks'
+        # per-worker armed call windows (~36 scale, ~51 serve) but past
+        # what any respawned worker has left, so one firing cannot cascade
+        return {"failpoints": f"worker.task=hang:p{1.0 / max(n, 1):.5f}:600",
+                "failpoint_seed": seed, "task_timeout_s": 1.0,
+                "fault_exclusion_ttl_s": 2.0}
+    if mode == "enospc":
+        # shm tier armed so the per-commit headroom/ENOSPC path is the one
+        # that fires; the degrade target is the spill-dir tier
+        return {"zero_copy_tier": "shm", "failpoint_seed": seed,
+                "failpoints": f"shm.commit=enospc:every{int(n)}"}
+    if mode == "corrupt":
+        # paranoid verification ON: a flipped payload byte must be caught as
+        # a crc mismatch and routed into lineage recompute
+        return {"shuffle_verify_checksum": True, "failpoint_seed": seed,
+                "failpoints": f"frame.decode=corrupt:every{int(n)}"}
+    return {}
+
+
+def chaos_matrix_main(spec: str):
+    """Chaos matrix (--chaos-spec kill:N,hang:N,enospc:N,corrupt:N): run the
+    shuffle-bearing shapes against a 2-worker pool once uninjected, then once
+    per requested injection mode, and gate EVERY mode on
+
+      * zero wrong results (bit-identical to the in-driver oracle),
+      * zero leaked memory-manager bytes and zero leaked /dev/shm roots,
+      * p99 <= 2x the uninjected phase,
+
+    plus per-mode evidence: kill -> worker deaths observed; hang -> hard
+    task timeouts fired; enospc -> ``shuffle_tier_degraded`` > 0 (the query
+    degraded tiers instead of failing); corrupt -> lineage recomputes > 0.
+    Evidence lands in CHAOS_r02.json (section "scale") BEFORE gates are
+    asserted. Env: CHAOS_ROWS (200_000), CHAOS_ITERS (6).
+    """
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from blaze_tpu.config import Config, set_config
+    from blaze_tpu.ir import exprs as E
+    from blaze_tpu.ir import nodes as N
+    from blaze_tpu.ir import types as T
+    from blaze_tpu.obs.telemetry import get_registry
+    from blaze_tpu.ops.parquet import scan_node_for_files
+    from blaze_tpu.runtime import failpoints
+    from blaze_tpu.runtime.cluster import ChaosMonkey
+    from blaze_tpu.runtime.memmgr import MemManager
+    from blaze_tpu.runtime.session import Session
+
+    modes = parse_chaos_spec(spec)
+    rows = int(os.environ.get("CHAOS_ROWS", 200_000))
+    iters = int(os.environ.get("CHAOS_ITERS", 6))
+
+    COUNTERS = ("blaze_cluster_worker_deaths_total",
+                "blaze_cluster_tasks_retried_total",
+                "blaze_cluster_tasks_timed_out_total",
+                "blaze_cluster_stages_recovered_total",
+                "blaze_cluster_maps_recomputed_total",
+                "blaze_chaos_kills_total")
+
+    def counters() -> dict:
+        snap = get_registry().to_raw()
+        out = {}
+        for name in COUNTERS:
+            series = snap.get(name, {}).get("series", [])
+            out[name] = series[0]["value"] if series else 0
+        return out
+
+    def agg_by(col, reducers):
+        def mk(paths):
+            scan = scan_node_for_files(paths, num_partitions=4)
+            ex = N.ShuffleExchange(
+                scan, N.HashPartitioning([E.Column(col)], reducers))
+            return N.Agg(ex, E.AggExecMode.HASH_AGG, [(col, E.Column(col))], [
+                N.AggColumn(E.AggExpr(E.AggFunction.SUM, [E.Column("paid")],
+                                      T.I64), E.AggMode.COMPLETE, "total")])
+        return mk
+
+    def sort_top(paths):
+        scan = scan_node_for_files(paths, num_partitions=4)
+        orders = [E.SortOrder(E.Column("paid"), ascending=False),
+                  E.SortOrder(E.Column("item"))]
+        ex = N.ShuffleExchange(scan, N.SinglePartitioning(1))
+        return N.Limit(N.Sort(ex, orders), 500)
+
+    shapes = [("agg_store", agg_by("store", 4)),
+              ("agg_item", agg_by("item", 8)),
+              ("sort_top", sort_top)]
+
+    def canon(table):
+        d = table.to_pydict()
+        return sorted(zip(*d.values())) if d else []
+
+    section = {"spec": spec, "rows": rows, "iters": iters, "phases": {}}
+    with tempfile.TemporaryDirectory(prefix="blaze_chaosm_") as tmpdir:
+        rng = np.random.default_rng(11)
+        paths = []
+        for p in range(2):
+            n = rows // 2
+            tbl = pa.table({
+                "store": pa.array(rng.integers(1, 41, n), type=pa.int64()),
+                "item": pa.array(rng.integers(1, 201, n), type=pa.int64()),
+                "paid": pa.array(rng.integers(0, 10_000, n), type=pa.int64()),
+            })
+            path = os.path.join(tmpdir, f"chaos_{p}.parquet")
+            pq.write_table(tbl, path)
+            paths.append(path)
+
+        with Session() as s_local:
+            oracle = {name: canon(s_local.execute_to_table(mk(paths)))
+                      for name, mk in shapes}
+
+        def run_phase(mode, n) -> dict:
+            MemManager.reset()
+            kwargs = dict(chaos_mode_conf_kwargs(mode, n)) if mode else {}
+            # injection starts AFTER a one-pass JIT warmup (identically in
+            # every phase, warmup latencies recorded in every phase): a
+            # failpoint landing inside worker compilation would measure the
+            # compiler, not the recovery path
+            arm_spec = kwargs.pop("failpoints", "")
+            arm_timeout = kwargs.pop("task_timeout_s", 0.0)
+            conf = Config(incident_dir=os.path.join(
+                tmpdir, f"incidents_{mode or 'baseline'}"), **kwargs)
+            # the GLOBAL config must match the session conf: driver-side
+            # readers (recompute pre-checks, tier selection outside a query)
+            # consult get_config(), and the corrupt mode's paranoia level
+            # must be coherent between them or recompute pre-checks would
+            # pass a crc-corrupt file as healthy
+            set_config(conf)
+            lats, wrong = [], []
+            c0 = counters()
+            shm0 = shm_roots()
+            with Session(conf=conf, num_worker_processes=2) as sess:
+                for name, mk in shapes:  # warmup pass, uninjected
+                    t0 = time.perf_counter()
+                    if canon(sess.execute_to_table(mk(paths))) != oracle[name]:
+                        wrong.append({"iter": "warmup", "shape": name})
+                    lats.append(time.perf_counter() - t0)
+                if arm_spec:
+                    # conf is shared by reference with the pool, so workers
+                    # pick the spec up from the next task's shipped conf and
+                    # the timeout monitor reads it per stage
+                    conf.failpoints = arm_spec
+                    conf.task_timeout_s = arm_timeout
+                    failpoints.arm_from(conf)
+                monkey = ChaosMonkey(sess.pool, n, seed=11).start() \
+                    if mode == "kill" else None
+                try:
+                    for it in range(iters):
+                        for name, mk in shapes:
+                            t0 = time.perf_counter()
+                            got = canon(sess.execute_to_table(mk(paths)))
+                            lats.append(time.perf_counter() - t0)
+                            if got != oracle[name]:
+                                wrong.append({"iter": it, "shape": name})
+                        print(json.dumps({
+                            "phase": mode or "baseline", "iter": it,
+                            "p99_s": round(_pctl(lats, 0.99), 3),
+                            "wrong": len(wrong)}), flush=True)
+                finally:
+                    if monkey is not None:
+                        monkey.stop()
+                        time.sleep(2.0)  # heartbeat grace for the last kill
+                    failpoints.unhang()
+                kills = list(monkey.kills) if monkey else []
+                tier_degraded = int(sess.metrics.total(
+                    "shuffle_tier_degraded"))
+                leaked_metric = int(sess.metrics.total(
+                    "query_leaked_mem_reclaimed"))
+                mm = MemManager._instance
+                used_after = int(mm.used) if mm is not None else 0
+            fired = failpoints.fired()  # driver-process firings (workers
+            failpoints.disarm()         # report through session metrics)
+            c1 = counters()
+            return {
+                "p50_s": round(_pctl(lats, 0.50), 4),
+                "p99_s": round(_pctl(lats, 0.99), 4),
+                "queries": len(lats),
+                "wrong_results": wrong,
+                "kills_injected": len(kills),
+                "failpoints_fired_in_driver": fired,
+                "shuffle_tier_degraded": tier_degraded,
+                "leaked_mem_reclaimed": leaked_metric,
+                "mem_used_after": used_after,
+                "shm_segments_leaked": len(shm_roots(shm0)),
+                "counters_delta": {k: c1[k] - c0[k] for k in COUNTERS},
+            }
+
+        section["phases"]["baseline"] = base = run_phase(None, 0)
+        for mode, n in modes.items():
+            section["phases"][mode] = run_phase(mode, n)
+
+    gates = {"p99_baseline_s": base["p99_s"], "modes": {}}
+    for mode in modes:
+        ph = section["phases"][mode]
+        d = ph["counters_delta"]
+        gates["modes"][mode] = {
+            "wrong_results": len(ph["wrong_results"]),
+            "leaked_bytes": ph["leaked_mem_reclaimed"]
+            + ph["mem_used_after"],
+            "shm_segments_leaked": ph["shm_segments_leaked"],
+            "p99_s": ph["p99_s"],
+            "p99_inflation": round(ph["p99_s"] / max(base["p99_s"], 1e-9),
+                                   2),
+            "worker_deaths": d["blaze_cluster_worker_deaths_total"],
+            "tasks_timed_out": d["blaze_cluster_tasks_timed_out_total"],
+            "maps_recomputed": d["blaze_cluster_maps_recomputed_total"],
+            "shuffle_tier_degraded": ph["shuffle_tier_degraded"],
+            "kills_injected": ph["kills_injected"],
+        }
+    section["gates"] = gates
+    path = _write_chaos_section("scale", section, fname="CHAOS_r02.json")
+    print(json.dumps({"gates": gates, "artifact": path}), flush=True)
+
+    # evidence is on disk; now enforce the matrix gates
+    for mode in modes:
+        g = gates["modes"][mode]
+        assert g["wrong_results"] == 0, (mode, g)
+        assert g["leaked_bytes"] == 0, (mode, g)
+        assert g["shm_segments_leaked"] == 0, (mode, g)
+        assert g["p99_s"] <= 2.0 * gates["p99_baseline_s"], (mode, g)
+    if "kill" in modes:
+        g = gates["modes"]["kill"]
+        assert g["kills_injected"] > 0 and g["worker_deaths"] > 0, g
+    if "hang" in modes:
+        assert gates["modes"]["hang"]["tasks_timed_out"] > 0, gates
+    if "enospc" in modes:
+        assert gates["modes"]["enospc"]["shuffle_tier_degraded"] > 0, gates
+    if "corrupt" in modes:
+        assert gates["modes"]["corrupt"]["maps_recomputed"] > 0, gates
+    print("CHAOS MATRIX (scale) PASSED", flush=True)
+
+
 if __name__ == "__main__":
     import argparse
 
@@ -532,8 +805,15 @@ if __name__ == "__main__":
                     help="chaos mode: hard-kill a random worker every N "
                          "seconds and gate on recovery (CHAOS_r01.json) "
                          "instead of running the scale soak")
+    ap.add_argument("--chaos-spec", metavar="SPEC",
+                    help="chaos matrix: comma-separated modes "
+                         "kill:N,hang:N,enospc:N,corrupt:N — one injected "
+                         "phase per mode plus an uninjected baseline, gated "
+                         "per mode (CHAOS_r02.json)")
     args = ap.parse_args()
-    if args.chaos_kill_every:
+    if args.chaos_spec:
+        chaos_matrix_main(args.chaos_spec)
+    elif args.chaos_kill_every:
         chaos_main(args.chaos_kill_every)
     else:
         main()

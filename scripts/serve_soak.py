@@ -648,6 +648,303 @@ def chaos_main(kill_every_s: float):
     print("CHAOS SOAK (serve) PASSED", flush=True)
 
 
+def chaos_matrix_main(spec: str):
+    """Serve chaos matrix (--chaos-spec kill:N,hang:N,enospc:N,corrupt:N):
+    client threads hammer a 2-worker clustered scheduler once uninjected,
+    then once per requested injection mode. EVERY mode gates on zero wrong
+    results, zero client-visible failures (the serve layer's auto-retry must
+    absorb worker loss — clients never see ``QueryRetryable``), zero leaked
+    memory bytes / shm roots, and p99 <= 2x the uninjected phase; plus the
+    same per-mode evidence as the scale matrix.
+
+    A deterministic retry-proof prologue runs first: a query whose first
+    execution is forced (``worker.task=ioerror`` failpoint, x-capped) to
+    exhaust the pool's task retry budget MUST complete via the scheduler's
+    transparent re-execution, with the retry recorded on the handle.
+    Evidence lands in CHAOS_r02.json (section "serve") BEFORE gates are
+    asserted. Env: CHAOS_ROWS (200_000), CHAOS_QUERIES (24),
+    CHAOS_CLIENTS (4).
+    """
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from blaze_tpu.config import Config, set_config
+    from blaze_tpu.ir import exprs as E
+    from blaze_tpu.ir import nodes as N
+    from blaze_tpu.ir import types as T
+    from blaze_tpu.obs.telemetry import get_registry
+    from blaze_tpu.ops.parquet import scan_node_for_files
+    from blaze_tpu.runtime import failpoints
+    from blaze_tpu.runtime.cluster import ChaosMonkey
+    from blaze_tpu.runtime.memmgr import MemManager
+    from blaze_tpu.runtime.session import Session
+    from blaze_tpu.serve import Overloaded, QueryRetryable, QueryScheduler
+    from scale_soak import (_pctl, _write_chaos_section,
+                            chaos_mode_conf_kwargs, parse_chaos_spec)
+
+    F, M, HASH = E.AggFunction, E.AggMode, E.AggExecMode.HASH_AGG
+    modes = parse_chaos_spec(spec)
+    rows = int(os.environ.get("CHAOS_ROWS", 200_000))
+    queries = int(os.environ.get("CHAOS_QUERIES", 24))
+    clients = int(os.environ.get("CHAOS_CLIENTS", 4))
+
+    COUNTERS = ("blaze_cluster_worker_deaths_total",
+                "blaze_cluster_tasks_retried_total",
+                "blaze_cluster_tasks_timed_out_total",
+                "blaze_cluster_maps_recomputed_total",
+                "blaze_serve_retries_total",
+                "blaze_chaos_kills_total")
+
+    def counters() -> dict:
+        snap = get_registry().to_raw()
+        out = {}
+        for name in COUNTERS:
+            series = snap.get(name, {}).get("series", [])
+            out[name] = series[0]["value"] if series else 0
+        return out
+
+    section = {"spec": spec, "rows": rows, "queries": queries,
+               "clients": clients, "phases": {}}
+    with tempfile.TemporaryDirectory(prefix="blaze_serve_chaosm_") as tmpdir:
+        rng = random.Random(11)
+        path = os.path.join(tmpdir, "store_sales.parquet")
+        pq.write_table(pa.table({
+            "ss_store_sk": [rng.randrange(12) for _ in range(rows)],
+            "ss_item_sk": [rng.randrange(2000) for _ in range(rows)],
+            "ss_net_paid": [rng.randrange(1, 50_000) for _ in range(rows)],
+        }), path)
+
+        def scan():
+            return scan_node_for_files([path], num_partitions=4)
+
+        def agg_plan():
+            g = [("ss_store_sk", E.Column("ss_store_sk"))]
+            partial = N.Agg(scan(), HASH, g, [N.AggColumn(
+                E.AggExpr(F.SUM, [E.Column("ss_net_paid")], T.I64),
+                M.PARTIAL, "paid")])
+            ex = N.ShuffleExchange(
+                partial, N.HashPartitioning([E.Column("ss_store_sk")], 4))
+            return N.Agg(ex, HASH, g, [N.AggColumn(
+                E.AggExpr(F.SUM, [E.Column("ss_net_paid")], T.I64),
+                M.FINAL, "paid")])
+
+        def sort_plan():
+            ex = N.ShuffleExchange(scan(), N.SinglePartitioning(1))
+            srt = N.Sort(ex, [E.SortOrder(E.Column("ss_net_paid"),
+                                          ascending=False)])
+            return N.Limit(srt, 1000)
+
+        def canon_rows(table):
+            d = table.to_pydict()
+            return sorted(zip(*d.values())) if d else []
+
+        def canon_sort(table):
+            # ties at the limit boundary make the exact top-1000 row set
+            # attempt-dependent; the sort-key multiset is deterministic
+            return sorted(table["ss_net_paid"].to_pylist())
+
+        shapes = [("agg", agg_plan, 12 << 20, canon_rows),
+                  ("sort", sort_plan, 24 << 20, canon_sort)]
+
+        with Session() as s_local:
+            oracle = {name: cn(s_local.execute_to_table(mk()))
+                      for name, mk, _e, cn in shapes}
+
+        # -- deterministic serve-retry proof -----------------------------
+        # x6 per worker: with 4 map tasks and a 3-attempt budget, 12 fires
+        # guarantee one task fails 3 attempts on the FIRST execution
+        # (TaskFailed), and the caps are spent before the scheduler's
+        # transparent re-execution, which must then succeed
+        MemManager.reset()
+        proof_conf = Config(
+            incident_dir=os.path.join(tmpdir, "incidents_proof"),
+            failpoints="worker.task=ioerror:every1:x6", failpoint_seed=7)
+        set_config(proof_conf)
+        c0 = counters()
+        with Session(conf=proof_conf, num_worker_processes=2) as sess:
+            with QueryScheduler(sess, max_concurrent=1) as sched:
+                h = sched.submit(agg_plan(), label="retry_proof")
+                table = h.result(timeout=180)  # QueryRetryable = hard fail
+        failpoints.disarm()
+        c1 = counters()
+        section["retry_proof"] = proof = {
+            "serve_retries": len(h.retries),
+            "retry_history": h.retries,
+            "serve_retries_counter_delta":
+                c1["blaze_serve_retries_total"]
+                - c0["blaze_serve_retries_total"],
+            "correct": canon_rows(table) == oracle["agg"],
+        }
+        print(json.dumps({"retry_proof": proof}), flush=True)
+
+        def run_phase(mode, n) -> dict:
+            MemManager.reset()
+            kwargs = dict(chaos_mode_conf_kwargs(mode, n)) if mode else {}
+            arm_spec = kwargs.pop("failpoints", "")
+            arm_timeout = kwargs.pop("task_timeout_s", 0.0)
+            conf = Config(
+                memory_total=BUDGET_MB << 20, memory_fraction=1.0,
+                mem_wait_timeout_s=5.0,
+                incident_dir=os.path.join(
+                    tmpdir, f"incidents_{mode or 'baseline'}"), **kwargs)
+            set_config(conf)
+            lats, wrong, hard_failures = [], [], []
+            tallies = {"completed": 0, "client_visible_retryable": 0,
+                       "gave_up": 0}
+            mu = threading.Lock()
+            seq = iter(range(queries))
+            shm0 = shm_roots()
+            c0 = counters()
+            with Session(conf=conf, num_worker_processes=2) as sess:
+                # warmup pass: uninjected, but RECORDED in every phase's
+                # latency population alike — worker JIT warmup is part of
+                # each phase's tail in both the baseline and injected runs
+                for name, mk, _e, cn in shapes:
+                    t0 = time.perf_counter()
+                    if cn(sess.execute_to_table(mk())) != oracle[name]:
+                        wrong.append({"query": "warmup", "shape": name})
+                    lats.append(time.perf_counter() - t0)
+                if arm_spec:
+                    conf.failpoints = arm_spec
+                    conf.task_timeout_s = arm_timeout
+                    failpoints.arm_from(conf)
+                monkey = ChaosMonkey(sess.pool, n, seed=13).start() \
+                    if mode == "kill" else None
+                try:
+                    with QueryScheduler(sess, max_concurrent=2, max_queue=8,
+                                        queue_timeout_s=60.0) as sched:
+                        def client(cid):
+                            rngc = random.Random(200 + cid)
+                            while True:
+                                with mu:
+                                    i = next(seq, None)
+                                if i is None:
+                                    return
+                                name, mk, est, cn = shapes[i % len(shapes)]
+                                t0 = time.perf_counter()
+                                got = None
+                                for _attempt in range(5):
+                                    try:
+                                        h = sched.submit(
+                                            mk(), mem_estimate=est,
+                                            label=f"{name}_{i}")
+                                        got = h.result(timeout=300)
+                                        break
+                                    except Overloaded:
+                                        time.sleep(rngc.uniform(0.05, 0.2))
+                                    except QueryRetryable:
+                                        # the auto-retry contract: clients
+                                        # must never see this now
+                                        with mu:
+                                            tallies[
+                                                "client_visible_retryable"
+                                            ] += 1
+                                    except BaseException as exc:
+                                        with mu:
+                                            hard_failures.append(
+                                                f"{name}_{i}: "
+                                                f"{type(exc).__name__}: "
+                                                f"{exc}")
+                                        return
+                                with mu:
+                                    if got is None:
+                                        tallies["gave_up"] += 1
+                                        return
+                                    tallies["completed"] += 1
+                                    lats.append(time.perf_counter() - t0)
+                                    if cn(got) != oracle[name]:
+                                        wrong.append(
+                                            {"query": i, "shape": name})
+
+                        ts = [threading.Thread(target=client, args=(c,),
+                                               daemon=True)
+                              for c in range(clients)]
+                        for t in ts:
+                            t.start()
+                        for t in ts:
+                            t.join()
+                finally:
+                    if monkey is not None:
+                        monkey.stop()
+                        time.sleep(2.0)  # heartbeat grace for the last kill
+                    failpoints.unhang()
+                kills = list(monkey.kills) if monkey else []
+                tier_degraded = int(sess.metrics.total(
+                    "shuffle_tier_degraded"))
+                mm = MemManager._instance
+                leaked = int(mm.used) if mm is not None else 0
+            failpoints.disarm()
+            c1 = counters()
+            return {
+                "p50_s": round(_pctl(lats, 0.50), 4),
+                "p99_s": round(_pctl(lats, 0.99), 4),
+                **tallies,
+                "wrong_results": wrong,
+                "hard_failures": hard_failures,
+                "kills_injected": len(kills),
+                "shuffle_tier_degraded": tier_degraded,
+                "leaked_mem": leaked,
+                "shm_segments_leaked": len(shm_roots(shm0)),
+                "counters_delta": {k: c1[k] - c0[k] for k in COUNTERS},
+            }
+
+        section["phases"]["baseline"] = base = run_phase(None, 0)
+        for mode, n in modes.items():
+            section["phases"][mode] = run_phase(mode, n)
+
+    gates = {"p99_baseline_s": base["p99_s"],
+             "retry_proof_serve_retries": proof["serve_retries"],
+             "retry_proof_correct": proof["correct"], "modes": {}}
+    for mode in modes:
+        ph = section["phases"][mode]
+        d = ph["counters_delta"]
+        gates["modes"][mode] = {
+            "wrong_results": len(ph["wrong_results"]),
+            "hard_failures": len(ph["hard_failures"]),
+            "client_visible_retryable": ph["client_visible_retryable"],
+            "gave_up": ph["gave_up"],
+            "leaked_bytes": ph["leaked_mem"],
+            "shm_segments_leaked": ph["shm_segments_leaked"],
+            "p99_s": ph["p99_s"],
+            "p99_inflation": round(ph["p99_s"] / max(base["p99_s"], 1e-9),
+                                   2),
+            "worker_deaths": d["blaze_cluster_worker_deaths_total"],
+            "tasks_timed_out": d["blaze_cluster_tasks_timed_out_total"],
+            "maps_recomputed": d["blaze_cluster_maps_recomputed_total"],
+            "serve_retries": d["blaze_serve_retries_total"],
+            "shuffle_tier_degraded": ph["shuffle_tier_degraded"],
+            "kills_injected": ph["kills_injected"],
+        }
+    section["gates"] = gates
+    path = _write_chaos_section("serve", section, fname="CHAOS_r02.json")
+    print(json.dumps({"gates": gates, "artifact": path}), flush=True)
+
+    # evidence is on disk; now enforce the matrix gates
+    assert proof["serve_retries"] >= 1 and proof["correct"], proof
+    assert proof["serve_retries_counter_delta"] >= 1, proof
+    for mode in modes:
+        g = gates["modes"][mode]
+        assert g["wrong_results"] == 0, (mode, g)
+        assert g["hard_failures"] == 0, (mode, g,
+                                         section["phases"][mode]
+                                         ["hard_failures"])
+        assert g["client_visible_retryable"] == 0, (mode, g)
+        assert g["gave_up"] == 0, (mode, g)
+        assert g["leaked_bytes"] == 0, (mode, g)
+        assert g["shm_segments_leaked"] == 0, (mode, g)
+        assert g["p99_s"] <= 2.0 * gates["p99_baseline_s"], (mode, g)
+    if "kill" in modes:
+        g = gates["modes"]["kill"]
+        assert g["kills_injected"] > 0 and g["worker_deaths"] > 0, g
+    if "hang" in modes:
+        assert gates["modes"]["hang"]["tasks_timed_out"] > 0, gates
+    if "enospc" in modes:
+        assert gates["modes"]["enospc"]["shuffle_tier_degraded"] > 0, gates
+    if "corrupt" in modes:
+        assert gates["modes"]["corrupt"]["maps_recomputed"] > 0, gates
+    print("CHAOS MATRIX (serve) PASSED", flush=True)
+
+
 if __name__ == "__main__":
     import argparse
 
@@ -656,8 +953,15 @@ if __name__ == "__main__":
                     help="chaos mode: hard-kill a random worker every N "
                          "seconds under serving load and gate on recovery "
                          "(CHAOS_r01.json) instead of the plain serve soak")
+    ap.add_argument("--chaos-spec", metavar="SPEC",
+                    help="chaos matrix: comma-separated modes "
+                         "kill:N,hang:N,enospc:N,corrupt:N — one injected "
+                         "phase per mode plus an uninjected baseline, gated "
+                         "per mode (CHAOS_r02.json)")
     args = ap.parse_args()
-    if args.chaos_kill_every:
+    if args.chaos_spec:
+        chaos_matrix_main(args.chaos_spec)
+    elif args.chaos_kill_every:
         chaos_main(args.chaos_kill_every)
     else:
         main()

@@ -14,7 +14,7 @@ import pyarrow as pa
 import pyarrow.parquet as pq
 import pytest
 
-from blaze_tpu.config import Config
+from blaze_tpu.config import Config, config_override
 from blaze_tpu.ir import exprs as E
 from blaze_tpu.ir import nodes as N
 from blaze_tpu.ir import types as T
@@ -359,3 +359,180 @@ def test_serve_worker_loss_is_typed_retryable(data_files, tmp_path):
             h2 = sched.submit(_agg_plan(data_files), label="after")
             table = h2.result(timeout=120)
             assert table.num_rows > 0
+
+
+# -- failpoint-driven degradation (ISSUE 12) ----------------------------------
+#
+# Paranoid-mode corruption, resource-exhaustion fallbacks, hard task
+# timeouts, and the serve layer's transparent auto-retry — each proven
+# bit-identical against an uninjected oracle on a real 2-worker pool.
+
+
+@pytest.fixture(autouse=True)
+def _disarm_failpoints():
+    """No failpoint armed in one test may leak into the next (the registry
+    is process-global; Session.__init__ arms from conf)."""
+    from blaze_tpu.runtime import failpoints
+
+    failpoints.disarm()
+    yield
+    failpoints.disarm()
+    failpoints.unhang()
+
+
+@pytest.mark.parametrize("tier", ["shm", "ipc"])
+def test_corrupt_frame_recovers_bit_identical(data_files, tier):
+    """Paranoid mode (full crc verification) + the frame.decode failpoint
+    flipping committed payload bytes on disk: corruption is detected as a
+    crc mismatch, routed into lineage recompute like a lost output, and the
+    result matches the clean run exactly — on both the shm and ipc tiers,
+    over a real 2-worker pool."""
+    from blaze_tpu.obs.telemetry import get_registry
+
+    with Session() as s_clean:
+        oracle = _sorted_rows(s_clean.execute_to_table(
+            _agg_plan(data_files, parts=2, reducers=3)).to_pydict())
+
+    def recomputed():
+        snap = get_registry().to_raw()
+        series = snap["blaze_cluster_maps_recomputed_total"]["series"]
+        return series[0]["value"] if series else 0
+
+    n0 = recomputed()
+    # triggers count per PROCESS: every2:x1 makes each worker corrupt the
+    # 2nd output it verifies, exactly once, wherever the schedule lands it.
+    # config_override (not just Session(conf=...)) because the paranoia
+    # level must also reach the DRIVER's global-config readers (providers,
+    # lineage recompute), not only the conf shipped to workers.
+    with config_override(zero_copy_tier=tier, shuffle_verify_checksum=True,
+                         failpoints="frame.decode=corrupt:every2:x1",
+                         failpoint_seed=12):
+        with Session(num_worker_processes=2) as sess:
+            got = _sorted_rows(sess.execute_to_table(
+                _agg_plan(data_files, parts=2, reducers=3)).to_pydict())
+    assert got == oracle, "corrupted frames must recompute, not change rows"
+    assert recomputed() > n0, "corruption must route through lineage"
+
+
+def test_shm_enospc_degrades_to_spill_tier(data_files):
+    """A shm-tier commit hitting ENOSPC mid-query degrades that map output
+    to the spill dir behind a redirect marker: same rows, the
+    shuffle_tier_degraded tripwire fires, and the degraded copies are
+    reclaimed with the query (no leaks outlive the session)."""
+    with Session() as s_clean:
+        oracle = _sorted_rows(s_clean.execute_to_table(
+            _agg_plan(data_files, parts=2, reducers=3)).to_pydict())
+
+    # every1: triggers count per PROCESS, and each pool worker only commits
+    # a couple of maps — firing on every commit keeps this deterministic
+    conf = Config(zero_copy_tier="shm",
+                  failpoints="shm.commit=enospc:every1", failpoint_seed=12)
+    with Session(conf=conf, num_worker_processes=2) as sess:
+        got = _sorted_rows(sess.execute_to_table(
+            _agg_plan(data_files, parts=2, reducers=3)).to_pydict())
+        degraded = sess.metrics.total("shuffle_tier_degraded")
+        spill_dir = sess.conf.spill_dir
+    assert got == oracle, "degraded outputs must serve identical rows"
+    assert degraded > 0, "the enospc failpoint must exercise the degrade"
+    leaks = glob.glob(os.path.join(spill_dir, "degraded_shuffle", "*"))
+    assert not leaks, f"degraded copies leaked: {leaks}"
+
+
+@pytest.mark.slow
+def test_hung_task_times_out_and_reroutes(data_files):
+    """task_timeout_s on top of speculation: a task hung past the hard
+    timeout is cancelled at the process level, charged to the retry budget,
+    rerouted, and the hung worker is marked suspect — the query still
+    returns the exact clean-run rows."""
+    from blaze_tpu.obs.telemetry import get_registry
+
+    with Session() as s_clean:
+        oracle = _sorted_rows(s_clean.execute_to_table(
+            _agg_plan(data_files, parts=4, reducers=3)).to_pydict())
+
+    def timed_out():
+        # the counter has no series until its first inc — tolerate absence
+        snap = get_registry().to_raw()
+        series = snap.get("blaze_cluster_tasks_timed_out_total", {}).get(
+            "series", [])
+        return series[0]["value"] if series else 0
+
+    n0 = timed_out()
+    conf = Config(task_timeout_s=1.5, fault_exclusion_ttl_s=2.0,
+                  failpoints="worker.task=hang:every2:600",
+                  failpoint_seed=12)
+    t0 = time.monotonic()
+    with Session(conf=conf, num_worker_processes=2) as sess:
+        got = _sorted_rows(sess.execute_to_table(
+            _agg_plan(data_files, parts=4, reducers=3)).to_pydict())
+        deaths = sess.pool.deaths_total
+    wall = time.monotonic() - t0
+    assert got == oracle
+    assert timed_out() > n0, "the hard timeout must have fired"
+    assert deaths >= 1, "a timed-out attempt kills its worker"
+    assert wall < 120, "hung attempts must not stall the query"
+
+
+class CrashFirstNTasks:
+    """Crash fixture UDF: hard-kills the hosting WORKER on each call until
+    ``n`` crash markers exist, then passes through. Lets a test exhaust the
+    pool's per-task retry budget on the FIRST query attempt and succeed on
+    the serve layer's transparent re-execution."""
+
+    def __init__(self, marker_dir, n):
+        self.marker_dir = marker_dir
+        self.n = n
+
+    def __call__(self, x):
+        import os
+
+        if os.environ.get("BLAZE_WORKER_PLATFORM") is None:
+            return x  # in-driver recompute paths survive
+        os.makedirs(self.marker_dir, exist_ok=True)
+        done = len(os.listdir(self.marker_dir))
+        if done < self.n:
+            with open(os.path.join(self.marker_dir, f"crash_{done}"), "w"):
+                pass
+            os._exit(9)
+        return x
+
+
+@pytest.mark.slow
+def test_serve_auto_retry_hides_worker_loss(data_files, tmp_path):
+    """A query whose first execution exhausts the pool retry budget is
+    transparently re-executed by the scheduler (backoff + jitter inside the
+    deadline): the CLIENT sees a clean result, never QueryRetryable, and
+    the handle records the retry history."""
+    from blaze_tpu.obs.telemetry import get_registry
+    from blaze_tpu.ops.parquet import scan_node_for_files
+    from blaze_tpu.serve import QueryScheduler
+
+    scan = scan_node_for_files(data_files, num_partitions=2)
+    proj = N.Projection(scan, [
+        E.Column("store"),
+        # n=5 over 2 tasks: one task is guaranteed 3 crashing attempts,
+        # exhausting the pool's max_task_retries=2 budget on the FIRST
+        # execution — which is what forces a serve-layer retry
+        E.PyUDF(CrashFirstNTasks(str(tmp_path / "crashes"), 5),
+                [E.Column("store")], T.I64, "crashN"),
+    ], ["store", "crashed"])
+    plan = N.ShuffleExchange(proj,
+                             N.HashPartitioning([E.Column("store")], 2))
+
+    def retries():
+        # the counter has no series until its first inc — tolerate absence
+        snap = get_registry().to_raw()
+        series = snap.get("blaze_serve_retries_total", {}).get("series", [])
+        return series[0]["value"] if series else 0
+
+    n0 = retries()
+    conf = Config(incident_dir=str(tmp_path / "incidents"),
+                  fault_max_worker_deaths=8, fault_exclusion_ttl_s=1.0)
+    with Session(conf=conf, num_worker_processes=2) as sess:
+        with QueryScheduler(sess, max_concurrent=1) as sched:
+            h = sched.submit(plan, label="flaky")
+            table = h.result(timeout=180)  # no QueryRetryable raised
+    assert table.num_rows > 0
+    assert h.retries, "the handle must record its transparent retries"
+    assert retries() > n0
+    assert h.snapshot().get("retries") == len(h.retries)

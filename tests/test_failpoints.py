@@ -1,0 +1,208 @@
+"""Failpoint registry smoke (quick tier): spec grammar, every action's
+behavior, deterministic triggers (including the per-worker slot salt), and
+the static call-site lint. All in-process — the cross-process arming path
+(conf -> worker) is exercised by tests/test_cluster_recovery.py and the
+chaos soaks."""
+
+import errno
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from blaze_tpu.runtime import failpoints
+from blaze_tpu.runtime.failpoints import (ACTIONS, SITES, arm, arm_from,
+                                          disarm, failpoint, fired,
+                                          is_armed, parse_spec, unhang)
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    disarm()
+    yield
+    disarm()
+
+
+# -- spec grammar --------------------------------------------------------------
+
+
+@pytest.mark.quick
+def test_parse_spec_rejects_malformed_entries():
+    for bad in ("nosuch.site=enospc",        # unknown site
+                "shm.commit=frobnicate",     # unknown action
+                "shm.commit",                # missing =action
+                "shm.commit=enospc:everyX",  # bad every token
+                "shm.commit=enospc:every0",  # every < 1
+                "shm.commit=delay:pzzz"):    # bad probability token
+        with pytest.raises(ValueError):
+            parse_spec(bad)
+
+
+@pytest.mark.quick
+def test_parse_spec_tokens_and_multi_entry():
+    rules = parse_spec(
+        "shm.commit=enospc:every3:x2; frame.decode=corrupt:p0.25;"
+        "worker.task=hang:600")
+    assert set(rules) == {"shm.commit", "frame.decode", "worker.task"}
+    assert rules["shm.commit"].every == 3
+    assert rules["shm.commit"].max_fires == 2
+    assert rules["frame.decode"].prob == 0.25
+    assert rules["worker.task"].param == 600.0
+    assert parse_spec("") == {}
+
+
+# -- actions -------------------------------------------------------------------
+
+
+@pytest.mark.quick
+def test_enospc_and_ioerror_raise_typed_oserrors():
+    arm("shm.commit=enospc; shuffle.fetch=ioerror")
+    with pytest.raises(OSError) as ei:
+        failpoint("shm.commit")
+    assert ei.value.errno == errno.ENOSPC
+    with pytest.raises(OSError) as ei:
+        failpoint("shuffle.fetch")
+    assert ei.value.errno == errno.EIO
+    # unarmed sites pass payloads through untouched
+    assert failpoint("map.commit", b"xyz") == b"xyz"
+
+
+@pytest.mark.quick
+def test_delay_returns_payload_and_hang_is_releasable():
+    arm("device.put=delay:0.01")
+    t0 = time.perf_counter()
+    assert failpoint("device.put", "p") == "p"
+    assert time.perf_counter() - t0 >= 0.01
+    arm("worker.task=hang:600")
+    done = threading.Event()
+
+    def victim():
+        failpoint("worker.task")
+        done.set()
+
+    threading.Thread(target=victim, daemon=True).start()
+    time.sleep(0.2)
+    assert not done.is_set()  # genuinely stuck
+    unhang()
+    assert done.wait(5.0)
+
+
+@pytest.mark.quick
+def test_corrupt_flips_bytes_and_files(tmp_path):
+    arm("frame.decode=corrupt")
+    before = b"\x00" * 64
+    after = failpoint("frame.decode", before)
+    assert after != before and len(after) == len(before)
+    assert sum(a != b for a, b in zip(before, after)) == 1
+    # path payload: one byte of the payload region flipped in place, and
+    # the 24-byte footer region is never the target
+    p = tmp_path / "block.bin"
+    p.write_bytes(b"\x00" * 40 + b"F" * 24)
+    arm("frame.decode=corrupt")
+    assert failpoint("frame.decode", str(p)) == str(p)
+    got = p.read_bytes()
+    assert got[40:] == b"F" * 24 and got[:40] != b"\x00" * 40
+
+
+# -- triggers ------------------------------------------------------------------
+
+
+@pytest.mark.quick
+def test_every_n_and_x_cap_fire_pattern():
+    arm("map.commit=ioerror:every3:x2")
+    pattern = []
+    for _ in range(9):
+        try:
+            failpoint("map.commit")
+            pattern.append(0)
+        except OSError:
+            pattern.append(1)
+    # 3rd and 6th calls fire; the x2 cap silences the 9th
+    assert pattern == [0, 0, 1, 0, 0, 1, 0, 0, 0]
+    assert fired("map.commit") == 2
+    assert fired() == {"map.commit": 2}
+
+
+def _prob_pattern(seed, salt, n=200):
+    os.environ["BLAZE_TPU_FAILPOINT_SALT"] = str(salt)
+    try:
+        arm("worker.task=delay:p0.05:0", seed=seed)
+        pat = [bool(failpoints._ARMED["worker.task"].should_fire())
+               for _ in range(n)]
+    finally:
+        os.environ.pop("BLAZE_TPU_FAILPOINT_SALT", None)
+    return pat
+
+
+@pytest.mark.quick
+def test_probability_trigger_is_seeded_and_slot_salted():
+    a = _prob_pattern(seed=42, salt=0)
+    assert a == _prob_pattern(seed=42, salt=0)      # reproducible
+    assert a != _prob_pattern(seed=43, salt=0)      # seed-keyed
+    # slot salt decorrelates symmetric workers without losing determinism
+    s1 = _prob_pattern(seed=42, salt=1)
+    assert s1 != a and s1 != _prob_pattern(seed=42, salt=2)
+    assert s1 == _prob_pattern(seed=42, salt=1)
+
+
+@pytest.mark.quick
+def test_arm_from_is_idempotent_and_respects_env_override():
+    class C:
+        failpoints = "shm.commit=enospc:every2"
+        failpoint_seed = 9
+
+    arm_from(C())
+    with pytest.raises(OSError):
+        for _ in range(2):
+            failpoint("shm.commit")
+    # re-arming with an UNCHANGED (spec, seed) must keep counters: the
+    # worker calls arm_from on EVERY task conf, and every-N triggers count
+    # per process lifetime, not per task
+    assert failpoints._ARMED["shm.commit"].calls == 2
+    arm_from(C())
+    assert failpoints._ARMED["shm.commit"].calls == 2
+    os.environ["BLAZE_TPU_FAILPOINTS"] = ""
+    try:
+        arm_from(C())  # env overrides conf: disarms
+        assert not is_armed()
+    finally:
+        os.environ.pop("BLAZE_TPU_FAILPOINTS")
+
+
+# -- static lint ---------------------------------------------------------------
+
+
+@pytest.mark.quick
+def test_check_failpoints_lint_passes():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "check_failpoints.py")],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@pytest.mark.quick
+def test_lint_catches_unknown_and_unused_sites(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import check_failpoints as lint
+    finally:
+        sys.path.pop(0)
+    (tmp_path / "blaze_tpu").mkdir()
+    (tmp_path / "scripts").mkdir()
+    (tmp_path / "blaze_tpu" / "x.py").write_text(
+        "failpoint('nosuch.site')\nfailpoints.failpoint('BadForm')\n")
+    violations = lint.run_lint(str(tmp_path))
+    assert any("'nosuch.site' not in" in v for v in violations)
+    assert any("'BadForm'" in v and "snake.dotted" in v for v in violations)
+    # every real SITES entry is unused in this fake tree
+    for site in SITES:
+        assert any(f"{site!r} has no failpoint() call site" in v
+                   for v in violations)
+    assert ACTIONS  # imported: the registry tuple is public API
