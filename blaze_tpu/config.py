@@ -339,6 +339,48 @@ class Config:
     serve_retry_backoff_s: float = 0.25
     serve_retry_backoff_max_s: float = 2.0
 
+    # Multi-tenant weighted-fair queuing (serve/scheduler.py): tenants are
+    # declared as "name:weight[:max_concurrent[:mem_quota_mb]]" entries,
+    # ';'-separated (e.g. "dash:4;adhoc:2;bulk:1:1:64"). Unknown tenants
+    # fall back to serve_tenant_default_weight with no per-tenant caps.
+    # Dispatch order is virtual-time WFQ: each query gets
+    # vfinish = max(V, tenant.last_vft) + cost/weight and the smallest
+    # vfinish among tenant queue heads is admitted next, so a flooding
+    # tenant cannot starve light ones.
+    serve_tenants: str = ""
+    serve_tenant_default_weight: float = 1.0
+
+    # Stage-boundary preemption: a running preemptible query whose tenant
+    # has fallen behind in virtual time (or that a higher-priority arrival
+    # is waiting on) is asked to pause at its next stage commit. Pausing
+    # releases its memory group and slot but PINS committed shuffle
+    # segments behind a stage cursor; resume replays the cursor without
+    # recomputing finished stages.
+    serve_preempt_enable: bool = True
+    # head-of-line wait before the dispatcher considers preempting
+    serve_preempt_after_s: float = 0.25
+    # a victim must have run at least this long (don't thrash short queries)
+    serve_preempt_min_run_s: float = 0.1
+    # max pauses per query (bounds pause/resume livelock)
+    serve_preempt_max: int = 3
+    # chaos knob: preempt whenever anything is waiting, regardless of
+    # priority/virtual-time ordering (the `preempt` storm mode)
+    serve_preempt_aggressive: bool = False
+
+    # Adaptive admission: when QueryScheduler is built without an explicit
+    # max_concurrent, the concurrency cap floats between 1 and
+    # serve_adaptive_max_concurrent based on MemManager headroom divided by
+    # the (profile-refined) per-query estimate. False restores the fixed
+    # serve_max_concurrent cap.
+    serve_adaptive_admission: bool = True
+    serve_adaptive_max_concurrent: int = 16
+
+    # Full-queue backpressure: instead of a hard Overloaded shed, a full
+    # queue raises Backpressure (HTTP 429) carrying a Retry-After computed
+    # from the observed drain rate, clamped to this ceiling.
+    serve_backpressure_enable: bool = True
+    serve_retry_after_max_s: float = 5.0
+
     # Adaptive device placement (runtime/placement.py — the TPU analogue of
     # the reference's removeInefficientConverts): "auto" runs each stage
     # where the measured-link cost model says it is cheapest; "device" /

@@ -65,6 +65,7 @@ SITES = (
     "frame.decode",   # ops/shuffle/reader.py — frame payload decode
     "worker.task",    # runtime/worker.py — task entry in worker processes
     "device.put",     # core/batch.py — host->device column upload
+    "serve.preempt",  # runtime/session.py — stage-boundary pause point
 )
 
 ACTIONS = ("enospc", "ioerror", "delay", "hang", "corrupt")

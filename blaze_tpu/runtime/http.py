@@ -31,7 +31,9 @@ bound to a free port exposes:
 - ``/serve/submit`` (POST)     — submit a plan to the serving scheduler:
   JSON body with ``plan_b64`` (base64 of ir/protoserde plan bytes) or
   ``spark_plan`` (Spark-plan JSON for frontend/converter), plus optional
-  ``priority``/``deadline_s``/``label``; 503 + typed body when Overloaded
+  ``priority``/``deadline_s``/``label``/``tenant``; 503 + typed body when
+  Overloaded, 429 + ``Retry-After`` header when the full queue is merely
+  backpressured (retry later instead of shedding)
 - ``/serve/queries``           — scheduler snapshot (queued + running)
 - ``/serve/status?id=N``       — one query's state/elapsed/error
 - ``/serve/cancel?id=N``       — flip a query's cancel token
@@ -90,11 +92,13 @@ class ProfilingService:
                     pass
 
                 def _send(self, body: str, ctype: str = "application/json",
-                          status: int = 200):
+                          status: int = 200, headers=None):
                     data = body.encode()
                     self.send_response(status)
                     self.send_header("Content-Type", ctype)
                     self.send_header("Content-Length", str(len(data)))
+                    for k, v in (headers or {}).items():
+                        self.send_header(k, v)
                     self.end_headers()
                     self.wfile.write(data)
 
@@ -310,7 +314,7 @@ class ProfilingService:
                             {"error": "no serve scheduler attached"}),
                             status=503)
                         return
-                    from blaze_tpu.serve import Overloaded
+                    from blaze_tpu.serve import Backpressure, Overloaded
 
                     try:
                         length = int(self.headers.get("Content-Length", 0))
@@ -341,7 +345,19 @@ class ProfilingService:
                             plan, priority=int(req.get("priority", 0)),
                             deadline_s=float(deadline)
                             if deadline is not None else None,
-                            label=req.get("label"))
+                            label=req.get("label"),
+                            tenant=req.get("tenant"))
+                    except Backpressure as exc:
+                        # retryable overload: the queue is full but
+                        # draining — 429 + Retry-After tells well-behaved
+                        # clients exactly when to come back
+                        self._send(json.dumps(
+                            {"error": "Backpressure", "reason": exc.reason,
+                             "retry_after_s": round(exc.retry_after_s, 3)}),
+                            status=429,
+                            headers={"Retry-After":
+                                     f"{exc.retry_after_s:.3f}"})
+                        return
                     except Overloaded as exc:
                         # typed load shed: clients back off, they don't retry
                         # into the same wall

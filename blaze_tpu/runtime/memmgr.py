@@ -118,6 +118,11 @@ class MemManager:
         # per-group admission reservations (serve/scheduler.py): bytes set
         # aside for an admitted query before its consumers register
         self._reservations: Dict[str, int] = {}
+        # named quota groups (multi-tenant serving): quota name ->
+        # {"max": bytes, "weight": float}; reservation groups join a quota
+        # at reserve time and leave it on release
+        self._quotas: Dict[str, dict] = {}
+        self._group_quota: Dict[str, str] = {}
         # ambient group for register(): set per task thread via group_scope
         self._tls = threading.local()
         self.wait_timeout_s = wait_timeout_s if wait_timeout_s is not None \
@@ -197,14 +202,18 @@ class MemManager:
 
     # -- per-query reservations (serving-layer admission control) -------------
 
-    def reserve_group(self, group: str, nbytes: int):
+    def reserve_group(self, group: str, nbytes: int,
+                      quota: Optional[str] = None):
         """Set aside ``nbytes`` for an admitted query before any of its
         consumers register — concurrent admissions cannot double-book the
-        same headroom."""
+        same headroom. ``quota`` enrolls the group in a named quota (see
+        ``set_quota``) so per-tenant footprints are queryable."""
         with self._mu:
             self._reservations[group] = \
                 self._reservations.get(group, 0) + int(nbytes)
             reserved = self._reservations[group]
+            if quota is not None:
+                self._group_quota[group] = quota
         self._tm_group_reserved.labels(group=group).set(reserved)
 
     def release_group(self, group: str) -> int:
@@ -213,6 +222,7 @@ class MemManager:
         the leaked consumer bytes reclaimed."""
         with self._mu:
             self._reservations.pop(group, None)
+            self._group_quota.pop(group, None)
             freed = 0
             for c in [c for c in self.consumers if c.group == group]:
                 freed += c.mem_used
@@ -223,6 +233,46 @@ class MemManager:
         # drop the label so gauge cardinality tracks LIVE groups only
         self._tm_group_reserved.remove(group=group)
         return freed
+
+    # -- named quota groups (multi-tenant serving) ----------------------------
+
+    def set_quota(self, name: str, max_bytes: Optional[int],
+                  weight: float = 1.0):
+        """Declare (or update) a named quota. ``max_bytes`` of 0/None means
+        uncapped — the quota then only names a footprint for accounting.
+        Reservation groups join via ``reserve_group(..., quota=name)``."""
+        with self._mu:
+            self._quotas[name] = {"max": int(max_bytes or 0),
+                                  "weight": float(weight)}
+
+    def _quota_usage_locked(self, name: str) -> int:
+        groups = {g for g, q in self._group_quota.items() if q == name}
+        if not groups:
+            return 0
+        used_by_group: Dict[str, int] = {}
+        for c in self.consumers:
+            if c.group in groups:
+                used_by_group[c.group] = \
+                    used_by_group.get(c.group, 0) + c.mem_used
+        return sum(max(self._reservations.get(g, 0),
+                       used_by_group.get(g, 0)) for g in groups)
+
+    def quota_usage(self, name: str) -> int:
+        """Committed footprint of a quota: sum over its member groups of
+        max(admission reservation, live consumer usage) — mirrors how
+        ``headroom()`` charges each group."""
+        with self._mu:
+            return self._quota_usage_locked(name)
+
+    def quota_headroom(self, name: str) -> Optional[int]:
+        """Remaining bytes under a quota's cap; None when the quota is
+        unknown or uncapped (pool-wide headroom() is then the only limit).
+        May go negative when member queries overshoot their estimates."""
+        with self._mu:
+            q = self._quotas.get(name)
+            if not q or not q["max"]:
+                return None
+            return q["max"] - self._quota_usage_locked(name)
 
     def headroom(self) -> int:
         """Admittable bytes: total minus each group's committed footprint
@@ -263,6 +313,10 @@ class MemManager:
                 "mem_spill_time_ns": self.spill_time_ns,
                 "wait_count": self.wait_count,
                 "reservations": dict(self._reservations),
+                "quotas": {
+                    name: {**q, "used": self._quota_usage_locked(name)}
+                    for name, q in self._quotas.items()
+                },
                 "consumers": [
                     {"name": c.name, "mem_used": c.mem_used,
                      "spillable": c.spillable, "group": c.group}

@@ -309,6 +309,24 @@ class LineageRegistry:
         with self._mu:
             self._stages.clear()
 
+    def heal(self, stages: Iterable[int]) -> int:
+        """Proactive sweep for RESUMED queries: verify every committed map
+        output the named stages depend on and recompute the casualties
+        before any reader touches them. A query paused at a stage boundary
+        can sit for seconds while its worker dies or chaos eats a segment;
+        healing at resume keeps the loss out of the downstream stage's
+        fetch path (where it would still recover, but torn mid-stage).
+        Returns the number of maps recomputed."""
+        ran = 0
+        for s in sorted(set(stages)):
+            lineage = self.get(s)
+            if lineage is None:
+                continue  # stage never registered lineage (no map outputs)
+            missing = lineage.missing()
+            if missing:
+                ran += len(lineage.recompute(missing))
+        return ran
+
     def recover(self, exc: ShuffleOutputMissing, depth: int = 0):
         """Walk lineage and recompute the outputs ``exc`` names. When the
         recompute itself hits a missing UPSTREAM output (its input stage's

@@ -65,6 +65,13 @@ class MemSegmentRegistry:
         with self._mu:
             return sum(self._nbytes.values())
 
+    def stage_bytes(self, stages: Iterable[int]) -> int:
+        """Bytes held by the named stages' segments — what a paused query's
+        StageCursor is pinning in memory (serve preemption accounting)."""
+        keep = set(stages)
+        with self._mu:
+            return sum(n for k, n in self._nbytes.items() if k[0] in keep)
+
     def __len__(self) -> int:
         with self._mu:
             return len(self._segs)

@@ -47,6 +47,10 @@ from blaze_tpu.runtime.metrics import MetricNode
 from blaze_tpu.runtime.segments import (MemSegmentBlockProvider,
                                         MemSegmentRegistry)
 
+_TM_STAGE_RESUMES = get_registry().counter(
+    "blaze_serve_stage_resumes_total",
+    "stage boundaries replayed from a paused query's cursor "
+    "instead of recomputed")
 _TM_QUERIES = get_registry().counter(
     "blaze_session_queries_total", "queries finished, by terminal state")
 _TM_QUERY_SECS = get_registry().histogram(
@@ -120,6 +124,90 @@ class _BlockListProvider:
         return self.blocks
 
 
+class PauseToken:
+    """Cooperative pause request for a running query (the preemption
+    sibling of ``CancelToken``): the scheduler sets it, the lowering thread
+    honors it at its next stage-boundary commit by raising ``StagePaused``.
+    Requests between boundaries (or after the last one) are simply never
+    observed — a query with no stages left to commit just finishes."""
+
+    __slots__ = ("_event", "reason")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self.reason: Optional[str] = None
+
+    def request(self, reason: str = "preempted"):
+        self.reason = reason
+        self._event.set()
+
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+    def clear(self):
+        self._event.clear()
+
+
+class StageCursor:
+    """Committed progress of a paused query: the lowered replacement node
+    of every finished stage boundary (keyed by deterministic pre-order
+    boundary index) plus ownership of the pinned state those stages need to
+    stay readable — stage records, shuffle dirs, resource-map entries.
+    While a cursor holds them, ``_release_query`` never runs against them;
+    resume hands them to the new run's ``_QueryRun``, and
+    ``Session.discard_cursor`` releases them if the query is never resumed
+    (the shm/disk leak gates stay 0 either way).
+
+    Entries are ``(lowered_node, end_idx)``: ``end_idx`` is the boundary
+    counter AFTER the step completed, so replaying a step that contains
+    nested boundaries (skew join lowering its own subtrees) skips exactly
+    the indexes its subtree consumed and alignment survives."""
+
+    def __init__(self, qid: int, label: Optional[str] = None):
+        self.qid = qid
+        self.label = label
+        self.entries: Dict[int, tuple] = {}
+        self.stage_meta: Dict[int, dict] = {}
+        self.shuffle_dirs: List[str] = []
+        self.resource_ids: List[str] = []
+        self.pauses = 0
+
+    def adopt(self, qrun: "_QueryRun"):
+        """Take ownership of a pausing run's pinned stage state."""
+        self.stage_meta.update(qrun.stage_meta)
+        for d in qrun.shuffle_dirs:
+            if d not in self.shuffle_dirs:
+                self.shuffle_dirs.append(d)
+        for r in qrun.resource_ids:
+            if r not in self.resource_ids:
+                self.resource_ids.append(r)
+        qrun.stage_meta = {}
+        qrun.shuffle_dirs = []
+        qrun.resource_ids = []
+
+    def hand_to(self, qrun: "_QueryRun"):
+        """Transfer pinned state to a resuming run — from here on the run's
+        normal failure/cancel teardown covers it."""
+        qrun.stage_meta.update(self.stage_meta)
+        qrun.shuffle_dirs.extend(self.shuffle_dirs)
+        qrun.resource_ids.extend(self.resource_ids)
+        self.stage_meta = {}
+        self.shuffle_dirs = []
+        self.resource_ids = []
+
+
+class StagePaused(Exception):
+    """Raised by the lowering thread when a pause request is honored at a
+    stage-boundary commit; carries the cursor that now owns the query's
+    committed progress."""
+
+    def __init__(self, cursor: StageCursor):
+        self.cursor = cursor
+        super().__init__(
+            f"query {cursor.label or cursor.qid} paused at stage boundary "
+            f"({len(cursor.entries)} committed)")
+
+
 class _QueryRun:
     """Driver-side state of ONE executing query: its cancel token, its
     MemManager reservation group, and everything that must be torn down if
@@ -128,7 +216,8 @@ class _QueryRun:
     driver threads can't interleave each other's stages (re-entrancy)."""
 
     __slots__ = ("qid", "token", "mem_group", "label", "stage_meta",
-                 "shuffle_dirs", "resource_ids", "stats")
+                 "shuffle_dirs", "resource_ids", "stats", "cursor", "pause",
+                 "boundary_idx")
 
     def __init__(self, qid: int, token=None, mem_group: Optional[str] = None,
                  label: Optional[str] = None):
@@ -140,6 +229,9 @@ class _QueryRun:
         self.shuffle_dirs: List[str] = []
         self.resource_ids: List[str] = []
         self.stats = None  # obs.stats.StatsPlane when conf.stats_enabled
+        self.cursor: Optional[StageCursor] = None  # set for pausable runs
+        self.pause: Optional[PauseToken] = None
+        self.boundary_idx = 0  # pre-order stage-boundary counter
 
 
 class Session:
@@ -255,7 +347,10 @@ class Session:
                 cancel_token=None,
                 mem_group: Optional[str] = None,
                 release_on_finish: bool = False,
-                label: Optional[str] = None) -> Iterator[ColumnarBatch]:
+                label: Optional[str] = None,
+                cursor: Optional[StageCursor] = None,
+                pause_token: Optional[PauseToken] = None
+                ) -> Iterator[ColumnarBatch]:
         """Run a plan, yielding all result batches (final-stage partitions in
         order). Partitions execute concurrently on the task pool — device
         round-trip latency overlaps — while batches are yielded in partition
@@ -268,12 +363,33 @@ class Session:
         ``mem_group``: MemManager reservation group for every consumer this
         query registers (per-query fair share). ``release_on_finish``: drop
         the query's shuffle dirs and resources as soon as it finishes instead
-        of at session close — what a long-lived serving session needs."""
+        of at session close — what a long-lived serving session needs.
+
+        ``pause_token``: makes the run PREEMPTIBLE — when the token is set,
+        the lowering thread raises ``StagePaused`` at its next stage-boundary
+        commit; the raised cursor owns all committed progress (pinned shuffle
+        segments, stage records) and can be passed back as ``cursor`` to
+        resume without recomputing finished stages (or released via
+        ``discard_cursor``)."""
         from blaze_tpu.ops.base import QueryCancelled, TaskCancelled
         from blaze_tpu.utils.logutil import clear_task_context, set_task_context
 
         qid = next(self._query_ids)
         qrun = _QueryRun(qid, cancel_token, mem_group, label)
+        qrun.pause = pause_token
+        if cursor is not None:
+            # resuming run: re-adopt the pinned stage state FIRST so every
+            # failure/cancel path from here releases it (no orphaned pins),
+            # then proactively heal any committed map output lost while
+            # paused (worker death, chaos) instead of letting a downstream
+            # fetch discover the hole mid-stage
+            qrun.cursor = cursor
+            cursor.hand_to(qrun)
+            healed = self._lineage.heal(qrun.stage_meta.keys())
+            if healed:
+                self.metrics.add("resume_maps_healed", healed)
+        elif pause_token is not None:
+            qrun.cursor = StageCursor(qid, label)
         t0 = time.perf_counter_ns()
         query = {
             "id": qid,
@@ -311,7 +427,11 @@ class Session:
                 self.inflight.pop(qid, None)
                 self.query_log.append(query)
                 del self.query_log[:-self._QUERY_LOG_MAX]
-            if state != "done" or release_on_finish:
+            if state == "paused":
+                # the cursor adopted the pinned stage state; releasing here
+                # would delete shuffle outputs the resume depends on
+                pass
+            elif state != "done" or release_on_finish:
                 self._release_query(qrun)
             _TM_QUERIES.labels(state=state).inc()
             _TM_QUERY_SECS.labels(state=state).observe(dur_ns / 1e9)
@@ -323,7 +443,8 @@ class Session:
             # flight-recorder dump for direct (non-serve) failures; serve
             # queries get richer bundles from QueryScheduler (which adds its
             # own snapshot), so skip those here to avoid double bundles
-            if state != "done" and not (mem_group or "").startswith("serve_"):
+            if state not in ("done", "paused") and \
+                    not (mem_group or "").startswith("serve_"):
                 from blaze_tpu.obs import dump as _dump
 
                 _dump.record_incident(state, label or f"query_{qid}",
@@ -369,7 +490,15 @@ class Session:
             where = self._decide_placement(lowered, "result")
         except BaseException as exc:
             err_holder[0] = exc
-            finish_query(0, classify(exc))
+            if isinstance(exc, StagePaused):
+                # ownership of committed stages moves run -> cursor; the
+                # caller (scheduler) re-enqueues the cursor and releases the
+                # memory group/slot itself
+                exc.cursor.adopt(qrun)
+                exc.cursor.pauses += 1
+                finish_query(0, "paused")
+            else:
+                finish_query(0, classify(exc))
             raise
 
         def run_partition_stream(p: int):
@@ -558,6 +687,18 @@ class Session:
                 if leaked:
                     self.metrics.add("query_leaked_mem_reclaimed", leaked)
 
+    def discard_cursor(self, cursor: Optional[StageCursor]):
+        """Release a paused query's pinned stage state without resuming it
+        (scheduler close / shed / cancel of a paused query) — the shm and
+        disk leak gates treat an abandoned cursor exactly like a finished
+        query."""
+        if cursor is None:
+            return
+        dummy = _QueryRun(cursor.qid, None, None, cursor.label)
+        cursor.hand_to(dummy)
+        cursor.entries.clear()
+        self._release_query(dummy)
+
     @staticmethod
     def _unlink_degraded_outputs(shuffle_dir: str):
         """Map outputs that degraded off a filling shm root live in the
@@ -680,12 +821,44 @@ class Session:
             return "shm"
         return "process"
 
+    def _boundary(self, fn, node: N.PlanNode):
+        """Run one stage-boundary lowering step through the query's stage
+        cursor (when the run is preemptible; a plain run pays one attribute
+        read). A resumed query replays the recorded replacement node instead
+        of re-running the stage; a pause request is honored only AFTER the
+        step commits — its outputs are pinned by the cursor, never torn
+        mid-stage. Boundary indexes are assigned pre-order on entry and
+        entries record the counter at completion, so nested boundaries
+        (skew join) replay with correct alignment."""
+        qrun = getattr(self._tls, "qrun", None)
+        cursor = qrun.cursor if qrun is not None else None
+        if cursor is None:
+            return fn(node)
+        idx = qrun.boundary_idx
+        qrun.boundary_idx += 1
+        if idx in cursor.entries:
+            out, end_idx = cursor.entries[idx]
+            qrun.boundary_idx = end_idx  # skip the subtree's indexes too
+            if out is not None:
+                self.metrics.add("stages_resumed_from_cursor", 1)
+                _TM_STAGE_RESUMES.inc()
+            return out
+        out = fn(node)
+        cursor.entries[idx] = (out, qrun.boundary_idx)
+        if out is not None and qrun.pause is not None \
+                and qrun.pause.requested():
+            from blaze_tpu.runtime.failpoints import failpoint
+
+            failpoint("serve.preempt")
+            raise StagePaused(cursor)
+        return out
+
     def _lower(self, node: N.PlanNode) -> N.PlanNode:
         self._check_op_enabled(node)
         if isinstance(node, N.SortMergeJoin) and self.conf.skew_join_enable \
                 and self.mesh is None and self.rss_sock_path is None \
                 and getattr(self._tls, "dist_ok", True):
-            out = self._try_skew_join(node)
+            out = self._boundary(self._try_skew_join, node)
             if out is not None:
                 return out
         # lowering recursion state lives on the thread, not the session:
@@ -707,25 +880,28 @@ class Session:
             # twice for nothing (a full-fact global sort pays seconds here)
             node = dataclasses.replace(node, child=node.child.child)
         if isinstance(node, N.ShuffleExchange):
-            if isinstance(node.partitioning, N.RangePartitioning) and \
-                    not node.partitioning.bounds and \
-                    node.partitioning.num_partitions > 1:
-                # driver-side bound sampling (reference: reservoir sampling in
-                # NativeShuffleExchangeBase.scala:211-246 shipping bounds as
-                # literals): sample the child once, derive per-reducer bounds
-                node = dataclasses.replace(
-                    node, partitioning=self._sample_range_bounds(node))
-            # reducer counts beyond the mesh size group G = ceil(R/n)
-            # reducers per device (parallel/mesh.py), so any partitioning
-            # lowers onto the collective
-            if self.mesh is not None:
-                return self._run_mesh_exchange(node)
-            if self.rss_sock_path is not None:
-                return self._run_rss_map_stage(node)
-            return self._run_shuffle_map_stage(node)
+            return self._boundary(self._lower_shuffle_exchange, node)
         if isinstance(node, N.BroadcastExchange):
-            return self._run_broadcast_collect(node)
+            return self._boundary(self._run_broadcast_collect, node)
         return node
+
+    def _lower_shuffle_exchange(self, node: N.ShuffleExchange) -> N.PlanNode:
+        if isinstance(node.partitioning, N.RangePartitioning) and \
+                not node.partitioning.bounds and \
+                node.partitioning.num_partitions > 1:
+            # driver-side bound sampling (reference: reservoir sampling in
+            # NativeShuffleExchangeBase.scala:211-246 shipping bounds as
+            # literals): sample the child once, derive per-reducer bounds
+            node = dataclasses.replace(
+                node, partitioning=self._sample_range_bounds(node))
+        # reducer counts beyond the mesh size group G = ceil(R/n)
+        # reducers per device (parallel/mesh.py), so any partitioning
+        # lowers onto the collective
+        if self.mesh is not None:
+            return self._run_mesh_exchange(node)
+        if self.rss_sock_path is not None:
+            return self._run_rss_map_stage(node)
+        return self._run_shuffle_map_stage(node)
 
     @staticmethod
     def _child_zip_ok(node: N.PlanNode, own_zip_ok: bool) -> bool:

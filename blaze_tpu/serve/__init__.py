@@ -1,16 +1,20 @@
-"""Query serving layer: concurrent scheduler with admission control,
-deadlines, cancellation, and per-query memory budgets (serve/scheduler.py).
+"""Query serving layer: weighted-fair multi-tenant scheduler with
+admission control, stage-boundary preemption, deadlines, cancellation, and
+per-query memory budgets (serve/scheduler.py).
 
 The reference delegates multi-query scheduling to Spark's scheduler + YARN
 admission; a standalone driver needs its own. ``QueryScheduler`` accepts
-plans from many client threads, runs up to ``serve_max_concurrent`` at
-once, arbitrates the rest with a priority queue plus MemManager-headroom
-admission, and sheds excess load with a typed ``Overloaded`` error.
+plans from many client threads, arbitrates per-tenant weighted-fair queues
+with MemManager-headroom admission and per-tenant quotas, pauses long
+queries at stage boundaries to let latecomers through, and converts
+overload into ``Backpressure`` (retry with Retry-After) or the typed
+``Overloaded`` shed error.
 """
 
-from blaze_tpu.serve.scheduler import (Overloaded, QueryHandle,
-                                       QueryRetryable, QueryScheduler,
+from blaze_tpu.serve.scheduler import (Backpressure, Overloaded,
+                                       QueryHandle, QueryRetryable,
+                                       QueryScheduler,
                                        estimate_plan_memory)
 
-__all__ = ["Overloaded", "QueryHandle", "QueryRetryable", "QueryScheduler",
-           "estimate_plan_memory"]
+__all__ = ["Backpressure", "Overloaded", "QueryHandle", "QueryRetryable",
+           "QueryScheduler", "estimate_plan_memory"]

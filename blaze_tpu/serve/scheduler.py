@@ -1,4 +1,4 @@
-"""Concurrent query scheduler: admission control, deadlines, cancellation.
+"""Concurrent query scheduler: weighted-fair tenants, preemption, admission.
 
 The reference hands multi-query scheduling to Spark's scheduler (slots via
 executor cores, admission via YARN queues, cancellation via task kill
@@ -6,23 +6,46 @@ through the JNI ``is_task_running`` flag). The standalone driver has
 nothing in that role, so this module provides it natively:
 
 - ``QueryScheduler.submit`` accepts a plan from any client thread and
-  returns a ``QueryHandle``; up to ``serve_max_concurrent`` queries run at
-  once and the rest wait in a priority queue.
+  returns a ``QueryHandle``. Queries queue PER TENANT and dispatch in
+  virtual-time weighted-fair order: each query is stamped
+  ``vfinish = max(V, tenant.last_vfinish) + cost / tenant.weight`` at
+  submit and the smallest ``vfinish`` among tenant queue heads is admitted
+  next — a flooding tenant advances its own virtual clock far ahead and
+  cannot starve light ones. With a single tenant this reduces exactly to
+  the old priority-heap order. Per-tenant concurrency and memory quotas
+  (named MemManager quota groups) bound what any one tenant can hold.
 - Admission is MEMORY-based: a query is admitted only when the
   ``MemManager``'s headroom covers its estimated footprint
-  (``estimate_plan_memory`` walks the plan for stateful operators). The
-  estimate is reserved as a per-query group at admission, so concurrent
-  admissions cannot double-book headroom — graceful degradation instead of
-  OOM (Sparkle, arxiv 1708.05746, on cross-query memory arbitration).
-- Overload sheds: a full queue rejects at submit; a queued query that
-  waits past ``serve_queue_timeout_s`` is shed by the dispatcher — both
-  with the typed ``Overloaded`` error ("Accelerating Presto with GPUs",
-  arxiv 2606.24647, on explicit concurrency slots + load shedding for
-  bounded tail latency).
+  (``estimate_plan_memory`` walks the plan for stateful operators; the
+  fingerprint-keyed profile store refines the estimate from observed stage
+  bytes when the same plan shape ran before). The estimate is reserved as
+  a per-query group at admission, so concurrent admissions cannot
+  double-book headroom — graceful degradation instead of OOM (Sparkle,
+  arxiv 1708.05746, on cross-query memory arbitration). Without an
+  explicit ``max_concurrent`` the slot count is ADAPTIVE: concurrency
+  floats up to ``serve_adaptive_max_concurrent`` with headroom doing the
+  real gating, instead of a fixed ``serve_max_concurrent``.
+- Overload turns into BACKPRESSURE, not loss: a full queue raises
+  ``Backpressure`` (HTTP 429) carrying a Retry-After computed from the
+  observed drain rate, so clients retry instead of losing work; a queued
+  query past ``serve_queue_timeout_s`` and a tenant-quota violation still
+  shed with the typed ``Overloaded`` error ("Accelerating Presto with
+  GPUs", arxiv 2606.24647, on explicit concurrency slots + load shedding
+  for bounded tail latency).
+- Long queries are PREEMPTIBLE at stage boundaries: when the weighted-fair
+  head has waited past ``serve_preempt_after_s`` behind a full house, the
+  dispatcher asks the furthest-behind running victim to pause. The session
+  honors the request at its next stage-boundary commit (``StagePaused``),
+  the query's memory group and slot are released while its committed
+  shuffle segments stay pinned behind a ``StageCursor``, and the query
+  re-enters its tenant queue; resume replays the cursor without
+  recomputing finished stages.
 - Every handle carries a ``CancelToken`` (client cancel and/or deadline)
   that Session stage execution, operator batch loops, and the WorkerPool
   scheduling loop all poll; cancellation stops map stages mid-flight and
-  ``Session._release_query`` reclaims shuffle dirs + the memory group.
+  ``Session._release_query`` reclaims shuffle dirs + the memory group
+  (``Session.discard_cursor`` does the same for paused queries that are
+  shed or cancelled before resuming).
 """
 
 from __future__ import annotations
@@ -33,8 +56,9 @@ import itertools
 import random
 import threading
 import time
+import zlib
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import pyarrow as pa
 
@@ -43,15 +67,29 @@ from blaze_tpu.ir import types as T
 from blaze_tpu.obs.telemetry import get_registry
 from blaze_tpu.ops.base import CancelToken, QueryCancelled, TaskCancelled
 from blaze_tpu.runtime.memmgr import MemManager
+from blaze_tpu.runtime.session import PauseToken, StageCursor, StagePaused
 
 
 class Overloaded(RuntimeError):
     """Typed load-shed error: the scheduler refused or dropped the query to
-    protect queries already running (full queue, queue timeout, shutdown)."""
+    protect queries already running (full queue, queue timeout, tenant
+    quota, shutdown)."""
 
     def __init__(self, reason: str):
         super().__init__(reason)
         self.reason = reason
+
+
+class Backpressure(Overloaded):
+    """Full-queue rejection that is RETRYABLE BY DESIGN: the server is
+    draining, just not fast enough for this arrival. Carries the seconds a
+    client should wait before resubmitting (computed from the observed
+    completion rate); the HTTP layer maps it to 429 + Retry-After.
+    Subclasses ``Overloaded`` so existing clients keep working."""
+
+    def __init__(self, reason: str, retry_after_s: float):
+        super().__init__(reason)
+        self.retry_after_s = retry_after_s
 
 
 class QueryRetryable(RuntimeError):
@@ -98,15 +136,70 @@ def estimate_plan_memory(plan: N.PlanNode, conf=None,
     return max(floor, n * 4 * conf.suggested_batch_mem_size)
 
 
+def parse_tenants(spec: str, default_weight: float) -> Dict[str, tuple]:
+    """``serve_tenants`` grammar: ';'-separated
+    ``name:weight[:max_concurrent[:mem_quota_mb]]`` entries; empty fields
+    fall back to defaults (weight) or no cap (concurrency/quota)."""
+    out: Dict[str, tuple] = {}
+    for entry in (spec or "").split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        name = parts[0].strip()
+        weight = float(parts[1]) if len(parts) > 1 and parts[1] \
+            else default_weight
+        maxc = int(parts[2]) if len(parts) > 2 and parts[2] else None
+        quota = int(float(parts[3]) * (1 << 20)) \
+            if len(parts) > 3 and parts[3] else None
+        out[name] = (weight, maxc, quota)
+    return out
+
+
+class _Tenant:
+    """One tenant's scheduling state: its FIFO-within-priority queue, its
+    virtual-finish clock, and its caps."""
+
+    __slots__ = ("name", "weight", "max_concurrent", "mem_quota", "heap",
+                 "last_vfinish", "running", "submitted", "admitted")
+
+    def __init__(self, name: str, weight: float,
+                 max_concurrent: Optional[int] = None,
+                 mem_quota: Optional[int] = None):
+        self.name = name
+        self.weight = max(weight, 1e-6)
+        self.max_concurrent = max_concurrent
+        self.mem_quota = mem_quota
+        self.heap: List[tuple] = []  # (-priority, seq, handle)
+        self.last_vfinish = 0.0
+        self.running = 0
+        self.submitted = 0
+        self.admitted = 0
+
+    def quota_name(self) -> str:
+        return f"tenant_{self.name}"
+
+    def snapshot(self) -> dict:
+        return {"name": self.name, "weight": self.weight,
+                "max_concurrent": self.max_concurrent,
+                "mem_quota": self.mem_quota, "queued": len(self.heap),
+                "running": self.running, "submitted": self.submitted,
+                "admitted": self.admitted,
+                "last_vfinish": round(self.last_vfinish, 6)}
+
+
 class QueryHandle:
     """One submission's lifetime: queued -> admitted -> running ->
-    done | failed | cancelled, or queued -> shed. ``result()`` blocks for
-    the outcome; ``cancel()`` flips the token the whole execution polls."""
+    done | failed | cancelled, or queued -> shed, with optional
+    running -> paused -> queued loops in between (stage-boundary
+    preemption). ``result()`` blocks for the outcome; ``cancel()`` flips
+    the token the whole execution polls."""
 
     def __init__(self, scheduler: "QueryScheduler", qid: int,
                  plan: N.PlanNode, priority: int,
                  deadline_s: Optional[float], mem_estimate: int,
-                 label: Optional[str]):
+                 label: Optional[str], tenant: str = "default",
+                 preemptible: bool = False):
         self.scheduler = scheduler
         self.qid = qid
         self.plan = plan
@@ -114,6 +207,7 @@ class QueryHandle:
         self.deadline_s = deadline_s
         self.mem_estimate = mem_estimate
         self.label = label or f"query_{qid}"
+        self.tenant = tenant
         self.submitted_at = time.monotonic()
         self.token = CancelToken(
             deadline=(self.submitted_at + deadline_s)
@@ -129,6 +223,16 @@ class QueryHandle:
         # in-scheduler auto-retry history: one record per transparent
         # re-execution after a worker-loss failure
         self.retries: List[dict] = []
+        # stage-boundary preemption state
+        self.preemptible = preemptible
+        self.pause: Optional[PauseToken] = PauseToken() if preemptible \
+            else None
+        self.cursor: Optional[StageCursor] = None
+        self.preempt_count = 0
+        # weighted-fair tags (re-stamped on every (re-)enqueue)
+        self.cost = 1.0
+        self.vstart = 0.0
+        self.vfinish = 0.0
 
     def cancel(self, reason: str = "cancelled by client"):
         self.token.cancel(reason)
@@ -151,11 +255,14 @@ class QueryHandle:
     def snapshot(self) -> dict:
         now = time.monotonic()
         d = {"qid": self.qid, "label": self.label, "state": self.state,
-             "priority": self.priority, "mem_estimate": self.mem_estimate,
+             "tenant": self.tenant, "priority": self.priority,
+             "mem_estimate": self.mem_estimate,
              "deadline_s": self.deadline_s,
              "elapsed_s": round(now - self.submitted_at, 3)}
         if self.admitted_at is not None:
             d["run_s"] = round((self.finished_at or now) - self.admitted_at, 3)
+        if self.preempt_count:
+            d["preempt_count"] = self.preempt_count
         if self.error is not None:
             d["error"] = f"{type(self.error).__name__}: {self.error}"
         if self.table is not None:
@@ -167,11 +274,15 @@ class QueryHandle:
 
 
 class QueryScheduler:
-    """Priority queue + concurrency slots + memory admission in front of one
-    ``Session``. Thread-safe: submit/cancel/status from any thread; a
-    dispatcher thread admits and sheds; queries run on a bounded executor."""
+    """Weighted-fair tenant queues + concurrency slots + memory admission in
+    front of one ``Session``. Thread-safe: submit/cancel/status from any
+    thread; a dispatcher thread admits, sheds, and preempts; queries run on
+    a bounded executor. ``max_queue`` bounds each tenant's backlog
+    individually (door-level isolation: one tenant's flood never fills
+    another tenant's doorway)."""
 
     _FINISHED_KEEP = 512  # finished handles retained for /serve/status
+    _DRAIN_WINDOW = 64    # completion timestamps kept for Retry-After
 
     def __init__(self, session, max_concurrent: Optional[int] = None,
                  max_queue: Optional[int] = None,
@@ -179,7 +290,18 @@ class QueryScheduler:
                  default_mem_estimate: Optional[int] = None):
         conf = session.conf
         self.session = session
-        self.max_concurrent = max_concurrent or conf.serve_max_concurrent
+        # explicit max_concurrent pins a fixed slot count (tests, ops
+        # overrides); None + serve_adaptive_admission floats concurrency up
+        # to the adaptive ceiling with memory headroom doing the gating
+        if max_concurrent is not None:
+            self.max_concurrent = max_concurrent
+            self.adaptive = False
+        elif conf.serve_adaptive_admission:
+            self.max_concurrent = conf.serve_adaptive_max_concurrent
+            self.adaptive = True
+        else:
+            self.max_concurrent = conf.serve_max_concurrent
+            self.adaptive = False
         self.max_queue = max_queue or conf.serve_max_queue
         self.queue_timeout_s = queue_timeout_s if queue_timeout_s is not None \
             else conf.serve_queue_timeout_s
@@ -189,33 +311,56 @@ class QueryScheduler:
         self._seq = itertools.count()  # FIFO tie-break within a priority
         self._mu = threading.Lock()
         self._cv = threading.Condition(self._mu)
-        self._queue: List[tuple] = []  # heap of (-priority, seq, handle)
+        self._tenants: Dict[str, _Tenant] = {}
+        self._vtime = 0.0  # weighted-fair virtual clock
         self._running: Dict[int, QueryHandle] = {}
         self._handles: Dict[int, QueryHandle] = {}
         self._finished: "collections.deque" = collections.deque()
+        self._drain: "collections.deque" = collections.deque(
+            maxlen=self._DRAIN_WINDOW)
         self._closed = False
         self.peak_inflight = 0
         self.metrics = session.metrics.named_child("serve")
+        mm = MemManager.get_or_init(conf)
+        for name, (w, maxc, quota) in parse_tenants(
+                conf.serve_tenants, conf.serve_tenant_default_weight).items():
+            t = _Tenant(name, w, maxc, quota)
+            self._tenants[name] = t
+            mm.set_quota(t.quota_name(), quota, w)
         # SLO instruments (the continuous fleet view next to the per-query
         # MetricNode tree). blaze_serve_rejected_total counts door sheds
-        # (submit-time Overloaded, one per ATTEMPT — no QueryHandle exists);
-        # blaze_serve_queries_total counts terminal outcomes of accepted
-        # queries (done / failed / cancelled / deadline / shed-from-queue),
-        # so the two reconcile exactly against a client-side tally.
+        # (submit-time Overloaded/Backpressure, one per ATTEMPT — no
+        # QueryHandle exists); blaze_serve_queries_total counts terminal
+        # outcomes of accepted queries (done / failed / cancelled /
+        # deadline / shed-from-queue), so the two reconcile exactly against
+        # a client-side tally. blaze_serve_sheds_total is the shed-REASON
+        # breakdown (queue_full / queue_timeout / quota / closed) across
+        # both kinds, split by tenant.
         reg = get_registry()
         self._tm_queries = reg.counter(
             "blaze_serve_queries_total",
-            "accepted queries by terminal outcome")
+            "accepted queries by terminal outcome and tenant")
         self._tm_rejected = reg.counter(
             "blaze_serve_rejected_total",
             "submit-time rejections (no handle created), by reason")
+        self._tm_sheds = reg.counter(
+            "blaze_serve_sheds_total",
+            "load sheds by reason (queue_full/queue_timeout/quota/closed) "
+            "and tenant, door rejections and queue drops combined")
+        self._tm_backpressure = reg.counter(
+            "blaze_serve_backpressure_total",
+            "full-queue arrivals answered with Backpressure/Retry-After "
+            "(HTTP 429) instead of a hard shed, by tenant")
+        self._tm_preempted = reg.counter(
+            "blaze_serve_preempted_total",
+            "stage-boundary pauses honored by running queries, by tenant")
         self._tm_retries = reg.counter(
             "blaze_serve_retries_total",
             "transparent in-scheduler re-executions after worker-loss "
             "failures (the client never saw these attempts fail)")
         self._tm_queue_wait = reg.histogram(
             "blaze_serve_queue_wait_seconds",
-            "submit-to-admission wait of admitted queries")
+            "submit-to-first-admission wait of admitted queries, by tenant")
         self._tm_run = reg.histogram(
             "blaze_serve_run_seconds",
             "admission-to-terminal wall time")
@@ -224,7 +369,7 @@ class QueryScheduler:
             "submit-to-terminal wall time, by outcome")
         reg.gauge("blaze_serve_queue_depth_count",
                   "queries waiting for admission").set_function(
-            lambda: len(self._queue))
+            lambda: sum(len(t.heap) for t in list(self._tenants.values())))
         reg.gauge("blaze_serve_inflight_count",
                   "queries admitted and not yet terminal").set_function(
             lambda: len(self._running))
@@ -240,31 +385,74 @@ class QueryScheduler:
     def submit(self, plan: N.PlanNode, priority: int = 0,
                deadline_s: Optional[float] = None,
                mem_estimate: Optional[int] = None,
-               label: Optional[str] = None) -> QueryHandle:
+               label: Optional[str] = None,
+               tenant: Optional[str] = None,
+               preemptible: bool = True) -> QueryHandle:
         """Enqueue a plan; returns immediately with a QueryHandle. Raises
-        ``Overloaded`` right here when the queue is full or the scheduler is
-        shut down (shedding at the door keeps the queue a bound, not a
-        buffer)."""
+        ``Overloaded`` right here when the scheduler is shut down or the
+        estimate exceeds the tenant's memory quota, and ``Backpressure``
+        (``Overloaded`` with a Retry-After) when THIS tenant's queue is
+        full — shedding at the door keeps the queue a bound, not a
+        buffer, and per-tenant bounds keep one tenant's flood out of
+        every other tenant's doorway."""
+        conf = self.session.conf
+        tname = tenant or "default"
+        mem_explicit = mem_estimate is not None
+        cost = None
         if mem_estimate is None:
             mem_estimate = estimate_plan_memory(
-                plan, self.session.conf, self.default_mem_estimate)
+                plan, conf, self.default_mem_estimate)
+            hint_mem, cost = self._profile_hints(plan)
+            if hint_mem is not None:
+                # profiles only SHRINK the plan-walk estimate (observed
+                # stage bytes beat operator counting); the floor keeps a
+                # tiny profile from starving the query of working memory
+                mem_estimate = max(4 * conf.suggested_batch_mem_size,
+                                   min(mem_estimate, hint_mem))
+        else:
+            _, cost = self._profile_hints(plan)
         with self._cv:
+            t = self._tenant_locked(tname)
             if self._closed:
                 self.metrics.add("queries_shed", 1)
-                self._tm_rejected.labels(reason="closed").inc()
+                self._count_shed_locked("closed", tname, door=True)
                 raise Overloaded("scheduler closed")
-            if len(self._queue) >= self.max_queue:
+            if t.mem_quota and mem_estimate > t.mem_quota:
                 self.metrics.add("queries_shed", 1)
-                self._tm_rejected.labels(reason="queue_full").inc()
+                self._count_shed_locked("quota", tname, door=True)
+                self._log_terminal(None, label or "query", "shed",
+                                   "over tenant mem quota", 0.0)
+                raise Overloaded(
+                    f"estimate {mem_estimate} over tenant {tname!r} "
+                    f"mem quota {t.mem_quota}")
+            # max_queue bounds EACH TENANT's backlog, not the union: a
+            # flooding tenant fills its own queue and eats its own 429s
+            # while a light tenant's next query still walks straight in —
+            # door-level isolation to match the WFQ admission behind it
+            if len(t.heap) >= self.max_queue:
+                self.metrics.add("queries_shed", 1)
+                self._count_shed_locked("queue_full", tname, door=True)
                 self._log_terminal(None, label or "query", "shed",
                                    "queue full", 0.0)
+                if conf.serve_backpressure_enable:
+                    retry_after = self._retry_after_locked()
+                    self.metrics.add("queries_backpressured", 1)
+                    self._tm_backpressure.labels(tenant=tname).inc()
+                    raise Backpressure(
+                        f"queue full ({self.max_queue} queries waiting), "
+                        f"retry in {retry_after:.2f}s", retry_after)
                 raise Overloaded(
                     f"queue full ({self.max_queue} queries waiting)")
             qid = next(self._ids)
-            h = QueryHandle(self, qid, plan, priority, deadline_s,
-                            mem_estimate, label)
+            h = QueryHandle(
+                self, qid, plan, priority, deadline_s, mem_estimate, label,
+                tenant=tname,
+                preemptible=preemptible and conf.serve_preempt_enable)
+            h.cost = cost if cost else 1.0
+            self._stamp_wfq_locked(t, h)
+            t.submitted += 1
             self._handles[qid] = h
-            heapq.heappush(self._queue, (-priority, next(self._seq), h))
+            heapq.heappush(t.heap, (-priority, next(self._seq), h))
             self.metrics.add("queries_submitted", 1)
             self._cv.notify_all()
         return h
@@ -284,6 +472,19 @@ class QueryScheduler:
             self._cv.notify_all()  # wake the dispatcher to reap queued ones
         return True
 
+    def preempt(self, qid: int, reason: str = "preempted by operator") -> bool:
+        """Ask a running preemptible query to pause at its next stage
+        boundary (explicit/operator-driven preemption; the dispatcher's
+        policy preemption uses the same mechanism). Returns False when the
+        query is not running or not preemptible."""
+        with self._mu:
+            h = self._running.get(qid)
+            if h is None or h.pause is None:
+                return False
+            h.pause.request(reason)
+            self.metrics.add("preempt_requested", 1)
+        return True
+
     def snapshot(self) -> dict:
         """Live view for /serve/queries and /debug/queries."""
         with self._mu:
@@ -292,22 +493,32 @@ class QueryScheduler:
     def _snapshot_locked(self) -> dict:
         # split out so incident recording (already under _mu/_cv — a plain
         # Lock, NOT reentrant) can build the same view without deadlocking
-        queued = [item[2].snapshot() for item in sorted(self._queue)]
+        queued = [item[2].snapshot()
+                  for t in sorted(self._tenants.values(),
+                                  key=lambda t: t.name)
+                  for item in sorted(t.heap)]
         running = [h.snapshot() for h in self._running.values()]
         return {"max_concurrent": self.max_concurrent,
+                "adaptive": self.adaptive,
                 "max_queue": self.max_queue,
                 "peak_inflight": self.peak_inflight,
+                "vtime": round(self._vtime, 6),
+                "tenants": [t.snapshot()
+                            for t in sorted(self._tenants.values(),
+                                            key=lambda t: t.name)],
                 "queued": queued, "running": running}
 
     def close(self, cancel_running: bool = True, timeout: float = 30.0):
-        """Shut down: shed everything queued, optionally cancel everything
-        running, wait for the dispatcher and executor to drain."""
+        """Shut down: shed everything queued (releasing any paused query's
+        pinned stage state), optionally cancel everything running, wait for
+        the dispatcher and executor to drain."""
         with self._cv:
             self._closed = True
-            while self._queue:
-                _, _, h = heapq.heappop(self._queue)
-                self._finish_unstarted_locked(h, "shed",
-                                              Overloaded("scheduler closed"))
+            for t in self._tenants.values():
+                while t.heap:
+                    _, _, h = heapq.heappop(t.heap)
+                    self._finish_unstarted_locked(
+                        h, "shed", Overloaded("scheduler closed"))
             if cancel_running:
                 for h in list(self._running.values()):
                     h.token.cancel("scheduler closed")
@@ -324,66 +535,250 @@ class QueryScheduler:
         self.close()
         return False
 
+    # -- tenants / weighted-fair bookkeeping ----------------------------------
+
+    def _tenant_locked(self, name: str) -> _Tenant:
+        t = self._tenants.get(name)
+        if t is None:
+            conf = self.session.conf
+            t = _Tenant(name, conf.serve_tenant_default_weight)
+            self._tenants[name] = t
+            mm = MemManager._instance
+            if mm is not None:
+                mm.set_quota(t.quota_name(), None, t.weight)
+        return t
+
+    def _stamp_wfq_locked(self, t: _Tenant, h: QueryHandle):
+        """Virtual-time WFQ tag: a tenant's queries finish (in virtual
+        time) cost/weight apart, so heavier tenants pack more queries per
+        unit of virtual time and the min-vfinish dispatch order interleaves
+        tenants proportionally to weight."""
+        h.vstart = max(self._vtime, t.last_vfinish)
+        h.vfinish = h.vstart + max(h.cost, 1e-3) / t.weight
+        t.last_vfinish = h.vfinish
+
+    def _queue_len_locked(self) -> int:
+        return sum(len(t.heap) for t in self._tenants.values())
+
+    def _count_shed_locked(self, reason: str, tenant: str, door: bool):
+        self._tm_sheds.labels(reason=reason, tenant=tenant).inc()
+        if door:
+            self._tm_rejected.labels(reason=reason, tenant=tenant).inc()
+
+    def _retry_after_locked(self) -> float:
+        """Retry-After from the observed drain rate: roughly the time one
+        queue slot takes to free, clamped to sane bounds (a cold scheduler
+        with no completions yet answers 1s)."""
+        conf = self.session.conf
+        d = self._drain
+        rate = 0.0
+        if len(d) >= 2:
+            span = d[-1] - d[0]
+            if span > 0:
+                rate = (len(d) - 1) / span
+        retry_after = (1.0 / rate) if rate > 0 else 1.0
+        return min(max(retry_after, 0.25), conf.serve_retry_after_max_s)
+
+    def _profile_hints(self, plan) -> Tuple[Optional[int], Optional[float]]:
+        """(refined mem estimate, runtime cost) from the last observed
+        profile of this plan shape (session in-memory store only — submit
+        must stay cheap). Memory refines from peak stage bytes, but never
+        when the shape spilled (its real footprint exceeded what it got);
+        cost is the observed wall_s feeding the WFQ virtual clock."""
+        try:
+            from blaze_tpu.obs.stats import plan_fingerprint
+
+            prof = self.session.profiles.get(plan_fingerprint(plan))
+        except Exception:
+            return None, None
+        if not prof:
+            return None, None
+        cost = None
+        wall = prof.get("wall_s")
+        if wall:
+            cost = float(wall)
+        mem = None
+        try:
+            spills = prof.get("spills") or {}
+            spilled = int(spills.get("spill_count") or 0) \
+                + int(spills.get("mem_spill_count") or 0)
+            peak = max((int(s.get("total_bytes") or 0)
+                        for s in (prof.get("stages") or [])), default=0)
+            if peak > 0 and not spilled:
+                mem = 2 * peak
+        except Exception:
+            mem = None
+        return mem, cost
+
     # -- dispatcher -----------------------------------------------------------
 
     def _dispatch_loop(self):
         while True:
             with self._cv:
-                if self._closed and not self._queue and not self._running:
+                if self._closed and not self._queue_len_locked() \
+                        and not self._running:
                     return
                 self._shed_expired_locked()
                 self._admit_locked()
+                self._maybe_preempt_locked()
                 self._cv.wait(timeout=0.05)
 
     def _shed_expired_locked(self):
         now = time.monotonic()
-        keep = []
-        for item in self._queue:
-            h = item[2]
-            if h.token.cancelled:  # client cancel / deadline while queued
-                self._finish_unstarted_locked(
-                    h, "cancelled",
-                    QueryCancelled(h.token.reason or "cancelled"))
-            elif now - h.submitted_at > self.queue_timeout_s:
-                self.metrics.add("queries_shed", 1)
-                self._finish_unstarted_locked(
-                    h, "shed",
-                    Overloaded(f"queued {now - h.submitted_at:.1f}s > "
-                               f"queue timeout {self.queue_timeout_s}s"))
-            else:
-                keep.append(item)
-        if len(keep) != len(self._queue):
-            self._queue[:] = keep
-            heapq.heapify(self._queue)
+        for t in self._tenants.values():
+            if not t.heap:
+                continue
+            keep = []
+            for item in t.heap:
+                h = item[2]
+                if h.token.cancelled:  # client cancel / deadline in queue
+                    self._finish_unstarted_locked(
+                        h, "cancelled",
+                        QueryCancelled(h.token.reason or "cancelled"))
+                elif h.admitted_at is None and \
+                        now - h.submitted_at > self.queue_timeout_s:
+                    # paused queries (admitted_at set) are exempt: they
+                    # already earned their committed stages; the deadline
+                    # token, not the queue timeout, bounds their lifetime
+                    self.metrics.add("queries_shed", 1)
+                    self._count_shed_locked("queue_timeout", h.tenant,
+                                            door=False)
+                    self._finish_unstarted_locked(
+                        h, "shed",
+                        Overloaded(f"queued {now - h.submitted_at:.1f}s > "
+                                   f"queue timeout {self.queue_timeout_s}s"))
+                else:
+                    keep.append(item)
+            if len(keep) != len(t.heap):
+                t.heap[:] = keep
+                heapq.heapify(t.heap)
         for h in self._running.values():
             h.token.cancelled  # touch: deadline fires with no other polls
 
+    def _eligible_head_locked(self, t: _Tenant,
+                              mm: MemManager) -> Optional[QueryHandle]:
+        """The tenant's next query, or None when the tenant itself blocks
+        it (its concurrency cap, or its memory quota while it has queries
+        running — an idle tenant always gets its head considered, the
+        per-tenant progress guarantee)."""
+        if not t.heap:
+            return None
+        h = t.heap[0][2]
+        if t.max_concurrent is not None and t.running >= t.max_concurrent:
+            return None
+        if t.mem_quota and t.running:
+            qh = mm.quota_headroom(t.quota_name())
+            if qh is not None and qh < h.mem_estimate:
+                self.metrics.add("quota_blocked", 1)
+                return None
+        return h
+
+    def _pick_locked(self, mm: MemManager) -> Optional[Tuple[_Tenant,
+                                                             QueryHandle]]:
+        """Weighted-fair pick: the eligible tenant head with the smallest
+        virtual finish time (name tie-break keeps it deterministic)."""
+        best: Optional[Tuple[_Tenant, QueryHandle]] = None
+        for name in sorted(self._tenants):
+            t = self._tenants[name]
+            h = self._eligible_head_locked(t, mm)
+            if h is not None and (best is None
+                                  or h.vfinish < best[1].vfinish):
+                best = (t, h)
+        return best
+
     def _admit_locked(self):
         mm = MemManager.get_or_init(self.session.conf)
-        while self._queue and len(self._running) < self.max_concurrent \
-                and not self._closed:
-            h = self._queue[0][2]
+        while not self._closed and len(self._running) < self.max_concurrent:
+            pick = self._pick_locked(mm)
+            if pick is None:
+                break
+            t, h = pick
             # progress guarantee: an empty scheduler admits unconditionally
             # — an estimate above the whole budget must degrade to "run
             # alone and spill", not wait forever
             if self._running and mm.headroom() < h.mem_estimate:
                 self.metrics.add("admission_blocked", 1)
                 break
-            heapq.heappop(self._queue)
-            mm.reserve_group(h.mem_group, h.mem_estimate)
+            heapq.heappop(t.heap)
+            self._vtime = max(self._vtime, h.vstart)
+            mm.reserve_group(h.mem_group, h.mem_estimate,
+                             quota=t.quota_name())
+            h._released = False
+            now = time.monotonic()
+            if h.admitted_at is None:
+                # first admission only: resumed queries already paid their
+                # queue wait, re-observing would double-count
+                self._tm_queue_wait.labels(tenant=t.name).observe(
+                    now - h.submitted_at)
             h.state = "admitted"
-            h.admitted_at = time.monotonic()
-            self._tm_queue_wait.observe(h.admitted_at - h.submitted_at)
+            h.admitted_at = now
+            t.running += 1
+            t.admitted += 1
+            if h.cursor is not None:
+                self.metrics.add("queries_resumed", 1)
             self._running[h.qid] = h
             if len(self._running) > self.peak_inflight:
                 self.peak_inflight = len(self._running)
                 self.metrics.set("peak_inflight", self.peak_inflight)
             self._exec.submit(self._run, h)
 
+    def _maybe_preempt_locked(self):
+        """Policy preemption: when the weighted-fair head has waited past
+        ``serve_preempt_after_s`` behind a full house, ask the
+        furthest-behind eligible victim to pause at its next stage
+        boundary. The victim must be preemptible, have run long enough to
+        have committed something, be under its pause budget, and actually
+        be AHEAD of the head in the fair order — judged by the vfinish its
+        remaining work would receive if re-enqueued NOW (which is exactly
+        what preemption does to it), not by its stored vfinish, which is
+        frozen at its own submit-time virtual clock and makes every later
+        arrival look "behind" it forever. Priority still trumps, and the
+        aggressive chaos knob waives the fairness test entirely."""
+        conf = self.session.conf
+        if not conf.serve_preempt_enable or not self._running:
+            return
+        mm = MemManager.get_or_init(conf)
+        pick = self._pick_locked(mm)
+        if pick is None:
+            return
+        _, head = pick
+        now = time.monotonic()
+        if now - head.submitted_at < conf.serve_preempt_after_s:
+            return
+        slots_full = len(self._running) >= self.max_concurrent
+        mem_blocked = bool(self._running) and \
+            mm.headroom() < head.mem_estimate
+        if not (slots_full or mem_blocked):
+            return  # the admit pass will take it
+        best: Optional[QueryHandle] = None
+        best_vf = 0.0
+        for v in self._running.values():
+            if v.pause is None or v.pause.requested():
+                continue
+            if v.preempt_count >= conf.serve_preempt_max:
+                continue
+            if now - (v.admitted_at or now) < conf.serve_preempt_min_run_s:
+                continue
+            tv = self._tenant_locked(v.tenant)
+            vf_now = max(self._vtime, tv.last_vfinish) \
+                + max(v.cost, 1e-3) / tv.weight
+            if not (conf.serve_preempt_aggressive
+                    or head.priority > v.priority
+                    or (v.tenant != head.tenant
+                        and vf_now > head.vfinish)):
+                continue
+            if best is None or vf_now > best_vf:
+                best, best_vf = v, vf_now
+        if best is not None:
+            best.pause.request(
+                f"preempted for {head.label} (tenant {head.tenant})")
+            self.metrics.add("preempt_requested", 1)
+
     def _run(self, h: QueryHandle):
         h.state = "running"
         err: Optional[BaseException] = None
         state = "done"
+        paused_cursor: Optional[StageCursor] = None
         conf = self.session.conf
         try:
             while True:
@@ -394,7 +789,8 @@ class QueryScheduler:
                         for b in self.session.execute(
                             h.plan, cancel_token=h.token,
                             mem_group=h.mem_group,
-                            release_on_finish=True, label=h.label)
+                            release_on_finish=True, label=h.label,
+                            cursor=h.cursor, pause_token=h.pause)
                         if b.num_rows]
                     if batches:
                         h.table = pa.Table.from_batches(batches)
@@ -402,6 +798,12 @@ class QueryScheduler:
                         h.table = T.schema_to_arrow(
                             h.plan.output_schema).empty_table()
                     break
+                except StagePaused as sp:
+                    # not a failure: the session honored our pause request
+                    # at a stage-boundary commit; the cursor now owns the
+                    # committed stages — repark in the finally below
+                    paused_cursor = sp.cursor
+                    return
                 except TaskCancelled:
                     raise
                 except BaseException as exc:
@@ -410,11 +812,11 @@ class QueryScheduler:
                         raise
                     # transparent auto-retry: worker loss is the serving
                     # layer's problem, not the client's. The backoff
-                    # (capped exponential + jitter) spends the query's own
-                    # remaining deadline budget, so a retried query can
-                    # still miss its deadline but never overstays it; the
-                    # client only sees QueryRetryable once every
-                    # in-scheduler attempt is exhausted.
+                    # (capped exponential + deterministic jitter) spends
+                    # the query's own remaining deadline budget, so a
+                    # retried query can still miss its deadline but never
+                    # overstays it; the client only sees QueryRetryable
+                    # once every in-scheduler attempt is exhausted.
                     h.retries.append({
                         "attempt": len(h.retries) + 1,
                         "error": f"{type(exc).__name__}: {exc}"[:300],
@@ -423,6 +825,10 @@ class QueryScheduler:
                             time.monotonic() - h.submitted_at, 3)})
                     self._tm_retries.inc()
                     self.metrics.add("query_retries", 1)
+                    # a failed attempt released the query's pins; a stale
+                    # cursor would replay readers over deleted shuffle dirs
+                    if h.cursor is not None:
+                        h.cursor.entries.clear()
                     # reset the admission reservation to exactly one share
                     # (Session dropped the group when the attempt failed)
                     mm = MemManager._instance
@@ -438,51 +844,96 @@ class QueryScheduler:
         except BaseException as exc:
             err, state = exc, "failed"
         finally:
-            # leak backstop: Session releases the group on cancel/failure,
-            # but the RESERVATION made at admission must go even when the
-            # query never reached execute(). Guarded so the slot/memory
-            # release happens exactly once per handle even if a future code
-            # path reaches this finally twice.
-            mm = MemManager._instance
-            if mm is not None and not h._released:
-                h._released = True
-                mm.release_group(h.mem_group)
-            with self._cv:
-                h.error = err
-                h.state = state
-                h.finished_at = time.monotonic()
-                self._running.pop(h.qid, None)
-                self.metrics.add(f"queries_{state}", 1)
-                self._retire_locked(h)
-                self._cv.notify_all()
-                scheduler_state = self._snapshot_locked() \
-                    if state != "done" else None
-            # SLO accounting + forensics happen OUTSIDE the lock but BEFORE
-            # _done.set(): a waiter that sees the outcome can already read
-            # the counters and fetch the incident bundle. Nothing here may
-            # prevent _done.set() — waiters would hang.
-            try:
-                outcome = self._outcome(state, err, h)
-                self._tm_queries.labels(outcome=outcome).inc()
-                self._tm_run.observe(h.finished_at - h.admitted_at)
-                self._tm_e2e.labels(outcome=outcome).observe(
-                    h.finished_at - h.submitted_at)
-                if state == "done" and h.retries:
-                    self._stamp_retries(h)
-                if state != "done":
-                    iid = self._record_incident(h, outcome, err,
-                                                scheduler_state)
-                    if state == "failed" and self._is_worker_loss(err):
-                        # infrastructure loss, not a query bug: hand the
-                        # client a typed retryable error carrying the
-                        # incident bundle id (set BEFORE _done fires so
-                        # every waiter sees the wrapped form)
-                        wrapped = QueryRetryable(
-                            f"worker loss: {err}", incident_id=iid)
-                        wrapped.__cause__ = err
-                        h.error = wrapped
-            finally:
-                h._done.set()
+            if paused_cursor is not None:
+                self._repark(h, paused_cursor)
+            else:
+                self._finish_run(h, state, err)
+
+    def _repark(self, h: QueryHandle, cursor: StageCursor):
+        """Paused at a stage boundary: release the memory group and slot
+        (committed shuffle segments stay pinned behind the cursor), then
+        re-enter the tenant queue with FRESH weighted-fair tags — the
+        resumed remainder competes from now, which also prevents an
+        admit/preempt ping-pong on the same stale vfinish
+        (``serve_preempt_max`` bounds the loop regardless)."""
+        mm = MemManager._instance
+        if mm is not None:
+            mm.release_group(h.mem_group)
+        with self._cv:
+            h.cursor = cursor
+            h.preempt_count += 1
+            h.state = "paused"
+            if h.pause is not None:
+                h.pause.clear()
+            self._running.pop(h.qid, None)
+            t = self._tenant_locked(h.tenant)
+            t.running = max(0, t.running - 1)
+            self._stamp_wfq_locked(t, h)
+            heapq.heappush(t.heap, (-h.priority, next(self._seq), h))
+            self.metrics.add("queries_preempted", 1)
+            # what the cursor is pinning while parked: committed in-memory
+            # segments (file-tier outputs cost disk, not budget)
+            self.metrics.set("paused_pinned_bytes",
+                             self.session.mem_segments.stage_bytes(
+                                 cursor.stage_meta.keys()))
+            self._cv.notify_all()
+        self._tm_preempted.labels(tenant=h.tenant).inc()
+
+    def _finish_run(self, h: QueryHandle, state: str,
+                    err: Optional[BaseException]):
+        # leak backstops: Session releases the group on cancel/failure, but
+        # the RESERVATION made at admission must go even when the query
+        # never reached execute() — and a cursor still pinning stage state
+        # here (cancel/failure before the resumed execute() adopted it)
+        # must release too. Guarded so the slot/memory release happens
+        # exactly once per handle even if a future code path reaches this
+        # twice.
+        mm = MemManager._instance
+        if mm is not None and not h._released:
+            h._released = True
+            mm.release_group(h.mem_group)
+        if h.cursor is not None:
+            self.session.discard_cursor(h.cursor)
+            h.cursor = None
+        with self._cv:
+            h.error = err
+            h.state = state
+            h.finished_at = time.monotonic()
+            self._running.pop(h.qid, None)
+            t = self._tenant_locked(h.tenant)
+            t.running = max(0, t.running - 1)
+            self._drain.append(h.finished_at)
+            self.metrics.add(f"queries_{state}", 1)
+            self._retire_locked(h)
+            self._cv.notify_all()
+            scheduler_state = self._snapshot_locked() \
+                if state != "done" else None
+        # SLO accounting + forensics happen OUTSIDE the lock but BEFORE
+        # _done.set(): a waiter that sees the outcome can already read
+        # the counters and fetch the incident bundle. Nothing here may
+        # prevent _done.set() — waiters would hang.
+        try:
+            outcome = self._outcome(state, err, h)
+            self._tm_queries.labels(outcome=outcome, tenant=h.tenant).inc()
+            self._tm_run.observe(h.finished_at - h.admitted_at)
+            self._tm_e2e.labels(outcome=outcome).observe(
+                h.finished_at - h.submitted_at)
+            if state == "done" and h.retries:
+                self._stamp_retries(h)
+            if state != "done":
+                iid = self._record_incident(h, outcome, err,
+                                            scheduler_state)
+                if state == "failed" and self._is_worker_loss(err):
+                    # infrastructure loss, not a query bug: hand the
+                    # client a typed retryable error carrying the
+                    # incident bundle id (set BEFORE _done fires so
+                    # every waiter sees the wrapped form)
+                    wrapped = QueryRetryable(
+                        f"worker loss: {err}", incident_id=iid)
+                    wrapped.__cause__ = err
+                    h.error = wrapped
+        finally:
+            h._done.set()
 
     # -- bookkeeping ----------------------------------------------------------
 
@@ -490,7 +941,17 @@ class QueryScheduler:
                                  error: BaseException):
         """Terminal transition for a query that never ran (shed or cancelled
         while queued): resolve waiters and log it — these queries have no
-        Session record, so the serve layer writes the query_log entry."""
+        Session record, so the serve layer writes the query_log entry. A
+        PAUSED query dying here releases its pinned stage state first."""
+        if h.cursor is not None:
+            self.session.discard_cursor(h.cursor)
+            h.cursor = None
+        mm = MemManager._instance
+        if mm is not None and not h._released:
+            # paused queries have no live reservation, but release_group
+            # also drops quota membership — idempotent and cheap
+            h._released = True
+            mm.release_group(h.mem_group)
         h.state = state
         h.error = error
         h.finished_at = time.monotonic()
@@ -501,7 +962,7 @@ class QueryScheduler:
         self._retire_locked(h)
         try:
             outcome = self._outcome(state, error, h)
-            self._tm_queries.labels(outcome=outcome).inc()
+            self._tm_queries.labels(outcome=outcome, tenant=h.tenant).inc()
             self._tm_e2e.labels(outcome=outcome).observe(
                 h.finished_at - h.submitted_at)
             self._record_incident(h, outcome, error,
@@ -533,7 +994,13 @@ class QueryScheduler:
             return None
         delay = min(conf.serve_retry_backoff_s * (2 ** k),
                     conf.serve_retry_backoff_max_s)
-        delay *= 0.5 + random.random() / 2  # jitter: 50-100% of the cap
+        # jitter: 50-100% of the cap, DETERMINISTICALLY seeded per
+        # (query label, attempt) like the failpoint streams — a chaos
+        # matrix run with a pinned failpoint_seed reproduces its retry
+        # timing bit-for-bit instead of depending on the global PRNG
+        rng = random.Random((conf.failpoint_seed or 0)
+                            ^ zlib.crc32(f"{h.label}:{k}".encode()))
+        delay *= 0.5 + rng.random() / 2
         if h.token.deadline is not None:
             # a retry only makes sense when, after sleeping out the
             # backoff, at least one prior attempt's average runtime still

@@ -525,13 +525,15 @@ def chaos_main(kill_every_s: float):
     print("CHAOS SOAK (scale) PASSED", flush=True)
 
 
-CHAOS_MODES = ("kill", "hang", "enospc", "corrupt")
+CHAOS_MODES = ("kill", "hang", "enospc", "corrupt", "preempt")
 
 
 def parse_chaos_spec(spec: str) -> dict:
-    """``kill:N,hang:N,enospc:N,corrupt:N`` -> ordered {mode: N}. N means
-    seconds-between-kills for ``kill`` and a failpoint every-N trigger for
-    the other three. Any subset of modes is allowed; unknown modes fail."""
+    """``kill:N,hang:N,enospc:N,corrupt:N,preempt:N`` -> ordered {mode: N}.
+    N means seconds-between-kills for ``kill`` and a failpoint every-N
+    trigger for the others. Any subset of modes is allowed; unknown modes
+    fail. ``preempt`` is scheduler-driven and only meaningful under the
+    serve soak's matrix (the scale matrix runs sessions directly)."""
     modes = {}
     for entry in (spec or "").split(","):
         entry = entry.strip()
@@ -578,6 +580,17 @@ def chaos_mode_conf_kwargs(mode: str, n: float, seed: int = 3044) -> dict:
         # a crc mismatch and routed into lineage recompute
         return {"shuffle_verify_checksum": True, "failpoint_seed": seed,
                 "failpoints": f"frame.decode=corrupt:every{int(n)}"}
+    if mode == "preempt":
+        # preemption storm: the scheduler preempts on ANY contention (no
+        # priority/vtime test), the pause window opens instantly, and a
+        # delay at every Nth stage-boundary commit stretches the window the
+        # dispatcher needs to land a pause request mid-plan
+        return {"serve_preempt_aggressive": True,
+                "serve_preempt_after_s": 0.05,
+                "serve_preempt_min_run_s": 0.0,
+                "failpoint_seed": seed,
+                "failpoints":
+                    f"serve.preempt=delay:every{max(int(n), 1)}:0.02"}
     return {}
 
 
@@ -614,6 +627,12 @@ def chaos_matrix_main(spec: str):
     from blaze_tpu.runtime.session import Session
 
     modes = parse_chaos_spec(spec)
+    if "preempt" in modes:
+        # stage-boundary preemption lives in the serve scheduler; the scale
+        # matrix calls Session.execute_to_table directly so nothing would
+        # ever pause — refuse rather than green-light a vacuous phase
+        raise SystemExit("--chaos-spec: mode 'preempt' is scheduler-driven; "
+                         "run it under scripts/serve_soak.py --chaos-spec")
     rows = int(os.environ.get("CHAOS_ROWS", 200_000))
     iters = int(os.environ.get("CHAOS_ITERS", 6))
 
