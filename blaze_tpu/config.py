@@ -72,6 +72,29 @@ class Config:
     # rounds over the same compiled step.
     mesh_exchange_round_bytes: int = 256 << 20
 
+    # Multichip device-primary execution: when enabled, a Session without
+    # an explicit ``mesh=`` argument builds one over the local devices
+    # (parallel/mesh.py make_mesh) and exchanges whose stages the placement
+    # model puts on-device ride the ICI all-to-all; fused-stage closures of
+    # concurrent same-shape batches additionally run data-parallel under
+    # shard_map across the mesh. Off by default: CI's tier-1 command
+    # (JAX_PLATFORMS=cpu) must behave exactly as before. Dev boxes emulate
+    # the mesh with XLA_FLAGS=--xla_force_host_platform_device_count=8.
+    multichip_enabled: bool = False
+
+    # Device count for the config-built mesh. 0 = all local devices. A
+    # request beyond the local device count clamps (escape hatch for
+    # sharing a box); 1 still builds a mesh so the code path is identical.
+    multichip_devices: int = 0
+
+    # The "device" shuffle tier: pool-less sessions with a mesh (or
+    # multichip enabled) commit device-resident sub-batch references into
+    # the MemSegmentRegistry — no host pull between fused stages. False
+    # pins such sessions back to the host "process" tier (escape hatch);
+    # the tier also degrades per map output past the HBM budget or when
+    # the ``device.put`` failpoint fires.
+    device_shuffle_tier: bool = True
+
     # AQE small-partition coalescing (Spark's coalescePartitions): adjacent
     # reducer partitions below the advisory size merge into one read task
     # when no ancestor relies on the exchange's partition count.
@@ -303,9 +326,10 @@ class Config:
     zero_copy_shuffle: bool = True
 
     # Force one tier for tests: None = negotiate from placement
-    # (pool-less -> "process", local pool -> "shm"); "process" | "shm" |
-    # "ipc" pin the tier. "process" with a worker pool degrades to "shm"
-    # (batch references cannot cross process boundaries).
+    # (pool-less -> "device" under a mesh / multichip, else "process";
+    # local pool -> "shm"); "device" | "process" | "shm" | "ipc" pin the
+    # tier. "process"/"device" with a worker pool degrade to "shm" (batch
+    # references cannot cross process boundaries).
     zero_copy_tier: Optional[str] = None
 
     # Directory for shm-tier segment files. None = /dev/shm when writable

@@ -96,9 +96,17 @@ class IpcReaderExec(Operator):
         def _materialize(ref):
             # process-tier block: the batch reference crossed the exchange
             # with serde skipped entirely; only the device upload remains
-            # (collect-path references are already ColumnarBatch — nothing
-            # left to do but count them)
-            batch = ref.to_columnar() if hasattr(ref, "to_columnar") else ref
+            # (device-tier references are already on-chip ColumnarBatches —
+            # nothing left to do but count the bytes that never touched
+            # the host)
+            if hasattr(ref, "to_columnar"):
+                batch = ref.to_columnar()
+            else:
+                batch = ref
+                from blaze_tpu.core.batch import DeviceColumn
+                if batch.columns and all(isinstance(c, DeviceColumn)
+                                         for c in batch.columns):
+                    metrics.add("device_shuffle_bytes", int(batch.nbytes()))
             metrics.add("serde_elided_batches", 1)
             _TM_ELIDED.inc()
             return batch

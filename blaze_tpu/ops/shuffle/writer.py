@@ -46,6 +46,11 @@ _TM_TIER_DEGRADED = get_registry().counter(
     "map outputs whose shm-tier commit ran out of tmpfs headroom and "
     "degraded to the spill-dir tier (redirect marker + disk file) instead "
     "of failing the query")
+_TM_DEVICE_RESIDENT = get_registry().counter(
+    "blaze_shuffle_device_resident_bytes",
+    "column bytes committed to the segment registry as device-resident "
+    "sub-batch references (the multichip 'device' shuffle tier — no host "
+    "pull between fused stages)")
 
 
 class _PartitionStreams:
@@ -94,14 +99,23 @@ class ShuffleWriterExec(Operator):
     worker pool) is a ``(MemSegmentRegistry, stage_id)`` pair — staged
     partitions commit as in-process batch REFERENCES, the data file
     becomes a footer-only lineage marker, and the index keeps logical
-    staged sizes so AQE coalescing/skew sizing still sees real bytes."""
+    staged sizes so AQE coalescing/skew sizing still sees real bytes.
+
+    ``device_sink`` (the multichip "device" tier, refines ``mem_sink``)
+    keeps the staged references DEVICE-RESIDENT: device batches are
+    bucketized on-chip (one gather, contiguous slices) and committed as
+    device sub-batch references, so the next fused stage reads them with
+    no host pull. Degrades to the host staging path per-batch (host-side
+    input, device.put failure) and from there exactly like the process
+    tier (spill / budget / pool → frames → shm or files)."""
 
     def __init__(self, child: Operator, partitioning, output_data_file: str,
-                 output_index_file: str, mem_sink=None):
+                 output_index_file: str, mem_sink=None, device_sink=False):
         self.partitioning = partitioning
         self.output_data_file = output_data_file
         self.output_index_file = output_index_file
         self.mem_sink = mem_sink
+        self.device_sink = device_sink
         super().__init__(child.schema, [child])
 
     def _execute(self, partition, ctx, metrics):
@@ -146,6 +160,15 @@ class _WriterState(MemConsumer):
         self.mem_sink = op.mem_sink
         self._mem_parts = {} if self.mem_sink is not None else None
         self._mem_bytes = 0
+        # device tier: stage device-resident sub-batch references. Budget
+        # is the tighter of the mem-segment cap and the device-resident
+        # cap — past it the staged set degrades like the process tier.
+        self.device_sink = bool(getattr(op, "device_sink", False)) \
+            and self._mem_parts is not None
+        self._mem_budget = ctx.conf.zero_copy_mem_segment_max_bytes
+        if self.device_sink:
+            self._mem_budget = min(self._mem_budget,
+                                   ctx.conf.mesh_device_resident_max_bytes)
         self.streams = self._new_streams()
         # spills: list of (SpillFile-backed raw file, per-partition (off, len))
         self.spills = []
@@ -181,12 +204,12 @@ class _WriterState(MemConsumer):
         from blaze_tpu.obs.stats import STATS_HUB
 
         part_rows = {} if STATS_HUB.enabled else None
-        for pid, sub in self.repart.bucketize_host(batch):
+        for pid, sub in self._bucketize(batch):
             if part_rows is not None:
                 part_rows[pid] = part_rows.get(pid, 0) + sub.num_rows
             if self._mem_parts is not None:
                 self._mem_parts.setdefault(pid, []).append(sub)
-                self._mem_bytes += _host_batch_nbytes(sub)
+                self._mem_bytes += _staged_batch_nbytes(sub)
             else:
                 self.streams.write(pid, sub)
         if part_rows:
@@ -195,8 +218,7 @@ class _WriterState(MemConsumer):
             # explain summarizes them, so the tree never renders raw lists)
             for pid, rows in part_rows.items():
                 self.metrics.add(f"part_rows_{pid}", rows)
-        if self._mem_parts is not None and self._mem_bytes > \
-                self.ctx.conf.zero_copy_mem_segment_max_bytes:
+        if self._mem_parts is not None and self._mem_bytes > self._mem_budget:
             self._mem_degrade()
         # hot-path invariant surfaced for soak/tests: one row gather per
         # split batch, never a per-partition take loop
@@ -210,6 +232,29 @@ class _WriterState(MemConsumer):
                              self.streams.serialized_bytes - s0)
             _TM_SERIALIZED.inc(self.streams.serialized_bytes - s0)
         self.update_mem_used(self._mem_bytes + self.streams.nbytes)
+
+    def _bucketize(self, batch: ColumnarBatch):
+        """Route one coalesced batch to per-partition sub-batches. Device
+        tier: bucketize ON-CHIP (one gather + contiguous slices) so the
+        staged references stay device-resident — but only when the batch is
+        actually device-backed, and only while device placement succeeds
+        (``device.put`` failpoint / OOM degrades this writer to the shm
+        tier for the whole map output, matching what the reader expects)."""
+        if self.device_sink and self._mem_parts is not None:
+            from blaze_tpu.core.batch import DeviceColumn
+            from blaze_tpu.runtime.failpoints import failpoint
+
+            if batch.columns and all(isinstance(c, DeviceColumn)
+                                     for c in batch.columns):
+                try:
+                    failpoint("device.put")
+                    return self.repart.bucketize(batch)
+                except OSError:
+                    self.device_sink = False
+                    self.metrics.add("shuffle_tier_degraded", 1)
+                    _TM_TIER_DEGRADED.inc()
+                    self._mem_degrade()
+        return self.repart.bucketize_host(batch)
 
     def _mem_degrade(self):
         """Leave the process tier for this map output: route the staged
@@ -277,10 +322,18 @@ class _WriterState(MemConsumer):
         registry, stage = self.op.mem_sink
         parts = self._mem_parts
         offsets = np.zeros(self.n + 1, dtype=np.int64)
+        device_bytes = 0
         for pid in range(self.n):
-            offsets[pid + 1] = offsets[pid] + sum(
-                _host_batch_nbytes(b) for b in parts.get(pid, ()))
+            for b in parts.get(pid, ()):
+                nb = _staged_batch_nbytes(b)
+                offsets[pid + 1] += nb
+                if isinstance(b, ColumnarBatch):
+                    device_bytes += nb
+            offsets[pid + 1] += offsets[pid]
         registry.commit(stage, self.map_id, parts, int(offsets[self.n]))
+        if device_bytes:
+            # device tier actually engaged: staged refs are on-chip batches
+            _TM_DEVICE_RESIDENT.inc(device_bytes)
         attempt = uuid.uuid4().hex
         tmp = f"{self.op.output_data_file}.tmp.{attempt}"
         os.makedirs(os.path.dirname(tmp) or ".", exist_ok=True)
@@ -433,6 +486,14 @@ def _host_batch_nbytes(hb) -> int:
         else:
             total += it.nbytes
     return total
+
+
+def _staged_batch_nbytes(b) -> int:
+    """Logical staged size of either staging representation: host batches
+    (process tier) or device-resident ColumnarBatches (device tier)."""
+    if isinstance(b, ColumnarBatch):
+        return int(b.nbytes())
+    return _host_batch_nbytes(b)
 
 
 def read_index_file(path: str) -> np.ndarray:

@@ -32,6 +32,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+try:  # jax >= 0.5 exports shard_map at top level
+    from jax import shard_map
+except ImportError:  # 0.4.x keeps it under experimental
+    from jax.experimental.shard_map import shard_map
+
 from blaze_tpu.exprs.spark_hash import murmur3_int64
 
 
@@ -118,7 +123,6 @@ def exchange_and_aggregate(mesh: Mesh, capacity: int, axis: str = "data"):
         total_rows = jax.lax.psum(jnp.sum(valid.astype(jnp.int64)), axis)
         return (jnp.where(out_valid, uk, 0), sums, counts, out_valid, total_rows)
 
-    from jax import shard_map
 
     sharded = shard_map(
         step, mesh=mesh,
@@ -149,7 +153,6 @@ def broadcast_join_sum(mesh: Mesh, capacity: int, build_capacity: int,
         total = jax.lax.psum(jnp.sum(hit.astype(jnp.int64)), axis)
         return hit, payload, total
 
-    from jax import shard_map
 
     sharded = shard_map(
         step, mesh=mesh,
@@ -219,7 +222,6 @@ def _exchange_compact_step(mesh, axis, nplanes, chunk, *planes):
     from the exchanged per-reducer row counts, so bytes on the wire track
     the data actually routed (reference: ``shuffle/buffered_data.rs:48-541``
     compact-before-transport)."""
-    from jax import shard_map
 
     n = mesh.shape[axis]
 
@@ -423,7 +425,6 @@ class MeshBatchExchange:
 
         sharding = NamedSharding(self.mesh, P(self.axis))
         devs = list(self.mesh.devices.flat)
-        per_dev = n * chunk
 
         # per-shard routing and device-resident column planes, precomputed
         # ONCE across rounds (only the round's permutation indices change
@@ -499,7 +500,11 @@ class MeshBatchExchange:
                           for s, p in enumerate(ps)]
                 gplanes.append(jax.make_array_from_single_device_arrays(
                     (n * seg_len,), sharding, shards))
-            with self.mesh:
+            # the collective is device work: the union-interval kernel clock
+            # must see it or mesh-run stages report device_time_fraction ~0
+            from blaze_tpu.utils.device import DEVICE_STATS
+
+            with DEVICE_STATS.kernel_span(), self.mesh:
                 outs = _exchange_compact_step(self.mesh, self.axis,
                                               len(gplanes), chunk, *gplanes)
             self.last_wire_bytes += sum(
@@ -507,28 +512,46 @@ class MeshBatchExchange:
 
             # per-reducer extraction for THIS round: gather only live rows
             # (device arrays sized by actual data, so cross-round storage
-            # is bounded by the payload, not the padding)
-            out_live_np = np.asarray(outs[0])
+            # is bounded by the payload, not the padding). Split the
+            # collective's outputs into their per-device shards FIRST:
+            # reducer r's slots live wholly inside device r//G's shard, so
+            # every gather below is a plain single-device program. Indexing
+            # the global sharded array instead compiles each take into a
+            # fresh n-participant collective, and at scale those interleave
+            # with the next round's all_to_all and wedge the XLA CPU
+            # rendezvous (observed: q67 at 2M rows on the 8-device mesh).
+            shard_view: List[List] = []
+            for p in outs:
+                by_dev = {next(iter(s.data.devices())): s.data
+                          for s in p.addressable_shards}
+                shard_view.append([by_dev[dv] for dv in devs])
+            live_np = [np.asarray(sv) for sv in shard_view[0]]
             for r in range(Rpad):
                 if red_cnt[r] == 0:
                     continue
                 d, g = divmod(r, G)
-                idxs = (d * per_dev + np.add.outer(
-                    np.arange(n) * chunk + g * scap,
-                    np.arange(scap))).ravel()
-                rows = np.nonzero(out_live_np[idxs])[0]
+                base = np.add.outer(np.arange(n) * chunk + g * scap,
+                                    np.arange(scap)).ravel()
+                rows = np.nonzero(live_np[d][base])[0]
                 if not len(rows):
                     continue
-                fidx_dev = jnp.asarray(idxs[rows])
+                fidx_dev = jnp.asarray(base[rows])
                 cols_rt = []
                 for i in range(ncols):
-                    pd_ = jnp.take(outs[1 + 2 * i], fidx_dev)
-                    pv = jnp.take(outs[2 + 2 * i], fidx_dev)
+                    pd_ = jnp.take(shard_view[1 + 2 * i][d], fidx_dev)
+                    pv = jnp.take(shard_view[2 + 2 * i][d], fidx_dev)
                     if device_resident and i not in host_slots:
-                        cols_rt.append((pd_, pv))
+                        # downstream single-stream operators expect all
+                        # operands on the primary device
+                        cols_rt.append((jax.device_put(pd_, devs[0]),
+                                        jax.device_put(pv, devs[0])))
                     else:
                         cols_rt.append((np.asarray(pd_), np.asarray(pv)))
-                pieces[r].append(cols_rt)
+                # this round's live rows per source shard (the extraction
+                # gather above is shard-major, ranks contiguous per shard)
+                c_live = np.minimum(np.maximum(
+                    counts[:, r] - t * scap, 0), scap)
+                pieces[r].append((cols_rt, c_live))
 
         # wire observability: naive masked-tile equivalent for comparison
         cap = conf.capacity_for(
@@ -545,20 +568,35 @@ class MeshBatchExchange:
         results: List[Optional[ColumnarBatch]] = []
         for r in range(R):
             ps = pieces[r]
-            cnt = sum(len(cr[0][1]) if isinstance(cr[0][1], np.ndarray)
-                      else cr[0][1].shape[0] for cr in ps) if ps else 0
+            cnt = sum(int(cl.sum()) for _, cl in ps) if ps else 0
             if cnt == 0:
                 results.append(None)
                 continue
+            # canonical row order: each reducer's rows sorted shard-major
+            # (source shard, then original row order), INDEPENDENT of the
+            # round split. A skew-driven extra round appends rows
+            # round-major; left unpermuted that row order — and with it
+            # float accumulation order and sort-tie order downstream —
+            # would depend on scap, i.e. on the mesh size, breaking the
+            # bit-identical-across-meshes contract.
+            perm = None
+            if len(ps) > 1:
+                key = np.concatenate(
+                    [np.repeat(np.arange(n), cl) for _, cl in ps])
+                p_ = np.argsort(key, kind="stable")
+                if not np.array_equal(p_, np.arange(len(p_))):
+                    perm = p_
             out_cap = conf.capacity_for(cnt)
             cols = []
             hitems = []
             for i, f in enumerate(schema.fields):
-                dparts = [cr[i][0] for cr in ps]
-                vparts = [cr[i][1] for cr in ps]
+                dparts = [cr[i][0] for cr, _ in ps]
+                vparts = [cr[i][1] for cr, _ in ps]
                 if i in host_slots:
                     cd = np.concatenate(dparts)
                     cv = np.concatenate(vparts)
+                    if perm is not None:
+                        cd, cv = cd[perm], cv[perm]
                     codes = pa.array(cd, type=pa.int32()) if cv.all() else \
                         pa.array(np.where(cv, cd, 0), type=pa.int32(),
                                  mask=~cv)
@@ -569,19 +607,126 @@ class MeshBatchExchange:
                         hitems.append(taken)
                 elif device_resident:
                     pad = out_cap - cnt
-                    ddata = jnp.concatenate(
-                        dparts + ([jnp.zeros(pad, dparts[0].dtype)]
-                                  if pad else []))
-                    dvalid = jnp.concatenate(
-                        vparts + ([jnp.zeros(pad, bool)] if pad else []))
+                    ddata = jnp.concatenate(dparts) if len(dparts) > 1 \
+                        else dparts[0]
+                    dvalid = jnp.concatenate(vparts) if len(vparts) > 1 \
+                        else vparts[0]
+                    if perm is not None:
+                        jperm = jnp.asarray(perm)
+                        ddata = jnp.take(ddata, jperm)
+                        dvalid = jnp.take(dvalid, jperm)
+                    if pad:
+                        ddata = jnp.concatenate(
+                            [ddata, jnp.zeros(pad, ddata.dtype)])
+                        dvalid = jnp.concatenate([dvalid,
+                                                  jnp.zeros(pad, bool)])
                     cols.append(DeviceColumn(f.dtype, ddata, dvalid))
                 else:
-                    hitems.append((np.concatenate(dparts),
-                                   np.concatenate(vparts)))
+                    cd = np.concatenate(dparts)
+                    cv = np.concatenate(vparts)
+                    if perm is not None:
+                        cd, cv = cd[perm], cv[perm]
+                    hitems.append((cd, cv))
             results.append(ColumnarBatch(schema, cols, cnt)
                            if device_resident
                            else HostBatch(schema, hitems, cnt))
         return results
+
+
+class ShardedFusedRunner:
+    """Run a fused-stage closure (ops/fused.py) data-parallel across the
+    mesh: k <= n consecutive same-shape batches stack into one
+    ``(n, capacity)`` NamedSharding global per column plane — one batch per
+    device — and the ORIGINAL per-batch jitted closure runs inside a
+    ``shard_map`` body that squeezes its device's leading axis. Per batch
+    the math is byte-for-byte the single-device dispatch (no row resharding,
+    no cross-shard compaction), so results are bit-identical across 1/2/8
+    device meshes by construction; the win is the n bodies executing
+    concurrently on n chips instead of queueing on one stream.
+
+    Short flushes pad by repeating the last batch (padded outputs are
+    dropped), so the compiled step is reused at one shape per
+    (closure, capacity, dtypes) key. Outputs are consolidated onto the
+    first mesh device: downstream single-stream operators (concat, agg
+    state) must not see operands committed to different devices."""
+
+    def __init__(self, mesh: Mesh, axis: Optional[str] = None):
+        assert len(mesh.axis_names) == 1, (
+            f"ShardedFusedRunner needs a 1-D mesh, got {mesh.axis_names}")
+        self.mesh = mesh
+        self.axis = axis or mesh.axis_names[0]
+        self.n = mesh.shape[self.axis]
+        self.devices = list(mesh.devices.flat)
+        self._wrapped: dict = {}  # id(fn) -> (fn ref, shard_map'd closure)
+        self.dispatches = 0
+
+    def _wrap(self, fn):
+        hit = self._wrapped.get(id(fn))
+        if hit is not None:
+            return hit[1]
+
+        axis = self.axis
+
+        def body(datas, valids, nrows):
+            out = fn(tuple(d[0] for d in datas),
+                     tuple(v[0] for v in valids), nrows[0])
+            # re-add the leading per-device axis so out_specs=P(axis)
+            # reassembles one global row per batch
+            return jax.tree_util.tree_map(lambda a: a[None], out)
+
+        wrapped = jax.jit(shard_map(
+            body, mesh=self.mesh,
+            in_specs=(P(axis), P(axis), P(axis)),
+            out_specs=P(axis)))
+        # hold fn so the id() key cannot be reused by a reclaimed closure
+        self._wrapped[id(fn)] = (fn, wrapped)
+        return wrapped
+
+    def dispatch(self, fn, batch_datas, batch_valids, batch_nrows):
+        """``batch_datas[i]``/``batch_valids[i]``: per-batch tuples of
+        (capacity,) column planes; ``batch_nrows[i]``: that batch's row
+        count. Returns ``(outs, compiled)`` where ``outs[i]`` is exactly
+        what ``fn(datas, valids, nrows)`` returns for batch i, with every
+        leaf committed to the first mesh device."""
+        from jax.sharding import NamedSharding
+
+        from blaze_tpu.core import kernels
+
+        k = len(batch_datas)
+        if k < self.n:  # pad with the tail batch; outputs dropped below
+            batch_datas = list(batch_datas) + [batch_datas[-1]] * (self.n - k)
+            batch_valids = list(batch_valids) + \
+                [batch_valids[-1]] * (self.n - k)
+            batch_nrows = list(batch_nrows) + \
+                [batch_nrows[-1]] * (self.n - k)
+        sharding = NamedSharding(self.mesh, P(self.axis))
+        devs = self.devices
+
+        def gput(per_batch):
+            per_batch = [jnp.asarray(a) for a in per_batch]
+            shards = [jax.device_put(a[None], devs[j])
+                      for j, a in enumerate(per_batch)]
+            return jax.make_array_from_single_device_arrays(
+                (self.n,) + per_batch[0].shape, sharding, shards)
+
+        ncols = len(batch_datas[0])
+        gdatas = tuple(gput([bd[i] for bd in batch_datas])
+                       for i in range(ncols))
+        gvalids = tuple(gput([bv[i] for bv in batch_valids])
+                        for i in range(ncols))
+        gnrows = gput([jnp.asarray(nr, jnp.int64) for nr in batch_nrows])
+        out, compiled = kernels.fused_dispatch(
+            self._wrap(fn), gdatas, gvalids, gnrows)
+        self.dispatches += 1
+        # consolidate onto one device, then slice per batch: downstream
+        # operators mix these leaves with driver-created arrays and jax
+        # refuses ops across different committed devices
+        dev0 = devs[0]
+        out0 = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, dev0), out)
+        outs = [jax.tree_util.tree_map(lambda a, i=i: a[i], out0)
+                for i in range(k)]
+        return outs, compiled
 
 
 def run_distributed_sum(keys: np.ndarray, vals: np.ndarray,

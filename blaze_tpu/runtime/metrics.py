@@ -144,6 +144,19 @@ class MetricNode:
 #                                    (0 on healthy runs; > 0 proves the
 #                                    degrade path ran instead of the query
 #                                    failing)
+#   sharded_stages                   stages executed data-parallel across
+#                                    the device mesh (mesh-collective
+#                                    exchanges + shard_map'd fused stages);
+#                                    0 with multichip off, > 0 proves the
+#                                    multichip path actually engaged
+#   device_shuffle_bytes             device-resident column bytes handed
+#                                    between stages through the registry
+#                                    ("device" shuffle tier) with no host
+#                                    pull — the device twin of
+#                                    serde_elided_batches
+#   collective_bytes                 bytes moved by mesh all-to-all
+#                                    collectives in place of shuffle file
+#                                    writes (MeshBatchExchange wire bytes)
 TRIPWIRE_METRICS = (
     "split_batches",
     "split_gathers",
@@ -163,6 +176,9 @@ TRIPWIRE_METRICS = (
     "shm_bytes_mapped",
     "serde_elided_batches",
     "shuffle_tier_degraded",
+    "sharded_stages",
+    "device_shuffle_bytes",
+    "collective_bytes",
 )
 
 

@@ -70,6 +70,12 @@ class LinkProfile:
 FREE_LINK = LinkProfile("cpu", math.inf, math.inf, 0.0)
 
 _lock = threading.Lock()
+# separate probe gate: the subprocess measurement can take up to
+# _PROBE_TIMEOUT_S, and ``placed()`` on every task thread takes ``_lock``
+# briefly — holding _lock across the probe would stall the whole task pool
+# behind the first session's measurement. One thread probes under
+# _probe_lock; latecomers block on it, then reuse the cached result.
+_probe_lock = threading.Lock()
 _profile: Optional[LinkProfile] = None
 
 
@@ -78,6 +84,30 @@ def set_link_profile(profile: Optional[LinkProfile]):
     global _profile
     with _lock:
         _profile = profile
+
+
+def _publish_link_metrics(prof: LinkProfile):
+    """Measured link numbers into the registry, so /debug/metrics explains
+    every placement decision (satellite: no more 'why did this stage land
+    on host?' spelunking). Gauges carry bytes PER SECOND; sync is seconds."""
+    try:
+        from blaze_tpu.obs.telemetry import get_registry
+
+        reg = get_registry()
+        h2d = prof.h2d_bytes_per_s
+        d2h = prof.d2h_bytes_per_s
+        reg.gauge("blaze_placement_link_h2d_bytes",
+                  "measured host->device bandwidth, bytes per second "
+                  "(inf on colocated/cpu links reports as 0)"
+                  ).set(0.0 if math.isinf(h2d) else h2d)
+        reg.gauge("blaze_placement_link_d2h_bytes",
+                  "measured device->host bandwidth, bytes per second "
+                  "(inf on colocated/cpu links reports as 0)"
+                  ).set(0.0 if math.isinf(d2h) else d2h)
+        reg.gauge("blaze_placement_link_sync_seconds",
+                  "measured device round-trip sync latency").set(prof.sync_s)
+    except Exception:  # telemetry must never break placement
+        pass
 
 
 def _parse_env() -> Optional[LinkProfile]:
@@ -229,24 +259,37 @@ def preinit_profile() -> Optional[LinkProfile]:
 def link_profile() -> LinkProfile:
     global _profile
     with _lock:
-        if _profile is None:
-            import jax
+        if _profile is not None:
+            return _profile
+    # measure OUTSIDE _lock (the probe subprocess can run for minutes);
+    # _probe_lock serializes probers so the measurement runs once per
+    # process no matter how many session threads race here
+    with _probe_lock:
+        with _lock:
+            if _profile is not None:
+                return _profile
+        import jax
 
-            env = _parse_env()
-            if env is not None:
-                _profile = env
-            elif (jax.config.jax_platforms or "") == "cpu":
-                # process pinned to the host backend: no link to measure
-                _profile = FREE_LINK
-            else:
-                cached = read_cached_profile()
-                _profile = cached or _probe()
-                # fresh measurements persist; a cache hit does NOT re-save
-                # (that would refresh the TTL forever and block re-probes)
-                if _profile is not cached and \
-                        _profile.platform not in ("cpu", "failed"):
-                    _save_cached(_profile)
-        return _profile
+        env = _parse_env()
+        if env is not None:
+            prof = env
+        elif (jax.config.jax_platforms or "") == "cpu":
+            # process pinned to the host backend: no link to measure
+            prof = FREE_LINK
+        else:
+            cached = read_cached_profile()
+            prof = cached or _probe()
+            # fresh measurements persist; a cache hit does NOT re-save
+            # (that would refresh the TTL forever and block re-probes)
+            if prof is not cached and \
+                    prof.platform not in ("cpu", "failed"):
+                _save_cached(prof)
+        with _lock:
+            if _profile is None:
+                _profile = prof
+            prof = _profile
+    _publish_link_metrics(prof)
+    return prof
 
 
 # --- stage analysis -----------------------------------------------------------
@@ -330,17 +373,52 @@ def decide_from_profile(est: StageEstimate, lp: LinkProfile) -> str:
     return "device" if device_cost < host_cost else "host"
 
 
-def decide(root: N.PlanNode, resources: dict, conf) -> str:
-    """Placement for one stage subtree: "device" or "host"."""
+def decide(root: N.PlanNode, resources: dict, conf,
+           record: Optional[dict] = None) -> str:
+    """Placement for one stage subtree: "device" or "host".
+
+    ``record`` is a prior run's stage record for this plan shape (the PR 11
+    stats plane: ``device_time_ns``/``compute_time_ns``/``total_bytes``/
+    ``device_time_fraction``). When present, MEASURED arithmetic intensity
+    replaces the static estimate: the observed bytes refine the transfer
+    term, and the observed compute seconds replace the side of the cost
+    model the stage actually ran on last time — the decision tracks what
+    this stage really does, not what the operator count guesses."""
     mode = getattr(conf, "device_placement", "auto")
     if mode in ("device", "host"):
         return mode
     lp = link_profile()
     est = estimate_stage(root, resources)
-    choice = decide_from_profile(est, lp)
-    log.info("placement[%s]: in=%.1fMB ops=%d reduces=%s -> %s",
+    measured_s = None
+    measured_on = None
+    if record:
+        tb = int(record.get("total_bytes") or 0)
+        if tb > 0:
+            est = dataclasses.replace(
+                est, input_bytes=max(est.input_bytes, tb))
+        comp_ns = int(record.get("compute_time_ns") or 0)
+        if comp_ns > 0:
+            measured_s = comp_ns / 1e9
+            measured_on = "device" if (
+                record.get("device_time_fraction") or 0.0) > 0.5 else "host"
+    if lp.is_colocated:
+        choice = "device"
+    elif est.input_bytes <= 0 and measured_s is None:
+        choice = "host"
+    else:
+        device_cost, host_cost = stage_costs(est, lp)
+        if measured_s is not None:
+            # the measured wall is ground truth for the side that ran
+            if measured_on == "host":
+                host_cost = measured_s
+            else:
+                device_cost = measured_s
+        choice = "device" if device_cost < host_cost else "host"
+    log.info("placement[%s]: in=%.1fMB ops=%d reduces=%s measured=%s -> %s",
              lp.platform, est.input_bytes / 1e6, est.n_ops,
-             est.reduces_output, choice)
+             est.reduces_output,
+             f"{measured_s:.3f}s/{measured_on}" if measured_s else "-",
+             choice)
     return choice
 
 

@@ -1,4 +1,4 @@
-"""In-memory segment registry: the single-process tier of the zero-copy
+"""In-memory segment registry: the single-process tiers of the zero-copy
 data plane.
 
 When a stage's consumer runs in the SAME process (pool-less local mode,
@@ -8,6 +8,13 @@ re-upload — is pure overhead. Instead the shuffle writer stages its
 ``bucketize_host`` output per reducer and commits the staged batch
 REFERENCES here; readers receive them through ``("batches", ...)`` blocks
 with serde skipped entirely (the ``serde_elided_batches`` tripwire).
+
+The registry is tier-AGNOSTIC about what a staged reference points at:
+the process tier commits host batches, the multichip "device" tier
+commits device-resident ``ColumnarBatch`` references (bucketized on-chip,
+so the next fused stage consumes them with no host pull — the
+``device_shuffle_bytes`` tripwire). Both are plain heap objects holding
+their buffers alive; release semantics are identical.
 
 Lineage compatibility: each committed mem segment is paired with a
 footer-only marker data file on disk (a 0-payload footer passes

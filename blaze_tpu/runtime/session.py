@@ -55,6 +55,14 @@ _TM_QUERIES = get_registry().counter(
     "blaze_session_queries_total", "queries finished, by terminal state")
 _TM_QUERY_SECS = get_registry().histogram(
     "blaze_session_query_seconds", "query wall time, by terminal state")
+_TM_SHARDED_STAGES = get_registry().counter(
+    "blaze_mesh_sharded_stages_total",
+    "exchanges lowered onto the device-mesh all-to-all collective instead "
+    "of shuffle files (multichip device-primary execution)")
+_TM_COLLECTIVE_BYTES = get_registry().counter(
+    "blaze_mesh_collective_bytes",
+    "bytes moved by mesh all-to-all collectives in place of shuffle file "
+    "writes (MeshBatchExchange wire bytes)")
 
 
 class _SubsetBlockProvider:
@@ -217,7 +225,7 @@ class _QueryRun:
 
     __slots__ = ("qid", "token", "mem_group", "label", "stage_meta",
                  "shuffle_dirs", "resource_ids", "stats", "cursor", "pause",
-                 "boundary_idx")
+                 "boundary_idx", "placement_idx")
 
     def __init__(self, qid: int, token=None, mem_group: Optional[str] = None,
                  label: Optional[str] = None):
@@ -232,6 +240,7 @@ class _QueryRun:
         self.cursor: Optional[StageCursor] = None  # set for pausable runs
         self.pause: Optional[PauseToken] = None
         self.boundary_idx = 0  # pre-order stage-boundary counter
+        self.placement_idx = 0  # ordinal of the next exchange's prior-stats
 
 
 class Session:
@@ -293,6 +302,17 @@ class Session:
                 f"Session needs a 1-D mesh (one exchange axis), got "
                 f"axes {mesh.axis_names}")
         self.mesh = mesh
+        if mesh is None and self.conf.multichip_enabled:
+            # multichip: build the exchange mesh from config over the local
+            # devices (multichip_devices == 0 → all of them; make_mesh
+            # clamps). A 1-device mesh still exercises the sharded code
+            # paths, which keeps 1/2/8-device bit-identity testable.
+            import jax as _jax
+
+            from blaze_tpu.parallel.mesh import make_mesh
+            nd = len(_jax.devices())
+            self.mesh = make_mesh(
+                max(1, min(self.conf.multichip_devices or nd, nd)))
         # push-shuffle through a remote shuffle service (runtime/rss.py) —
         # the Celeborn/Uniffle role, SURVEY.md §2.6
         self.rss_sock_path = rss_sock_path
@@ -309,6 +329,15 @@ class Session:
 
         self._lineage = LineageRegistry()
         self.resources = {}
+        if self.mesh is not None and self.conf.multichip_enabled \
+                and self.pool is None:
+            # sharded fused execution: fused stages reach this through
+            # ExecContext.resources. Driver-only — the runner holds live
+            # device handles that cannot cross a process boundary (pool
+            # workers fall back to per-batch dispatch).
+            from blaze_tpu.parallel.mesh import ShardedFusedRunner
+
+            self.resources["__sharded_fused__"] = ShardedFusedRunner(self.mesh)
         self._ids = itertools.count()
         self._stage_ids = itertools.count()
         self.metrics = MetricNode("session")
@@ -752,16 +781,49 @@ class Session:
 
     # -- internals ------------------------------------------------------------
 
-    def _decide_placement(self, stage_root: N.PlanNode, label: str) -> str:
+    def _decide_placement(self, stage_root: N.PlanNode, label: str,
+                          record: Optional[dict] = None) -> str:
         """Adaptive device placement per stage (runtime/placement.py — the
         TPU analogue of removeInefficientConverts): consult the measured
-        link cost model; record the decision in the metric tree."""
+        link cost model, refined by the prior run's stage record when the
+        stats plane has one; record the decision in the metric tree."""
         from blaze_tpu.runtime import placement
 
-        where = placement.decide(stage_root, self.resources, self.conf)
+        where = placement.decide(stage_root, self.resources, self.conf,
+                                 record=record)
         self.metrics.add(f"placement_{where}_stages", 1)
         self.metrics.named_child(label).add(f"placement_{where}", 1)
         return where
+
+    def _prior_exchange_record(self) -> Optional[dict]:
+        """Prior-run statistics for the exchange about to lower, matched by
+        ordinal among the profile's map-stage records (stage ids differ
+        between runs; ordinals are stable for a fixed plan fingerprint).
+        This is what makes the mesh-vs-files decision STATS-DRIVEN: the
+        roofline estimate gets replaced by measured bytes and device time
+        from the PR 11 stats plane once the query has run once."""
+        qrun = self._qrun()
+        if qrun is None or qrun.stats is None:
+            return None
+        idx = qrun.placement_idx
+        qrun.placement_idx += 1
+        fp = qrun.stats.fingerprint
+        prof = self.profiles.get(fp)
+        if prof is None:
+            from blaze_tpu.obs.stats import load_profile
+
+            try:
+                prof = load_profile(fp, self.conf)
+            except Exception:
+                prof = None
+            if prof:
+                self.profiles[fp] = prof
+        if not prof:
+            return None
+        stages = [s for s in (prof.get("stages") or [])
+                  if str(s.get("kind", "")).startswith(("shuffle_map",
+                                                        "mesh_map"))]
+        return stages[idx] if idx < len(stages) else None
 
     def _record_stage(self, stage: int, kind: str, num_tasks: int,
                       child_op: Operator, wrapper: Optional[str] = None):
@@ -804,14 +866,16 @@ class Session:
 
     def _shuffle_tier(self) -> str:
         """Negotiate the zero-copy tier for this session's (writer, reader)
-        placement: ``process`` passes batch references through the in-memory
-        segment registry (consumer in the same process — serde skipped
-        entirely), ``shm`` commits raw mappable frames that readers mmap
-        (same host, decode skipped), ``ipc`` is the classic framed serde
-        (zero-copy off, or forced). A forced ``process`` degrades to ``shm``
-        under a worker pool — references cannot cross the process boundary;
-        mesh/RSS exchanges never reach this (they keep their own transports
-        and IPC serde)."""
+        placement: ``device`` keeps staged sub-batches device-RESIDENT in
+        the segment registry (multichip: the next fused stage reads them
+        with no host pull), ``process`` passes host batch references through
+        the in-memory segment registry (consumer in the same process — serde
+        skipped entirely), ``shm`` commits raw mappable frames that readers
+        mmap (same host, decode skipped), ``ipc`` is the classic framed
+        serde (zero-copy off, or forced). Forced ``process``/``device``
+        degrade to ``shm`` under a worker pool — references cannot cross the
+        process boundary; mesh/RSS exchanges never reach this (they keep
+        their own transports and IPC serde)."""
         conf = self.conf
         if not conf.zero_copy_shuffle or conf.zero_copy_tier == "ipc":
             return "ipc"
@@ -819,6 +883,11 @@ class Session:
             return "shm"
         if conf.zero_copy_tier == "shm":
             return "shm"
+        if conf.zero_copy_tier == "device":
+            return "device"
+        if conf.device_shuffle_tier and conf.multichip_enabled \
+                and self.mesh is not None:
+            return "device"
         return "process"
 
     def _boundary(self, fn, node: N.PlanNode):
@@ -896,9 +965,18 @@ class Session:
                 node, partitioning=self._sample_range_bounds(node))
         # reducer counts beyond the mesh size group G = ceil(R/n)
         # reducers per device (parallel/mesh.py), so any partitioning
-        # lowers onto the collective
+        # lowers onto the collective — gated per-exchange by the placement
+        # cost model (refined by the prior run's measured stage record):
+        # host-heavy stages keep the file/segment shuffle even under a mesh
         if self.mesh is not None:
-            return self._run_mesh_exchange(node)
+            record = self._prior_exchange_record()
+            where = self._decide_placement(node.child, "exchange_gate",
+                                           record=record)
+            if where == "device":
+                return self._run_mesh_exchange(node)
+            if self.rss_sock_path is not None:
+                return self._run_rss_map_stage(node)
+            return self._run_shuffle_map_stage(node, where=where)
         if self.rss_sock_path is not None:
             return self._run_rss_map_stage(node)
         return self._run_shuffle_map_stage(node)
@@ -985,13 +1063,18 @@ class Session:
             bounds.append(samples[min(len(samples) - 1, i * len(samples) // n)])
         return dataclasses.replace(part, bounds=bounds)
 
-    def _exec_map_stage(self, node: N.ShuffleExchange, mem_sink: bool = False):
+    def _exec_map_stage(self, node: N.ShuffleExchange, mem_sink: bool = False,
+                        device_sink: bool = False,
+                        where: Optional[str] = None):
         """Run one exchange's map side to files; returns (stage,
         [(data_path, offsets)] per map). ``mem_sink``: process-tier
         zero-copy — map tasks commit staged batch references into the
         session's segment registry (plus footer-only marker files so
         lineage/chaos semantics stay file-shaped); only sound when the
-        reducers run in this same process."""
+        reducers run in this same process. ``device_sink`` refines it to
+        the device tier (staged references stay on-chip). ``where``: a
+        placement decision already made by the exchange gate — reused
+        instead of deciding again per stage."""
         stage = next(self._stage_ids)
         child_op = build_operator(node.child)
         num_maps = child_op.num_partitions()
@@ -1014,7 +1097,7 @@ class Session:
         # finds map m's output missing/torn, recovery re-runs exactly this,
         # in-driver (never back on the pool: recovery can fire from a pool
         # serve thread, and run_tasks is not re-entrant)
-        where_cell: List[str] = []
+        where_cell: List[str] = [where] if where else []
 
         def run_map(m: int):
             from blaze_tpu.ops.shuffle.writer import ShuffleWriterExec
@@ -1027,7 +1110,8 @@ class Session:
             data, index = paths_for(m)
             writer = ShuffleWriterExec(
                 child_op, node.partitioning, data, index,
-                mem_sink=(self.mem_segments, stage) if mem_sink else None)
+                mem_sink=(self.mem_segments, stage) if mem_sink else None,
+                device_sink=device_sink)
             ctx = self._make_ctx(m, stage)
             task_metrics = self.metrics.named_child(f"stage_{stage}").named_child(f"map_{m}")
             scope = (STATS_HUB.scoped(qrun.stats.scope_key(stage))
@@ -1069,14 +1153,15 @@ class Session:
         if qrun is not None and qrun.stats is not None:
             # mem_sink=False in a process-tier session (skew-join map
             # stages) still writes files, so the label degrades to ipc/shm
-            tier = "process" if mem_sink else (
-                "shm" if self._shuffle_tier() == "shm" else "ipc")
+            tier = ("device" if device_sink else "process") if mem_sink \
+                else ("shm" if self._shuffle_tier() == "shm" else "ipc")
             qrun.stats.on_map_stage(stage, f"shuffle_map/{tier}", num_maps,
                                     node.partitioning.num_partitions,
                                     indexes=indexes)
         return stage, indexes
 
-    def _run_shuffle_map_stage(self, node: N.ShuffleExchange) -> N.PlanNode:
+    def _run_shuffle_map_stage(self, node: N.ShuffleExchange,
+                               where: Optional[str] = None) -> N.PlanNode:
         """Execute the map side (one ShuffleWriter task per child partition)
         — on the process pool when configured, else on driver threads — then
         expose the per-reducer file segments as an IpcReader resource."""
@@ -1091,8 +1176,9 @@ class Session:
             return self._run_single_collect(node)
         num_reducers = node.partitioning.num_partitions
         tier = self._shuffle_tier()
-        stage, indexes = self._exec_map_stage(node,
-                                              mem_sink=(tier == "process"))
+        stage, indexes = self._exec_map_stage(
+            node, mem_sink=(tier in ("process", "device")),
+            device_sink=(tier == "device"), where=where)
         rid = f"shuffle_{stage}"
         groups = self._coalesce_reducers(indexes, num_reducers)
         if groups is not None:
@@ -1103,9 +1189,10 @@ class Session:
             # partition-zipping ancestors (joins/unions). Mem-tier indexes
             # carry LOGICAL offsets, so sizing works unchanged.
             self.metrics.add("coalesced_partitions", num_reducers - len(groups))
-        if tier == "process":
+        if tier in ("process", "device"):
             # reducers pull staged batch references straight from the
-            # registry; maps that degraded to files mid-write serve file
+            # registry (device tier: on-chip ColumnarBatches — no host
+            # pull); maps that degraded to files mid-write serve file
             # segments transparently through the same provider
             self._register_resource(rid, MemSegmentBlockProvider(
                 self.mem_segments, stage, indexes, groups=groups))
@@ -1412,13 +1499,18 @@ class Session:
         if qrun is not None and qrun.stats is not None:
             qrun.stats.on_map_stage(stage, "mesh_map", num_maps, num_reducers)
 
-        # fold map partitions onto the n mesh slots (round-robin)
+        # fold map partitions onto the n mesh slots in CONTIGUOUS blocks
+        # (slot = m*n // num_maps, ascending): together with the exchange's
+        # shard-major reducer assembly this keeps every reducer's row order
+        # equal to the file path's map-order concat at EVERY mesh size — a
+        # round-robin fold would interleave map outputs differently per n
+        # and break the bit-identical-across-meshes contract
         shard_batches: List[Optional[ColumnarBatch]] = [None] * n
         shard_pids: List[Optional[np.ndarray]] = [None] * n
         for m, (b, p) in enumerate(outputs):
             if b is None:
                 continue
-            s = m % n
+            s = (m * n) // num_maps
             if shard_batches[s] is None:
                 shard_batches[s], shard_pids[s] = b, p
             else:
@@ -1436,6 +1528,13 @@ class Session:
                                        device_resident_budget=remaining)
         if exchange.last_device_resident:
             self._mesh_pinned_bytes = pinned + exchange.last_payload_bytes
+        # tripwires: the mesh path actually engaged, and how many bytes the
+        # collective carried in place of shuffle file writes
+        stage_node = self.metrics.named_child(f"stage_{stage}")
+        stage_node.add("sharded_stages", 1)
+        stage_node.add("collective_bytes", int(exchange.last_wire_bytes))
+        _TM_SHARDED_STAGES.inc()
+        _TM_COLLECTIVE_BYTES.inc(int(exchange.last_wire_bytes))
         rid = f"mesh_shuffle_{stage}"
         # reducer batches (parallel/mesh.py): device-resident ColumnarBatch
         # for small exchanges (the next stage's device aggregation consumes
@@ -1676,7 +1775,7 @@ class Session:
         stage = next(self._stage_ids)
         blocks = self._collect_child_chunks(
             node.child, stage, "single",
-            elide=self._shuffle_tier() == "process")
+            elide=self._shuffle_tier() in ("process", "device"))
         rid = f"single_{stage}"
         self._register_resource(rid, _BlockListProvider(blocks))
         return N.CoalesceBatches(

@@ -46,6 +46,23 @@ shapes are growth, not regression).
 - a mode covered by the base must still be covered by the candidate, and
   the serve section's auto-retry proof must stay present and correct.
 
+``--multichip`` diffs two MULTICHIP_rNN.json device-primary rounds
+(``scripts/scale_soak.py --devices N``)::
+
+    python scripts/bench_diff.py --multichip MULTICHIP_r06.json MULTICHIP_r07.json
+
+- every candidate shape must be bit-identical across its mesh sizes —
+  absolute, the multichip contract;
+- per-shape wall at the top mesh size must stay within ``--wall-tol`` of
+  the base;
+- ``device_time_fraction`` must not drop more than ``--frac-tol`` below
+  the base (host round-trips crept back into a device-resident plan);
+- ``sharded_stages`` proven live by the base must not fall to 0 (the
+  mesh path silently stopped engaging), and ``shuffle_bytes_serialized``
+  must not appear where the base had none (serde crept back in).
+  Pre-r06 raw-stderr artifacts carry no ``shapes`` section: as a base
+  they contribute no relative gates; as a candidate they fail.
+
 ``--serve`` diffs two SERVE_rNN.json serving soaks (PR 13's multi-tenant
 QoS artifacts)::
 
@@ -215,6 +232,62 @@ def diff_chaos(base: dict, cand: dict,
     return regressions
 
 
+def diff_multichip(base: dict, cand: dict, wall_tol: float = 0.25,
+                   frac_tol: float = 0.10) -> List[str]:
+    """Regressions between two MULTICHIP_rNN.json device-primary rounds
+    (empty == candidate is no worse). Absolute gates (bit-identity) apply
+    to every candidate shape; relative gates (wall, device fraction,
+    mesh-path liveness, serde creep) apply where the base measured the
+    same shape."""
+    regressions: List[str] = []
+    cand_shapes = cand.get("shapes") or {}
+    if not cand_shapes:
+        return ["candidate has no shapes section (pre-r06 raw artifact"
+                " cannot be gated)"]
+    base_shapes = base.get("shapes") or {}
+    if not base_shapes:
+        print("  base has no shapes section (pre-r06 raw artifact);"
+              " absolute gates only")
+    for name, crec in sorted(cand_shapes.items()):
+        if not crec.get("bit_identical", False):
+            regressions.append(
+                f"{name}: results not bit-identical across mesh sizes "
+                f"{sorted((crec.get('per_mesh') or {}))}")
+        brec = base_shapes.get(name)
+        if brec is None:
+            if base_shapes:
+                print(f"  {name}: new shape (no base), absolute gates only")
+            continue
+        bwall, cwall = brec.get("wall_s"), crec.get("wall_s")
+        if bwall and cwall is not None and \
+                float(cwall) > float(bwall) * (1 + wall_tol):
+            regressions.append(
+                f"{name}: wall {cwall}s vs base {bwall}s at "
+                f"{crec.get('n_devices')} devices "
+                f"(+{(float(cwall) / float(bwall) - 1) * 100:.0f}% > "
+                f"{wall_tol * 100:.0f}%)")
+        bfrac = float(brec.get("device_time_fraction") or 0.0)
+        cfrac = float(crec.get("device_time_fraction") or 0.0)
+        if bfrac > 0 and cfrac < bfrac - frac_tol:
+            regressions.append(
+                f"{name}: device_time_fraction {cfrac} vs base {bfrac} "
+                f"(-{bfrac - cfrac:.3f} > {frac_tol}; host round-trips "
+                f"crept back into the device-resident plan)")
+        if int(brec.get("sharded_stages", 0) or 0) > 0 and \
+                int(crec.get("sharded_stages", 0) or 0) == 0:
+            regressions.append(
+                f"{name}: sharded_stages fell to 0 (base "
+                f"{brec['sharded_stages']}) — the mesh path no longer "
+                f"engages")
+        bser = int(brec.get("shuffle_bytes_serialized", 0) or 0)
+        cser = int(crec.get("shuffle_bytes_serialized", 0) or 0)
+        if cser > bser * 1.10 + 4096:
+            regressions.append(
+                f"{name}: shuffle_bytes_serialized {cser} vs base {bser} "
+                f"(serde crept back into the device tiers)")
+    return regressions
+
+
 # serve-soak tripwires: once an artifact proves the machinery fires, a
 # successor where it reads 0 has silently unhooked it
 SERVE_TRIPWIRES = ("queries_preempted", "stages_resumed_from_cursor",
@@ -292,6 +365,13 @@ def main(argv=None) -> int:
                     help="diff SERVE_rNN.json serving soaks instead "
                          "(per-tenant p99, shed counts, preemption "
                          "tripwires)")
+    ap.add_argument("--multichip", action="store_true",
+                    help="diff MULTICHIP_rNN.json device-primary rounds "
+                         "instead (bit-identity, top-mesh wall, "
+                         "device_time_fraction, mesh-path liveness)")
+    ap.add_argument("--frac-tol", type=float, default=0.10,
+                    help="--multichip: device_time_fraction drop "
+                         "tolerance (abs)")
     ap.add_argument("--inflation-tol", type=float, default=0.25,
                     help="--chaos: p99_inflation growth tolerance (abs)")
     ap.add_argument("--p99-tol", type=float, default=0.25,
@@ -304,6 +384,9 @@ def main(argv=None) -> int:
     print(f"diffing {args.cand} against {args.base}")
     if args.chaos:
         regressions = diff_chaos(base, cand, args.inflation_tol)
+    elif args.multichip:
+        regressions = diff_multichip(base, cand, args.wall_tol,
+                                     args.frac_tol)
     elif args.serve:
         regressions = diff_serve(base, cand, args.p99_tol)
     else:

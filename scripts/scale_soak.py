@@ -37,6 +37,22 @@ os.environ["BENCH_ROWS"] = str(ROWS)
 os.environ["BENCH_PARTITIONS"] = str(PARTS)
 os.environ["BLAZE_BENCH_TUNNEL_WAIT_S"] = "5"
 
+# ``--devices N`` (the multichip round) needs the forced host-device count
+# in place BEFORE jax initializes its backends, so honor the flag here at
+# import time — one command, no manual XLA_FLAGS incantation:
+#   python scripts/scale_soak.py --devices 8
+if "--devices" in sys.argv[1:]:
+    try:
+        _n_dev = int(sys.argv[sys.argv.index("--devices") + 1])
+    except (IndexError, ValueError):
+        _n_dev = 0
+    if _n_dev > 1 and "xla_force_host_platform_device_count" \
+            not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={_n_dev}").strip()
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
@@ -153,6 +169,9 @@ def main():
                 "shuffle_bytes_serialized": trips["shuffle_bytes_serialized"],
                 "shm_bytes_mapped": trips["shm_bytes_mapped"],
                 "serde_elided_batches": trips["serde_elided_batches"],
+                "sharded_stages": trips["sharded_stages"],
+                "device_shuffle_bytes": trips["device_shuffle_bytes"],
+                "collective_bytes": trips["collective_bytes"],
                 "peak_mem_used": peak_used,
                 "peak_rss_mb": peak_rss_mb(),
             }
@@ -253,6 +272,9 @@ def main():
                 "shuffle_bytes_serialized": trips["shuffle_bytes_serialized"],
                 "shm_bytes_mapped": trips["shm_bytes_mapped"],
                 "serde_elided_batches": trips["serde_elided_batches"],
+                "sharded_stages": trips["sharded_stages"],
+                "device_shuffle_bytes": trips["device_shuffle_bytes"],
+                "collective_bytes": trips["collective_bytes"],
                 "peak_rss_mb": peak_rss_mb(),
             }
             if profile is not None:
@@ -273,6 +295,153 @@ def main():
             os.path.abspath(__file__))), "SOAK_r09.json"), "w") as f:
         json.dump(out, f, indent=1)
     assert not leaked, f"/dev/shm leak: {leaked}"
+
+
+def _result_digest(table) -> str:
+    """Stable content hash of an arrow result table, for the multichip
+    round's bit-identity gate. ``repr`` of python scalars is exact
+    (shortest-roundtrip floats), so two tables hash equal iff every cell —
+    including null positions and -0.0 vs 0.0 — is identical, independent
+    of chunking."""
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(repr(table.schema).encode())
+    for name in table.column_names:
+        h.update(repr(table[name].to_pylist()).encode())
+    return h.hexdigest()[:16]
+
+
+def multichip_main(n_devices: int):
+    """Multichip round: the five bench shapes + the full-fact global sort,
+    each run over 1/2/N-device meshes with device-primary execution on
+    (``multichip_enabled``), gated on bit-identical results across mesh
+    sizes and on the oracle checks at mesh size 1. Writes the structured
+    MULTICHIP_r06.json artifact — per-shape wall, n_devices,
+    device_time_fraction (stats plane), sharded_stages, collective/device
+    shuffle bytes — replacing the raw-stderr-tail format of earlier
+    rounds (``scripts/bench_diff.py --multichip`` diffs two of these).
+
+    Dev boxes emulate the mesh: the ``--devices N`` preamble above forces
+    ``--xla_force_host_platform_device_count=N`` before jax initializes.
+    Env: MULTICHIP_ROWS (2_000_000), MULTICHIP_PARTS (8),
+    MULTICHIP_WARMUP (1 — per-(shape, mesh) compile warmup run).
+    """
+    mc_rows = int(os.environ.get("MULTICHIP_ROWS", 2_000_000))
+    mc_parts = int(os.environ.get("MULTICHIP_PARTS", 8))
+    warmup = int(os.environ.get("MULTICHIP_WARMUP", 1))
+    os.environ["BENCH_ROWS"] = str(mc_rows)
+    os.environ["BENCH_PARTITIONS"] = str(mc_parts)
+
+    import bench  # repo-root bench.py (shapes, generators, oracles)
+    from blaze_tpu.config import Config
+    from blaze_tpu.runtime.memmgr import MemManager
+    from blaze_tpu.runtime.metrics import tripwire_totals
+    from blaze_tpu.runtime.session import Session
+
+    avail = len(jax.devices())
+    assert avail >= n_devices, \
+        f"{n_devices} devices requested, jax sees {avail} " \
+        f"(XLA_FLAGS={os.environ.get('XLA_FLAGS')!r})"
+    mesh_sizes = sorted({k for k in (1, 2, n_devices) if k <= avail})
+    emulated = "xla_force_host_platform_device_count" in \
+        os.environ.get("XLA_FLAGS", "")
+
+    def plan_big_sort(paths):
+        from blaze_tpu.ir import exprs as E
+        from blaze_tpu.ir import nodes as N
+        from blaze_tpu.ops.parquet import scan_node_for_files
+
+        scan = scan_node_for_files(paths["store_sales"],
+                                   num_partitions=mc_parts)
+        orders = [E.SortOrder(E.Column("ss_sales_price"), ascending=False),
+                  E.SortOrder(E.Column("ss_item_sk"))]
+        ex = N.ShuffleExchange(scan, N.RangePartitioning(
+            orders, mc_parts, []))
+        return N.Sort(ex, orders)
+
+    def check_big_sort(table, _oracle):
+        import pyarrow.compute as pc
+
+        assert table.num_rows == mc_rows, table.num_rows
+        prices = table["ss_sales_price"].combine_chunks()
+        assert pc.min(pc.subtract(
+            prices.cast("float64").slice(0, len(prices) - 1),
+            prices.cast("float64").slice(1))).as_py() >= 0
+
+    out = {"metric": "multichip_device_primary",
+           "forced_devices": n_devices, "emulated": emulated,
+           "rows": mc_rows, "partitions": mc_parts,
+           "mesh_sizes": mesh_sizes, "shapes": {}}
+    with tempfile.TemporaryDirectory(prefix="blaze_mchip_") as tmpdir:
+        t0 = time.perf_counter()
+        paths = bench.make_data(tmpdir)
+        out["datagen_s"] = round(time.perf_counter() - t0, 1)
+        _, oracles = bench.run_baseline(paths)
+        oracles["sort10M"] = None
+
+        shapes = list(bench.SHAPES) + [
+            ("sort10M", plan_big_sort, None, None, check_big_sort, ())]
+        for name, plan_fn, _o, _a, check_fn, _t in shapes:
+            per_mesh = {}
+            for k in mesh_sizes:
+                MemManager.reset()
+                conf = Config(multichip_enabled=True, multichip_devices=k)
+                for _ in range(warmup):  # compile outside the timed run
+                    with Session(conf=conf) as sess:
+                        sess.execute_to_table(plan_fn(paths))
+                MemManager.reset()
+                t0 = time.perf_counter()
+                with Session(conf=conf) as sess:
+                    table = sess.execute_to_table(plan_fn(paths))
+                    wall = time.perf_counter() - t0
+                    trips = tripwire_totals(sess.metrics)
+                    profile = sess.profile()
+                if k == mesh_sizes[0]:
+                    check_fn(table, oracles[name])  # absolute correctness
+                per_mesh[str(k)] = {
+                    "wall_s": round(wall, 3), "n_devices": k,
+                    "device_time_fraction":
+                        (profile or {}).get("device_time_fraction", 0.0),
+                    "sharded_stages": trips["sharded_stages"],
+                    "collective_bytes": trips["collective_bytes"],
+                    "device_shuffle_bytes": trips["device_shuffle_bytes"],
+                    "shuffle_bytes_serialized":
+                        trips["shuffle_bytes_serialized"],
+                    "serde_elided_batches": trips["serde_elided_batches"],
+                    "digest": _result_digest(table),
+                }
+            digests = {r["digest"] for r in per_mesh.values()}
+            top = per_mesh[str(mesh_sizes[-1])]
+            out["shapes"][name] = dict(top, per_mesh=per_mesh,
+                                       bit_identical=len(digests) == 1)
+            print(json.dumps({name: out["shapes"][name]}), flush=True)
+
+    sort_rec = out["shapes"].get("sort10M", {}).get("per_mesh", {})
+    w1 = (sort_rec.get(str(mesh_sizes[0])) or {}).get("wall_s")
+    wn = (sort_rec.get(str(mesh_sizes[-1])) or {}).get("wall_s")
+    out["gates"] = {
+        "bit_identical": all(s["bit_identical"]
+                             for s in out["shapes"].values()),
+        "sort_wall_1dev_s": w1,
+        f"sort_wall_{mesh_sizes[-1]}dev_s": wn,
+        "sort_speedup": round(w1 / wn, 2) if w1 and wn else None,
+    }
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "MULTICHIP_r06.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"gates": out["gates"], "artifact": path}), flush=True)
+    # the hard gates: every shape must agree across mesh sizes, and the
+    # device tiers must not re-serialize shuffle traffic
+    for name, rec in out["shapes"].items():
+        assert rec["bit_identical"], (name, rec)
+    if out["gates"]["sort_speedup"] is not None \
+            and out["gates"]["sort_speedup"] < 1.0:
+        print(f"WARNING: {mesh_sizes[-1]}-way sort did not beat 1-device "
+              f"({wn}s vs {w1}s) — emulated meshes share host cores",
+              flush=True)
+    print("MULTICHIP ROUND PASSED", flush=True)
 
 
 def _pctl(vals, q):
@@ -820,6 +989,12 @@ if __name__ == "__main__":
     import argparse
 
     ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--devices", type=int, metavar="N",
+                    help="multichip round: run the bench shapes + the "
+                         "global sort over 1/2/N-device meshes (emulated "
+                         "via --xla_force_host_platform_device_count, set "
+                         "automatically) and write the structured "
+                         "MULTICHIP_r06.json artifact instead of soaking")
     ap.add_argument("--chaos-kill-every", type=float, metavar="N",
                     help="chaos mode: hard-kill a random worker every N "
                          "seconds and gate on recovery (CHAOS_r01.json) "
@@ -830,7 +1005,9 @@ if __name__ == "__main__":
                          "phase per mode plus an uninjected baseline, gated "
                          "per mode (CHAOS_r02.json)")
     args = ap.parse_args()
-    if args.chaos_spec:
+    if args.devices:
+        multichip_main(args.devices)
+    elif args.chaos_spec:
         chaos_matrix_main(args.chaos_spec)
     elif args.chaos_kill_every:
         chaos_main(args.chaos_kill_every)
