@@ -648,6 +648,12 @@ def main():
                         "device_time_fraction")}
                         for o in profile["operators"]],
                 }
+                # why-is-it-slow plane: per-category exclusive wall split
+                # (sum <= wall by construction), the critical path, and the
+                # fusion/placement decision audit for THIS shape's query
+                for k in ("attribution", "critical_path", "decision_audit"):
+                    if profile.get(k):
+                        shapes[name][k] = profile[k]
             total += engine_s
         arrow_total, arrow_shapes = run_arrow_baseline(paths)
         for name, _p, _o, _a, _c, _t in SHAPES:
@@ -664,6 +670,9 @@ def main():
             "arrow_threads": ARROW_THREADS,
             "shapes": shapes,
         }
+        from blaze_tpu.obs.attribution import artifact_section
+
+        record.update(artifact_section())
         if device == "cpu_fallback":
             record["note"] = "accelerator unreachable; ran on cpu fallback"
         elif device == "host_placed":

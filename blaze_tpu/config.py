@@ -235,6 +235,20 @@ class Config:
     # profile_store_dir (capped at profile_store_max, oldest-mtime deleted
     # first; <= 0 disables persistence), served at GET /debug/profiles.
     stats_enabled: bool = True
+
+    # Attribution plane (obs/attribution.py): classify tracer spans into the
+    # fixed category taxonomy and decompose each query's wall into exclusive
+    # per-category time (sum <= wall), plus the critical path. Needs tracer
+    # events (full trace or the flight-recorder ring); one attribute check
+    # per query when off.
+    attribution_enabled: bool = True
+    # regression-watch thresholds (scripts/regression_watch.py and
+    # bench_diff --attribution): a category regresses when its new exclusive
+    # time exceeds ratio x baseline AND the growth clears the noise floor.
+    attribution_regress_ratio: float = 2.0
+    attribution_regress_jit_ratio: float = 3.0
+    attribution_regress_min_ms: float = 50.0
+
     profile_store_dir: str = dataclasses.field(
         default_factory=lambda: os.environ.get(
             "BLAZE_TPU_PROFILE_STORE", "/tmp/blaze_tpu_profiles")

@@ -68,17 +68,25 @@ def _fuse(node: N.PlanNode, conf, allow_start: bool) -> N.PlanNode:
             return node
         return dataclasses.replace(node, child=child)
     if allow_start and _op_fusable(node, conf):
+        from blaze_tpu.obs import attribution as _audit
+
         chain = [node]  # outermost-first
         cur = node.child
         while _op_fusable(cur, conf):
             chain.append(cur)
             cur = cur.child
+        # decision audit: why did the chain stop at ``cur``?
+        _audit.note_fusion_break(_op_unfusable_reason(cur, conf)
+                                 or "blocking_op")
         if _worth_fusing(chain, conf):
+            _audit.note_fusion_chain(len(chain), len(chain))
             fused_child = _fuse(cur, conf, allow_start=True)
             return N.FusedStage(child=fused_child,
                                 ops=tuple(reversed(chain)))
         # a maximal chain not worth fusing has no worthwhile subchain
         # (the gain estimate is additive) — recurse past it instead
+        _audit.note_fusion_chain(0, len(chain))
+        _audit.note_fusion_break("cost_below_min_saved")
     return _recurse(node, conf)
 
 
@@ -88,6 +96,10 @@ def _recurse(node: N.PlanNode, conf) -> N.PlanNode:
     def fn(child):
         nonlocal changed
         allow = not (isinstance(node, N.Agg) and isinstance(child, N.Filter))
+        if not allow and _op_fusable(child, conf):
+            from blaze_tpu.obs import attribution as _audit
+
+            _audit.note_fusion_break("agg_filter_guard")
         out = _fuse(child, conf, allow_start=allow)
         changed = changed or out is not child
         return out
@@ -108,27 +120,58 @@ def _all_device(schema) -> bool:
 def _op_fusable(node: N.PlanNode, conf) -> bool:
     """Can this node join a fused chain? Structural kind + traceable
     expressions + fully fixed-width schemas on both sides."""
+    return _op_unfusable_reason(node, conf) is None
+
+
+def _contains_pyudf(expr) -> bool:
+    from blaze_tpu.ir import exprs as E
+
+    if isinstance(expr, E.PyUDF):
+        return True
+    try:
+        return any(_contains_pyudf(c) for c in expr.children())
+    except Exception:
+        return False
+
+
+def _expr_break_reason(exprs) -> str:
+    return "pyudf" if any(_contains_pyudf(e) for e in exprs) \
+        else "unfusable_expr"
+
+
+def _op_unfusable_reason(node: N.PlanNode, conf):
+    """None when the node can join a fused chain, else the break reason
+    (one of ``obs.attribution.FUSION_BREAK_REASONS``) — the decision-audit
+    form of ``_op_fusable``, same checks in the same order."""
     from blaze_tpu.exprs.compiler import fusable_expr
 
     if not isinstance(node, (N.Projection, N.Filter, N.RenameColumns,
                              N.CoalesceBatches, N.Expand)):
-        return False
+        return "blocking_op"
     try:
         in_schema = node.child.output_schema
         if not _all_device(in_schema):
-            return False
+            return "host_schema"
         if isinstance(node, N.Projection):
-            return _all_device(node.output_schema) and \
-                all(fusable_expr(e, in_schema) for e in node.exprs)
+            if not _all_device(node.output_schema):
+                return "host_schema"
+            if not all(fusable_expr(e, in_schema) for e in node.exprs):
+                return _expr_break_reason(node.exprs)
+            return None
         if isinstance(node, N.Filter):
-            return all(fusable_expr(p, in_schema) for p in node.predicates)
+            if not all(fusable_expr(p, in_schema) for p in node.predicates):
+                return _expr_break_reason(node.predicates)
+            return None
         if isinstance(node, N.Expand):
-            return _all_device(node.schema) and all(
-                fusable_expr(e, in_schema)
-                for proj in node.projections for e in proj)
-        return True  # rename / coalesce: structural only
+            if not _all_device(node.schema):
+                return "host_schema"
+            flat = [e for proj in node.projections for e in proj]
+            if not all(fusable_expr(e, in_schema) for e in flat):
+                return _expr_break_reason(flat)
+            return None
+        return None  # rename / coalesce: structural only
     except Exception:
-        return False
+        return "schema_error"
 
 
 def _nontrivial(exprs) -> int:

@@ -24,8 +24,16 @@ _PRIMARY = ("output_rows", "output_batches", "elapsed_compute_time_ns")
 
 
 def fmt_ns(ns: int) -> str:
-    """Human duration from nanoseconds: 1.23s / 45.6ms / 7.8us / 90ns."""
+    """Human duration from nanoseconds: 2h05m / 4m12s / 1.23s / 45.6ms /
+    7.8us / 90ns. Hour/minute tiers keep long soak counters readable
+    (5025.37s is not a duration anyone can parse at a glance)."""
     ns = int(ns)
+    if ns >= 3_600_000_000_000:
+        h, rem = divmod(ns, 3_600_000_000_000)
+        return f"{h}h{rem // 60_000_000_000:02d}m"
+    if ns >= 60_000_000_000:
+        m, rem = divmod(ns, 60_000_000_000)
+        return f"{m}m{rem // 1_000_000_000:02d}s"
     if ns >= 1_000_000_000:
         return f"{ns / 1e9:.2f}s"
     if ns >= 1_000_000:
@@ -211,4 +219,25 @@ def render_explain_analyze(query: dict, session_metrics: MetricNode) -> str:
                 f"   {o['op']}: est={o['est_rows']}"
                 f" actual={o['actual_rows']}"
                 f" device_frac={frac:.2f}")
+    attr = stats.get("attribution")
+    if attr:
+        from blaze_tpu.obs.attribution import CATEGORIES
+
+        wall = int(attr.get("wall_ns") or 0)
+        lines.append("-- Wall-time attribution (exclusive) --")
+        parts = []
+        for c in CATEGORIES:
+            v = int(attr.get(f"{c}_time_ns") or 0)
+            if v:
+                pct = f" ({100.0 * v / wall:.0f}%)" if wall else ""
+                parts.append(f"{c}={fmt_ns(v)}{pct}")
+        cov = attr.get("coverage_fraction")
+        parts.append(f"coverage={cov:.2f}" if cov is not None else "coverage=?")
+        lines.append("   " + " ".join(parts))
+    cp = stats.get("critical_path")
+    if cp:
+        from blaze_tpu.obs.attribution import critical_path_lines
+
+        lines.append("-- Critical path --")
+        lines.extend("   " + ln for ln in critical_path_lines(cp))
     return "\n".join(lines)

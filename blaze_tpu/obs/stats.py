@@ -54,6 +54,7 @@ PROFILE_FIELDS = (
     "fingerprint", "query_id", "label", "state", "unix_time", "wall_s",
     "rows", "nparts", "device_time_fraction", "operators", "stages",
     "residency", "spills", "recovery", "truncated",
+    "attribution", "critical_path", "decision_audit", "attribution_baseline",
 )
 STAGE_FIELDS = (
     "stage", "kind", "num_tasks", "partitions", "partition_bytes",
@@ -77,9 +78,27 @@ RESIDENCY_FIELDS = (
 SPILL_FIELDS = ("spill_count", "spilled_bytes", "mem_spill_count")
 RECOVERY_FIELDS = ("kind", "stage", "detail")
 
+# attribution plane (obs/attribution.py): per-category exclusive times plus
+# the sweep's own accounting; CRITICAL_PATH/AUDIT keys mirror the segment
+# and decision_audit dicts query_attribution/decision_audit emit.
+from blaze_tpu.obs.attribution import CATEGORY_FIELDS as _CATEGORY_FIELDS
+
+ATTRIBUTION_FIELDS = _CATEGORY_FIELDS + (
+    "wall_ns", "attributed_ns", "coverage_fraction")
+CRITICAL_PATH_FIELDS = (
+    "kind", "name", "stage", "dur_ms", "task", "task_ms", "operators", "op",
+    "self_time_ms",
+)
+AUDIT_FIELDS = (
+    "ops_fused", "ops_eligible", "fused_op_fraction", "fusion_break_reasons",
+    "placement_decisions", "placement_decline_reasons",
+)
+BASELINE_FIELDS = _CATEGORY_FIELDS + ("wall_ns", "samples")
+
 ALL_PROFILE_FIELDS = (PROFILE_FIELDS + STAGE_FIELDS + OPERATOR_FIELDS +
                       SKEW_FIELDS + RESIDENCY_FIELDS + SPILL_FIELDS +
-                      RECOVERY_FIELDS)
+                      RECOVERY_FIELDS + ATTRIBUTION_FIELDS +
+                      CRITICAL_PATH_FIELDS + AUDIT_FIELDS + BASELINE_FIELDS)
 
 _SAFE_ID = re.compile(r"[^A-Za-z0-9_.-]+")
 
@@ -272,12 +291,22 @@ class StatsPlane:
         self._stages: Dict[int, dict] = {}
         self._worker_radix: Dict[int, dict] = {}
         self._recovery: List[dict] = []
+        self._attribution: Optional[dict] = None
         try:
             from blaze_tpu.utils.device import DEVICE_STATS
 
             self._dev0 = DEVICE_STATS.snapshot()
         except Exception:
             self._dev0 = {}
+        # fusion/placement decision-audit counters are process-global (and
+        # absorb worker deltas); the snapshot delta is per-query by the same
+        # exact-alone/upper-bound-concurrent argument as DEVICE_STATS
+        try:
+            from blaze_tpu.obs.attribution import audit_snapshot
+
+            self._audit0 = audit_snapshot()
+        except Exception:
+            self._audit0 = None
 
     def scope_key(self, stage: int):
         """The STATS_HUB scope driver task threads of ``stage`` run under
@@ -353,6 +382,13 @@ class StatsPlane:
         with self._mu:
             self._worker_radix[stage] = _merge_radix(
                 self._worker_radix.get(stage), rec)
+
+    def note_attribution(self, attr: Optional[dict]) -> None:
+        """Attach the per-query exclusive decomposition + critical path
+        (``obs.attribution.query_attribution`` output) before finalize."""
+        if attr:
+            with self._mu:
+                self._attribution = attr
 
     def note_recovery(self, kind: str, stage: Optional[int] = None,
                       detail=None) -> None:
@@ -446,8 +482,28 @@ class StatsPlane:
         }
         with self._mu:
             recovery = list(self._recovery)
+            attribution = self._attribution
+
+        audit = None
+        try:
+            from blaze_tpu.obs.attribution import decision_audit
+
+            audit = decision_audit(self._audit0)
+        except Exception:
+            pass
+
+        extra = {}
+        if attribution is not None:
+            extra["attribution"] = {
+                k: v for k, v in attribution.items() if k != "critical_path"}
+            extra["attribution"].update(attribution.get("categories") or {})
+            extra["attribution"].pop("categories", None)
+            extra["critical_path"] = attribution.get("critical_path") or []
+        if audit is not None:
+            extra["decision_audit"] = audit
 
         return {
+            **extra,
             "fingerprint": self.fingerprint,
             "query_id": query.get("id"),
             "label": query.get("label"),
@@ -576,11 +632,43 @@ def _conf(conf):
     return get_config()
 
 
+_BASELINE_WINDOW = 8  # capped-window running mean
+
+
+def _merge_baseline(profile: dict, path: str) -> dict:
+    """Fold this run's attribution into the previously stored per-category
+    baseline (capped-window running mean over the fingerprint's recent
+    runs) — the history ``scripts/regression_watch.py`` compares a single
+    run against. Stored profiles without attribution pass through."""
+    attr = profile.get("attribution") or {}
+    if not attr:
+        return profile
+    try:
+        with open(path) as f:
+            prev = json.load(f).get("attribution_baseline") or {}
+    except (OSError, ValueError):
+        prev = {}
+    from blaze_tpu.obs.attribution import CATEGORY_FIELDS
+
+    n = int(prev.get("samples") or 0)
+    weight = min(n + 1, _BASELINE_WINDOW)
+    base = {"samples": n + 1}
+    for k in CATEGORY_FIELDS + ("wall_ns",):
+        x = float(attr.get(k) or 0.0)
+        old = float(prev.get(k) or 0.0) if n else x
+        base[k] = int(old + (x - old) / weight)
+    profile = dict(profile)
+    profile["attribution_baseline"] = base
+    return profile
+
+
 def save_profile(profile: dict, conf=None) -> Optional[str]:
     """Persist one QueryProfile under ``<fingerprint>.json`` (the latest
     run of a plan shape overwrites: the store answers "last observed stats
     for this fingerprint"). Atomic write, mtime-GC'd to
-    ``conf.profile_store_max``; never raises."""
+    ``conf.profile_store_max``; never raises. Profiles carrying an
+    ``attribution`` section also fold into the fingerprint's rolling
+    per-category baseline (the regression-watch history)."""
     try:
         conf = _conf(conf)
         out_dir = getattr(conf, "profile_store_dir", "") or ""
@@ -592,6 +680,7 @@ def save_profile(profile: dict, conf=None) -> Optional[str]:
             return None
         os.makedirs(out_dir, exist_ok=True)
         path = os.path.join(out_dir, fp + ".json")
+        profile = _merge_baseline(profile, path)
         tmp = f"{path}.tmp{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump(profile, f, default=str)

@@ -120,6 +120,12 @@ class _Instrument:
             self._series.clear()
             self._bound.clear()
 
+    def series(self) -> Dict[Tuple, object]:
+        """Snapshot of every label series: ``{label_key_tuple: value}``
+        (read-side accessor for audit/attribution aggregation)."""
+        with self._mu:
+            return dict(self._series)
+
 
 class _BoundCounter:
     __slots__ = ("_c", "_key")

@@ -235,15 +235,50 @@ class Tracer:
             return list(self._events)
 
     def to_chrome_trace(self, process_name: str = "blaze_tpu-driver") -> Dict[str, Any]:
-        """Perfetto/chrome://tracing-loadable JSON object."""
-        events = self.snapshot()
+        """Perfetto/chrome://tracing-loadable JSON object. Spans carry a
+        stable per-attribution-category ``cname`` (same work, same color,
+        across traces and rounds), and each stage's shuffle-write spans are
+        linked to the downstream fetch spans with flow events so the
+        cross-stage critical path is visible as arrows."""
+        from blaze_tpu.obs.attribution import CATEGORY_CNAME, classify_span
+
+        events = []
+        writes_by_stage: Dict[Any, dict] = {}
+        fetches: List[dict] = []
+        for ev in self.snapshot():
+            cat = classify_span(ev.get("name", ""), ev.get("cat", ""))
+            if cat is not None:
+                ev = dict(ev)
+                ev["cname"] = CATEGORY_CNAME[cat]
+            if cat == "shuffle_write":
+                stage = (ev.get("args") or {}).get("stage")
+                if stage is not None:
+                    writes_by_stage.setdefault(stage, ev)
+            elif cat == "shuffle_fetch":
+                fetches.append(ev)
+            events.append(ev)
+        flows = []
+        for fe in fetches:
+            stage = (fe.get("args") or {}).get("stage")
+            we = writes_by_stage.get(stage)
+            if we is None:
+                continue
+            fid = f"shuffle_{stage}"
+            flows.append({"ph": "s", "name": fid, "cat": "shuffle_flow",
+                          "id": fid, "ts": we["ts"] + we.get("dur", 0.0),
+                          "pid": we.get("pid", self.pid),
+                          "tid": we.get("tid", 0)})
+            flows.append({"ph": "f", "bp": "e", "name": fid,
+                          "cat": "shuffle_flow", "id": fid, "ts": fe["ts"],
+                          "pid": fe.get("pid", self.pid),
+                          "tid": fe.get("tid", 0)})
         pids = {e.get("pid", self.pid) for e in events} | {self.pid}
         meta = []
         for pid in sorted(pids):
             name = process_name if pid == self.pid else f"blaze_tpu-worker-{pid}"
             meta.append({"ph": "M", "name": "process_name", "pid": pid,
                          "tid": 0, "args": {"name": name}})
-        return {"traceEvents": meta + events, "displayTimeUnit": "ms",
+        return {"traceEvents": meta + events + flows, "displayTimeUnit": "ms",
                 "otherData": {"dropped_events": self.dropped,
                               "wall_epoch_ns": self.wall_epoch_ns}}
 

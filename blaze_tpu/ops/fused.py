@@ -90,6 +90,9 @@ class _FusedSegment:
                 _BROKEN[self.fingerprint] = repr(err)
                 log.warning("fused segment %s fell back to eager: %r",
                             self.fingerprint, err)
+                from blaze_tpu.obs import attribution as _audit
+
+                _audit.note_fusion_break("broken_fingerprint")
 
 
 class FusedStageExec(Operator):

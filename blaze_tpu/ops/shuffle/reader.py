@@ -191,9 +191,13 @@ class IpcReaderExec(Operator):
                 t1 = time.perf_counter_ns()
                 _TM_FETCH_SECS.observe((t1 - t0) / 1e9)
                 if trace:
+                    import re as _re
+
+                    m = _re.search(r"shuffle_(\d+)", self.resource_id or "")
                     TRACER.complete(
                         "shuffle_fetch", "shuffle", t0, t1 - t0,
-                        {"partition": partition, "blocks": nblocks})
+                        {"partition": partition, "blocks": nblocks,
+                         "stage": int(m.group(1)) if m else None})
 
         t = threading.Thread(target=produce, daemon=True, name="ipc-prefetch")
         t.start()

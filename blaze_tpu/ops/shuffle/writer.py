@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import io
 import os
+import re
 import struct
 from typing import List, Optional
 
@@ -128,10 +129,20 @@ class ShuffleWriterExec(Operator):
                 state.insert(batch)
             import time as _time
 
+            from blaze_tpu.obs.tracer import TRACER
+
             t0 = _time.perf_counter()
+            t0_ns = _time.perf_counter_ns()
             with metrics.timer("shuffle_write_time_ns"):
                 state.finish()
             _TM_WRITE_SECS.observe(_time.perf_counter() - t0)
+            if TRACER.active:
+                m = re.search(r"shuffle_(\d+)", self.output_data_file or "")
+                TRACER.complete(
+                    "shuffle_write", "shuffle", t0_ns,
+                    _time.perf_counter_ns() - t0_ns,
+                    {"stage": int(m.group(1)) if m else None,
+                     "map": partition})
         finally:
             ctx.mem.unregister(state)
             state.release()

@@ -68,6 +68,12 @@ def _query_record(q: dict) -> dict:
 
         d["stage_stats"] = [stage_summary_line(s) for s in stats["stages"]]
         d["fingerprint"] = stats.get("fingerprint")
+    if stats and stats.get("attribution"):
+        d["attribution"] = stats["attribution"]
+    if stats and stats.get("critical_path"):
+        from blaze_tpu.obs.attribution import critical_path_lines
+
+        d["critical_path"] = critical_path_lines(stats["critical_path"])
     return d
 
 

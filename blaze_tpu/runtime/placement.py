@@ -384,8 +384,12 @@ def decide(root: N.PlanNode, resources: dict, conf,
     term, and the observed compute seconds replace the side of the cost
     model the stage actually ran on last time — the decision tracks what
     this stage really does, not what the operator count guesses."""
+    from blaze_tpu.obs import attribution as _audit
+
     mode = getattr(conf, "device_placement", "auto")
     if mode in ("device", "host"):
+        _audit.note_placement(
+            mode, "conf_forced_host" if mode == "host" else None)
         return mode
     lp = link_profile()
     est = estimate_stage(root, resources)
@@ -401,10 +405,12 @@ def decide(root: N.PlanNode, resources: dict, conf,
             measured_s = comp_ns / 1e9
             measured_on = "device" if (
                 record.get("device_time_fraction") or 0.0) > 0.5 else "host"
+    reason = None  # decision audit: why the device side lost (when it did)
     if lp.is_colocated:
         choice = "device"
     elif est.input_bytes <= 0 and measured_s is None:
         choice = "host"
+        reason = "no_measurable_input"
     else:
         device_cost, host_cost = stage_costs(est, lp)
         if measured_s is not None:
@@ -414,6 +420,10 @@ def decide(root: N.PlanNode, resources: dict, conf,
             else:
                 device_cost = measured_s
         choice = "device" if device_cost < host_cost else "host"
+        if choice == "host":
+            reason = "measured_cost" if measured_s is not None \
+                else "cost_model_transfer_bound"
+    _audit.note_placement(choice, reason)
     log.info("placement[%s]: in=%.1fMB ops=%d reduces=%s measured=%s -> %s",
              lp.platform, est.input_bytes / 1e6, est.n_ops,
              est.reduces_output,

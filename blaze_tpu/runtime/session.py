@@ -443,6 +443,18 @@ class Session:
             query["wall_s"] = dur_ns / 1e9
             query["state"] = state
             if qrun.stats is not None:
+                # exclusive wall decomposition + critical path over the
+                # query's tracer window (obs/attribution.py); one attribute
+                # check when the tracer/ring and the knob are off
+                if TRACER.active and \
+                        getattr(self.conf, "attribution_enabled", True):
+                    try:
+                        from blaze_tpu.obs.attribution import query_attribution
+
+                        qrun.stats.note_attribution(
+                            query_attribution(t0, dur_ns))
+                    except Exception:
+                        pass
                 # fold the stats plane into the record BEFORE it enters the
                 # query log; completed queries also persist their profile
                 # under the plan fingerprint (obs/stats.py store)

@@ -708,8 +708,18 @@ class QueryScheduler:
             if h.admitted_at is None:
                 # first admission only: resumed queries already paid their
                 # queue wait, re-observing would double-count
-                self._tm_queue_wait.labels(tenant=t.name).observe(
-                    now - h.submitted_at)
+                wait_s = now - h.submitted_at
+                self._tm_queue_wait.labels(tenant=t.name).observe(wait_s)
+                from blaze_tpu.obs import attribution as _attr
+                from blaze_tpu.obs.tracer import TRACER
+
+                _attr.note_queue_wait(wait_s)
+                if TRACER.active:
+                    end_ns = time.perf_counter_ns()
+                    TRACER.complete("queue_wait", "queue",
+                                    end_ns - int(wait_s * 1e9),
+                                    int(wait_s * 1e9),
+                                    {"qid": h.qid, "tenant": t.name})
             h.state = "admitted"
             h.admitted_at = now
             t.running += 1

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 
 import numpy as np
 
@@ -227,10 +228,17 @@ def pull_columns(cols, n: int):
     # start every transfer before blocking on any (device_get would pull
     # leaves sequentially on this backend — async-then-collect overlaps the
     # round trips, ~3x on the tunnel)
+    from blaze_tpu.obs.tracer import TRACER
+
+    t0_ns = time.perf_counter_ns() if TRACER.active else 0
     for a in to_pull:
         a.copy_to_host_async()
     pulled = [np.asarray(a)[:n] for a in to_pull]
-    DEVICE_STATS.add_to_host(sum(a.nbytes for a in to_pull))
+    nbytes = sum(a.nbytes for a in to_pull)
+    DEVICE_STATS.add_to_host(nbytes)
+    if t0_ns:
+        TRACER.complete("to_host", "transfer", t0_ns,
+                        time.perf_counter_ns() - t0_ns, {"bytes": nbytes})
     out = [None] * len(cols)
     for k, i in enumerate(dev_slots):
         out[i] = (pulled[2 * k], pulled[2 * k + 1])

@@ -78,6 +78,27 @@ QoS artifacts)::
   ``stages_resumed_from_cursor``, ``backpressure_429s``) must not fall to
   zero once a base artifact proves them live, and the preemption proof
   must still resume bit-identical.
+
+``--attribution`` gates on the per-category exclusive wall decomposition
+(PR 15's why-is-it-slow plane) instead of total wall clock::
+
+    python scripts/bench_diff.py --attribution BENCH_r10.json BENCH_r11.json
+
+- for each shape present in BOTH artifacts with an ``attribution``
+  section, each ``<category>_time_ns`` in the candidate must stay under
+  ``ratio x max(base, floor)`` where the floor is ``--attr-min-ms``
+  (default 50ms — sub-floor categories are noise) and the ratio is
+  ``--attr-jit-ratio`` for ``jit_compile_time_ns`` (default 3.0 —
+  compile time is the classic flat-wall regression: caching broke but a
+  faster kernel hid it) and ``--attr-ratio`` for everything else
+  (default 2.0). This catches category-level regressions even when the
+  shape's total wall is flat;
+- ``fused_op_fraction`` (from the shape's ``decision_audit``) must not
+  drop more than 0.2 below the base: the fusion tripwire — chains
+  silently stopped fusing;
+- shapes or sections missing from either artifact are skipped clean
+  (pre-attribution artifacts like BENCH_r10 carry no sections; a
+  self-diff of those must stay clean).
 """
 
 from __future__ import annotations
@@ -288,6 +309,52 @@ def diff_multichip(base: dict, cand: dict, wall_tol: float = 0.25,
     return regressions
 
 
+def diff_attribution(base: dict, cand: dict, ratio: float = 2.0,
+                     jit_ratio: float = 3.0,
+                     min_ms: float = 50.0) -> List[str]:
+    """Regressions between the per-shape ``attribution`` sections of two
+    BENCH artifacts (empty == clean). A category regresses when the
+    candidate exceeds ``ratio x max(base, floor)``; the floor keeps noise
+    categories (sub-``min_ms``) from tripping on jitter. Shapes/sections
+    absent from either side are skipped clean so pre-attribution
+    artifacts (BENCH_r10 and earlier) gate trivially."""
+    regressions: List[str] = []
+    floor_ns = min_ms * 1e6
+    base_shapes = base.get("shapes") or {}
+    cand_shapes = cand.get("shapes") or {}
+    for name, crec in sorted(cand_shapes.items()):
+        brec = base_shapes.get(name)
+        cattr = crec.get("attribution")
+        battr = (brec or {}).get("attribution")
+        if cattr is None or battr is None:
+            which = "candidate" if cattr is None else "base"
+            print(f"  {name}: no attribution section in {which}, skipped")
+        else:
+            cats = sorted(k for k in set(battr) | set(cattr)
+                          if k.endswith("_time_ns"))
+            for cat in cats:
+                bv = float(battr.get(cat, 0) or 0)
+                cv = float(cattr.get(cat, 0) or 0)
+                r = jit_ratio if cat == "jit_compile_time_ns" else ratio
+                limit = r * max(bv, floor_ns)
+                if cv > limit:
+                    regressions.append(
+                        f"{name}: {cat} {cv / 1e6:.1f}ms vs base "
+                        f"{bv / 1e6:.1f}ms (> {r:.1f}x max(base, "
+                        f"{min_ms:.0f}ms) — category-level regression"
+                        f" even if wall is flat)")
+        bfrac = ((brec or {}).get("decision_audit")
+                 or {}).get("fused_op_fraction")
+        cfrac = (crec.get("decision_audit") or {}).get("fused_op_fraction")
+        if bfrac is not None and cfrac is not None and \
+                float(cfrac) < float(bfrac) - 0.2:
+            regressions.append(
+                f"{name}: fused_op_fraction {cfrac} vs base {bfrac} "
+                f"(-{float(bfrac) - float(cfrac):.2f} > 0.2 — chains "
+                f"silently stopped fusing)")
+    return regressions
+
+
 # serve-soak tripwires: once an artifact proves the machinery fires, a
 # successor where it reads 0 has silently unhooked it
 SERVE_TRIPWIRES = ("queries_preempted", "stages_resumed_from_cursor",
@@ -376,6 +443,17 @@ def main(argv=None) -> int:
                     help="--chaos: p99_inflation growth tolerance (abs)")
     ap.add_argument("--p99-tol", type=float, default=0.25,
                     help="--serve: per-tenant p99 growth tolerance (frac)")
+    ap.add_argument("--attribution", action="store_true",
+                    help="diff per-shape exclusive-time attribution "
+                         "sections instead (per-category ratio gates; "
+                         "catches regressions hidden by a flat wall)")
+    ap.add_argument("--attr-ratio", type=float, default=2.0,
+                    help="--attribution: growth ratio per category")
+    ap.add_argument("--attr-jit-ratio", type=float, default=3.0,
+                    help="--attribution: growth ratio for jit_compile")
+    ap.add_argument("--attr-min-ms", type=float, default=50.0,
+                    help="--attribution: noise floor (ms) under which a "
+                         "category never regresses")
     args = ap.parse_args(argv)
     with open(args.base) as f:
         base = json.load(f)
@@ -389,6 +467,10 @@ def main(argv=None) -> int:
                                      args.frac_tol)
     elif args.serve:
         regressions = diff_serve(base, cand, args.p99_tol)
+    elif args.attribution:
+        regressions = diff_attribution(base, cand, args.attr_ratio,
+                                       args.attr_jit_ratio,
+                                       args.attr_min_ms)
     else:
         regressions = diff_artifacts(base, cand, args.wall_tol,
                                      args.bytes_tol)

@@ -14,7 +14,13 @@ Walks every registration call site (``<reg>.counter("...")`` /
 3. every field the stats plane emits into QueryProfile JSON
    (``obs.stats.ALL_PROFILE_FIELDS``) is snake_case — profiles are an
    external artifact surface (HTTP, bench records, the on-disk store), so
-   field names are API.
+   field names are API;
+4. the attribution taxonomy (``obs.attribution``) is internally
+   consistent: categories snake_case and unique, the priority sweep order
+   a permutation of them, every category carrying a Chrome-trace color
+   and a ``<category>_time_ns`` artifact field, and the fusion-break /
+   placement-decline reason vocabularies snake_case — these strings land
+   verbatim in artifacts and metric labels, so they are API too.
 
 Tests are deliberately NOT scanned: they register intentionally-bad names
 to assert the runtime validation. Standalone: exits 1 with a report on any
@@ -90,6 +96,7 @@ def run_lint(root: str = REPO):
     if count == 0:
         violations.append("no registrations found — scan roots wrong?")
     violations.extend(check_profile_fields())
+    violations.extend(check_attribution_taxonomy())
     return violations
 
 
@@ -112,6 +119,10 @@ def check_profile_fields():
         ("RESIDENCY_FIELDS", stats.RESIDENCY_FIELDS),
         ("SPILL_FIELDS", stats.SPILL_FIELDS),
         ("RECOVERY_FIELDS", stats.RECOVERY_FIELDS),
+        ("ATTRIBUTION_FIELDS", stats.ATTRIBUTION_FIELDS),
+        ("CRITICAL_PATH_FIELDS", stats.CRITICAL_PATH_FIELDS),
+        ("AUDIT_FIELDS", stats.AUDIT_FIELDS),
+        ("BASELINE_FIELDS", stats.BASELINE_FIELDS),
     ]
     for schema_name, fields in schemas:
         if len(set(fields)) != len(fields):
@@ -122,6 +133,62 @@ def check_profile_fields():
                 violations.append(
                     f"obs/stats.py: {schema_name} field {f!r}"
                     " is not snake_case")
+    return violations
+
+
+def check_attribution_taxonomy():
+    """Validate the attribution plane's category/reason vocabularies —
+    strings that appear verbatim in artifacts, metric labels, and the
+    Chrome-trace color map, so internal consistency is an API contract."""
+    import re
+
+    try:
+        from blaze_tpu.obs import attribution as attr
+    except Exception as exc:
+        return [f"obs.attribution unimportable: {exc}"]
+    snake = re.compile(r"^[a-z][a-z0-9_]*$")
+    violations = []
+    cats = attr.CATEGORIES
+    if len(set(cats)) != len(cats):
+        violations.append("obs/attribution.py: duplicate in CATEGORIES")
+    for c in cats:
+        if not snake.match(c):
+            violations.append(
+                f"obs/attribution.py: category {c!r} is not snake_case")
+    if sorted(attr.PRIORITY) != sorted(cats):
+        violations.append(
+            "obs/attribution.py: PRIORITY is not a permutation of "
+            "CATEGORIES — the exclusivity sweep would drop or invent "
+            "a category")
+    missing_cname = [c for c in cats if c not in attr.CATEGORY_CNAME]
+    if missing_cname:
+        violations.append(
+            f"obs/attribution.py: CATEGORY_CNAME missing {missing_cname}"
+            " (uncolored spans in the Chrome trace)")
+    if attr.CATEGORY_FIELDS != tuple(f"{c}_time_ns" for c in cats):
+        violations.append(
+            "obs/attribution.py: CATEGORY_FIELDS out of sync with "
+            "CATEGORIES — artifact keys diverge from the taxonomy")
+    for vocab_name, vocab in (
+            ("FUSION_BREAK_REASONS", attr.FUSION_BREAK_REASONS),
+            ("PLACEMENT_DECLINE_REASONS", attr.PLACEMENT_DECLINE_REASONS)):
+        if len(set(vocab)) != len(vocab):
+            violations.append(
+                f"obs/attribution.py: duplicate in {vocab_name}")
+        for r in vocab:
+            if not snake.match(r):
+                violations.append(
+                    f"obs/attribution.py: {vocab_name} reason {r!r}"
+                    " is not snake_case")
+    try:
+        from blaze_tpu.obs import stats
+        for f in ("fused_op_fraction", "fusion_break_reasons"):
+            if f not in stats.AUDIT_FIELDS:
+                violations.append(
+                    f"obs/stats.py: AUDIT_FIELDS missing {f!r} — the "
+                    f"fusion-coverage tripwire left the profile schema")
+    except Exception as exc:
+        violations.append(f"obs.stats unimportable: {exc}")
     return violations
 
 

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Per-fingerprint attribution regression watch.
+
+Walks the profile store (``conf.profile_store_dir``) and compares each
+fingerprint's LAST run's per-category exclusive times (``attribution``)
+against its own rolling baseline (``attribution_baseline``, the
+capped-window mean ``obs/stats.py`` folds on every save). A category
+breaches when::
+
+    current > ratio x max(baseline, floor)
+
+with ``--jit-ratio`` (default ``conf.attribution_regress_jit_ratio``,
+3.0) for ``jit_compile_time_ns`` and ``--ratio`` (default
+``conf.attribution_regress_ratio``, 2.0) for everything else; the floor
+``--min-ms`` (default ``conf.attribution_regress_min_ms``, 50ms) keeps
+sub-noise categories from tripping. This is the category-level watch the
+wall-clock gates can't provide: a query whose compile time tripled but
+whose kernels got faster shows a flat wall and still breaches here.
+
+On breach the watch emits a flight-recorder incident bundle
+(``kind="attribution_regression"`` under ``conf.incident_dir``, browsable
+at GET /debug/incidents) carrying the offending categories, and exits 1.
+Fingerprints with fewer than 2 baseline samples are skipped — a
+first-observed shape has no history to regress against (its baseline IS
+its first run).
+
+Run it after a soak/bench round, or from cron against a production
+profile store::
+
+    python scripts/regression_watch.py --store /tmp/blaze_tpu_profiles
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from blaze_tpu.config import get_config  # noqa: E402
+from blaze_tpu.obs.attribution import CATEGORY_FIELDS  # noqa: E402
+
+
+def check_profile(profile: dict, ratio: float, jit_ratio: float,
+                  min_ms: float):
+    """Breached categories for one stored profile:
+    ``[{category, current_ns, baseline_ns, ratio, limit_ns}, ...]``
+    (empty == within baseline, or no history yet)."""
+    attr = profile.get("attribution") or {}
+    base = profile.get("attribution_baseline") or {}
+    if not attr or int(base.get("samples") or 0) < 2:
+        return []
+    floor_ns = min_ms * 1e6
+    breaches = []
+    for field in CATEGORY_FIELDS:
+        cur = float(attr.get(field) or 0.0)
+        bl = float(base.get(field) or 0.0)
+        r = jit_ratio if field == "jit_compile_time_ns" else ratio
+        limit = r * max(bl, floor_ns)
+        if cur > limit:
+            breaches.append({"category": field,
+                             "current_ns": int(cur),
+                             "baseline_ns": int(bl),
+                             "ratio": round(cur / max(bl, floor_ns), 2),
+                             "limit_ns": int(limit)})
+    return breaches
+
+
+def watch(store: str, ratio: float, jit_ratio: float, min_ms: float,
+          incident_dir: str = "") -> dict:
+    """Scan every stored profile; returns the report dict. Writes one
+    incident bundle per breached fingerprint when ``incident_dir`` is
+    set."""
+    report = {"store": store, "checked": 0, "skipped_no_history": 0,
+              "breaches": []}
+    names = []
+    if os.path.isdir(store):
+        names = sorted(n for n in os.listdir(store) if n.endswith(".json"))
+    for name in names:
+        try:
+            with open(os.path.join(store, name)) as f:
+                profile = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not (profile.get("attribution") or {}):
+            continue
+        if int((profile.get("attribution_baseline") or {})
+               .get("samples") or 0) < 2:
+            report["skipped_no_history"] += 1
+            continue
+        report["checked"] += 1
+        breaches = check_profile(profile, ratio, jit_ratio, min_ms)
+        if not breaches:
+            continue
+        fp = profile.get("fingerprint") or name[:-5]
+        entry = {"fingerprint": fp, "label": profile.get("label"),
+                 "breaches": breaches}
+        if incident_dir:
+            import dataclasses
+
+            from blaze_tpu.obs.dump import record_incident
+
+            conf = dataclasses.replace(get_config(),
+                                       incident_dir=incident_dir)
+            entry["incident"] = record_incident(
+                kind="attribution_regression", label=str(fp), conf=conf,
+                extra={"breaches": breaches,
+                       "wall_ns": (profile.get("attribution")
+                                   or {}).get("wall_ns"),
+                       "baseline_samples": (
+                           profile.get("attribution_baseline")
+                           or {}).get("samples")})
+        report["breaches"].append(entry)
+    return report
+
+
+def main(argv=None) -> int:
+    conf = get_config()
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--store", default=conf.profile_store_dir,
+                    help="profile store directory to scan")
+    ap.add_argument("--ratio", type=float,
+                    default=conf.attribution_regress_ratio,
+                    help="per-category growth ratio over baseline")
+    ap.add_argument("--jit-ratio", type=float,
+                    default=conf.attribution_regress_jit_ratio,
+                    help="growth ratio for jit_compile (compile-cache "
+                         "breakage hides behind flat walls)")
+    ap.add_argument("--min-ms", type=float,
+                    default=conf.attribution_regress_min_ms,
+                    help="noise floor: categories under this never breach")
+    ap.add_argument("--incident-dir", default=conf.incident_dir,
+                    help="write incident bundles here on breach "
+                         "('' disables)")
+    args = ap.parse_args(argv)
+    report = watch(args.store, args.ratio, args.jit_ratio, args.min_ms,
+                   args.incident_dir)
+    print(json.dumps(report, indent=2))
+    if report["breaches"]:
+        print(f"REGRESSION: {len(report['breaches'])} fingerprint(s) "
+              f"breached their attribution baseline", file=sys.stderr)
+        return 1
+    print(f"ok: {report['checked']} fingerprint(s) within baseline "
+          f"({report['skipped_no_history']} without history)",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -191,6 +191,9 @@ def main():
     soak_path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "SOAK_r09.json")
     if "tpcds" not in os.environ.get("SOAK_PHASES", "shapes,tpcds"):
+        from blaze_tpu.obs.attribution import artifact_section
+
+        out.update(artifact_section())
         out["peak_rss_mb"] = peak_rss_mb()
         leaked = shm_roots(shm0)
         out["shm_segments_leaked"] = len(leaked)
@@ -287,6 +290,9 @@ def main():
                         for s in profile["stages"]],
                 }
             print(json.dumps({name: out["tpcds"][name]}), flush=True)
+    from blaze_tpu.obs.attribution import artifact_section
+
+    out.update(artifact_section())
     out["peak_rss_mb"] = peak_rss_mb()
     leaked = shm_roots(shm0)
     out["shm_segments_leaked"] = len(leaked)
@@ -427,6 +433,9 @@ def multichip_main(n_devices: int):
         f"sort_wall_{mesh_sizes[-1]}dev_s": wn,
         "sort_speedup": round(w1 / wn, 2) if w1 and wn else None,
     }
+    from blaze_tpu.obs.attribution import artifact_section
+
+    out.update(artifact_section())
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "MULTICHIP_r06.json")
     with open(path, "w") as f:
@@ -678,6 +687,9 @@ def chaos_main(kill_every_s: float):
         "p99_chaos_s": chaos["p99_s"],
         "p99_inflation": round(chaos["p99_s"] / max(base["p99_s"], 1e-9), 2),
     }
+    from blaze_tpu.obs.attribution import artifact_section
+
+    section.update(artifact_section())
     path = _write_chaos_section("scale", section)
     print(json.dumps({"gates": gates, "artifact": path}), flush=True)
 

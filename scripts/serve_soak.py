@@ -604,6 +604,9 @@ def main():
             "wall_s": round(time.perf_counter() - t_all, 2),
         })
 
+    from blaze_tpu.obs.attribution import artifact_section
+
+    out.update(artifact_section())
     iso_p99 = out["isolated_light"]["latency_ms"]["p99"]
     light_p99 = out["tenants"]["light"]["latency_ms"]["p99"]
     out["gates"] = {
@@ -635,6 +638,11 @@ def main():
     assert out["tripwires"]["stages_resumed_from_cursor"] >= 1, \
         out["tripwires"]
     assert probe["bit_identical"] and probe["preempt_count"] >= 1, probe
+    # tracer-drop gate: a soak must never overflow the trace buffer (full
+    # tracing stays off here, so any drop means the flight-recorder path or
+    # a worker absorb went wrong)
+    assert out["tracer_events_dropped"] == 0, (
+        f"tracer dropped {out['tracer_events_dropped']} events during soak")
     print(f"\nwrote {dst}")
 
 
@@ -875,6 +883,9 @@ def chaos_main(kill_every_s: float):
         "p99_chaos_s": chaos["p99_s"],
         "p99_inflation": round(chaos["p99_s"] / max(base["p99_s"], 1e-9), 2),
     }
+    from blaze_tpu.obs.attribution import artifact_section
+
+    section.update(artifact_section())
     path = _write_chaos_section("serve", section)
     print(json.dumps({"gates": gates, "artifact": path}), flush=True)
 
@@ -1172,6 +1183,9 @@ def chaos_matrix_main(spec: str):
             "kills_injected": ph["kills_injected"],
         }
     section["gates"] = gates
+    from blaze_tpu.obs.attribution import artifact_section
+
+    section.update(artifact_section())
     path = _write_chaos_section("serve", section, fname="CHAOS_r02.json")
     print(json.dumps({"gates": gates, "artifact": path}), flush=True)
 
