@@ -1,0 +1,27 @@
+"""Fingerprint-keyed result/subplan cache with incremental maintenance.
+
+Three cooperating pieces (ROADMAP item 2; reference: the Paimon
+streaming-table integration scenario in PAPER.md):
+
+- ``result_cache.QueryCache`` — whole-plan result hits and per-exchange
+  subplan sharing, keyed by the PR 11 canonical plan fingerprint, stored
+  as batch references in the session's ``MemSegmentRegistry`` (serde
+  elided), LRU + bytes-capped as a ``MemConsumer`` so serve admission
+  sees cache pressure, with the memory -> spill-dir -> miss degrade
+  ladder instead of hard failure.
+- ``ingest.IngestRegistry`` — append-only versioned tables behind
+  ``Session.append`` / ``POST /ingest``; appends bump a per-table version
+  that cached entries record, turning later hits stale.
+- ``incremental`` — mergeable-plan detection (final SUM/COUNT/MIN/MAX
+  aggregation) and the tail-recompute + merge that refreshes a stale
+  entry without recomputing history.
+"""
+
+from blaze_tpu.cache.incremental import mergeable_spec, merge_tables
+from blaze_tpu.cache.ingest import INGEST_PREFIX, IngestRegistry
+from blaze_tpu.cache.result_cache import QueryCache, plan_cacheable
+
+__all__ = [
+    "QueryCache", "IngestRegistry", "INGEST_PREFIX", "plan_cacheable",
+    "mergeable_spec", "merge_tables",
+]

@@ -1,0 +1,82 @@
+"""Mergeable-plan detection and partial-state merge for stale cache hits.
+
+A cached result over a grown ingest table can be refreshed WITHOUT
+recomputing history exactly when the plan's final output is itself a
+mergeable aggregation state: a FINAL/COMPLETE hash aggregation whose
+functions are all in {SUM, COUNT, MIN, MAX}. For those, the cached
+output IS the materialized partial state — running the same plan over
+only the appended tail and folding the two tables (sum for SUM/COUNT,
+min/max for MIN/MAX, grouped by the grouping columns) is algebraically
+identical to a full recompute. AVG and distinct aggregates are not
+foldable from their final values, window plans carry frame state the
+output doesn't expose, and joins can pair old rows with new — all of
+those fall back to full recompute (``mergeable_spec`` returns None).
+
+Merged output is canonically sorted by the grouping columns: hash-agg
+output order depends on insertion order, so refresh-after-refresh
+determinism needs an explicit order (full-recompute comparisons
+canonicalize the same way, as the chaos soak oracles already do).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+_FOLD = {"sum": "sum", "count": "sum", "min": "min", "max": "max"}
+
+
+def mergeable_spec(plan) -> Optional[Tuple[List[str], List[Tuple[str, str]]]]:
+    """``(group_names, [(agg_name, fold_fn)])`` when ``plan``'s output can
+    be merged with a tail recompute, else None. The aggregation must be
+    the plan's OUTPUT (only batch-shape-preserving wrappers above it):
+    anything downstream of the agg would see merged rows it never
+    produced."""
+    from blaze_tpu.ir import exprs as E
+    from blaze_tpu.ir import nodes as N
+
+    node = plan
+    while isinstance(node, N.CoalesceBatches):
+        node = node.child
+    if not isinstance(node, N.Agg):
+        return None
+    if not node.aggs:
+        return None  # pure distinct-by-grouping: union semantics differ
+    folds: List[Tuple[str, str]] = []
+    for col in node.aggs:
+        if col.mode not in (E.AggMode.FINAL, E.AggMode.COMPLETE):
+            return None
+        fold = _FOLD.get(col.agg.fn.value)
+        if fold is None:
+            return None
+        folds.append((col.name, fold))
+    group_names = [name for name, _ in node.groupings]
+    return group_names, folds
+
+
+def merge_tables(cached, delta, spec):
+    """Fold a tail recompute into the cached table per ``mergeable_spec``'s
+    recipe; returns the refreshed table (canonically sorted by the
+    grouping columns, cast back to the cached schema)."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    group_names, folds = spec
+    if delta.num_rows == 0:
+        return cached
+    both = pa.concat_tables([cached, delta]) if cached.num_rows \
+        else delta
+    if not group_names:
+        # global aggregate: one output row, folded column-wise
+        cols = []
+        for name, fold in folds:
+            col = both.column(name)
+            val = {"sum": pc.sum, "min": pc.min, "max": pc.max}[fold](col)
+            cols.append(pa.array([val.as_py()], type=col.type))
+        return pa.Table.from_arrays(cols, names=[n for n, _ in folds]) \
+            .cast(cached.schema)
+    merged = both.group_by(group_names).aggregate(
+        [(name, fold) for name, fold in folds])
+    merged = merged.rename_columns(
+        group_names + [name for name, _ in folds]) \
+        .select(cached.schema.names).cast(cached.schema)
+    return merged.sort_by([(n, "ascending") for n in group_names])

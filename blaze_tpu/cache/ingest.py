@@ -1,0 +1,224 @@
+"""Append-only versioned ingest tables (the streaming side of the cache).
+
+An ingest table is a named, schema-stable sequence of batches living in
+the session's resource map behind ``ingest://<name>`` — the landing zone
+for ``Session.append`` / ``POST /ingest``. Every append bumps the table
+version; cached entries record the version vector of every ingest table
+their plan reads, so a later lookup can tell FRESH (same versions) from
+STALE (the table grew) without any invalidation fan-out.
+
+The resource id is deliberately version-free: the canonical plan
+fingerprint of a dashboard query stays identical across appends, which
+is exactly what lets the same cache key transition hit -> stale -> hit.
+Tail reads for incremental refresh use the versioned form
+``ingest://<name>@<from>:<to>`` — a TEMPORARY resource the refresh
+registers, reads, and drops, never a cache key.
+
+Scan partitioning assigns batches round-robin by append ordinal, so a
+full recompute and a tail recompute see the same batch -> partition
+mapping — partition-confined operators stay bit-identical either way.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+INGEST_PREFIX = "ingest://"
+
+
+class IngestTable:
+    """One append-only table: ColumnarBatch refs + a version per append.
+    ``version_offsets[v]`` is the batch count when version v was current,
+    so the tail appended since version v is ``batches[version_offsets[v]:]``."""
+
+    def __init__(self, name: str, schema, num_partitions: int):
+        self.name = name
+        self.schema = schema  # T.Schema
+        self.num_partitions = max(1, int(num_partitions))
+        self.batches: List[object] = []
+        self.version = 0
+        self.version_offsets: List[int] = [0]
+        self.nbytes = 0
+
+    def tail_since(self, version: int) -> List[object]:
+        v = max(0, min(int(version), len(self.version_offsets) - 1))
+        return self.batches[self.version_offsets[v]:]
+
+
+class _IngestScanProvider:
+    """``partition -> [ColumnarBatch]`` over a snapshot of the table's
+    batches (round-robin by append ordinal, offset by ``start`` so tail
+    slices keep the ordinals they'd have in a full scan)."""
+
+    def __init__(self, batches: List[object], num_partitions: int,
+                 start: int = 0):
+        self._batches = list(batches)
+        self._nparts = max(1, num_partitions)
+        self._start = start
+
+    def __call__(self, partition: int):
+        return [b for i, b in enumerate(self._batches, self._start)
+                if i % self._nparts == partition]
+
+
+class IngestRegistry:
+    """Session-scoped registry of ingest tables. Thread-safe; appends are
+    serialized per registry (the streaming path is append-dominated, not
+    append-contended)."""
+
+    def __init__(self, session):
+        self._session = session
+        self._mu = threading.Lock()
+        self._tables: Dict[str, IngestTable] = {}
+
+    def append(self, name: str, batches, num_partitions: int = 2) -> int:
+        """Append arrow RecordBatches (or ColumnarBatches) to ``name``,
+        creating the table on first use; returns the new version. The
+        live ``ingest://name`` scan resource is refreshed to a snapshot
+        of the grown table, so queries lowered after this append see it
+        while in-flight scans keep their own snapshot."""
+        import pyarrow as pa
+
+        from blaze_tpu.core.batch import ColumnarBatch
+        from blaze_tpu.ir import types as T
+        from blaze_tpu.runtime.failpoints import failpoint
+
+        failpoint("ingest.append")
+        cols = []
+        for rb in batches:
+            if isinstance(rb, pa.Table):
+                cols.extend(ColumnarBatch.from_arrow(b)
+                            for b in rb.to_batches())
+            elif isinstance(rb, pa.RecordBatch):
+                cols.append(ColumnarBatch.from_arrow(rb))
+            else:
+                cols.append(rb)  # already a ColumnarBatch
+        with self._mu:
+            t = self._tables.get(name)
+            if t is None:
+                if not cols:
+                    raise ValueError(
+                        f"ingest table {name!r}: first append needs rows "
+                        f"(the schema comes from them)")
+                schema = T.schema_from_arrow(cols[0].to_arrow().schema)
+                t = IngestTable(name, schema, num_partitions)
+                self._tables[name] = t
+            t.batches.extend(cols)
+            t.nbytes += sum(int(b.nbytes()) for b in cols)
+            t.version += 1
+            t.version_offsets.append(len(t.batches))
+            # refresh the live scan resource to the new snapshot (plain
+            # dict assignment: concurrent lowers see old or new, both
+            # self-consistent)
+            self._session.resources[INGEST_PREFIX + name] = \
+                _IngestScanProvider(t.batches, t.num_partitions)
+            version = t.version
+        cache = getattr(self._session, "cache", None)
+        if cache is not None:
+            cache.on_append(name, version)
+        self._session.metrics.add("ingest_appends", 1)
+        return version
+
+    def get(self, name: str) -> Optional[IngestTable]:
+        with self._mu:
+            return self._tables.get(name)
+
+    def versions(self, names) -> Dict[str, int]:
+        """Current version of each named table (0 for unknown names, so a
+        plan over a not-yet-created table is cacheable and goes stale on
+        the table's first append)."""
+        with self._mu:
+            return {n: (self._tables[n].version if n in self._tables else 0)
+                    for n in names}
+
+    def scan_node(self, name: str):
+        """Plan leaf for the table: ``BatchSource(ingest://name)`` with a
+        version-free resource id (stable fingerprint across appends)."""
+        from blaze_tpu.ir import nodes as N
+
+        t = self.get(name)
+        if t is None:
+            raise KeyError(f"unknown ingest table {name!r}")
+        return N.BatchSource(schema=t.schema,
+                             resource_id=INGEST_PREFIX + name,
+                             num_partitions=t.num_partitions)
+
+    def register_tail(self, name: str, from_version: int) -> Optional[str]:
+        """Register a temporary tail resource covering batches appended
+        after ``from_version``; returns its resource id (caller drops it
+        via ``release_tail``). None when the table is unknown."""
+        with self._mu:
+            t = self._tables.get(name)
+            if t is None:
+                return None
+            rid = f"{INGEST_PREFIX}{name}@{from_version}:{t.version}"
+            start = t.version_offsets[
+                max(0, min(int(from_version), len(t.version_offsets) - 1))]
+            self._session.resources[rid] = _IngestScanProvider(
+                t.batches[start:], t.num_partitions, start=start)
+            return rid
+
+    def release_tail(self, rid: str):
+        self._session.resources.pop(rid, None)
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {n: {"version": t.version, "batches": len(t.batches),
+                        "nbytes": t.nbytes,
+                        "num_partitions": t.num_partitions}
+                    for n, t in self._tables.items()}
+
+    def clear(self):
+        with self._mu:
+            for name in self._tables:
+                self._session.resources.pop(INGEST_PREFIX + name, None)
+            self._tables.clear()
+
+
+def ingest_table_names(plan) -> List[str]:
+    """Names of every ingest table a plan reads (deduped, sorted) — the
+    keys of the version vector a cached entry records."""
+    from blaze_tpu.ir import nodes as N
+
+    names = set()
+    stack = [plan]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (N.BatchSource, N.IpcReader, N.FFIReader)):
+            rid = getattr(node, "resource_id", "")
+            if rid.startswith(INGEST_PREFIX):
+                names.add(rid[len(INGEST_PREFIX):].split("@", 1)[0])
+        stack.extend(node.children())
+    return sorted(names)
+
+
+def retarget_to_tails(plan, versions: Dict[str, int], registry:
+                      IngestRegistry):
+    """Rewrite every ingest scan leaf to its tail since ``versions[name]``
+    — the plan that computes ONLY the appended delta. Returns (tail_plan,
+    [tail resource ids to release]) or (None, []) when any table vanished."""
+    import dataclasses
+
+    from blaze_tpu.ir import nodes as N
+
+    rids: List[str] = []
+
+    def rewrite(node):
+        node = N.map_children(node, rewrite)
+        if isinstance(node, N.BatchSource) and \
+                node.resource_id.startswith(INGEST_PREFIX):
+            name = node.resource_id[len(INGEST_PREFIX):].split("@", 1)[0]
+            rid = registry.register_tail(name, versions.get(name, 0))
+            if rid is None:
+                raise KeyError(name)
+            rids.append(rid)
+            return dataclasses.replace(node, resource_id=rid)
+        return node
+
+    try:
+        return rewrite(plan), rids
+    except KeyError:
+        for rid in rids:
+            registry.release_tail(rid)
+        return None, []

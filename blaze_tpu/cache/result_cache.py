@@ -1,0 +1,706 @@
+"""Fingerprint-keyed result + subplan cache (the serving fast path).
+
+Whole-plan entries hold the query's arrow result table; subplan entries
+hold one shuffle-map stage's staged batch references (the same objects
+the ``MemSegmentRegistry`` process tier serves, re-committed under a
+cache-owned stage id so they outlive the producing query's release).
+Both are LRU + bytes-capped, and the cache registers itself as a
+spillable ``MemConsumer`` — its residency competes in the memory
+manager's fair-share math, so serve admission and operator spill
+decisions see cache pressure like any other consumer.
+
+Degrade ladder (PR 12 shape): a fill or an over-budget update moves
+LRU result entries to spill-dir arrow IPC files (still hits, slower
+tier), then drops them (miss) — never a hard failure. Subplan entries
+are reference-only and drop straight to miss.
+
+Keys: the lookup key is the sha256 of the UNNORMALIZED canonical plan
+JSON — ``plan_fingerprint``'s basename collapsing (built for cross-run
+profile stability) would alias two different directories' files with
+equal basenames, which for a cache means wrong results, not a stale
+profile. The PR 11 fingerprint is still computed and carried on every
+entry for the profile/explain/artifact surface.
+
+Staleness and the epoch check: entries record the version of every
+ingest table their plan reads; a lookup whose versions lag the registry
+is STALE and is never served as-is — it is refreshed by tail merge
+(cache/incremental.py) or dropped for full recompute. ``epoch`` counts
+manual bumps plus pool worker deaths: a fill whose execution overlapped
+a worker death is discarded (conservative — the retried execution was
+correct, but mid-ingest kills must never leave a doubtful entry behind).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import os
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from blaze_tpu.cache.incremental import merge_tables, mergeable_spec
+from blaze_tpu.cache.ingest import INGEST_PREFIX, ingest_table_names
+from blaze_tpu.obs.telemetry import get_registry
+
+_reg = get_registry()
+_TM_HITS = _reg.counter(
+    "blaze_cache_hits_total",
+    "cache hits by kind (result/subplan), serving tier and tenant")
+_TM_MISSES = _reg.counter(
+    "blaze_cache_misses_total",
+    "cache lookups that found no fresh entry, by kind and tenant")
+_TM_EVICTIONS = _reg.counter(
+    "blaze_cache_evictions_total",
+    "entries dropped, by reason (lru/pressure/version/epoch/closed)")
+_TM_STALE = _reg.counter(
+    "blaze_cache_stale_total",
+    "stale lookups by resolution: refreshed (tail merge) / recompute "
+    "(full re-execution) / served (MUST stay zero — a stale entry is "
+    "never served without merge)")
+_TM_BYTES = _reg.gauge(
+    "blaze_cache_resident_bytes", "bytes held by memory-tier entries")
+_TM_ENTRIES = _reg.gauge(
+    "blaze_cache_entries_count", "live entries, memory + spill tiers")
+_TM_SPILLED = _reg.counter(
+    "blaze_cache_spilled_bytes_total",
+    "result-entry bytes moved to the spill-dir persistence tier")
+
+_ids = itertools.count()
+
+
+def cache_key(plan) -> Optional[str]:
+    """24-hex lookup key over the UNNORMALIZED plan serde (see module
+    docs); None when the plan cannot serialize (UDF closures etc.) —
+    such plans are simply uncacheable."""
+    try:
+        from blaze_tpu.ir.serde import plan_to_json
+
+        return hashlib.sha256(
+            plan_to_json(plan).encode()).hexdigest()[:24]
+    except Exception:
+        return None
+
+
+def plan_cacheable(plan) -> bool:
+    """A plan may be cached only when every leaf is a deterministic,
+    re-readable source: file scans, empty partitions, or version-free
+    ingest tables. Session-internal readers (shuffle/mesh resources),
+    FFI sources (arbitrary callables) and sinks (side effects) make the
+    result either irreproducible or wrong to share."""
+    from blaze_tpu.ir import nodes as N
+
+    stack = [plan]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (N.ParquetSink, N.Debug)):
+            return False
+        kids = node.children()
+        if not kids:
+            if isinstance(node, (N.ParquetScan, N.OrcScan,
+                                 N.EmptyPartitions)):
+                continue
+            if isinstance(node, N.BatchSource) and \
+                    node.resource_id.startswith(INGEST_PREFIX) and \
+                    "@" not in node.resource_id:
+                continue
+            return False
+        stack.extend(kids)
+    return True
+
+
+class CacheEntry:
+    __slots__ = ("kind", "key", "fingerprint", "nbytes", "versions",
+                 "epoch", "hits", "tier", "spill_path", "table", "maps",
+                 "groups", "num_reducers", "stage", "mergeable", "label")
+
+    def __init__(self, kind: str, key: str, fingerprint: str, nbytes: int,
+                 versions: Dict[str, int], epoch: int,
+                 label: Optional[str] = None):
+        self.kind = kind              # "result" | "subplan"
+        self.key = key
+        self.fingerprint = fingerprint
+        self.nbytes = int(nbytes)
+        self.versions = versions      # ingest table -> version at fill
+        self.epoch = epoch
+        self.hits = 0
+        self.tier = "mem"             # "mem" | "spill"
+        self.spill_path: Optional[str] = None
+        self.table = None             # pa.Table (result entries, mem tier)
+        self.maps: Optional[List[dict]] = None  # per-map parts (subplan)
+        self.groups = None            # AQE reducer grouping at capture
+        self.num_reducers = 0
+        self.stage: Optional[int] = None  # registry stage id (accounting)
+        self.mergeable = False
+        self.label = label
+
+    def snapshot(self) -> dict:
+        return {"kind": self.kind, "fingerprint": self.fingerprint,
+                "nbytes": self.nbytes, "hits": self.hits,
+                "tier": self.tier, "versions": dict(self.versions),
+                "mergeable": self.mergeable, "label": self.label}
+
+
+class CachedSubplanProvider:
+    """Reduce-side block provider over a subplan entry's captured batch
+    references. Unlike ``MemSegmentBlockProvider`` there is no on-disk
+    marker check: the producing query's shuffle dir (and its markers)
+    died with that query — the cache owns these references outright, and
+    the provider closes over them so an eviction mid-read cannot pull
+    batches out from under a running consumer."""
+
+    def __init__(self, maps: List[dict], groups):
+        self.maps = maps
+        self.groups = groups
+
+    def __call__(self, partition: int):
+        pids = self.groups[partition] if self.groups is not None \
+            else [partition]
+        blocks = []
+        for parts in self.maps:
+            batches = [b for p in pids for b in parts.get(p, ())]
+            if batches:
+                blocks.append(("batches", batches))
+        return blocks
+
+
+class QueryCache:
+    """One session's cache. Public entry points:
+
+    - ``serve(plan)`` — fresh whole-plan hit or None (microsecond path).
+    - ``refresh_or_none(plan, execute)`` — stale mergeable entry: tail
+      recompute + merge; None -> caller recomputes in full.
+    - ``offer(plan, table, epoch0)`` — fill after a cold execution.
+    - ``lookup_subplan`` / ``offer_subplan`` — per-exchange sharing,
+      driven by ``Session._run_shuffle_map_stage``.
+
+    All state behind one RLock: the memory manager may call ``spill()``
+    synchronously from inside our own ``update_mem_used``."""
+
+    def __init__(self, session):
+        from blaze_tpu.runtime.memmgr import MemConsumer
+
+        self.session = session
+        conf = session.conf
+        self.max_bytes = int(conf.cache_max_bytes)
+        self.max_entries = int(conf.cache_max_entries)
+        self.spill_enabled = bool(conf.cache_spill_enabled)
+        self._mu = threading.RLock()
+        self._results: "OrderedDict[str, CacheEntry]" = OrderedDict()
+        self._subplans: "OrderedDict[str, CacheEntry]" = OrderedDict()
+        self._epoch = 0
+        self._consumer = MemConsumer(f"query_cache_{next(_ids)}",
+                                     spillable=self.spill_enabled)
+        self._consumer.spill = self._spill_for_manager
+        self._closed = False
+        # tenant-attributed counter shadows for artifacts/snapshots (the
+        # registry counters are the fleet view; these reconcile per cache)
+        self.counts = {"hits": 0, "misses": 0, "stale": 0, "evictions": 0,
+                       "stale_served": 0, "subplan_hits": 0, "refreshes": 0,
+                       "degraded_puts": 0}
+
+    # -- epoch / invalidation --------------------------------------------------
+
+    def epoch(self) -> int:
+        pool = getattr(self.session, "pool", None)
+        deaths = getattr(pool, "deaths_total", 0) if pool is not None else 0
+        return self._epoch + deaths
+
+    def bump_epoch(self):
+        with self._mu:
+            self._epoch += 1
+
+    def on_append(self, name: str, version: int):
+        """Appends make matching entries stale. Result entries stay —
+        a later lookup refreshes or recomputes them; subplan entries
+        cannot merge, so they drop eagerly and give their bytes back."""
+        with self._mu:
+            for key in [k for k, e in self._subplans.items()
+                        if name in e.versions]:
+                self._drop_locked(self._subplans, key, reason="version")
+            self._publish_gauges_locked()
+
+    # -- memory-manager citizenship -------------------------------------------
+
+    def _mm(self):
+        from blaze_tpu.runtime.memmgr import MemManager
+
+        mm = MemManager.get_or_init(self.session.conf)
+        if self._consumer._manager is not mm:
+            # first use, or tests reset the singleton: (re-)register with
+            # no group — the cache is session-, not query-scoped
+            self._consumer._manager = None
+            self._consumer.mem_used = 0
+            mm.register(self._consumer, group=None)
+        return mm
+
+    def _update_mm_locked(self):
+        resident = sum(e.nbytes for e in self._results.values()
+                       if e.tier == "mem")
+        resident += sum(e.nbytes for e in self._subplans.values())
+        if self._closed:
+            return
+        try:
+            self._mm()
+            self._consumer.update_mem_used(resident)
+        except Exception:
+            # SpillFailed or a wedged wait must degrade to eviction, not
+            # fail the caller's query: shed LRU until back under budget
+            self._evict_to_fit_locked(self.max_bytes // 2, "pressure")
+            try:
+                self._consumer.update_mem_used(
+                    sum(e.nbytes for e in self._results.values()
+                        if e.tier == "mem")
+                    + sum(e.nbytes for e in self._subplans.values()))
+            except Exception:
+                pass
+
+    def _publish_gauges_locked(self):
+        _TM_BYTES.set(sum(e.nbytes for e in self._results.values()
+                          if e.tier == "mem")
+                      + sum(e.nbytes for e in self._subplans.values()))
+        _TM_ENTRIES.set(len(self._results) + len(self._subplans))
+
+    # -- eviction / spill ladder ----------------------------------------------
+
+    def _drop_locked(self, store, key: str, reason: str,
+                     count: bool = True):
+        e = store.pop(key, None)
+        if e is None:
+            return 0
+        if e.stage is not None:
+            self.session.mem_segments.release_stages([e.stage])
+        if e.spill_path:
+            try:
+                os.unlink(e.spill_path)
+            except OSError:
+                pass
+        freed = e.nbytes if e.tier == "mem" else 0
+        if count:
+            self.counts["evictions"] += 1
+            _TM_EVICTIONS.labels(reason=reason).inc()
+        return freed
+
+    def _spill_entry_locked(self, e: CacheEntry) -> int:
+        """memory -> spill-dir rung: persist a result entry's table as an
+        arrow IPC file and drop the heap reference. Raises OSError on a
+        full/broken spill dir — callers degrade to eviction."""
+        import pyarrow as pa
+
+        spill_dir = self.session.conf.spill_dir
+        os.makedirs(spill_dir, exist_ok=True)
+        path = os.path.join(spill_dir,
+                            f"cache_{e.key}_{next(_ids)}.arrow")
+        with pa.OSFile(path, "wb") as f, \
+                pa.ipc.new_file(f, e.table.schema) as w:
+            w.write_table(e.table)
+        if e.stage is not None:
+            self.session.mem_segments.release_stages([e.stage])
+            e.stage = None
+        freed = e.nbytes
+        e.table = None
+        e.tier = "spill"
+        e.spill_path = path
+        _TM_SPILLED.inc(freed)
+        return freed
+
+    def _evict_to_fit_locked(self, budget: int, reason: str):
+        def resident():
+            return sum(e.nbytes for e in self._results.values()
+                       if e.tier == "mem") + \
+                sum(e.nbytes for e in self._subplans.values())
+
+        # subplans first (reference-only, cheapest to rebuild), LRU order
+        while self._subplans and (
+                resident() > budget or
+                len(self._results) + len(self._subplans) > self.max_entries):
+            self._drop_locked(self._subplans,
+                              next(iter(self._subplans)), reason)
+        while self._results and (
+                resident() > budget or
+                len(self._results) + len(self._subplans) > self.max_entries):
+            key = next((k for k, e in self._results.items()
+                        if e.tier == "mem"), None)
+            if key is None:
+                break
+            e = self._results[key]
+            if self.spill_enabled and len(self._results) + \
+                    len(self._subplans) <= self.max_entries:
+                try:
+                    self._spill_entry_locked(e)
+                    continue
+                except OSError:
+                    pass  # next rung: miss
+            self._drop_locked(self._results, key, reason)
+
+    def _spill_for_manager(self) -> int:
+        """MemConsumer.spill: the manager decided the cache is over its
+        fair share. Move LRU result entries down the ladder (or out) until
+        roughly half the resident bytes are freed."""
+        with self._mu:
+            target = sum(e.nbytes for e in self._results.values()
+                         if e.tier == "mem")
+            target += sum(e.nbytes for e in self._subplans.values())
+            freed_goal = max(1, target // 2)
+            freed = 0
+            while freed < freed_goal and self._subplans:
+                freed += self._drop_locked(
+                    self._subplans, next(iter(self._subplans)), "pressure")
+            while freed < freed_goal:
+                key = next((k for k, e in self._results.items()
+                            if e.tier == "mem"), None)
+                if key is None:
+                    break
+                e = self._results[key]
+                if self.spill_enabled:
+                    try:
+                        freed += self._spill_entry_locked(e)
+                        continue
+                    except OSError:
+                        pass
+                freed += self._drop_locked(self._results, key, "pressure")
+            self._publish_gauges_locked()
+            return freed
+
+    # -- whole-plan results ---------------------------------------------------
+
+    def _versions_for(self, plan) -> Dict[str, int]:
+        names = ingest_table_names(plan)
+        if not names:
+            return {}
+        return self.session.ingest.versions(names)
+
+    def _fresh_locked(self, e: CacheEntry) -> bool:
+        if e.versions:
+            current = self.session.ingest.versions(e.versions.keys())
+            if current != e.versions:
+                return False
+        return True
+
+    def serve(self, plan, tenant: str = "default",
+              key: Optional[str] = None):
+        """Fresh whole-plan result or None. Never serves stale: a stale
+        entry counts ``stale`` here and resolves via refresh/recompute."""
+        key = key or cache_key(plan)
+        if key is None or not plan_cacheable(plan):
+            _TM_MISSES.labels(kind="result", tenant=tenant).inc()
+            with self._mu:
+                self.counts["misses"] += 1
+            return None
+        with self._mu:
+            e = self._results.get(key)
+            if e is None:
+                self.counts["misses"] += 1
+                _TM_MISSES.labels(kind="result", tenant=tenant).inc()
+                return None
+            if not self._fresh_locked(e):
+                # detected stale; counted when it RESOLVES (refresh or
+                # recompute) so the stale tally isn't double-booked
+                return None
+            table = e.table
+            if e.tier == "spill":
+                table = self._unspill_locked(e)
+                if table is None:
+                    self._drop_locked(self._results, key, "lru")
+                    self.counts["misses"] += 1
+                    _TM_MISSES.labels(kind="result", tenant=tenant).inc()
+                    return None
+            e.hits += 1
+            self.counts["hits"] += 1
+            self._results.move_to_end(key)
+            _TM_HITS.labels(kind="result", tenant=tenant,
+                            tier=e.tier).inc()
+            return table
+
+    def _unspill_locked(self, e: CacheEntry):
+        """spill -> memory promotion on hit; None when the file is gone
+        (spill dir swept) — the entry degrades to a miss."""
+        import pyarrow as pa
+
+        try:
+            with pa.OSFile(e.spill_path, "rb") as f:
+                table = pa.ipc.open_file(f).read_all()
+        except (OSError, pa.ArrowInvalid):
+            return None
+        try:
+            os.unlink(e.spill_path)
+        except OSError:
+            pass
+        e.spill_path = None
+        e.table = table
+        e.tier = "mem"
+        self._update_mm_locked()
+        return table
+
+    def refresh_or_none(self, plan, execute, tenant: str = "default"):
+        """Stale-entry resolution. ``execute`` runs a plan to an arrow
+        table (the caller decides HOW — scheduler retry loop or direct
+        session). Returns the refreshed table after a tail merge, or None
+        when the entry is missing/fresh/non-mergeable (caller recomputes
+        in full and ``offer``s)."""
+        conf = self.session.conf
+        key = cache_key(plan)
+        if key is None:
+            return None
+        with self._mu:
+            e = self._results.get(key)
+            if e is None or self._fresh_locked(e):
+                return None
+            if not (conf.cache_incremental_enabled and e.mergeable
+                    and e.tier == "mem"):
+                # no mergeable partial form: full recompute path (not an
+                # eviction — the slot turns over on the caller's offer)
+                self._drop_locked(self._results, key, "version",
+                                  count=False)
+                self._tm_stale("recompute")
+                self._publish_gauges_locked()
+                return None
+            cached_table = e.table
+            cached_versions = dict(e.versions)
+            fingerprint = e.fingerprint
+            label = e.label
+        spec = mergeable_spec(plan)
+        if spec is None:
+            with self._mu:
+                self._drop_locked(self._results, key, "version",
+                                  count=False)
+                self._tm_stale("recompute")
+            return None
+        from blaze_tpu.cache.ingest import retarget_to_tails
+
+        epoch0 = self.epoch()
+        target_versions = self._versions_for(plan)
+        tail_plan, rids = retarget_to_tails(
+            plan, cached_versions, self.session.ingest)
+        if tail_plan is None:
+            with self._mu:
+                self._drop_locked(self._results, key, "version")
+                self._tm_stale("recompute")
+            return None
+        try:
+            delta = execute(tail_plan)
+        finally:
+            for rid in rids:
+                self.session.ingest.release_tail(rid)
+        merged = merge_tables(cached_table, delta, spec)
+        with self._mu:
+            self._tm_stale("refreshed")
+            self.counts["refreshes"] += 1
+            if self.epoch() != epoch0:
+                # a worker died mid-refresh: the merged table is correct
+                # (execute retried), but conservatively do not keep it
+                self._drop_locked(self._results, key, "epoch")
+                self._publish_gauges_locked()
+                return merged
+            self._store_result_locked(key, fingerprint, merged,
+                                      target_versions, epoch0,
+                                      mergeable=True, label=label)
+        return merged
+
+    def _tm_stale(self, result: str):
+        self.counts["stale"] += 1 if result != "served" else 0
+        if result == "served":
+            self.counts["stale_served"] += 1
+        _TM_STALE.labels(result=result).inc()
+
+    def offer(self, plan, table, epoch0: int, tenant: str = "default",
+              label: Optional[str] = None):
+        """Fill after a cold execution. Silently refuses uncacheable
+        plans, epoch-crossed executions, and oversized tables; degrades
+        through the spill rung on injected/real put failures."""
+        if self._closed or table is None:
+            return
+        key = cache_key(plan)
+        if key is None or not plan_cacheable(plan):
+            return
+        nbytes = int(table.nbytes)
+        if nbytes > self.max_bytes:
+            return
+        versions = self._versions_for(plan)
+        fingerprint = self._display_fingerprint(plan)
+        mergeable = mergeable_spec(plan) is not None
+        with self._mu:
+            if self.epoch() != epoch0:
+                _TM_EVICTIONS.labels(reason="epoch").inc()
+                self.counts["evictions"] += 1
+                return
+            try:
+                from blaze_tpu.runtime.failpoints import failpoint
+
+                failpoint("cache.put")
+                self._store_result_locked(key, fingerprint, table,
+                                          versions, epoch0,
+                                          mergeable=mergeable, label=label)
+            except Exception:
+                # degrade ladder: try the spill rung, then give up (miss)
+                self.counts["degraded_puts"] += 1
+                e = CacheEntry("result", key, fingerprint, nbytes,
+                               versions, epoch0, label=label)
+                e.table = table
+                e.mergeable = mergeable
+                if self.spill_enabled:
+                    try:
+                        self._spill_entry_locked(e)
+                        self._results[key] = e
+                        self._results.move_to_end(key)
+                    except OSError:
+                        pass
+                self._publish_gauges_locked()
+
+    def _display_fingerprint(self, plan) -> str:
+        from blaze_tpu.obs.stats import plan_fingerprint
+
+        return plan_fingerprint(plan)
+
+    def _store_result_locked(self, key, fingerprint, table, versions,
+                             epoch, mergeable: bool,
+                             label: Optional[str] = None):
+        old = self._results.pop(key, None)
+        if old is not None:
+            if old.stage is not None:
+                self.session.mem_segments.release_stages([old.stage])
+            if old.spill_path:
+                try:
+                    os.unlink(old.spill_path)
+                except OSError:
+                    pass
+        e = CacheEntry("result", key, fingerprint, int(table.nbytes),
+                       versions, epoch, label=label)
+        e.table = table
+        e.mergeable = mergeable
+        # registry citizenship: the result rides the zero-copy plane as
+        # batch references under a cache-owned stage id, so artifact/leak
+        # tooling that sweeps the registry sees cache residency too
+        stage = next(self.session._stage_ids)
+        self.session.mem_segments.commit(
+            stage, 0, {0: table.to_batches()}, e.nbytes)
+        e.stage = stage
+        self._results[key] = e
+        self._results.move_to_end(key)
+        self._evict_to_fit_locked(self.max_bytes, "lru")
+        self._update_mm_locked()
+        self._publish_gauges_locked()
+
+    # -- subplan sharing -------------------------------------------------------
+
+    def subplan_active(self, qrun) -> bool:
+        scope = self.session.conf.cache_subplan_scope
+        if scope == "all":
+            return True
+        if scope != "serve" or qrun is None:
+            return False
+        return (qrun.mem_group or "").startswith("serve_")
+
+    def lookup_subplan(self, node, tenant: str = "default"):
+        """Fresh subplan entry for this exchange subtree, or None. The
+        returned entry's ``maps``/``groups``/``num_reducers`` rebuild the
+        reducer-side provider exactly as the capture run saw it."""
+        key = cache_key(node)
+        if key is None or not plan_cacheable(node):
+            return None
+        with self._mu:
+            e = self._subplans.get(key)
+            if e is None:
+                _TM_MISSES.labels(kind="subplan", tenant=tenant).inc()
+                return None
+            if not self._fresh_locked(e) or e.epoch != self.epoch():
+                self._drop_locked(self._subplans, key, "version")
+                self._publish_gauges_locked()
+                return None
+            e.hits += 1
+            self.counts["subplan_hits"] += 1
+            self._subplans.move_to_end(key)
+            _TM_HITS.labels(kind="subplan", tenant=tenant,
+                            tier="mem").inc()
+            return e
+
+    def offer_subplan(self, node, maps: List[dict], nbytes: int,
+                      groups, num_reducers: int, epoch0: int):
+        if self._closed:
+            return
+        key = cache_key(node)
+        if key is None or not plan_cacheable(node):
+            return
+        if nbytes > self.max_bytes:
+            return
+        with self._mu:
+            if self.epoch() != epoch0:
+                _TM_EVICTIONS.labels(reason="epoch").inc()
+                self.counts["evictions"] += 1
+                return
+            old = self._subplans.pop(key, None)
+            if old is not None and old.stage is not None:
+                self.session.mem_segments.release_stages([old.stage])
+            e = CacheEntry("subplan", key,
+                           self._display_fingerprint(node), nbytes,
+                           self._versions_for(node), epoch0)
+            e.maps = maps
+            e.groups = groups
+            e.num_reducers = num_reducers
+            stage = next(self.session._stage_ids)
+            for m, parts in enumerate(maps):
+                self.session.mem_segments.commit(
+                    stage, m, parts, nbytes // max(1, len(maps)))
+            e.stage = stage
+            self._subplans[key] = e
+            self._subplans.move_to_end(key)
+            self._evict_to_fit_locked(self.max_bytes, "lru")
+            self._update_mm_locked()
+            self._publish_gauges_locked()
+
+    # -- introspection / lifecycle --------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            resident = sum(e.nbytes for e in self._results.values()
+                           if e.tier == "mem") + \
+                sum(e.nbytes for e in self._subplans.values())
+            return {
+                "entries": len(self._results) + len(self._subplans),
+                "results": [e.snapshot() for e in self._results.values()],
+                "subplans": [e.snapshot()
+                             for e in self._subplans.values()],
+                "resident_bytes": resident,
+                "max_bytes": self.max_bytes,
+                "epoch": self.epoch(),
+                "counts": dict(self.counts),
+            }
+
+    def stats_fields(self) -> dict:
+        """The ``cache_*`` tripwire block artifacts embed (obs/stats.py
+        CACHE_FIELDS schema)."""
+        with self._mu:
+            return {
+                "cache_hits": self.counts["hits"],
+                "cache_misses": self.counts["misses"],
+                "cache_stale": self.counts["stale"],
+                "cache_stale_served": self.counts["stale_served"],
+                "cache_evictions": self.counts["evictions"],
+                "cache_refreshes": self.counts["refreshes"],
+                "cache_subplan_hits": self.counts["subplan_hits"],
+                "cache_degraded_puts": self.counts["degraded_puts"],
+                "cache_bytes": sum(e.nbytes
+                                   for e in self._results.values()
+                                   if e.tier == "mem")
+                + sum(e.nbytes for e in self._subplans.values()),
+                "cache_entries": len(self._results) + len(self._subplans),
+            }
+
+    def clear(self, reason: str = "closed"):
+        with self._mu:
+            for key in list(self._subplans):
+                self._drop_locked(self._subplans, key, reason)
+            for key in list(self._results):
+                self._drop_locked(self._results, key, reason)
+            self._publish_gauges_locked()
+            if self._consumer._manager is not None:
+                try:
+                    self._consumer._manager.unregister(self._consumer)
+                except Exception:
+                    pass
+                self._consumer._manager = None
+
+    def close(self):
+        self.clear("closed")
+        with self._mu:
+            self._closed = True

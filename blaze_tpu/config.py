@@ -419,6 +419,28 @@ class Config:
     serve_backpressure_enable: bool = True
     serve_retry_after_max_s: float = 5.0
 
+    # Result/subplan cache (blaze_tpu/cache/): fingerprint-keyed reuse of
+    # whole-query results and shuffle-map subplans, LRU + bytes-capped as a
+    # MemConsumer so admission control sees cache pressure. cache_enabled
+    # False is the escape hatch — every consult/fill site is behind it, so
+    # the disabled path stays near-free (test_cache.py's <5% overhead
+    # guard). Entries record their ingest-table versions; a stale hit with
+    # a mergeable plan (final SUM/COUNT/MIN/MAX agg) recomputes only the
+    # appended tail and merges (cache_incremental_enabled), else recomputes
+    # in full — a stale entry is NEVER served as-is.
+    cache_enabled: bool = True
+    cache_max_bytes: int = 256 << 20
+    cache_max_entries: int = 256
+    # subplan (per-exchange) caching scope: "serve" engages it only for
+    # scheduler-submitted queries (mem_group serve_*) so direct Session
+    # runs keep their exact seed behavior; "all" engages everywhere;
+    # "off" disables subplan capture while whole-plan results still cache
+    cache_subplan_scope: str = "serve"
+    # degrade ladder on eviction/pressure: memory -> spill-dir arrow IPC
+    # persistence -> miss. False drops straight to miss.
+    cache_spill_enabled: bool = True
+    cache_incremental_enabled: bool = True
+
     # Adaptive device placement (runtime/placement.py — the TPU analogue of
     # the reference's removeInefficientConverts): "auto" runs each stage
     # where the measured-link cost model says it is cheapest; "device" /

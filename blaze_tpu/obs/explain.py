@@ -208,6 +208,15 @@ def render_explain_analyze(query: dict, session_metrics: MetricNode) -> str:
             task_parts = [p for p in task_parts if p is not None]
         merged = merge_partition_metrics(task_parts) if task_parts else None
         lines.extend(render_annotated_tree(stage["shape"], merged))
+    cache = stats.get("cache")
+    if cache:
+        # subtrees whose map stages never ran: served from the subplan
+        # cache as staged batch references (blaze_tpu/cache/)
+        lines.append(
+            f"-- Cache: {cache.get('cache_subplan_hits', 0)} subtree(s) "
+            f"served from subplan cache "
+            f"({cache.get('cache_served_bytes', 0)} bytes, fingerprints "
+            f"{', '.join(cache.get('cache_served') or [])}) --")
     ops = stats.get("operators") or []
     paired = [o for o in ops if o.get("est_rows") is not None]
     if paired:

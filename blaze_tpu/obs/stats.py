@@ -55,6 +55,7 @@ PROFILE_FIELDS = (
     "rows", "nparts", "device_time_fraction", "operators", "stages",
     "residency", "spills", "recovery", "truncated",
     "attribution", "critical_path", "decision_audit", "attribution_baseline",
+    "cache",
 )
 STAGE_FIELDS = (
     "stage", "kind", "num_tasks", "partitions", "partition_bytes",
@@ -95,10 +96,21 @@ AUDIT_FIELDS = (
 )
 BASELINE_FIELDS = _CATEGORY_FIELDS + ("wall_ns", "samples")
 
+# result/subplan cache plane (blaze_tpu/cache/): the ``cache`` profile
+# section (subplan hits noted during execution) plus the cache_* tripwire
+# block soak/serve artifacts embed via QueryCache.stats_fields()
+CACHE_FIELDS = (
+    "cache_hits", "cache_misses", "cache_stale", "cache_stale_served",
+    "cache_evictions", "cache_refreshes", "cache_subplan_hits",
+    "cache_degraded_puts", "cache_bytes", "cache_entries",
+    "cache_served_bytes", "cache_served",
+)
+
 ALL_PROFILE_FIELDS = (PROFILE_FIELDS + STAGE_FIELDS + OPERATOR_FIELDS +
                       SKEW_FIELDS + RESIDENCY_FIELDS + SPILL_FIELDS +
                       RECOVERY_FIELDS + ATTRIBUTION_FIELDS +
-                      CRITICAL_PATH_FIELDS + AUDIT_FIELDS + BASELINE_FIELDS)
+                      CRITICAL_PATH_FIELDS + AUDIT_FIELDS + BASELINE_FIELDS +
+                      CACHE_FIELDS)
 
 _SAFE_ID = re.compile(r"[^A-Za-z0-9_.-]+")
 
@@ -291,6 +303,7 @@ class StatsPlane:
         self._stages: Dict[int, dict] = {}
         self._worker_radix: Dict[int, dict] = {}
         self._recovery: List[dict] = []
+        self._cache_notes: List[dict] = []
         self._attribution: Optional[dict] = None
         try:
             from blaze_tpu.utils.device import DEVICE_STATS
@@ -390,6 +403,15 @@ class StatsPlane:
             with self._mu:
                 self._attribution = attr
 
+    def note_cache_subplan(self, fingerprint: str, nbytes: int) -> None:
+        """Record one exchange subtree served from the subplan cache —
+        surfaces in the profile's ``cache`` section and in
+        explain_analyze's cache line."""
+        with self._mu:
+            if len(self._cache_notes) < MAX_RECOVERY_EVENTS:
+                self._cache_notes.append(
+                    {"fingerprint": fingerprint, "nbytes": int(nbytes)})
+
     def note_recovery(self, kind: str, stage: Optional[int] = None,
                       detail=None) -> None:
         with self._mu:
@@ -482,6 +504,7 @@ class StatsPlane:
         }
         with self._mu:
             recovery = list(self._recovery)
+            cache_notes = list(self._cache_notes)
             attribution = self._attribution
 
         audit = None
@@ -501,6 +524,13 @@ class StatsPlane:
             extra["critical_path"] = attribution.get("critical_path") or []
         if audit is not None:
             extra["decision_audit"] = audit
+        if cache_notes:
+            extra["cache"] = {
+                "cache_subplan_hits": len(cache_notes),
+                "cache_served_bytes": sum(n["nbytes"]
+                                          for n in cache_notes),
+                "cache_served": [n["fingerprint"] for n in cache_notes],
+            }
 
         return {
             **extra,

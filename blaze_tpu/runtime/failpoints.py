@@ -66,6 +66,8 @@ SITES = (
     "worker.task",    # runtime/worker.py — task entry in worker processes
     "device.put",     # core/batch.py — host->device column upload
     "serve.preempt",  # runtime/session.py — stage-boundary pause point
+    "cache.put",      # cache/result_cache.py — result-cache fill/persist
+    "ingest.append",  # cache/ingest.py — append-only ingest commit
 )
 
 ACTIONS = ("enospc", "ioerror", "delay", "hang", "corrupt")

@@ -39,6 +39,13 @@ bound to a free port exposes:
 - ``/serve/cancel?id=N``       — flip a query's cancel token
 - ``/serve/result?id=N&timeout_s=T`` — block (bounded) for a result; the
   table returns as columns JSON
+- ``/debug/cache``             — result/subplan cache snapshot (entries,
+  hit/miss/stale/eviction counters, resident bytes) plus ingest table
+  versions; 404 when ``cache_enabled=false``
+- ``/ingest`` (POST)           — append-only streaming ingest: JSON body
+  ``{"table": name, "rows": {col: [...]}}`` appends one batch to the named
+  ingest table and bumps its version (dependent cache entries go stale —
+  refreshed incrementally or recomputed on the next hit, never served)
 
 Start with ``ProfilingService.start(session)``; idempotent per process."""
 
@@ -277,6 +284,19 @@ class ProfilingService:
                                 {"qid": qid, "rows": table.num_rows,
                                  "columns": table.to_pydict()},
                                 default=str))
+                    elif url.path == "/debug/cache":
+                        sess = getattr(self.server, "blaze_session", None)
+                        cache = getattr(sess, "cache", None) \
+                            if sess is not None else None
+                        if cache is None:
+                            self._send(json.dumps(
+                                {"error": "result cache disabled"}),
+                                status=404)
+                        else:
+                            body = cache.snapshot()
+                            body["ingest"] = sess.ingest.snapshot()
+                            self._send(json.dumps(body, indent=2,
+                                                  default=str))
                     elif url.path == "/debug/pprof/profile":
                         # sampling profiler across ALL threads (cProfile only
                         # hooks the calling thread; engine work runs on task
@@ -310,6 +330,9 @@ class ProfilingService:
 
                 def do_POST(self):
                     url = urlparse(self.path)
+                    if url.path == "/ingest":
+                        self._post_ingest()
+                        return
                     if url.path != "/serve/submit":
                         self.send_response(404)
                         self.end_headers()
@@ -378,6 +401,40 @@ class ProfilingService:
                         return
                     self._send(json.dumps({"qid": h.qid, "state": h.state,
                                            "label": h.label}))
+
+                def _post_ingest(self):
+                    # append-only streaming ingest: JSON rows become one
+                    # batch of the named ingest table; the bumped version
+                    # marks dependent cache entries stale (never served —
+                    # refreshed incrementally or recomputed on next hit)
+                    sess = getattr(self.server, "blaze_session", None)
+                    if sess is None:
+                        self._send(json.dumps(
+                            {"error": "no session attached"}), status=503)
+                        return
+                    try:
+                        import pyarrow as pa
+
+                        length = int(self.headers.get("Content-Length", 0))
+                        req = json.loads(self.rfile.read(length) or b"{}")
+                        name = req.get("table")
+                        rows = req.get("rows")
+                        if not name or not isinstance(rows, dict) or not rows:
+                            self._send(json.dumps(
+                                {"error": "need table and non-empty rows"}),
+                                status=400)
+                            return
+                        batch = pa.RecordBatch.from_pydict(rows)
+                        version = sess.append(
+                            name, [batch],
+                            num_partitions=int(req.get("num_partitions", 2)))
+                    except Exception as exc:
+                        self._send(json.dumps(
+                            {"error": f"{type(exc).__name__}: {exc}"}),
+                            status=400)
+                        return
+                    self._send(json.dumps({"table": name, "version": version,
+                                           "rows": batch.num_rows}))
 
             server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
             server.blaze_session = session

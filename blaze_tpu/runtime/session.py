@@ -367,6 +367,18 @@ class Session:
         # without threading a parameter through every closure
         self._tls = threading.local()
         self.serve_scheduler = None  # set by serve.QueryScheduler
+        # fingerprint-keyed result/subplan cache over versioned ingest
+        # tables (blaze_tpu/cache/) — None with cache_enabled=False, and
+        # every consult site checks that first (the <5% disabled-path
+        # overhead guard in test_cache.py)
+        from blaze_tpu.cache.ingest import IngestRegistry
+
+        self.ingest = IngestRegistry(self)
+        self.cache = None
+        if self.conf.cache_enabled:
+            from blaze_tpu.cache.result_cache import QueryCache
+
+            self.cache = QueryCache(self)
 
     _QUERY_LOG_MAX = 50
 
@@ -669,6 +681,41 @@ class Session:
     def execute_to_pydict(self, plan: N.PlanNode, **kw) -> dict:
         return self.execute_to_table(plan, **kw).to_pydict()
 
+    def execute_cached(self, plan: N.PlanNode, **kw) -> pa.Table:
+        """``execute_to_table`` behind the result cache: fresh hit ->
+        stored table (no execution), stale mergeable hit -> tail
+        recompute + merge, else full execution that fills the cache.
+        The plain ``execute*`` entry points never consult the cache —
+        callers opt in here (the serve scheduler is the default-on
+        consumer)."""
+        if self.cache is None:
+            return self.execute_to_table(plan, **kw)
+        table = self.cache.serve(plan)
+        if table is not None:
+            return table
+        table = self.cache.refresh_or_none(
+            plan, lambda p: self.execute_to_table(p, **kw))
+        if table is not None:
+            return table
+        epoch0 = self.cache.epoch()
+        table = self.execute_to_table(plan, **kw)
+        self.cache.offer(plan, table, epoch0, label=kw.get("label"))
+        return table
+
+    def append(self, table: str, batches, num_partitions: int = 2) -> int:
+        """Append-only ingest: add arrow batches to the named versioned
+        table (created on first append), bumping its version so cached
+        results over it turn stale; returns the new version. Scan it with
+        ``table_scan(name)``."""
+        return self.ingest.append(table, batches,
+                                  num_partitions=num_partitions)
+
+    def table_scan(self, table: str) -> N.PlanNode:
+        """Plan leaf over an ingest table (version-free resource id, so
+        the same dashboard plan keeps one fingerprint as the table
+        grows)."""
+        return self.ingest.scan_node(table)
+
     def explain_analyze(self, plan: N.PlanNode) -> str:
         """EXPLAIN ANALYZE: execute the plan to completion and render its
         operator tree annotated with the observed per-node metrics (rows,
@@ -770,6 +817,12 @@ class Session:
             self.pool.close()
             self.pool = None
         self._lineage.clear()
+        if self.cache is not None:
+            # releases cache-owned registry stages, unlinks spill files
+            # and unregisters the MemConsumer — the soak leak gates
+            # assert mm.used == 0 after close
+            self.cache.close()
+        self.ingest.clear()
         self.mem_segments.clear()
         self.resources.clear()
         import glob
@@ -1188,6 +1241,35 @@ class Session:
             return self._run_single_collect(node)
         num_reducers = node.partitioning.num_partitions
         tier = self._shuffle_tier()
+        # subplan cache (blaze_tpu/cache/): identical exchange subtrees
+        # across queries serve their staged map outputs from the cache
+        # instead of re-running the map stage — process tier only (the
+        # references must be plain same-process heap objects) and only in
+        # cache_subplan_scope (serve-submitted queries by default, so
+        # direct runs keep their exact uncached behavior)
+        cache = self.cache
+        use_subplan = (cache is not None and tier == "process"
+                       and cache.subplan_active(self._qrun()))
+        epoch0 = 0
+        if use_subplan:
+            hit = cache.lookup_subplan(node)
+            if hit is not None:
+                from blaze_tpu.cache.result_cache import CachedSubplanProvider
+
+                rid = f"cache_sub_{next(self._stage_ids)}"
+                self._register_resource(
+                    rid, CachedSubplanProvider(hit.maps, hit.groups))
+                qrun = self._qrun()
+                if qrun is not None and qrun.stats is not None:
+                    qrun.stats.note_cache_subplan(hit.fingerprint,
+                                                  hit.nbytes)
+                self.metrics.add("cache_subplan_hits", 1)
+                return N.CoalesceBatches(
+                    N.IpcReader(schema=node.child.output_schema,
+                                resource_id=rid,
+                                num_partitions=hit.num_reducers),
+                    batch_size=0)
+            epoch0 = cache.epoch()
         stage, indexes = self._exec_map_stage(
             node, mem_sink=(tier in ("process", "device")),
             device_sink=(tier == "device"), where=where)
@@ -1208,6 +1290,19 @@ class Session:
             # segments transparently through the same provider
             self._register_resource(rid, MemSegmentBlockProvider(
                 self.mem_segments, stage, indexes, groups=groups))
+            if use_subplan:
+                # capture for cross-query reuse: only when every map
+                # committed registry references (none degraded to files
+                # mid-write — a degraded map's segments live in THIS
+                # query's shuffle dir, which dies with it)
+                maps = [self.mem_segments.get(stage, m)
+                        for m in range(len(indexes))]
+                if maps and all(p is not None for p in maps):
+                    nbytes = sum(int(offs[-1]) for _, offs in indexes)
+                    cache.offer_subplan(
+                        node, maps, nbytes, groups,
+                        len(groups) if groups is not None
+                        else num_reducers, epoch0)
             if groups is not None:
                 num_reducers = len(groups)
         elif groups is not None:

@@ -397,6 +397,17 @@ class QueryScheduler:
         every other tenant's doorway."""
         conf = self.session.conf
         tname = tenant or "default"
+        cache = getattr(self.session, "cache", None)
+        if cache is not None:
+            # result-cache fast path: a fresh fingerprint hit is served
+            # HERE, before estimation, quota, and the tenant heap — the
+            # whole submit→result round trip is a dict lookup plus handle
+            # bookkeeping (microseconds), and a hit consumes no executor
+            # slot, no admission reservation, no queue position
+            hit = cache.serve(plan, tenant=tname)
+            if hit is not None:
+                return self._finish_cache_hit(plan, hit, priority,
+                                              deadline_s, label, tname)
         mem_explicit = mem_estimate is not None
         cost = None
         if mem_estimate is None:
@@ -457,6 +468,39 @@ class QueryScheduler:
             self._cv.notify_all()
         return h
 
+    def _finish_cache_hit(self, plan, table, priority: int,
+                          deadline_s: Optional[float],
+                          label: Optional[str], tname: str) -> QueryHandle:
+        """Book a completed handle for a fresh cache hit without touching
+        the tenant heap, admission, or the executor. The handle behaves
+        exactly like a normal completion (``result()``, ``status()``,
+        ``/serve/status`` all work) but its outcome class is ``cache_hit``
+        so SLO accounting distinguishes served-from-cache from executed."""
+        now = time.monotonic()
+        with self._cv:
+            if self._closed:
+                self.metrics.add("queries_shed", 1)
+                self._count_shed_locked("closed", tname, door=True)
+                raise Overloaded("scheduler closed")
+            t = self._tenant_locked(tname)
+            qid = next(self._ids)
+            h = QueryHandle(self, qid, plan, priority, deadline_s, 0,
+                            label, tenant=tname, preemptible=False)
+            h.table = table
+            h.state = "done"
+            h.admitted_at = now
+            h.finished_at = now
+            t.submitted += 1
+            self._handles[qid] = h
+            self.metrics.add("queries_submitted", 1)
+            self.metrics.add("queries_cache_hit", 1)
+            self._retire_locked(h)
+        self._tm_queries.labels(outcome="cache_hit", tenant=tname).inc()
+        self._tm_e2e.labels(outcome="cache_hit").observe(
+            max(0.0, now - h.submitted_at))
+        h._done.set()
+        return h
+
     def status(self, qid: int) -> Optional[dict]:
         with self._mu:
             h = self._handles.get(qid)
@@ -506,7 +550,10 @@ class QueryScheduler:
                 "tenants": [t.snapshot()
                             for t in sorted(self._tenants.values(),
                                             key=lambda t: t.name)],
-                "queued": queued, "running": running}
+                "queued": queued, "running": running,
+                "cache": (self.session.cache.snapshot()
+                          if getattr(self.session, "cache", None) is not None
+                          else None)}
 
     def close(self, cancel_running: bool = True, timeout: float = 30.0):
         """Shut down: shed everything queued (releasing any paused query's
@@ -790,7 +837,35 @@ class QueryScheduler:
         state = "done"
         paused_cursor: Optional[StageCursor] = None
         conf = self.session.conf
+        cache = getattr(self.session, "cache", None)
+        # sampled BEFORE any execution: the cache only accepts this run's
+        # result if no worker died (and no explicit invalidation landed)
+        # between here and the offer — conservative, but mid-failure
+        # results must never become cache entries
+        epoch0 = cache.epoch() if cache is not None else 0
         try:
+            if cache is not None and h.cursor is None:
+                refreshed = None
+                try:
+                    # stale-but-mergeable entry: recompute only the
+                    # appended ingest tail and fold it into the cached
+                    # table; any failure here falls through to the full
+                    # execute below (never serve stale, never give up)
+                    refreshed = cache.refresh_or_none(
+                        h.plan,
+                        lambda p: self.session.execute_to_table(
+                            p, cancel_token=h.token,
+                            mem_group=h.mem_group,
+                            release_on_finish=True,
+                            label=f"{h.label}#tail"),
+                        tenant=h.tenant)
+                except TaskCancelled:
+                    raise
+                except BaseException:
+                    refreshed = None
+                if refreshed is not None:
+                    h.table = refreshed
+                    return
             while True:
                 try:
                     h.token.check()
@@ -807,6 +882,9 @@ class QueryScheduler:
                     else:
                         h.table = T.schema_to_arrow(
                             h.plan.output_schema).empty_table()
+                    if cache is not None:
+                        cache.offer(h.plan, h.table, epoch0,
+                                    tenant=h.tenant, label=h.label)
                     break
                 except StagePaused as sp:
                     # not a failure: the session honored our pause request

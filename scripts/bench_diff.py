@@ -38,7 +38,8 @@ shapes are growth, not regression).
   candidate — absolute, not relative;
 - a mode's injection EVIDENCE counter (kill -> worker deaths, hang ->
   tasks timed out, enospc -> shuffle_tier_degraded, corrupt ->
-  maps_recomputed) must not drop to zero when the base proves it fired:
+  maps_recomputed, mid_ingest_kill -> worker deaths + cache epoch
+  evictions) must not drop to zero when the base proves it fired:
   a refactor that silently unhooks a failpoint site still "passes" every
   latency gate, and this is the check that catches it;
 - per-mode p99 inflation over the in-artifact baseline must stay within
@@ -76,8 +77,12 @@ QoS artifacts)::
   ceiling, and per-tenant p99s within ``--p99-tol`` of the base;
 - the preemption tripwires (``queries_preempted``,
   ``stages_resumed_from_cursor``, ``backpressure_429s``) must not fall to
-  zero once a base artifact proves them live, and the preemption proof
-  must still resume bit-identical.
+  zero once a base artifact proves them live (skipped when the candidate
+  records no tripwire section — the cache soak's SERVE_r04 schema), and
+  the preemption proof must still resume bit-identical;
+- the result-cache gates (SERVE_r04+): ``cache_hit_rate`` must not drop
+  more than 0.2 below the base, and ``cache_stale_served`` must be 0 —
+  a stale entry is never served without a refresh.
 
 ``--attribution`` gates on the per-category exclusive wall decomposition
 (PR 15's why-is-it-slow plane) instead of total wall clock::
@@ -180,16 +185,20 @@ def diff_artifacts(base: dict, cand: dict, wall_tol: float = 0.25,
 
 # chaos-matrix fields that must be 0 in every candidate, wherever present
 CHAOS_ZERO = ("wrong_results", "leaked_bytes", "shm_segments_leaked",
-              "hard_failures", "client_visible_retryable", "gave_up")
+              "hard_failures", "client_visible_retryable", "gave_up",
+              "cache_stale_served", "stale_entries_surviving")
 # per-mode proof that the injection actually reached its target
 CHAOS_EVIDENCE = {"kill": ("worker_deaths", "kills_injected"),
                   "hang": ("tasks_timed_out",),
                   "enospc": ("shuffle_tier_degraded",),
                   "corrupt": ("maps_recomputed",),
-                  "preempt": ("queries_preempted", "stage_resumes")}
+                  "preempt": ("queries_preempted", "stage_resumes"),
+                  "mid_ingest_kill": ("worker_deaths", "kills_injected",
+                                      "cache_epoch_evictions")}
 # modes whose latency is allowed to blow out by design (a preemption storm
-# parks victims at stage boundaries); correctness/evidence gates still bind
-CHAOS_P99_WAIVED = ("preempt",)
+# parks victims at stage boundaries; the ingest-kill phase measures
+# recovery refreshes); correctness/evidence gates still bind
+CHAOS_P99_WAIVED = ("preempt", "mid_ingest_kill")
 
 
 def diff_chaos(base: dict, cand: dict,
@@ -385,10 +394,31 @@ def diff_serve(base: dict, cand: dict, p99_tol: float = 0.25) -> List[str]:
         regressions.append(
             f"shed_door {cshed} vs base {bshed} (door give-ups grew)")
     # the QoS contract: loaded light p99 within 1.5x isolated, absolute
-    ratio = (cand.get("gates") or {}).get("light_p99_ratio")
-    if ratio is not None and float(ratio) > 1.5:
+    # (with the cache soak's small-percentile floor: when both sides sit
+    # within ~25ms, ratio alone is scheduler jitter, not starvation)
+    cgates = cand.get("gates") or {}
+    ratio = cgates.get("light_p99_ratio")
+    iso = cgates.get("light_p99_isolated_ms")
+    loaded = cgates.get("light_p99_loaded_ms")
+    close = (iso is not None and loaded is not None
+             and float(loaded) <= float(iso) + 25.0)
+    if ratio is not None and float(ratio) > 1.5 and not close:
         regressions.append(
             f"light_p99_ratio {ratio} over the 1.5x isolation ceiling")
+    # cache contract (SERVE_r04+): zipfian repeats must keep hitting, and
+    # a stale entry must never be served as-is
+    bhit = _serve_field(base, "cache_hit_rate")
+    chit = _serve_field(cand, "cache_hit_rate")
+    if bhit is not None and chit is not None and \
+            float(chit) < float(bhit) - 0.2:
+        regressions.append(
+            f"cache_hit_rate {chit} vs base {bhit} (dropped > 0.2 — "
+            f"fingerprinting or admission broke reuse)")
+    cstale = _serve_field(cand, "cache_stale_served")
+    if cstale is not None and int(cstale) != 0:
+        regressions.append(
+            f"cache_stale_served={cstale} (a stale entry was served "
+            f"without refresh — must be 0)")
     # per-tenant p99s, for tenants both artifacts measured
     btenants = base.get("tenants") or {}
     for tname, crec in sorted((cand.get("tenants") or {}).items()):
@@ -403,14 +433,21 @@ def diff_serve(base: dict, cand: dict, p99_tol: float = 0.25) -> List[str]:
             regressions.append(
                 f"tenant {tname}: p99 {cp99}ms vs base {bp99}ms "
                 f"(+>{p99_tol * 100:.0f}%)")
-    # preemption tripwires: proven-live machinery must not fall silent
+    # preemption tripwires: proven-live machinery must not fall silent.
+    # Only when the candidate carries the section at all — the cache soak
+    # (SERVE_r04) measures a different workload and records none.
     btrip = base.get("tripwires") or {}
-    ctrip = cand.get("tripwires") or {}
-    for t in SERVE_TRIPWIRES:
-        if int(btrip.get(t, 0) or 0) > 0 and int(ctrip.get(t, 0) or 0) == 0:
-            regressions.append(
-                f"tripwire {t} fell to 0 (base {btrip[t]}) — the "
-                f"preempt/backpressure path no longer fires")
+    ctrip = cand.get("tripwires")
+    if ctrip is None:
+        print("  tripwires: candidate records none (cache-soak schema), "
+              "skipped")
+    else:
+        for t in SERVE_TRIPWIRES:
+            if int(btrip.get(t, 0) or 0) > 0 and \
+                    int(ctrip.get(t, 0) or 0) == 0:
+                regressions.append(
+                    f"tripwire {t} fell to 0 (base {btrip[t]}) — the "
+                    f"preempt/backpressure path no longer fires")
     proof = cand.get("preempt_proof")
     if proof is not None and not proof.get("bit_identical"):
         regressions.append(

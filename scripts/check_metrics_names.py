@@ -97,6 +97,27 @@ def run_lint(root: str = REPO):
         violations.append("no registrations found — scan roots wrong?")
     violations.extend(check_profile_fields())
     violations.extend(check_attribution_taxonomy())
+    violations.extend(check_cache_instruments(seen))
+    return violations
+
+
+def check_cache_instruments(seen: dict):
+    """The cache instrument families are a dashboard contract (ISSUE 19):
+    every one of the five blaze_cache_* families must stay registered
+    somewhere in the scanned tree — a rename or deletion silently breaks
+    hit-rate panels and the soak tripwires that scrape them."""
+    violations = []
+    names = list(seen)
+    for prefix in ("blaze_cache_hits_", "blaze_cache_misses_",
+                   "blaze_cache_evictions_", "blaze_cache_stale_"):
+        if not any(n.startswith(prefix) for n in names):
+            violations.append(
+                f"no registration found for required cache instrument "
+                f"family {prefix}*")
+    if not any(n.startswith("blaze_cache_") and "bytes" in n for n in names):
+        violations.append(
+            "no registration found for required cache instrument family "
+            "blaze_cache_*bytes*")
     return violations
 
 
@@ -123,6 +144,7 @@ def check_profile_fields():
         ("CRITICAL_PATH_FIELDS", stats.CRITICAL_PATH_FIELDS),
         ("AUDIT_FIELDS", stats.AUDIT_FIELDS),
         ("BASELINE_FIELDS", stats.BASELINE_FIELDS),
+        ("CACHE_FIELDS", stats.CACHE_FIELDS),
     ]
     for schema_name, fields in schemas:
         if len(set(fields)) != len(fields):

@@ -706,7 +706,11 @@ def chaos_main(kill_every_s: float):
     print("CHAOS SOAK (scale) PASSED", flush=True)
 
 
-CHAOS_MODES = ("kill", "hang", "enospc", "corrupt", "preempt")
+# mid_ingest_kill is a serve-matrix-only mode (serve_soak.py): it needs the
+# streaming ingest path and the result cache, which the scale soak doesn't
+# exercise — chaos_mode_conf_kwargs contributes nothing for it
+CHAOS_MODES = ("kill", "hang", "enospc", "corrupt", "preempt",
+               "mid_ingest_kill")
 
 
 def parse_chaos_spec(spec: str) -> dict:

@@ -24,6 +24,15 @@ percentiles, shed-reason breakdowns, and the preemption tripwires
 numbers BASELINE.md cites. Client tallies are still reconciled EXACTLY
 against the registry's counters, now summed across tenant labels.
 
+Round 4 (--zipf, SERVE_r04.json): the result-cache soak — zipfian
+repeats over ~20 query variants gate hit rate >= 0.5, warm hits >= 100x
+faster than cold, zero stale serves, and the light tenant's p99 inside
+round 3's envelope; a streaming section gates incremental refreshes
+(>= 10x below the cold wall, bit-identical to full recompute). The
+chaos matrix gains ``mid_ingest_kill`` (CHAOS_r03.json): worker kills
+landing between append and refresh must never surface a stale or wrong
+cached result.
+
 Run: python scripts/serve_soak.py   (CPU; ~2-4 min)
 Env: SERVE_CLIENTS (64), SERVE_QUERIES (160 total), SERVE_CONCURRENT
 (0 = adaptive admission), SERVE_BUDGET_MB (192), SERVE_ROWS (120_000),
@@ -149,6 +158,12 @@ def main():
                           serve_adaptive_max_concurrent=ADAPTIVE_CAP,
                           serve_preempt_after_s=0.02,
                           serve_preempt_min_run_s=0.02,
+                          # the QoS soak measures EXECUTION under load; the
+                          # result cache would turn the repeated shapes into
+                          # microsecond hits and break the exact
+                          # executed-outcome reconciliation below
+                          # (--zipf is the cache soak, SERVE_r04.json)
+                          cache_enabled=False,
                           incident_dir=os.path.join(tmpdir, "incidents"),
                           incident_max_bundles=64))
         MemManager.reset()
@@ -646,6 +661,391 @@ def main():
     print(f"\nwrote {dst}")
 
 
+def zipf_main():
+    """Cache serve soak (--zipf) -> SERVE_r04.json: a ``heavy`` tenant's
+    clients draw from ~20 dashboard-query variants with zipfian
+    popularity — exactly the repeated-fingerprint traffic the result
+    cache (blaze_tpu/cache/) exists for — while a ``light`` tenant issues
+    UNIQUE-fingerprint queries that always execute, so its p99 measures
+    real execution latency in both phases. Gates: overall hit rate
+    >= 0.5, every heavy result (cache-served or not) equal to an
+    engine-direct oracle, zero stale serves, the light tenant's loaded
+    p99 inside SERVE_r03's 1.5x envelope (cache traffic must not starve
+    execution), and a warm/cold probe proving a repeated query returns
+    >= 100x faster than its cold execution, already done at submit
+    return. A streaming section then proves incremental maintenance:
+    appends to an ingest table turn the cached aggregate stale, each
+    refresh recomputes only the appended tail (median refresh >= 10x
+    below the cold wall) and stays bit-identical to a full recompute.
+    Client tallies reconcile exactly against the registry, with
+    ``cache_hit`` a first-class outcome. Env: same SERVE_* family as the
+    plain soak."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from blaze_tpu.config import Config, set_config
+    from blaze_tpu.ir import exprs as E
+    from blaze_tpu.ir import nodes as N
+    from blaze_tpu.ir import types as T
+    from blaze_tpu.obs.telemetry import get_registry
+    from blaze_tpu.ops.base import QueryCancelled
+    from blaze_tpu.ops.parquet import scan_node_for_files
+    from blaze_tpu.runtime.memmgr import MemManager
+    from blaze_tpu.runtime.session import Session
+    from blaze_tpu.serve import Backpressure, Overloaded, QueryScheduler
+
+    F, M, HASH = E.AggFunction, E.AggMode, E.AggExecMode.HASH_AGG
+
+    VARIANTS = 20
+    ADAPTIVE_CAP = max(18, os.cpu_count() or 1)
+    HEAVY_C = max(4, CLIENTS * 3 // 4)
+    LIGHT_C = max(4, CLIENTS - HEAVY_C)
+    HEAVY_Q = max(40, QUERIES * 3 // 4)
+    LIGHT_Q = max(16, QUERIES - HEAVY_Q)
+    # zipf(s=1.1) popularity over the variant ranks: the head variant is
+    # drawn ~20x as often as the tail — a realistic dashboard skew where
+    # a >= 0.5 hit rate only needs each variant executed once
+    WEIGHTS = [1.0 / (r + 1) ** 1.1 for r in range(VARIANTS)]
+
+    out = {"clients": CLIENTS, "queries": QUERIES, "budget_mb": BUDGET_MB,
+           "rows": ROWS, "variants": VARIANTS, "zipf_s": 1.1,
+           "mix": {"heavy": {"clients": HEAVY_C, "queries": HEAVY_Q},
+                   "light": {"clients": LIGHT_C, "queries": LIGHT_Q}}}
+    t_all = time.perf_counter()
+    with tempfile.TemporaryDirectory(prefix="blaze_serve_zipf_") as tmpdir:
+        set_config(Config(memory_total=BUDGET_MB << 20, memory_fraction=1.0,
+                          mem_wait_timeout_s=5.0,
+                          serve_tenants="heavy:1:2;light:8",
+                          serve_adaptive_max_concurrent=ADAPTIVE_CAP,
+                          incident_dir=os.path.join(tmpdir, "incidents")))
+        MemManager.reset()
+
+        rng = random.Random(7)
+        path = os.path.join(tmpdir, "store_sales.parquet")
+        pq.write_table(pa.table({
+            "ss_store_sk": [rng.randrange(12) for _ in range(ROWS)],
+            "ss_item_sk": [rng.randrange(2000) for _ in range(ROWS)],
+            "ss_net_paid": [rng.randrange(1, 50_000) for _ in range(ROWS)],
+        }), path)
+
+        def scan():
+            return scan_node_for_files([path], num_partitions=4)
+
+        def agg_over(filt):
+            g = [("ss_store_sk", E.Column("ss_store_sk"))]
+            partial = N.Agg(filt, HASH, g, [N.AggColumn(
+                E.AggExpr(F.SUM, [E.Column("ss_net_paid")], T.I64),
+                M.PARTIAL, "paid")])
+            ex = N.ShuffleExchange(
+                partial, N.HashPartitioning([E.Column("ss_store_sk")], 4))
+            return N.Agg(ex, HASH, g, [N.AggColumn(
+                E.AggExpr(F.SUM, [E.Column("ss_net_paid")], T.I64),
+                M.FINAL, "paid")])
+
+        def variant_plan(i):
+            # the i-th dashboard variant: same rollup, different item
+            # threshold — distinct canonical fingerprint per variant
+            return agg_over(N.Filter(scan(), [E.BinaryExpr(
+                E.BinaryOp.LT, E.Column("ss_item_sk"),
+                E.Literal(100 + i * 90, T.I64))]))
+
+        def unique_plan(j):
+            # pass-all predicate with a UNIQUE literal: a fingerprint no
+            # earlier query shares, so the cache always misses and the
+            # query always executes — the light tenant's latency (and the
+            # cold half of the warm/cold probe) measures real execution
+            return agg_over(N.Filter(scan(), [E.BinaryExpr(
+                E.BinaryOp.GT, E.Column("ss_item_sk"),
+                E.Literal(-1 - j, T.I64))]))
+
+        def canon(table):
+            d = table.to_pydict()
+            return sorted(zip(*d.values())) if d else []
+
+        mu = threading.Lock()
+
+        def run_clients(sched, spec, oracle, uniq_base):
+            """spec: {tenant: (nclients, nqueries)}. Heavy clients draw
+            variants zipfian and check results against the oracle; light
+            clients burn unique fingerprints from ``uniq_base``."""
+            counts = {t: {"completed": 0, "shed_queued": 0, "cancelled": 0,
+                          "failed": 0, "door_overloads": 0} for t in spec}
+            lat_ms = {t: [] for t in spec}
+            wrong = []
+            seqs = {t: iter(range(n)) for t, (_c, n) in spec.items()}
+
+            def client(cid, tenant):
+                rngc = random.Random(300 + cid)
+                seq_t = seqs[tenant]
+                while True:
+                    with mu:
+                        i = next(seq_t, None)
+                    if i is None:
+                        return
+                    if tenant == "heavy":
+                        v = rngc.choices(range(VARIANTS),
+                                         weights=WEIGHTS)[0]
+                        mk, est = (lambda v=v: variant_plan(v)), 12 << 20
+                        label = f"heavy_v{v}_{i}"
+                    else:
+                        v = None
+                        mk, est = (lambda j=uniq_base + i:
+                                   unique_plan(j)), 8 << 20
+                        label = f"light_u{i}"
+                    h = None
+                    for _attempt in range(40):
+                        try:
+                            h = sched.submit(mk(), mem_estimate=est,
+                                             label=label, tenant=tenant)
+                            break
+                        except Backpressure as exc:
+                            with mu:
+                                counts[tenant]["door_overloads"] += 1
+                            time.sleep(
+                                min(exc.retry_after_s
+                                    * (2 ** min(_attempt, 3)), 2.0)
+                                * rngc.uniform(0.8, 1.2))
+                        except Overloaded:
+                            with mu:
+                                counts[tenant]["door_overloads"] += 1
+                            time.sleep(rngc.uniform(0.1, 0.4))
+                    if h is None:
+                        with mu:
+                            counts[tenant]["failed"] += 1
+                        continue
+                    try:
+                        got = h.result(timeout=300)
+                        ms = (h.finished_at - h.submitted_at) * 1e3
+                        with mu:
+                            counts[tenant]["completed"] += 1
+                            lat_ms[tenant].append(ms)
+                            if v is not None and canon(got) != oracle[v]:
+                                wrong.append({"variant": v, "query": i})
+                    except Overloaded:
+                        with mu:
+                            counts[tenant]["shed_queued"] += 1
+                    except QueryCancelled:
+                        with mu:
+                            counts[tenant]["cancelled"] += 1
+                    except BaseException as exc:
+                        print(f"[client {cid}] {label} failed: "
+                              f"{type(exc).__name__}: {exc}",
+                              file=sys.stderr)
+                        with mu:
+                            counts[tenant]["failed"] += 1
+                    time.sleep(rngc.uniform(0, 0.02))
+
+            threads, cid = [], 0
+            for tenant, (nclients, _n) in spec.items():
+                for _ in range(nclients):
+                    threads.append(threading.Thread(
+                        target=client, args=(cid, tenant), daemon=True))
+                    cid += 1
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return counts, lat_ms, wrong
+
+        shm0 = shm_roots()
+        with Session() as sess:
+            # engine-direct oracles + JIT warmup (warmup plans use the
+            # unique-fingerprint family so they never seed the cache the
+            # soak is about to measure)
+            oracle = {i: canon(sess.execute_to_table(
+                variant_plan(i), release_on_finish=True))
+                for i in range(VARIANTS)}
+            sess.cache.clear(reason="closed")
+
+            # -- phase 1: the light tenant ISOLATED -----------------------
+            get_registry().reset_values()
+            with QueryScheduler(sess, max_concurrent=CONCURRENT or None,
+                                max_queue=QUEUE,
+                                queue_timeout_s=QUEUE_TIMEOUT_S) as sched:
+                iso_counts, iso_lat, _w = run_clients(
+                    sched, {"light": (LIGHT_C, LIGHT_Q)}, oracle,
+                    uniq_base=0)
+            out["isolated_light"] = {
+                "latency_ms": {"p50": pctl(iso_lat["light"], 50),
+                               "p95": pctl(iso_lat["light"], 95),
+                               "p99": pctl(iso_lat["light"], 99)},
+                **iso_counts["light"]}
+
+            # -- phase 2: zipfian heavy traffic + the same light load -----
+            sess.cache.clear(reason="closed")
+            get_registry().reset_values()
+            probe = {}
+            with QueryScheduler(sess, max_concurrent=CONCURRENT or None,
+                                max_queue=QUEUE,
+                                queue_timeout_s=QUEUE_TIMEOUT_S) as sched:
+                counts, lat_ms, wrong = run_clients(
+                    sched, {"heavy": (HEAVY_C, HEAVY_Q),
+                            "light": (LIGHT_C, LIGHT_Q)}, oracle,
+                    uniq_base=10_000)
+
+                # -- warm/cold probe, scheduler still open ----------------
+                # cold: a never-seen fingerprint, timed on the scheduler's
+                # own clock; warm: the SAME plan resubmitted — the submit
+                # call itself must return a finished handle (the hit
+                # bypasses admission, queue, and executor entirely)
+                h1 = sched.submit(unique_plan(99_999), mem_estimate=8 << 20,
+                                  label="probe_cold")
+                cold_table = h1.result(timeout=300)
+                cold_s = h1.finished_at - h1.submitted_at
+                t0 = time.perf_counter()
+                h2 = sched.submit(unique_plan(99_999), mem_estimate=8 << 20,
+                                  label="probe_warm")
+                warm_s = time.perf_counter() - t0
+                probe = {"cold_ms": round(cold_s * 1e3, 3),
+                         "warm_us": round(warm_s * 1e6, 1),
+                         "warm_done_at_submit": h2.done(),
+                         "warm_bit_identical":
+                             h2.result(timeout=5).equals(cold_table),
+                         "speedup": round(cold_s / max(warm_s, 1e-9), 1)}
+
+                reg = get_registry().to_raw()
+                out["cache"] = dict(sess.cache.stats_fields())
+                out["serve_metrics"] = sched.metrics.to_dict()
+                out["cache_snapshot_entries"] = \
+                    sched.snapshot()["cache"]["counts"]
+
+            # -- reconciliation: every accepted query in ONE outcome ------
+            tot = {k: sum(c[k] for c in counts.values())
+                   for k in next(iter(counts.values()))}
+            tot["completed"] += 2  # the probe's two queries
+            accepted_total = sum(
+                int(s["value"])
+                for s in reg["blaze_serve_queries_total"]["series"])
+            assert accepted_total == (tot["completed"] + tot["shed_queued"]
+                                      + tot["cancelled"] + tot["failed"]), \
+                (accepted_total, tot)
+            hits = _counter(reg, "blaze_serve_queries_total",
+                            outcome="cache_hit")
+            executed = _counter(reg, "blaze_serve_queries_total",
+                                outcome="done")
+            out["totals"] = tot
+            out["hit_rate"] = round(hits / max(hits + executed, 1), 3)
+            out["tenants"] = {
+                tname: {
+                    "latency_ms": {"p50": pctl(lat_ms[tname], 50),
+                                   "p95": pctl(lat_ms[tname], 95),
+                                   "p99": pctl(lat_ms[tname], 99)},
+                    **counts[tname],
+                } for tname in ("heavy", "light")}
+            out["wrong_results"] = wrong
+            out["warm_cold_probe"] = probe
+
+        # -- streaming section: incremental maintenance under appends ----
+        stream = {"history_rows": 0, "appends": [], "cold_ms": None}
+        with Session() as sess:
+            hist = []
+            for _ in range(24):
+                hist.append(pa.RecordBatch.from_pydict({
+                    "k": [rng.randrange(16) for _ in range(5000)],
+                    "v": [rng.randrange(1000) for _ in range(5000)]}))
+            sess.append("stream", hist, num_partitions=4)
+            stream["history_rows"] = 24 * 5000
+            g = [("k", E.Column("k"))]
+            partial = N.Agg(sess.table_scan("stream"), HASH, g,
+                            [N.AggColumn(
+                                E.AggExpr(F.SUM, [E.Column("v")], T.I64),
+                                M.PARTIAL, "paid")])
+            ex = N.ShuffleExchange(
+                partial, N.HashPartitioning([E.Column("k")], 4))
+            plan = N.Agg(ex, HASH, g, [N.AggColumn(
+                E.AggExpr(F.SUM, [E.Column("v")], T.I64),
+                M.FINAL, "paid")])
+            t0 = time.perf_counter()
+            got = sess.execute_cached(plan)
+            stream["cold_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+            bit_identical = True
+            for r in range(8):
+                sess.append("stream", [pa.RecordBatch.from_pydict({
+                    "k": [rng.randrange(16) for _ in range(2000)],
+                    "v": [rng.randrange(1000) for _ in range(2000)]})])
+                t0 = time.perf_counter()
+                got = sess.execute_cached(plan)
+                refresh_ms = round((time.perf_counter() - t0) * 1e3, 2)
+                full = sess.execute_to_table(plan, release_on_finish=True)
+                same = canon(got) == canon(full)
+                bit_identical = bit_identical and same
+                stream["appends"].append(
+                    {"round": r, "refresh_ms": refresh_ms,
+                     "bit_identical": same})
+            stream["cache"] = dict(sess.cache.stats_fields())
+            refreshes = sorted(a["refresh_ms"] for a in stream["appends"])
+            stream["median_refresh_ms"] = refreshes[len(refreshes) // 2]
+            stream["refresh_speedup"] = round(
+                stream["cold_ms"] / max(stream["median_refresh_ms"], 1e-6),
+                1)
+            stream["bit_identical"] = bit_identical
+        out["stream"] = stream
+
+        mm = MemManager._instance
+        out.update({
+            "leaked_mem": mm.used if mm else 0,
+            "shm_segments_leaked": len(shm_roots(shm0)),
+            "wall_s": round(time.perf_counter() - t_all, 2),
+        })
+
+    from blaze_tpu.obs.attribution import artifact_section
+
+    out.update(artifact_section())
+    iso_p99 = out["isolated_light"]["latency_ms"]["p99"]
+    light_p99 = out["tenants"]["light"]["latency_ms"]["p99"]
+    out["gates"] = {
+        "cache_hit_rate": out["hit_rate"],
+        "cache_hits": hits,
+        "cache_misses": out["cache"]["cache_misses"],
+        "cache_stale_served": out["cache"]["cache_stale_served"],
+        "light_p99_isolated_ms": iso_p99,
+        "light_p99_loaded_ms": light_p99,
+        "light_p99_ratio": round(light_p99 / max(iso_p99, 1e-9), 3),
+        "cold_ms": probe["cold_ms"],
+        "warm_hit_us": probe["warm_us"],
+        "warm_speedup": probe["speedup"],
+        "warm_done_at_submit": probe["warm_done_at_submit"],
+        "stream_refresh_speedup": stream["refresh_speedup"],
+        "stream_bit_identical": stream["bit_identical"],
+        "wrong_results": len(wrong),
+        "failed": tot["failed"],
+        "leaked_mem": out["leaked_mem"],
+        "shm_segments_leaked": out["shm_segments_leaked"],
+    }
+    dst = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "SERVE_r04.json")
+    with open(dst, "w") as f:
+        json.dump(out, f, indent=2, default=str)
+    print(json.dumps(out["gates"], indent=2, default=str))
+    # evidence is on disk; now the cache gates
+    g = out["gates"]
+    assert g["failed"] == 0, "soak had hard failures"
+    assert g["wrong_results"] == 0, wrong
+    assert g["cache_stale_served"] == 0, g
+    assert g["cache_hit_rate"] >= 0.5, (
+        f"hit rate {g['cache_hit_rate']} < 0.5 under zipfian repeats "
+        f"({hits} hits / {executed} executions)")
+    # SERVE_r03's QoS envelope, with a small absolute floor: when both
+    # percentiles sit in the tens of milliseconds, scheduler jitter on a
+    # loaded box can exceed 1.5x without any starvation
+    assert light_p99 <= max(1.5 * iso_p99, iso_p99 + 25.0), (
+        f"light tenant p99 {light_p99}ms under cache traffic breached "
+        f"1.5x its isolated p99 {iso_p99}ms")
+    assert g["warm_done_at_submit"], probe
+    assert probe["warm_bit_identical"], probe
+    assert g["warm_speedup"] >= 100, (
+        f"warm hit only {g['warm_speedup']}x faster than cold "
+        f"({probe['warm_us']}us vs {probe['cold_ms']}ms)")
+    assert g["stream_bit_identical"], stream["appends"]
+    assert g["stream_refresh_speedup"] >= 10, (
+        f"median incremental refresh {stream['median_refresh_ms']}ms is "
+        f"not 10x below the {stream['cold_ms']}ms cold wall")
+    assert stream["cache"]["cache_refreshes"] >= 8, stream["cache"]
+    assert g["leaked_mem"] == 0, "memory leaked across queries"
+    assert g["shm_segments_leaked"] == 0, "/dev/shm segment roots leaked"
+    print(f"\nwrote {dst}")
+
+
 def chaos_main(kill_every_s: float):
     """Serve chaos soak (--chaos-kill-every): clients hammer a 2-worker
     clustered scheduler while a ChaosMonkey hard-kills a random worker every
@@ -755,6 +1155,7 @@ def chaos_main(kill_every_s: float):
             conf = Config(
                 memory_total=BUDGET_MB << 20, memory_fraction=1.0,
                 mem_wait_timeout_s=5.0,
+                cache_enabled=False,  # chaos measures recovery, not reuse
                 incident_dir=os.path.join(
                     tmpdir,
                     "incidents_chaos" if with_chaos else "incidents_base"))
@@ -906,7 +1307,8 @@ def chaos_main(kill_every_s: float):
 
 def chaos_matrix_main(spec: str):
     """Serve chaos matrix (--chaos-spec
-    kill:N,hang:N,enospc:N,corrupt:N,preempt:N): client threads hammer a
+    kill:N,hang:N,enospc:N,corrupt:N,preempt:N,mid_ingest_kill:N): client
+    threads hammer a
     2-worker clustered scheduler once uninjected, then once per requested
     injection mode. EVERY mode gates on zero wrong results, zero
     client-visible failures (the serve layer's auto-retry must absorb
@@ -918,6 +1320,14 @@ def chaos_matrix_main(spec: str):
     preempted AND resumed from their stage cursors, its correctness gate
     is the same zero-wrong-results / zero-leaks bar (the p99 bound is
     waived: a storm deliberately delays its victims).
+
+    ``mid_ingest_kill`` (ISSUE 19) is the cache-enabled phase: it
+    hard-kills a worker between a streaming ``append`` and the
+    incremental refresh that follows, and gates on the cache epoch
+    discarding every kill-spanning computation — zero wrong results
+    against a running oracle, zero stale results served, zero stale
+    entries surviving, and a deterministic refused-offer proof. When the
+    spec requests it the artifact lands in CHAOS_r03.json instead.
 
     A deterministic retry-proof prologue runs first: a query whose first
     execution is forced (``worker.task=ioerror`` failpoint, x-capped) to
@@ -1023,6 +1433,7 @@ def chaos_matrix_main(spec: str):
         MemManager.reset()
         proof_conf = Config(
             incident_dir=os.path.join(tmpdir, "incidents_proof"),
+            cache_enabled=False,  # the proof needs a REAL re-execution
             failpoints="worker.task=ioerror:every1:x6", failpoint_seed=7)
         set_config(proof_conf)
         c0 = counters()
@@ -1050,6 +1461,10 @@ def chaos_matrix_main(spec: str):
             conf = Config(
                 memory_total=BUDGET_MB << 20, memory_fraction=1.0,
                 mem_wait_timeout_s=5.0,
+                # repeated shapes would otherwise be served from cache and
+                # starve the injections of executions to land in
+                # (mid_ingest_kill is the cache-enabled chaos phase)
+                cache_enabled=False,
                 incident_dir=os.path.join(
                     tmpdir, f"incidents_{mode or 'baseline'}"), **kwargs)
             set_config(conf)
@@ -1153,9 +1568,137 @@ def chaos_matrix_main(spec: str):
                 "counters_delta": {k: c1[k] - c0[k] for k in COUNTERS},
             }
 
+        def run_mid_ingest_kill(n) -> dict:
+            """Streaming-ingest chaos: a 2-worker session serves a cached
+            mergeable aggregation over an append-only ingest table while a
+            worker is hard-killed between every ``n``-th append and the
+            refresh that follows it. The cache epoch (manual bumps +
+            ``pool.deaths_total``) must discard any entry whose execution
+            spanned a kill: gates are zero wrong results against a running
+            python oracle, zero stale results served, zero stale entries
+            left in the cache, and a deterministic epoch-discard proof
+            (an offer stamped with the pre-kill epoch is refused)."""
+            from collections import defaultdict
+
+            MemManager.reset()
+            conf = Config(
+                memory_total=BUDGET_MB << 20, memory_fraction=1.0,
+                mem_wait_timeout_s=5.0,
+                fault_exclusion_ttl_s=0.5,
+                incident_dir=os.path.join(tmpdir, "incidents_mik"))
+            set_config(conf)
+            kill_every = max(int(n), 2)
+            iters = max(queries // 2, 10)
+            lats, wrong, hard_failures = [], [], []
+            oracle_sums = defaultdict(int)
+            rng2 = random.Random(77)
+            shm0 = shm_roots()
+            c0 = counters()
+
+            def mk_batch(nrows=2000):
+                ks = [rng2.randrange(16) for _ in range(nrows)]
+                vs = [rng2.randrange(1000) for _ in range(nrows)]
+                for k, v in zip(ks, vs):
+                    oracle_sums[k] += v
+                return pa.RecordBatch.from_pydict({"k": ks, "v": vs})
+
+            def canon(table):
+                return sorted(zip(table["k"].to_pylist(),
+                                  table["paid"].to_pylist()))
+
+            def expect():
+                return sorted(oracle_sums.items())
+
+            def epoch_evictions() -> int:
+                snap = get_registry().to_raw()
+                series = snap.get("blaze_cache_evictions_total",
+                                  {}).get("series", [])
+                return sum(s["value"] for s in series
+                           if s.get("labels", {}).get("reason") == "epoch")
+
+            ev0 = epoch_evictions()
+            kills = 0
+            stats = {}
+            with Session(conf=conf, num_worker_processes=2) as sess:
+                sess.append("stream", [mk_batch() for _ in range(4)],
+                            num_partitions=4)
+                g = [("k", E.Column("k"))]
+                partial = N.Agg(sess.table_scan("stream"), HASH, g,
+                                [N.AggColumn(
+                                    E.AggExpr(F.SUM, [E.Column("v")], T.I64),
+                                    M.PARTIAL, "paid")])
+                ex = N.ShuffleExchange(
+                    partial, N.HashPartitioning([E.Column("k")], 4))
+                plan = N.Agg(ex, HASH, g, [N.AggColumn(
+                    E.AggExpr(F.SUM, [E.Column("v")], T.I64),
+                    M.FINAL, "paid")])
+                try:
+                    if canon(sess.execute_cached(plan)) != expect():
+                        wrong.append({"query": "seed"})
+                    for i in range(iters):
+                        sess.append("stream", [mk_batch()])
+                        if i % kill_every == 0:
+                            sess.pool.kill_worker(
+                                rng2.randrange(len(sess.pool.workers)))
+                            kills += 1
+                        t0 = time.perf_counter()
+                        got = sess.execute_cached(plan)
+                        lats.append(time.perf_counter() - t0)
+                        if canon(got) != expect():
+                            wrong.append({"query": i})
+                    # deterministic epoch-discard proof: wait out the
+                    # supervisor's detection of one more kill, then offer a
+                    # result stamped with the PRE-kill epoch — the cache
+                    # must refuse it (an execution that spanned a worker
+                    # death may have been built mid-recovery)
+                    e0 = sess.cache.epoch()
+                    sess.pool.kill_worker(
+                        rng2.randrange(len(sess.pool.workers)))
+                    kills += 1
+                    deadline = time.monotonic() + 30
+                    while sess.cache.epoch() == e0 \
+                            and time.monotonic() < deadline:
+                        time.sleep(0.05)
+                    proof_plan = sess.table_scan("stream")
+                    sess.cache.offer(proof_plan,
+                                     sess.execute_to_table(proof_plan), e0)
+                    discard_proof = (
+                        sess.cache.epoch() != e0
+                        and sess.cache.serve(proof_plan) is None)
+                except BaseException as exc:  # noqa: BLE001
+                    hard_failures.append(f"{type(exc).__name__}: {exc}")
+                    discard_proof = False
+                time.sleep(2.0)  # heartbeat grace for the last kill
+                with sess.cache._mu:
+                    stale_surviving = sum(
+                        0 if sess.cache._fresh_locked(e) else 1
+                        for e in sess.cache._results.values())
+                stats = dict(sess.cache.stats_fields())
+            mm = MemManager._instance
+            c1 = counters()
+            return {
+                "p50_s": round(_pctl(lats, 0.50), 4),
+                "p99_s": round(_pctl(lats, 0.99), 4),
+                "completed": len(lats),
+                "client_visible_retryable": 0,
+                "gave_up": 0,
+                "wrong_results": wrong,
+                "hard_failures": hard_failures,
+                "kills_injected": kills,
+                "shuffle_tier_degraded": 0,
+                "leaked_mem": int(mm.used) if mm is not None else 0,
+                "shm_segments_leaked": len(shm_roots(shm0)),
+                "counters_delta": {k: c1[k] - c0[k] for k in COUNTERS},
+                "cache": stats,
+                "cache_epoch_evictions": epoch_evictions() - ev0,
+                "epoch_discard_proof": discard_proof,
+                "stale_entries_surviving": stale_surviving,
+            }
+
         section["phases"]["baseline"] = base = run_phase(None, 0)
         for mode, n in modes.items():
-            section["phases"][mode] = run_phase(mode, n)
+            section["phases"][mode] = run_mid_ingest_kill(n) \
+                if mode == "mid_ingest_kill" else run_phase(mode, n)
 
     gates = {"p99_baseline_s": base["p99_s"],
              "retry_proof_serve_retries": proof["serve_retries"],
@@ -1182,11 +1725,22 @@ def chaos_matrix_main(spec: str):
             "shuffle_tier_degraded": ph["shuffle_tier_degraded"],
             "kills_injected": ph["kills_injected"],
         }
+        if mode == "mid_ingest_kill":
+            gates["modes"][mode].update({
+                "cache_stale_served": ph["cache"].get(
+                    "cache_stale_served", 0),
+                "cache_refreshes": ph["cache"].get("cache_refreshes", 0),
+                "cache_epoch_evictions": ph["cache_epoch_evictions"],
+                "stale_entries_surviving": ph["stale_entries_surviving"],
+                "epoch_discard_proof": ph["epoch_discard_proof"],
+            })
     section["gates"] = gates
     from blaze_tpu.obs.attribution import artifact_section
 
     section.update(artifact_section())
-    path = _write_chaos_section("serve", section, fname="CHAOS_r02.json")
+    fname = "CHAOS_r03.json" if "mid_ingest_kill" in modes \
+        else "CHAOS_r02.json"
+    path = _write_chaos_section("serve", section, fname=fname)
     print(json.dumps({"gates": gates, "artifact": path}), flush=True)
 
     # evidence is on disk; now enforce the matrix gates
@@ -1202,9 +1756,11 @@ def chaos_matrix_main(spec: str):
         assert g["gave_up"] == 0, (mode, g)
         assert g["leaked_bytes"] == 0, (mode, g)
         assert g["shm_segments_leaked"] == 0, (mode, g)
-        if mode != "preempt":
+        if mode not in ("preempt", "mid_ingest_kill"):
             # a preemption storm deliberately parks victims at stage
-            # boundaries; its bar is correctness + hygiene, not latency
+            # boundaries, and the ingest-kill phase measures recovery
+            # refreshes against a warmup-free baseline — their bar is
+            # correctness + hygiene, not latency
             assert g["p99_s"] <= 2.0 * gates["p99_baseline_s"], (mode, g)
     if "kill" in modes:
         g = gates["modes"]["kill"]
@@ -1219,6 +1775,12 @@ def chaos_matrix_main(spec: str):
         g = gates["modes"]["preempt"]
         assert g["queries_preempted"] > 0, gates
         assert g["stage_resumes"] > 0, gates
+    if "mid_ingest_kill" in modes:
+        g = gates["modes"]["mid_ingest_kill"]
+        assert g["kills_injected"] > 0 and g["worker_deaths"] > 0, g
+        assert g["cache_stale_served"] == 0, g
+        assert g["stale_entries_surviving"] == 0, g
+        assert g["epoch_discard_proof"], g
     print("CHAOS MATRIX (serve) PASSED", flush=True)
 
 
@@ -1226,17 +1788,26 @@ if __name__ == "__main__":
     import argparse
 
     ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--zipf", action="store_true",
+                    help="cache serve soak: zipfian repeats over ~20 query "
+                         "variants against the result cache, plus a "
+                         "streaming incremental-maintenance section "
+                         "(SERVE_r04.json) instead of the plain serve soak")
     ap.add_argument("--chaos-kill-every", type=float, metavar="N",
                     help="chaos mode: hard-kill a random worker every N "
                          "seconds under serving load and gate on recovery "
                          "(CHAOS_r01.json) instead of the plain serve soak")
     ap.add_argument("--chaos-spec", metavar="SPEC",
                     help="chaos matrix: comma-separated modes "
-                         "kill:N,hang:N,enospc:N,corrupt:N,preempt:N — one "
-                         "injected phase per mode plus an uninjected "
-                         "baseline, gated per mode (CHAOS_r02.json)")
+                         "kill:N,hang:N,enospc:N,corrupt:N,preempt:N,"
+                         "mid_ingest_kill:N — one injected phase per mode "
+                         "plus an uninjected baseline, gated per mode "
+                         "(CHAOS_r02.json; CHAOS_r03.json when the spec "
+                         "includes mid_ingest_kill)")
     args = ap.parse_args()
-    if args.chaos_spec:
+    if args.zipf:
+        zipf_main()
+    elif args.chaos_spec:
         chaos_matrix_main(args.chaos_spec)
     elif args.chaos_kill_every:
         chaos_main(args.chaos_kill_every)

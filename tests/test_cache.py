@@ -1,0 +1,451 @@
+"""Result/subplan cache tests (blaze_tpu/cache/): fingerprint keying and
+cacheability, the serve/offer/refresh lifecycle, version invalidation over
+the streaming ingest path, incremental tail-merge correctness, LRU + memory
+pressure eviction, the put-failure degrade ladder (memory -> spill-dir ->
+miss), epoch discards around worker death, scheduler integration
+(``cache_hit`` as a first-class outcome that bypasses the queue), and the
+disabled-path guard (cache off => the cache is never even consulted)."""
+
+import os
+import time
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from blaze_tpu.cache import incremental, result_cache
+from blaze_tpu.cache.incremental import merge_tables, mergeable_spec
+from blaze_tpu.cache.result_cache import cache_key, plan_cacheable
+from blaze_tpu.config import Config
+from blaze_tpu.ir import exprs as E
+from blaze_tpu.ir import nodes as N
+from blaze_tpu.ir import types as T
+from blaze_tpu.runtime import failpoints
+from blaze_tpu.runtime.memmgr import MemManager
+from blaze_tpu.runtime.session import Session
+
+F = E.AggFunction
+M = E.AggMode
+HASH = E.AggExecMode.HASH_AGG
+
+
+@pytest.fixture(autouse=True)
+def _fresh_memmgr():
+    MemManager.reset()
+    failpoints.disarm()
+    yield
+    failpoints.disarm()
+    MemManager.reset()
+
+
+def _write_parquet(tmp_path, name="t.parquet", n=4000, stores=7):
+    path = str(tmp_path / name)
+    pq.write_table(pa.table({
+        "k": [i % stores for i in range(n)],
+        "v": list(range(n)),
+    }), path)
+    return path
+
+
+def _agg_plan(child, key="k", val="v", fn=F.SUM, out="s", reducers=3):
+    g = [(key, E.Column(key))]
+    partial = N.Agg(child, HASH, g, [N.AggColumn(
+        E.AggExpr(fn, [E.Column(val)], T.I64), M.PARTIAL, out)])
+    ex = N.ShuffleExchange(partial,
+                           N.HashPartitioning([E.Column(key)], reducers))
+    return N.Agg(ex, HASH, g, [N.AggColumn(
+        E.AggExpr(fn, [E.Column(val)], T.I64), M.FINAL, out)])
+
+
+def _scan(path, nparts=2):
+    from blaze_tpu.ops.parquet import scan_node_for_files
+
+    return scan_node_for_files([path], num_partitions=nparts)
+
+
+def _canon(table):
+    d = table.to_pydict()
+    return sorted(zip(*d.values())) if d else []
+
+
+def _batch(ks, vs):
+    return pa.RecordBatch.from_pydict({"k": ks, "v": vs})
+
+
+# -- keying / cacheability ----------------------------------------------------
+
+
+def test_cache_key_stable_and_literal_sensitive(tmp_path):
+    path = _write_parquet(tmp_path)
+
+    def filt(v):
+        return N.Filter(_scan(path), [E.BinaryExpr(
+            E.BinaryOp.GT, E.Column("v"), E.Literal(v, T.I64))])
+
+    assert cache_key(_agg_plan(filt(5))) == cache_key(_agg_plan(filt(5)))
+    assert cache_key(_agg_plan(filt(5))) != cache_key(_agg_plan(filt(6)))
+    assert plan_cacheable(_agg_plan(filt(5)))
+
+
+def test_ffi_and_sink_plans_uncacheable(tmp_path):
+    schema = pa.schema([("k", pa.int64()), ("v", pa.int64())])
+    ffi = N.FFIReader(schema=schema, resource_id="src", num_partitions=1)
+    assert not plan_cacheable(_agg_plan(ffi))
+    path = _write_parquet(tmp_path)
+    sink = N.ParquetSink(_scan(path), fs_path=str(tmp_path / "out"))
+    assert not plan_cacheable(sink)
+
+
+# -- serve / offer lifecycle --------------------------------------------------
+
+
+def test_execute_cached_fill_then_hit(tmp_path):
+    path = _write_parquet(tmp_path)
+    with Session(conf=Config()) as sess:
+        plan = _agg_plan(_scan(path))
+        cold = sess.execute_cached(plan)
+        stats = sess.cache.stats_fields()
+        assert stats["cache_misses"] == 1 and stats["cache_hits"] == 0
+        warm = sess.execute_cached(_agg_plan(_scan(path)))
+        stats = sess.cache.stats_fields()
+        assert stats["cache_hits"] == 1
+        assert warm.equals(cold)
+        assert stats["cache_bytes"] > 0 and stats["cache_entries"] == 1
+
+
+def test_warm_hit_is_microsecond_scale(tmp_path):
+    """The whole point of the subsystem: a repeat lookup must not re-run
+    the engine. Bound generously (10ms) — the cold run takes 100x that."""
+    path = _write_parquet(tmp_path, n=20_000)
+    with Session(conf=Config()) as sess:
+        plan = _agg_plan(_scan(path))
+        sess.execute_cached(plan)
+        t0 = time.perf_counter()
+        sess.execute_cached(_agg_plan(_scan(path)))
+        assert time.perf_counter() - t0 < 0.010
+
+
+def test_bit_identity_cold_warm_disabled(tmp_path):
+    path = _write_parquet(tmp_path)
+    plan = _agg_plan(_scan(path))
+    with Session(conf=Config()) as sess:
+        cold = sess.execute_cached(plan)
+        warm = sess.execute_cached(plan)
+    MemManager.reset()
+    with Session(conf=Config(cache_enabled=False)) as sess:
+        off = sess.execute_cached(plan)
+    assert _canon(cold) == _canon(warm) == _canon(off)
+    assert warm.equals(cold)
+
+
+# -- version invalidation + incremental maintenance ---------------------------
+
+
+def test_append_bumps_version_and_staleness(tmp_path):
+    with Session(conf=Config()) as sess:
+        v1 = sess.append("t", [_batch([0, 1], [10, 20])])
+        v2 = sess.append("t", [_batch([1], [5])])
+        assert v2 == v1 + 1
+        assert sess.ingest.versions(["t"]) == {"t": v2}
+
+
+def test_incremental_refresh_matches_full_recompute(tmp_path):
+    with Session(conf=Config()) as sess:
+        sess.append("t", [_batch([0, 1, 2, 0], [1, 2, 3, 4])],
+                    num_partitions=2)
+        plan = _agg_plan(sess.table_scan("t"))
+        first = sess.execute_cached(plan)
+        assert _canon(first) == [(0, 5), (1, 2), (2, 3)]
+        sess.append("t", [_batch([0, 3], [100, 7])])
+        refreshed = sess.execute_cached(plan)
+        oracle = sess.execute_to_table(plan, release_on_finish=True)
+        assert _canon(refreshed) == _canon(oracle) == [
+            (0, 105), (1, 2), (2, 3), (3, 7)]
+        stats = sess.cache.stats_fields()
+        assert stats["cache_refreshes"] == 1
+        assert stats["cache_stale_served"] == 0
+        # and the refreshed entry is itself servable
+        assert _canon(sess.execute_cached(plan)) == _canon(oracle)
+        assert sess.cache.stats_fields()["cache_hits"] >= 1
+
+
+def test_nonmergeable_stale_falls_back_to_full_recompute(tmp_path):
+    with Session(conf=Config()) as sess:
+        sess.append("t", [_batch([0, 1], [3, 9])], num_partitions=2)
+        # a Sort atop the agg is not tail-mergeable
+        plan = N.Sort(_agg_plan(sess.table_scan("t")),
+                      [E.SortOrder(E.Column("s"))])
+        sess.execute_cached(plan)
+        sess.append("t", [_batch([0], [1])])
+        got = sess.execute_cached(plan)
+        oracle = sess.execute_to_table(plan, release_on_finish=True)
+        assert _canon(got) == _canon(oracle)
+        stats = sess.cache.stats_fields()
+        assert stats["cache_refreshes"] == 0  # full recompute, not merge
+        assert stats["cache_stale"] >= 1
+        assert stats["cache_stale_served"] == 0
+
+
+def test_stale_entry_never_served_pin(tmp_path):
+    """The invariant the chaos matrix and soaks pin to zero, unit-scale:
+    no sequence of appends and lookups may return a pre-append table."""
+    with Session(conf=Config()) as sess:
+        sess.append("t", [_batch([0], [1])])
+        plan = _agg_plan(sess.table_scan("t"))
+        for i in range(5):
+            got = sess.execute_cached(plan)
+            assert _canon(got)[0][1] == i + 1
+            sess.append("t", [_batch([0], [1])])
+        assert sess.cache.stats_fields()["cache_stale_served"] == 0
+
+
+# -- incremental units --------------------------------------------------------
+
+
+def test_mergeable_spec_units(tmp_path):
+    path = _write_parquet(tmp_path)
+    spec = mergeable_spec(_agg_plan(_scan(path)))
+    assert spec is not None
+    assert mergeable_spec(N.Sort(_agg_plan(_scan(path)),
+                                 [E.SortOrder(E.Column("s"))])) is None
+    assert mergeable_spec(_scan(path)) is None
+    # AVG has no pure fold — must refuse
+    assert mergeable_spec(_agg_plan(_scan(path), fn=F.AVG)) is None
+
+
+def test_merge_tables_folds():
+    spec = (["k"], [("mn", "min"), ("mx", "max"), ("sm", "sum")])
+    cached = pa.table({"k": [0, 1], "mn": [3, 5], "mx": [9, 5],
+                       "sm": [12, 5]})
+    delta = pa.table({"k": [1, 2], "mn": [1, 8], "mx": [10, 8],
+                      "sm": [11, 8]})
+    out = merge_tables(cached, delta, spec)
+    assert _canon(out) == [(0, 3, 9, 12), (1, 1, 10, 16), (2, 8, 8, 8)]
+    assert out.schema.names == ["k", "mn", "mx", "sm"]
+    # empty delta short-circuits to the cached table
+    assert merge_tables(cached, delta.slice(0, 0), spec) is cached
+
+
+# -- eviction / degrade ladder ------------------------------------------------
+
+
+def test_eviction_under_byte_pressure(tmp_path):
+    """A byte cap far below the working set forces the LRU ladder; the
+    cache must keep serving (spill tier) without ever exceeding its cap
+    or failing a fill."""
+    path = _write_parquet(tmp_path, n=20_000)
+    conf = Config(cache_max_bytes=1 << 20, cache_spill_enabled=True,
+                  spill_dir=str(tmp_path / "spill"))
+    with Session(conf=conf) as sess:
+        plans = []
+        for v in range(6):
+            # group by the ~unique v column: each result is ~320 KB, so
+            # six entries overflow the 1 MB cap (one always fits)
+            p = _agg_plan(N.Filter(_scan(path), [E.BinaryExpr(
+                E.BinaryOp.GT, E.Column("v"), E.Literal(v * 100, T.I64))]),
+                key="v", val="k")
+            plans.append(p)
+            sess.execute_cached(p)
+        snap = sess.cache.snapshot()
+        assert snap["resident_bytes"] <= 1 << 20
+        assert snap["counts"]["evictions"] + sum(
+            1 for e in snap["results"] if e["tier"] == "spill") > 0
+        # every plan still answers correctly, whatever tier it landed on
+        for p in plans:
+            got = sess.execute_cached(p)
+            oracle = sess.execute_to_table(p, release_on_finish=True)
+            assert _canon(got) == _canon(oracle)
+
+
+def test_max_entries_cap(tmp_path):
+    path = _write_parquet(tmp_path)
+    conf = Config(cache_max_entries=2, cache_spill_enabled=False)
+    with Session(conf=conf) as sess:
+        for v in range(5):
+            sess.execute_cached(_agg_plan(N.Filter(_scan(path), [
+                E.BinaryExpr(E.BinaryOp.GT, E.Column("v"),
+                             E.Literal(v, T.I64))])))
+        assert sess.cache.snapshot()["entries"] <= 2
+
+
+def test_degrade_ladder_put_failure_spills_then_serves(tmp_path):
+    """An injected put failure (failpoint ``cache.put``) must degrade to
+    the spill rung — and the spilled entry must still HIT, promoted back
+    to memory with the exact table."""
+    path = _write_parquet(tmp_path)
+    conf = Config(failpoints="cache.put=ioerror:every1:x1",
+                  spill_dir=str(tmp_path / "spill"))
+    with Session(conf=conf) as sess:
+        failpoints.arm_from(conf)
+        plan = _agg_plan(_scan(path))
+        cold = sess.execute_cached(plan)
+        stats = sess.cache.stats_fields()
+        assert stats["cache_degraded_puts"] == 1
+        snap = sess.cache.snapshot()
+        assert [e["tier"] for e in snap["results"]] == ["spill"]
+        warm = sess.execute_cached(plan)
+        assert warm.equals(cold)
+        assert sess.cache.stats_fields()["cache_hits"] == 1
+        assert sess.cache.snapshot()["results"][0]["tier"] == "mem"
+
+
+def test_degrade_ladder_spill_disabled_drops_to_miss(tmp_path):
+    path = _write_parquet(tmp_path)
+    conf = Config(failpoints="cache.put=ioerror:every1:x1",
+                  cache_spill_enabled=False)
+    with Session(conf=conf) as sess:
+        failpoints.arm_from(conf)
+        plan = _agg_plan(_scan(path))
+        cold = sess.execute_cached(plan)
+        assert sess.cache.snapshot()["entries"] == 0  # dropped, not stored
+        again = sess.execute_cached(plan)  # a MISS that re-executes
+        assert again.equals(cold)
+        assert sess.cache.stats_fields()["cache_hits"] == 0
+
+
+def test_memconsumer_citizenship_and_clean_close(tmp_path):
+    path = _write_parquet(tmp_path, n=20_000)
+    with Session(conf=Config()) as sess:
+        sess.execute_cached(_agg_plan(_scan(path)))
+        mm = MemManager._instance
+        assert mm is not None and mm.used > 0  # cache residency is booked
+    assert MemManager._instance is None or MemManager._instance.used == 0
+
+
+# -- epoch: worker death must invalidate in-flight fills ----------------------
+
+
+def test_epoch_bump_discards_inflight_offer(tmp_path):
+    path = _write_parquet(tmp_path)
+    with Session(conf=Config()) as sess:
+        plan = _agg_plan(_scan(path))
+        table = sess.execute_to_table(plan, release_on_finish=True)
+        e0 = sess.cache.epoch()
+        sess.cache.bump_epoch()  # what a worker death does via deaths_total
+        sess.cache.offer(plan, table, e0)
+        assert sess.cache.serve(plan) is None  # refused, not admitted
+        assert sess.cache.snapshot()["entries"] == 0
+
+
+@pytest.mark.slow
+def test_epoch_discard_on_pool_worker_death(tmp_path):
+    path = _write_parquet(tmp_path)
+    conf = Config(fault_exclusion_ttl_s=0.5)
+    with Session(conf=conf, num_worker_processes=2) as sess:
+        plan = _agg_plan(_scan(path))
+        table = sess.execute_to_table(plan, release_on_finish=True)
+        e0 = sess.cache.epoch()
+        sess.pool.kill_worker(0)
+        deadline = time.monotonic() + 30
+        while sess.cache.epoch() == e0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert sess.cache.epoch() > e0
+        sess.cache.offer(plan, table, e0)
+        assert sess.cache.serve(plan) is None
+
+
+# -- subplan sharing ----------------------------------------------------------
+
+
+def test_subplan_sharing_across_plans(tmp_path):
+    """Two different whole plans over the SAME exchange subtree: the
+    second must serve the map stage from the subplan cache (no re-run),
+    and explain_analyze must show the cache-served subtree."""
+    path = _write_parquet(tmp_path)
+    conf = Config(cache_subplan_scope="all")
+    with Session(conf=conf) as sess:
+        g = [("k", E.Column("k"))]
+        partial = N.Agg(_scan(path), HASH, g, [N.AggColumn(
+            E.AggExpr(F.SUM, [E.Column("v")], T.I64), M.PARTIAL, "s")])
+        ex = N.ShuffleExchange(partial,
+                               N.HashPartitioning([E.Column("k")], 3))
+        final = N.Agg(ex, HASH, g, [N.AggColumn(
+            E.AggExpr(F.SUM, [E.Column("v")], T.I64), M.FINAL, "s")])
+        a = sess.execute_to_table(final, release_on_finish=True)
+        plan_b = N.Filter(
+            N.Agg(ex, HASH, g, [N.AggColumn(
+                E.AggExpr(F.SUM, [E.Column("v")], T.I64), M.FINAL, "s")]),
+            [E.BinaryExpr(E.BinaryOp.GT, E.Column("s"),
+                          E.Literal(-1, T.I64))])
+        b = sess.execute_to_table(plan_b, release_on_finish=True)
+        assert _canon(a) == _canon(b)
+        assert sess.cache.stats_fields()["cache_subplan_hits"] == 1
+        text = sess.explain_analyze(plan_b)
+        assert "served from subplan cache" in text
+
+
+def test_subplan_invalidated_by_append(tmp_path):
+    conf = Config(cache_subplan_scope="all")
+    with Session(conf=conf) as sess:
+        sess.append("t", [_batch([0, 1], [2, 3])], num_partitions=2)
+        plan = _agg_plan(sess.table_scan("t"))
+        sess.execute_to_table(plan, release_on_finish=True)
+        sess.append("t", [_batch([0], [10])])
+        got = sess.execute_to_table(plan, release_on_finish=True)
+        assert _canon(got) == [(0, 12), (1, 3)]  # no stale subplan reuse
+        assert sess.cache.stats_fields()["cache_subplan_hits"] == 0
+
+
+# -- scheduler integration ----------------------------------------------------
+
+
+def test_scheduler_cache_hit_outcome_bypasses_queue(tmp_path):
+    from blaze_tpu.serve import QueryScheduler
+
+    path = _write_parquet(tmp_path)
+    with Session(conf=Config()) as sess:
+        with QueryScheduler(sess, max_concurrent=1,
+                            queue_timeout_s=30.0) as sched:
+            h1 = sched.submit(_agg_plan(_scan(path)), label="cold")
+            cold = h1.result(timeout=120)
+            h2 = sched.submit(_agg_plan(_scan(path)), label="warm")
+            assert h2.done()  # finished AT submit return: no queue, no slot
+            assert h2.result(timeout=5).equals(cold)
+            assert sched.metrics.values.get("queries_cache_hit") == 1
+            # hits are not executions: done still counts only the cold run
+            assert sched.metrics.values.get("queries_done") == 1
+            assert sched.snapshot()["cache"]["counts"]["hits"] == 1
+
+
+def test_scheduler_refreshes_stale_through_cache(tmp_path):
+    from blaze_tpu.serve import QueryScheduler
+
+    with Session(conf=Config()) as sess:
+        sess.append("t", [_batch([0, 1], [5, 6])], num_partitions=2)
+        plan = _agg_plan(sess.table_scan("t"))
+        with QueryScheduler(sess, max_concurrent=1,
+                            queue_timeout_s=30.0) as sched:
+            sched.submit(plan, label="cold").result(timeout=120)
+            sess.append("t", [_batch([1], [4])])
+            got = sched.submit(plan, label="stale").result(timeout=120)
+            assert _canon(got) == [(0, 5), (1, 10)]
+        assert sess.cache.stats_fields()["cache_refreshes"] == 1
+        assert sess.cache.stats_fields()["cache_stale_served"] == 0
+
+
+# -- disabled path ------------------------------------------------------------
+
+
+def test_disabled_cache_is_never_consulted(tmp_path, monkeypatch):
+    """cache_enabled=False must keep the hot path free of cache work —
+    not "a fast miss", NO consult at all (the structural form of the <5%%
+    overhead guarantee: the only added cost is one attribute check)."""
+    from blaze_tpu.serve import QueryScheduler
+
+    path = _write_parquet(tmp_path)
+
+    def _boom(plan):
+        raise AssertionError("cache consulted on the disabled path")
+
+    monkeypatch.setattr(result_cache, "cache_key", _boom)
+    monkeypatch.setattr(incremental, "mergeable_spec", _boom)
+    with Session(conf=Config(cache_enabled=False)) as sess:
+        assert sess.cache is None
+        plan = _agg_plan(_scan(path))
+        a = sess.execute_cached(plan)
+        b = sess.execute_cached(plan)
+        assert _canon(a) == _canon(b)
+        with QueryScheduler(sess, max_concurrent=1,
+                            queue_timeout_s=30.0) as sched:
+            sched.submit(plan, label="q").result(timeout=120)
+            assert sched.metrics.values.get("queries_cache_hit") is None
