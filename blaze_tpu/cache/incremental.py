@@ -76,7 +76,13 @@ def merge_tables(cached, delta, spec):
             .cast(cached.schema)
     merged = both.group_by(group_names).aggregate(
         [(name, fold) for name, fold in folds])
-    merged = merged.rename_columns(
-        group_names + [name for name, _ in folds]) \
-        .select(cached.schema.names).cast(cached.schema)
+    # select by the generated names — aggregates come out as
+    # "{name}_{fold}", group keys under their own names; the relative
+    # ORDER of keys vs aggregates differs across pyarrow majors, so a
+    # positional rename could silently mislabel (and with coinciding
+    # types, swap) columns
+    agg_out = {name: f"{name}_{fold}" for name, fold in folds}
+    merged = pa.Table.from_arrays(
+        [merged.column(agg_out.get(n, n)) for n in cached.schema.names],
+        names=list(cached.schema.names)).cast(cached.schema)
     return merged.sort_by([(n, "ascending") for n in group_names])

@@ -22,7 +22,7 @@ mapping — partition-confined operators stay bit-identical either way.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 INGEST_PREFIX = "ingest://"
 
@@ -144,10 +144,17 @@ class IngestRegistry:
                              resource_id=INGEST_PREFIX + name,
                              num_partitions=t.num_partitions)
 
-    def register_tail(self, name: str, from_version: int) -> Optional[str]:
+    def register_tail(self, name: str,
+                      from_version: int) -> Optional[Tuple[str, int]]:
         """Register a temporary tail resource covering batches appended
-        after ``from_version``; returns its resource id (caller drops it
-        via ``release_tail``). None when the table is unknown."""
+        after ``from_version``; returns ``(resource_id, to_version)``
+        where ``to_version`` is the version the snapshot ACTUALLY covers
+        — the only value a refreshed cache entry may record (a vector
+        sampled before registration can lag a racing append, and a
+        recorded vector behind the merged data re-merges the same tail
+        on the next refresh, double-counting SUM/COUNT). None when the
+        table is unknown. Caller drops the resource via
+        ``release_tail``."""
         with self._mu:
             t = self._tables.get(name)
             if t is None:
@@ -157,7 +164,7 @@ class IngestRegistry:
                 max(0, min(int(from_version), len(t.version_offsets) - 1))]
             self._session.resources[rid] = _IngestScanProvider(
                 t.batches[start:], t.num_partitions, start=start)
-            return rid
+            return rid, t.version
 
     def release_tail(self, rid: str):
         self._session.resources.pop(rid, None)
@@ -197,28 +204,36 @@ def retarget_to_tails(plan, versions: Dict[str, int], registry:
                       IngestRegistry):
     """Rewrite every ingest scan leaf to its tail since ``versions[name]``
     — the plan that computes ONLY the appended delta. Returns (tail_plan,
-    [tail resource ids to release]) or (None, []) when any table vanished."""
+    [tail resource ids to release], {name: to_version each tail snapshot
+    covers} — the version vector the refreshed entry must record), or
+    (None, [], {}) when any table vanished or an append moved a table
+    between two of its own leaf registrations (the two tails would cover
+    different data, making the delta inconsistent)."""
     import dataclasses
 
     from blaze_tpu.ir import nodes as N
 
     rids: List[str] = []
+    covered: Dict[str, int] = {}
 
     def rewrite(node):
         node = N.map_children(node, rewrite)
         if isinstance(node, N.BatchSource) and \
                 node.resource_id.startswith(INGEST_PREFIX):
             name = node.resource_id[len(INGEST_PREFIX):].split("@", 1)[0]
-            rid = registry.register_tail(name, versions.get(name, 0))
-            if rid is None:
+            reg = registry.register_tail(name, versions.get(name, 0))
+            if reg is None:
                 raise KeyError(name)
+            rid, to_version = reg
             rids.append(rid)
+            if covered.setdefault(name, to_version) != to_version:
+                raise KeyError(name)
             return dataclasses.replace(node, resource_id=rid)
         return node
 
     try:
-        return rewrite(plan), rids
+        return rewrite(plan), rids, covered
     except KeyError:
         for rid in rids:
             registry.release_tail(rid)
-        return None, []
+        return None, [], {}

@@ -21,13 +21,18 @@ equal basenames, which for a cache means wrong results, not a stale
 profile. The PR 11 fingerprint is still computed and carried on every
 entry for the profile/explain/artifact surface.
 
-Staleness and the epoch check: entries record the version of every
+Staleness and the fill token: entries record the version of every
 ingest table their plan reads; a lookup whose versions lag the registry
 is STALE and is never served as-is — it is refreshed by tail merge
-(cache/incremental.py) or dropped for full recompute. ``epoch`` counts
-manual bumps plus pool worker deaths: a fill whose execution overlapped
-a worker death is discarded (conservative — the retried execution was
-correct, but mid-ingest kills must never leave a doubtful entry behind).
+(cache/incremental.py) or dropped for full recompute. Fills present a
+``fill_token`` sampled BEFORE execution (lowering included): an offer
+whose epoch moved (``epoch`` counts manual bumps plus pool worker
+deaths — a mid-failure result must never become an entry) or whose
+version vector moved (an append landed while the query ran, so the
+result's scan snapshot cannot be stamped with either vector) is
+discarded. The vector check matters because the race window is the
+whole query duration: an entry stamped post-append over pre-append
+data would read as fresh — and serve stale — forever.
 """
 
 from __future__ import annotations
@@ -170,7 +175,8 @@ class QueryCache:
     - ``serve(plan)`` — fresh whole-plan hit or None (microsecond path).
     - ``refresh_or_none(plan, execute)`` — stale mergeable entry: tail
       recompute + merge; None -> caller recomputes in full.
-    - ``offer(plan, table, epoch0)`` — fill after a cold execution.
+    - ``fill_token(plan)`` — pre-execution (epoch, versions) snapshot.
+    - ``offer(plan, table, token)`` — fill after a cold execution.
     - ``lookup_subplan`` / ``offer_subplan`` — per-exchange sharing,
       driven by ``Session._run_shuffle_map_stage``.
 
@@ -209,6 +215,15 @@ class QueryCache:
     def bump_epoch(self):
         with self._mu:
             self._epoch += 1
+
+    def fill_token(self, plan) -> Tuple[int, Dict[str, int]]:
+        """The (epoch, ingest-version-vector) snapshot an ``offer`` /
+        ``offer_subplan`` must present. Sample BEFORE execution — before
+        lowering takes its scan snapshots — so a mismatch at offer time
+        proves a worker death or an append overlapped the run and the
+        fill is discarded instead of stamped with versions the data may
+        not actually cover."""
+        return self.epoch(), self._versions_for(plan)
 
     def on_append(self, name: str, version: int):
         """Appends make matching entries stale. Result entries stay —
@@ -263,11 +278,9 @@ class QueryCache:
 
     # -- eviction / spill ladder ----------------------------------------------
 
-    def _drop_locked(self, store, key: str, reason: str,
-                     count: bool = True):
-        e = store.pop(key, None)
-        if e is None:
-            return 0
+    def _release_entry_locked(self, e: CacheEntry):
+        """Give back everything an entry holds outside the dicts: its
+        registry stage references and its spill file."""
         if e.stage is not None:
             self.session.mem_segments.release_stages([e.stage])
         if e.spill_path:
@@ -275,6 +288,13 @@ class QueryCache:
                 os.unlink(e.spill_path)
             except OSError:
                 pass
+
+    def _drop_locked(self, store, key: str, reason: str,
+                     count: bool = True):
+        e = store.pop(key, None)
+        if e is None:
+            return 0
+        self._release_entry_locked(e)
         freed = e.nbytes if e.tier == "mem" else 0
         if count:
             self.counts["evictions"] += 1
@@ -469,8 +489,13 @@ class QueryCache:
         from blaze_tpu.cache.ingest import retarget_to_tails
 
         epoch0 = self.epoch()
-        target_versions = self._versions_for(plan)
-        tail_plan, rids = retarget_to_tails(
+        # the refreshed entry's version vector comes from the tail
+        # registration itself — the 'to' version each snapshot actually
+        # covers — never from a separately-sampled current vector, which
+        # an append between sampling and registration would leave lagging
+        # the merged data (the next lookup would then re-merge the same
+        # tail and double-count SUM/COUNT)
+        tail_plan, rids, covered = retarget_to_tails(
             plan, cached_versions, self.session.ingest)
         if tail_plan is None:
             with self._mu:
@@ -493,7 +518,7 @@ class QueryCache:
                 self._publish_gauges_locked()
                 return merged
             self._store_result_locked(key, fingerprint, merged,
-                                      target_versions, epoch0,
+                                      covered, epoch0,
                                       mergeable=True, label=label)
         return merged
 
@@ -503,20 +528,22 @@ class QueryCache:
             self.counts["stale_served"] += 1
         _TM_STALE.labels(result=result).inc()
 
-    def offer(self, plan, table, epoch0: int, tenant: str = "default",
-              label: Optional[str] = None):
-        """Fill after a cold execution. Silently refuses uncacheable
-        plans, epoch-crossed executions, and oversized tables; degrades
-        through the spill rung on injected/real put failures."""
+    def offer(self, plan, table, token: Tuple[int, Dict[str, int]],
+              tenant: str = "default", label: Optional[str] = None):
+        """Fill after a cold execution. ``token`` is the caller's
+        pre-execution ``fill_token``. Silently refuses uncacheable plans,
+        executions that an epoch bump or an append overlapped, and
+        oversized tables; degrades through the spill rung on
+        injected/real put failures."""
         if self._closed or table is None:
             return
+        epoch0, versions0 = token
         key = cache_key(plan)
         if key is None or not plan_cacheable(plan):
             return
         nbytes = int(table.nbytes)
         if nbytes > self.max_bytes:
             return
-        versions = self._versions_for(plan)
         fingerprint = self._display_fingerprint(plan)
         mergeable = mergeable_spec(plan) is not None
         with self._mu:
@@ -524,27 +551,39 @@ class QueryCache:
                 _TM_EVICTIONS.labels(reason="epoch").inc()
                 self.counts["evictions"] += 1
                 return
+            if self._versions_for(plan) != versions0:
+                # an append landed while the query ran: the result's scan
+                # snapshot may or may not include it, so the entry cannot
+                # be stamped with either vector — discard (the plan's
+                # next run refills against the grown table)
+                _TM_EVICTIONS.labels(reason="version").inc()
+                self.counts["evictions"] += 1
+                return
             try:
                 from blaze_tpu.runtime.failpoints import failpoint
 
                 failpoint("cache.put")
                 self._store_result_locked(key, fingerprint, table,
-                                          versions, epoch0,
+                                          versions0, epoch0,
                                           mergeable=mergeable, label=label)
             except Exception:
                 # degrade ladder: try the spill rung, then give up (miss)
                 self.counts["degraded_puts"] += 1
                 e = CacheEntry("result", key, fingerprint, nbytes,
-                               versions, epoch0, label=label)
+                               versions0, epoch0, label=label)
                 e.table = table
                 e.mergeable = mergeable
                 if self.spill_enabled:
                     try:
                         self._spill_entry_locked(e)
+                    except OSError:
+                        e = None  # next rung: miss
+                    if e is not None:
+                        old = self._results.pop(key, None)
+                        if old is not None:
+                            self._release_entry_locked(old)
                         self._results[key] = e
                         self._results.move_to_end(key)
-                    except OSError:
-                        pass
                 self._publish_gauges_locked()
 
     def _display_fingerprint(self, plan) -> str:
@@ -557,13 +596,7 @@ class QueryCache:
                              label: Optional[str] = None):
         old = self._results.pop(key, None)
         if old is not None:
-            if old.stage is not None:
-                self.session.mem_segments.release_stages([old.stage])
-            if old.spill_path:
-                try:
-                    os.unlink(old.spill_path)
-                except OSError:
-                    pass
+            self._release_entry_locked(old)
         e = CacheEntry("result", key, fingerprint, int(table.nbytes),
                        versions, epoch, label=label)
         e.table = table
@@ -615,9 +648,11 @@ class QueryCache:
             return e
 
     def offer_subplan(self, node, maps: List[dict], nbytes: int,
-                      groups, num_reducers: int, epoch0: int):
+                      groups, num_reducers: int,
+                      token: Tuple[int, Dict[str, int]]):
         if self._closed:
             return
+        epoch0, versions0 = token
         key = cache_key(node)
         if key is None or not plan_cacheable(node):
             return
@@ -628,12 +663,18 @@ class QueryCache:
                 _TM_EVICTIONS.labels(reason="epoch").inc()
                 self.counts["evictions"] += 1
                 return
+            if self._versions_for(node) != versions0:
+                # same append-overlapped-execution rule as offer(): the
+                # captured map outputs may predate the append
+                _TM_EVICTIONS.labels(reason="version").inc()
+                self.counts["evictions"] += 1
+                return
             old = self._subplans.pop(key, None)
             if old is not None and old.stage is not None:
                 self.session.mem_segments.release_stages([old.stage])
             e = CacheEntry("subplan", key,
                            self._display_fingerprint(node), nbytes,
-                           self._versions_for(node), epoch0)
+                           versions0, epoch0)
             e.maps = maps
             e.groups = groups
             e.num_reducers = num_reducers

@@ -697,9 +697,12 @@ class Session:
             plan, lambda p: self.execute_to_table(p, **kw))
         if table is not None:
             return table
-        epoch0 = self.cache.epoch()
+        # sampled BEFORE execution (and before lowering's scan snapshots):
+        # the cache refuses the fill if a worker death or an append
+        # overlapped the run
+        token = self.cache.fill_token(plan)
         table = self.execute_to_table(plan, **kw)
-        self.cache.offer(plan, table, epoch0, label=kw.get("label"))
+        self.cache.offer(plan, table, token, label=kw.get("label"))
         return table
 
     def append(self, table: str, batches, num_partitions: int = 2) -> int:
@@ -1250,7 +1253,7 @@ class Session:
         cache = self.cache
         use_subplan = (cache is not None and tier == "process"
                        and cache.subplan_active(self._qrun()))
-        epoch0 = 0
+        token = None
         if use_subplan:
             hit = cache.lookup_subplan(node)
             if hit is not None:
@@ -1269,7 +1272,9 @@ class Session:
                                 resource_id=rid,
                                 num_partitions=hit.num_reducers),
                     batch_size=0)
-            epoch0 = cache.epoch()
+            # pre-execution fill token: an append or worker death during
+            # the map stage invalidates the capture (cache/ docs)
+            token = cache.fill_token(node)
         stage, indexes = self._exec_map_stage(
             node, mem_sink=(tier in ("process", "device")),
             device_sink=(tier == "device"), where=where)
@@ -1302,7 +1307,7 @@ class Session:
                     cache.offer_subplan(
                         node, maps, nbytes, groups,
                         len(groups) if groups is not None
-                        else num_reducers, epoch0)
+                        else num_reducers, token)
             if groups is not None:
                 num_reducers = len(groups)
         elif groups is not None:

@@ -229,6 +229,11 @@ class QueryHandle:
             else None
         self.cursor: Optional[StageCursor] = None
         self.preempt_count = 0
+        # cache fill token: sampled once before the FIRST execution
+        # attempt and pinned across pause/resume loops (a resumed query's
+        # early stages ran under the pre-pause snapshot, so re-sampling
+        # on resume would stamp post-append versions onto older data)
+        self.cache_fill: Optional[tuple] = None
         # weighted-fair tags (re-stamped on every (re-)enqueue)
         self.cost = 1.0
         self.vstart = 0.0
@@ -838,11 +843,14 @@ class QueryScheduler:
         paused_cursor: Optional[StageCursor] = None
         conf = self.session.conf
         cache = getattr(self.session, "cache", None)
-        # sampled BEFORE any execution: the cache only accepts this run's
-        # result if no worker died (and no explicit invalidation landed)
-        # between here and the offer — conservative, but mid-failure
-        # results must never become cache entries
-        epoch0 = cache.epoch() if cache is not None else 0
+        # sampled BEFORE any execution and pinned on the handle across
+        # pause/resume: the cache only accepts this run's result if no
+        # worker died AND no append landed between here and the offer —
+        # an append mid-execution means the result's scan snapshot can't
+        # be trusted to match any version vector, and mid-failure results
+        # must never become cache entries
+        if cache is not None and h.cache_fill is None:
+            h.cache_fill = cache.fill_token(h.plan)
         try:
             if cache is not None and h.cursor is None:
                 refreshed = None
@@ -883,7 +891,7 @@ class QueryScheduler:
                         h.table = T.schema_to_arrow(
                             h.plan.output_schema).empty_table()
                     if cache is not None:
-                        cache.offer(h.plan, h.table, epoch0,
+                        cache.offer(h.plan, h.table, h.cache_fill,
                                     tenant=h.tenant, label=h.label)
                     break
                 except StagePaused as sp:

@@ -1651,7 +1651,9 @@ def chaos_matrix_main(spec: str):
                     # result stamped with the PRE-kill epoch — the cache
                     # must refuse it (an execution that spanned a worker
                     # death may have been built mid-recovery)
-                    e0 = sess.cache.epoch()
+                    proof_plan = sess.table_scan("stream")
+                    t0 = sess.cache.fill_token(proof_plan)
+                    e0 = t0[0]
                     sess.pool.kill_worker(
                         rng2.randrange(len(sess.pool.workers)))
                     kills += 1
@@ -1659,9 +1661,8 @@ def chaos_matrix_main(spec: str):
                     while sess.cache.epoch() == e0 \
                             and time.monotonic() < deadline:
                         time.sleep(0.05)
-                    proof_plan = sess.table_scan("stream")
                     sess.cache.offer(proof_plan,
-                                     sess.execute_to_table(proof_plan), e0)
+                                     sess.execute_to_table(proof_plan), t0)
                     discard_proof = (
                         sess.cache.epoch() != e0
                         and sess.cache.serve(proof_plan) is None)

@@ -320,9 +320,9 @@ def test_epoch_bump_discards_inflight_offer(tmp_path):
     with Session(conf=Config()) as sess:
         plan = _agg_plan(_scan(path))
         table = sess.execute_to_table(plan, release_on_finish=True)
-        e0 = sess.cache.epoch()
+        t0 = sess.cache.fill_token(plan)
         sess.cache.bump_epoch()  # what a worker death does via deaths_total
-        sess.cache.offer(plan, table, e0)
+        sess.cache.offer(plan, table, t0)
         assert sess.cache.serve(plan) is None  # refused, not admitted
         assert sess.cache.snapshot()["entries"] == 0
 
@@ -334,14 +334,108 @@ def test_epoch_discard_on_pool_worker_death(tmp_path):
     with Session(conf=conf, num_worker_processes=2) as sess:
         plan = _agg_plan(_scan(path))
         table = sess.execute_to_table(plan, release_on_finish=True)
-        e0 = sess.cache.epoch()
+        t0 = sess.cache.fill_token(plan)
         sess.pool.kill_worker(0)
         deadline = time.monotonic() + 30
-        while sess.cache.epoch() == e0 and time.monotonic() < deadline:
+        while sess.cache.epoch() == t0[0] and time.monotonic() < deadline:
             time.sleep(0.05)
-        assert sess.cache.epoch() > e0
-        sess.cache.offer(plan, table, e0)
+        assert sess.cache.epoch() > t0[0]
+        sess.cache.offer(plan, table, t0)
         assert sess.cache.serve(plan) is None
+
+
+# -- append races: fills and refreshes that overlap ingest --------------------
+
+
+def test_append_overlapping_execution_discards_offer(tmp_path):
+    """An append landing between the pre-execution fill token and the
+    offer means the result's scan snapshot may predate the append — the
+    fill must be refused, never stamped with the post-append vector
+    (which would serve pre-append data as fresh forever)."""
+    with Session(conf=Config()) as sess:
+        sess.append("t", [_batch([0], [1])])
+        plan = _agg_plan(sess.table_scan("t"))
+        token = sess.cache.fill_token(plan)
+        table = sess.execute_to_table(plan, release_on_finish=True)
+        sess.append("t", [_batch([0], [2])])  # lands "mid-execution"
+        sess.cache.offer(plan, table, token)
+        assert sess.cache.serve(plan) is None
+        assert sess.cache.snapshot()["entries"] == 0
+        # the full path still converges: recompute sees both appends
+        assert _canon(sess.execute_cached(plan)) == [(0, 3)]
+        assert sess.cache.stats_fields()["cache_stale_served"] == 0
+
+
+def test_retarget_covered_matches_registered_snapshot(tmp_path):
+    """``retarget_to_tails`` must report the version each tail snapshot
+    ACTUALLY covers — including an append that raced in after the caller
+    last sampled the registry — so refreshed entries never record a
+    vector behind their data (which would double-merge the same tail)."""
+    from blaze_tpu.cache.ingest import retarget_to_tails
+
+    with Session(conf=Config()) as sess:
+        sess.append("t", [_batch([0], [1])])
+        plan = sess.table_scan("t")
+        sess.append("t", [_batch([0], [2])])  # the "racing" append: v2
+        tail_plan, rids, covered = retarget_to_tails(
+            plan, {"t": 1}, sess.ingest)
+        assert tail_plan is not None
+        assert covered == {"t": 2}
+        for rid in rids:
+            sess.ingest.release_tail(rid)
+
+
+def test_refresh_records_covered_versions_no_double_merge(tmp_path):
+    """An append landing DURING a tail refresh must not be folded into
+    the recorded vector: the entry records what the tail snapshot
+    covered, the racing append stays pending, and the next lookup merges
+    exactly it — never twice."""
+    with Session(conf=Config()) as sess:
+        sess.append("t", [_batch([0], [1])])
+        plan = _agg_plan(sess.table_scan("t"))
+        assert _canon(sess.execute_cached(plan)) == [(0, 1)]  # fill @v1
+        sess.append("t", [_batch([0], [2])])  # v2: entry now stale
+
+        def execute_with_midflight_append(p):
+            tbl = sess.execute_to_table(p, release_on_finish=True)
+            sess.append("t", [_batch([0], [4])])  # v3 lands mid-refresh
+            return tbl
+
+        merged = sess.cache.refresh_or_none(
+            plan, execute_with_midflight_append)
+        assert merged is not None and _canon(merged) == [(0, 3)]
+        key = cache_key(plan)
+        with sess.cache._mu:
+            assert sess.cache._results[key].versions == {"t": 2}
+        # v3 merges exactly once on the next lookup: 1 + 2 + 4, not 1+2+4+4
+        assert _canon(sess.execute_cached(plan)) == [(0, 7)]
+        assert sess.cache.stats_fields()["cache_stale_served"] == 0
+
+
+def test_degraded_put_replacing_entry_releases_old_stage(tmp_path):
+    """A degraded (spill-rung) put over an existing key must release the
+    old entry's registry stage and spill file like the normal store path
+    — otherwise the soak leak gates (mm.used == 0 after close) trip."""
+    conf = Config(spill_dir=str(tmp_path / "spill"))
+    with Session(conf=conf) as sess:
+        sess.append("t", [_batch([0], [1])])
+        plan = _agg_plan(sess.table_scan("t"))
+        sess.execute_cached(plan)  # normal fill: mem tier, stage held
+        key = cache_key(plan)
+        with sess.cache._mu:
+            old_stage = sess.cache._results[key].stage
+        assert sess.mem_segments.get(old_stage, 0) is not None
+        table2 = sess.execute_to_table(plan, release_on_finish=True)
+        failpoints.arm("cache.put=ioerror:every1:x1")
+        sess.cache.offer(plan, table2, sess.cache.fill_token(plan))
+        stats = sess.cache.stats_fields()
+        assert stats["cache_degraded_puts"] == 1
+        with sess.cache._mu:
+            assert sess.cache._results[key].tier == "spill"
+        assert sess.mem_segments.get(old_stage, 0) is None  # old refs freed
+        # the spilled replacement still serves, promoted back to memory
+        assert _canon(sess.execute_cached(plan)) == _canon(table2)
+    assert MemManager._instance is None or MemManager._instance.used == 0
 
 
 # -- subplan sharing ----------------------------------------------------------
