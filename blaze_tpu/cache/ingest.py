@@ -24,7 +24,17 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from blaze_tpu.obs.telemetry import get_registry
+
 INGEST_PREFIX = "ingest://"
+
+_reg = get_registry()
+_TM_APPENDS = _reg.counter(
+    "blaze_ingest_appends_total",
+    "ingest table appends (version bumps), by table")
+_TM_ROWS = _reg.counter(
+    "blaze_ingest_rows_total",
+    "rows landed through ingest appends, by table")
 
 
 class IngestTable:
@@ -118,6 +128,9 @@ class IngestRegistry:
         if cache is not None:
             cache.on_append(name, version)
         self._session.metrics.add("ingest_appends", 1)
+        _TM_APPENDS.labels(table=name).inc()
+        _TM_ROWS.labels(table=name).inc(
+            sum(int(b.num_rows) for b in cols))
         return version
 
     def get(self, name: str) -> Optional[IngestTable]:

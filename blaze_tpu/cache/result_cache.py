@@ -707,6 +707,31 @@ class QueryCache:
                 "counts": dict(self.counts),
             }
 
+    def ingest_lag_probe(self) -> dict:
+        """How far serving has fallen behind ingest: for every cached
+        result, compare the version vector it was filled at against the
+        tables' current versions. ``ingest_lag_versions`` is the worst
+        gap, ``refresh_backlog`` the count of stale entries awaiting
+        refresh/recompute — the timeline sampler's ingest-health source.
+        Reads ingest versions under the cache lock, same order as
+        ``_fresh_locked``."""
+        with self._mu:
+            newest: Dict[str, int] = {}
+            backlog = 0
+            for e in self._results.values():
+                if not self._fresh_locked(e):
+                    backlog += 1
+                for n, v in e.versions.items():
+                    if v > newest.get(n, -1):
+                        newest[n] = v
+            current = self.session.ingest.versions(newest.keys()) \
+                if newest else {}
+        per_table = {n: max(0, current.get(n, 0) - v)
+                     for n, v in newest.items()}
+        return {"ingest_lag_versions": max(per_table.values(), default=0),
+                "refresh_backlog": backlog,
+                "per_table": per_table}
+
     def stats_fields(self) -> dict:
         """The ``cache_*`` tripwire block artifacts embed (obs/stats.py
         CACHE_FIELDS schema)."""

@@ -255,6 +255,43 @@ class Config:
     )
     profile_store_max: int = 128
 
+    # Live health plane (obs/timeline.py): a background sampler thread
+    # turns registry counters into windowed per-second rates, gauges into
+    # samples and histograms into interval p50/p95/p99 (bucket-snapshot
+    # deltas), stored in fixed-size ring buffers next to derived
+    # serve/cache/ingest series (ingest lag in versions, refresh backlog,
+    # admission queue depth, per-tenant deadline-miss ratio). Served at
+    # GET /debug/timeseries?name=&since= and summarized by the SLO/health
+    # machinery below at GET /debug/health. The sampler binds to the
+    # newest Session and stops when that session closes; disabling leaves
+    # a single attribute check per site (test_timeline.py's <5% guard).
+    # BLAZE_TPU_TIMELINE=0/1 force-overrides.
+    timeline_enabled: bool = True
+    timeline_interval_s: float = 1.0
+    timeline_ring: int = 512
+
+    # Declarative SLOs over timeline series: ';'-separated
+    # "<subsystem>:<series><op><threshold>" with op in {<=,<,==,>=,>} and
+    # subsystem in obs/timeline.SUBSYSTEMS (serve/cache/ingest/memmgr/
+    # shuffle/workers). Each SLO is checked per sample with
+    # Google-SRE-style fast/slow burn-rate windows: a breaching sample
+    # spends error budget; burn = breaching fraction / budget ratio.
+    # degraded fires on the fast window alone (onset), critical only when
+    # BOTH windows burn past slo_critical_burn (sustained — the
+    # multiwindow rule that keeps one hiccup from paging). A subsystem's
+    # health is the worst state across its SLOs; transitions write
+    # incident bundles through obs/dump.py.
+    slo_specs: str = ("serve:serve_deadline_miss_ratio<=0.05;"
+                      "cache:cache_stale_served_rate==0;"
+                      "ingest:ingest_lag_versions<=2;"
+                      "shuffle:shuffle_tier_degraded_rate==0;"
+                      "workers:worker_deaths_rate==0")
+    slo_fast_window_s: float = 10.0
+    slo_slow_window_s: float = 60.0
+    slo_error_budget_ratio: float = 0.1
+    slo_degraded_burn: float = 1.0
+    slo_critical_burn: float = 2.0
+
     # Number of host worker threads for IO/decode and task overlap
     # (reference: tokio worker threads conf). On the tunneled-TPU backend
     # threads mostly overlap device round trips, not CPU.

@@ -259,6 +259,29 @@ class Histogram(_Instrument):
         st = self.snapshot(**kw)
         return st["count"] if st else 0
 
+    def snapshot_delta(self, prev: Optional[dict], **kw) -> Optional[dict]:
+        """Interval view since ``prev`` (a previous :meth:`snapshot` of the
+        SAME label set): bucket-vector subtraction for windowed quantiles.
+        The current snapshot is taken under the instrument lock, so a
+        concurrent ``observe()`` either lands fully in it or not at all —
+        buckets only grow, which makes every delta non-negative. A shrunk
+        count (``reset_values`` between samples) returns the current
+        snapshot whole instead of a negative delta."""
+        cur = self.snapshot(**kw)
+        if cur is None:
+            return None
+        if not prev or cur["count"] < prev["count"]:
+            return cur
+        buckets = {}
+        prev_buckets = prev["buckets"]
+        for i, c in cur["buckets"].items():
+            d = c - prev_buckets.get(i, 0)
+            if d > 0:
+                buckets[i] = d
+        return {"buckets": buckets,
+                "sum": cur["sum"] - prev["sum"],
+                "count": cur["count"] - prev["count"]}
+
     def quantile(self, q: float, **kw) -> Optional[float]:
         st = self.snapshot(**kw)
         if not st or not st["count"]:
@@ -271,6 +294,21 @@ class Histogram(_Instrument):
             run += c
             cum.append((le, run))
         return quantile_from_le_buckets(cum, q)
+
+
+def quantile_from_snapshot(snap: Optional[dict],
+                           q: float) -> Optional[float]:
+    """Quantile of one ``snapshot()``/``snapshot_delta()`` dict — how the
+    timeline sampler turns an interval bucket delta into a windowed
+    p50/p95/p99 without touching the live instrument again."""
+    if not snap or not snap.get("count"):
+        return None
+    cum = []
+    run = 0
+    for i in sorted(snap["buckets"]):
+        run += snap["buckets"][i]
+        cum.append((bucket_upper_bound(int(i)), run))
+    return quantile_from_le_buckets(cum, q)
 
 
 def quantile_from_le_buckets(pairs: List[Tuple[float, int]],

@@ -278,9 +278,33 @@ class Tracer:
             name = process_name if pid == self.pid else f"blaze_tpu-worker-{pid}"
             meta.append({"ph": "M", "name": "process_name", "pid": pid,
                          "tid": 0, "args": {"name": name}})
-        return {"traceEvents": meta + events + flows, "displayTimeUnit": "ms",
+        counters = self._timeline_counter_events()
+        return {"traceEvents": meta + events + flows + counters,
+                "displayTimeUnit": "ms",
                 "otherData": {"dropped_events": self.dropped,
                               "wall_epoch_ns": self.wall_epoch_ns}}
+
+    def _timeline_counter_events(self) -> List[dict]:
+        """Sampled timeline series (inflight, ingest lag, memmgr bytes) as
+        Chrome counter events ("ph":"C") — Perfetto renders them as load
+        curves under the spans. Timeline timestamps are wall-clock; spans
+        are epoch-relative, so convert through ``wall_epoch_ns``."""
+        counters: List[dict] = []
+        try:
+            from blaze_tpu.obs.timeline import (COUNTER_TRACK_SERIES,
+                                                get_timeline)
+
+            tl = get_timeline()
+            for series in COUNTER_TRACK_SERIES:
+                for t, v in (tl.series_since(series, 0.0) or []):
+                    counters.append(
+                        {"ph": "C", "name": series, "cat": "timeline",
+                         "pid": self.pid, "tid": 0,
+                         "ts": (t * 1e9 - self.wall_epoch_ns) / 1e3,
+                         "args": {series: v}})
+        except Exception:
+            pass  # the trace export never fails for a health-plane hiccup
+        return counters
 
 
 TRACER = Tracer()

@@ -46,6 +46,10 @@ bound to a free port exposes:
   ``{"table": name, "rows": {col: [...]}}`` appends one batch to the named
   ingest table and bumps its version (dependent cache entries go stale —
   refreshed incrementally or recomputed on the next hit, never served)
+- ``/debug/health``            — live health plane: per-subsystem states,
+  SLO burn rates, transition/interval history (obs/timeline.py)
+- ``/debug/timeseries``        — sampled time series; no params lists the
+  series names, ``?name=&since=`` returns one series' ``[[t, v], ...]``
 
 Start with ``ProfilingService.start(session)``; idempotent per process."""
 
@@ -324,6 +328,32 @@ class ProfilingService:
                         from blaze_tpu.utils.device import DEVICE_STATS
 
                         self._send(json.dumps(DEVICE_STATS.snapshot(), indent=2))
+                    elif url.path == "/debug/health":
+                        from blaze_tpu.obs.timeline import get_timeline
+
+                        self._send(json.dumps(
+                            get_timeline().health_report(), indent=2))
+                    elif url.path == "/debug/timeseries":
+                        from blaze_tpu.obs.timeline import get_timeline
+
+                        tl = get_timeline()
+                        q = parse_qs(url.query)
+                        name = q.get("name", [""])[0]
+                        if not name:
+                            self._send(json.dumps(
+                                {"series": tl.names(),
+                                 "enabled": tl.enabled,
+                                 "interval_s": tl.interval_s}, indent=2))
+                        else:
+                            since = float(q.get("since", ["0"])[0])
+                            samples = tl.series_since(name, since)
+                            if samples is None:
+                                self._send(json.dumps(
+                                    {"error": f"no series {name!r}"}),
+                                    status=404)
+                            else:
+                                self._send(json.dumps(
+                                    {"name": name, "samples": samples}))
                     else:
                         self.send_response(404)
                         self.end_headers()

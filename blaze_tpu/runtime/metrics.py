@@ -77,6 +77,21 @@ class MetricNode:
             children = list(self.children)
         return own + sum(c.total(metric) for c in children)
 
+    def totals(self, metrics) -> Dict[str, int]:
+        """Totals of several metrics in ONE tree walk. ``total()`` per
+        name re-walks the whole tree each time — fine for a single
+        lookup, quadratic for periodic samplers and tripwire blocks that
+        want 20+ names at once."""
+        out = {m: 0 for m in metrics}
+        stack = [self]
+        while stack:
+            node = stack.pop()
+            with node._mu:
+                for m in out:
+                    out[m] += node.values.get(m, 0)
+                stack.extend(node.children)
+        return out
+
     def merge_dict(self, d: dict):
         """Fold a serialized metric tree (to_dict of a remote task) into
         this node — how worker-process task metrics reach the driver's tree
@@ -185,7 +200,7 @@ TRIPWIRE_METRICS = (
 def tripwire_totals(node: "MetricNode") -> Dict[str, int]:
     """Totals of the tripwire counters for a metric tree (session root or a
     single query) — the shape bench/SOAK records embed."""
-    return {m: node.total(m) for m in TRIPWIRE_METRICS}
+    return node.totals(TRIPWIRE_METRICS)
 
 
 class Timer:

@@ -379,6 +379,13 @@ class Session:
             from blaze_tpu.cache.result_cache import QueryCache
 
             self.cache = QueryCache(self)
+        # live health plane (obs/timeline.py): background sampler over the
+        # registry + SLO burn-rate health states, bound to this session
+        # (the sampler's derived probes read serve_scheduler/cache/ingest
+        # through a weakref); detached in close()
+        from blaze_tpu.obs import timeline as _timeline
+
+        _timeline.configure_from(self.conf, session=self)
 
     _QUERY_LOG_MAX = 50
 
@@ -816,6 +823,11 @@ class Session:
         session closes its durable intermediates go too)."""
         import shutil
 
+        # stop the timeline sampler FIRST (if bound to this session): its
+        # derived probes walk cache/ingest/scheduler state being torn down
+        from blaze_tpu.obs import timeline as _timeline
+
+        _timeline.get_timeline().detach(self)
         if self.pool is not None:
             self.pool.close()
             self.pool = None

@@ -65,6 +65,7 @@ import pyarrow as pa
 from blaze_tpu.ir import nodes as N
 from blaze_tpu.ir import types as T
 from blaze_tpu.obs.telemetry import get_registry
+from blaze_tpu.obs.timeline import TIMELINE as _TIMELINE
 from blaze_tpu.ops.base import CancelToken, QueryCancelled, TaskCancelled
 from blaze_tpu.runtime.memmgr import MemManager
 from blaze_tpu.runtime.session import PauseToken, StageCursor, StagePaused
@@ -503,6 +504,7 @@ class QueryScheduler:
         self._tm_queries.labels(outcome="cache_hit", tenant=tname).inc()
         self._tm_e2e.labels(outcome="cache_hit").observe(
             max(0.0, now - h.submitted_at))
+        _TIMELINE.note_outcome(tname, "cache_hit")
         h._done.set()
         return h
 
@@ -538,6 +540,22 @@ class QueryScheduler:
         """Live view for /serve/queries and /debug/queries."""
         with self._mu:
             return self._snapshot_locked()
+
+    def health_probe(self) -> dict:
+        """Cheap scalar view for the timeline sampler: queue depth and
+        inflight without the per-query snapshots ``snapshot()`` builds
+        (this runs every ``timeline_interval_s``, snapshot() does not)."""
+        with self._mu:
+            return {
+                "queue_depth": sum(len(t.heap)
+                                   for t in self._tenants.values()),
+                "inflight": len(self._running),
+                "peak_inflight": self.peak_inflight,
+                "max_concurrent": self.max_concurrent,
+                "tenants": {t.name: {"submitted": t.submitted,
+                                     "queued": len(t.heap)}
+                            for t in self._tenants.values()},
+            }
 
     def _snapshot_locked(self) -> dict:
         # split out so incident recording (already under _mu/_cv — a plain
@@ -1011,6 +1029,7 @@ class QueryScheduler:
         try:
             outcome = self._outcome(state, err, h)
             self._tm_queries.labels(outcome=outcome, tenant=h.tenant).inc()
+            _TIMELINE.note_outcome(h.tenant, outcome)
             self._tm_run.observe(h.finished_at - h.admitted_at)
             self._tm_e2e.labels(outcome=outcome).observe(
                 h.finished_at - h.submitted_at)
@@ -1059,6 +1078,7 @@ class QueryScheduler:
         try:
             outcome = self._outcome(state, error, h)
             self._tm_queries.labels(outcome=outcome, tenant=h.tenant).inc()
+            _TIMELINE.note_outcome(h.tenant, outcome)
             self._tm_e2e.labels(outcome=outcome).observe(
                 h.finished_at - h.submitted_at)
             self._record_incident(h, outcome, error,

@@ -104,6 +104,20 @@ QoS artifacts)::
 - shapes or sections missing from either artifact are skipped clean
   (pre-attribution artifacts like BENCH_r10 carry no sections; a
   self-diff of those must stay clean).
+
+``--health`` gates on the ``health`` section soak artifacts carry since
+the live health plane (SERVE_r05 / SOAK_r10)::
+
+    python scripts/bench_diff.py --health SOAK_r10.json SOAK_r11.json
+
+- any ``critical`` interval in the candidate's health history is a
+  regression — absolute (the burn-rate evaluator needs both its fast and
+  slow windows burning, so this is never a one-sample blip);
+- a subsystem still ``critical`` at the end of the run fails;
+- the degraded-time ratio must stay under ``max(base,
+  --degraded-tol)`` (default 0.25);
+- artifacts without a ``health`` section are skipped clean, like
+  ``--attribution`` does for pre-attribution rounds.
 """
 
 from __future__ import annotations
@@ -364,6 +378,61 @@ def diff_attribution(base: dict, cand: dict, ratio: float = 2.0,
     return regressions
 
 
+def diff_health(base: dict, cand: dict,
+                degraded_tol: float = 0.25) -> List[str]:
+    """Regressions between the ``health`` sections two soak artifacts carry
+    (PR 20's live health plane; empty == clean). Any ``critical`` interval
+    in the candidate's health HISTORY is a regression — absolute, not
+    relative: the burn-rate evaluator only reaches critical when both the
+    fast and slow windows are burning, so a single sampling hiccup cannot
+    trip this. The degraded-time ratio must stay under
+    ``max(base, --degraded-tol)`` (a base that ran degraded grandfathers
+    its own ratio; the floor keeps a clean base from failing the candidate
+    on one short brownout). Artifacts without a ``health`` section (every
+    round before SERVE_r05/SOAK_r10) are skipped clean, like
+    ``--attribution`` does for pre-attribution rounds."""
+    regressions: List[str] = []
+    bh, ch = base.get("health"), cand.get("health")
+    if ch is None or bh is None:
+        which = "candidate" if ch is None else "base"
+        print(f"  health: no health section in {which} (pre-health "
+              f"artifact), skipped")
+        return regressions
+    # "enabled" reflects the instant the report was taken (soaks build
+    # artifacts after the session closes, which stops the sampler), so
+    # judge by recorded history: 0 samples with the plane off is a
+    # legitimately disabled run; 0 samples otherwise means the sampler
+    # never ran — itself a regression
+    if int(ch.get("samples", 0) or 0) == 0:
+        if not ch.get("enabled", True):
+            print("  health: candidate ran with the timeline disabled, "
+                  "history gates vacuous")
+        else:
+            regressions.append(
+                "health: candidate recorded 0 samples (the sampler "
+                "thread never ran)")
+        return regressions
+    crit = int(ch.get("critical_intervals", 0) or 0)
+    if crit != 0:
+        secs = float(ch.get("critical_s", 0.0) or 0.0)
+        regressions.append(
+            f"health: {crit} critical interval(s) totalling {secs:.1f}s "
+            f"(any critical state in the history is a regression)")
+    for sub, state in sorted((ch.get("subsystems") or {}).items()):
+        if state == "critical":
+            regressions.append(
+                f"health: subsystem {sub} ended the run critical")
+    bratio = float(bh.get("degraded_ratio", 0.0) or 0.0)
+    cratio = float(ch.get("degraded_ratio", 0.0) or 0.0)
+    limit = max(bratio, degraded_tol)
+    if cratio > limit:
+        regressions.append(
+            f"health: degraded_ratio {cratio:.3f} vs base {bratio:.3f} "
+            f"(> max(base, {degraded_tol}) — the run spent too much of "
+            f"its wall degraded)")
+    return regressions
+
+
 # serve-soak tripwires: once an artifact proves the machinery fires, a
 # successor where it reads 0 has silently unhooked it
 SERVE_TRIPWIRES = ("queries_preempted", "stages_resumed_from_cursor",
@@ -491,6 +560,14 @@ def main(argv=None) -> int:
     ap.add_argument("--attr-min-ms", type=float, default=50.0,
                     help="--attribution: noise floor (ms) under which a "
                          "category never regresses")
+    ap.add_argument("--health", action="store_true",
+                    help="diff the health sections of two soak artifacts "
+                         "instead (any critical interval fails; degraded-"
+                         "time ratio gate; pre-health artifacts skip "
+                         "clean)")
+    ap.add_argument("--degraded-tol", type=float, default=0.25,
+                    help="--health: degraded-time ratio floor under which "
+                         "the candidate never regresses (abs)")
     args = ap.parse_args(argv)
     with open(args.base) as f:
         base = json.load(f)
@@ -508,6 +585,8 @@ def main(argv=None) -> int:
         regressions = diff_attribution(base, cand, args.attr_ratio,
                                        args.attr_jit_ratio,
                                        args.attr_min_ms)
+    elif args.health:
+        regressions = diff_health(base, cand, args.degraded_tol)
     else:
         regressions = diff_artifacts(base, cand, args.wall_tol,
                                      args.bytes_tol)

@@ -98,6 +98,7 @@ def run_lint(root: str = REPO):
     violations.extend(check_profile_fields())
     violations.extend(check_attribution_taxonomy())
     violations.extend(check_cache_instruments(seen))
+    violations.extend(check_timeline_taxonomy(seen))
     return violations
 
 
@@ -118,6 +119,70 @@ def check_cache_instruments(seen: dict):
         violations.append(
             "no registration found for required cache instrument family "
             "blaze_cache_*bytes*")
+    return violations
+
+
+def check_timeline_taxonomy(seen: dict):
+    """Validate the health plane (ISSUE 20): the blaze_timeline_* /
+    blaze_slo_* instrument families must stay registered, and the
+    timeline's vocabularies — subsystems, health states, derived series
+    names, health-artifact fields — are API (they land verbatim in soak
+    artifacts, /debug/health responses, and metric labels), so they must
+    be snake_case (dots allowed in series names: ``<series>.<tenant>``
+    variants), unique, and internally consistent."""
+    import re
+
+    try:
+        from blaze_tpu.obs import timeline as tl
+    except Exception as exc:
+        return [f"obs.timeline unimportable: {exc}"]
+    violations = []
+    names = list(seen)
+    for prefix in ("blaze_timeline_samples_", "blaze_timeline_sample_",
+                   "blaze_timeline_series_", "blaze_slo_breaches_",
+                   "blaze_slo_transitions_"):
+        if not any(n.startswith(prefix) for n in names):
+            violations.append(
+                f"no registration found for required health-plane "
+                f"instrument family {prefix}*")
+    snake = re.compile(r"^[a-z][a-z0-9_]*$")
+    for vocab_name, vocab in (
+            ("SUBSYSTEMS", tl.SUBSYSTEMS),
+            ("HEALTH_STATES", tl.HEALTH_STATES),
+            ("DERIVED_SERIES", tl.DERIVED_SERIES),
+            ("HEALTH_FIELDS", tl.HEALTH_FIELDS)):
+        if len(set(vocab)) != len(vocab):
+            violations.append(f"obs/timeline.py: duplicate in {vocab_name}")
+        for v in vocab:
+            if not snake.match(v):
+                violations.append(
+                    f"obs/timeline.py: {vocab_name} entry {v!r}"
+                    " is not snake_case")
+    for s in tl.COUNTER_TRACK_SERIES:
+        if s not in tl.DERIVED_SERIES:
+            violations.append(
+                f"obs/timeline.py: COUNTER_TRACK_SERIES entry {s!r} not "
+                f"in DERIVED_SERIES — the Chrome counter track would "
+                f"sample a series the timeline never produces")
+    for s in tl.ARTIFACT_SERIES:
+        if s not in tl.DERIVED_SERIES:
+            violations.append(
+                f"obs/timeline.py: ARTIFACT_SERIES entry {s!r} not in "
+                f"DERIVED_SERIES — soak artifacts would carry an empty "
+                f"series")
+    for hs in ("healthy", "degraded", "critical"):
+        if hs not in tl.HEALTH_STATES:
+            violations.append(
+                f"obs/timeline.py: HEALTH_STATES missing {hs!r} — the "
+                f"state machine vocabulary is a gate contract")
+    # every derived series leads with the subsystem it reports on, so a
+    # reader (and the slo_specs grammar) can route it without a table
+    known = set(tl.SUBSYSTEMS) | {"worker"}
+    for s in tl.DERIVED_SERIES:
+        if s.split("_", 1)[0] not in known:
+            violations.append(
+                f"obs/timeline.py: derived series {s!r} does not lead "
+                f"with a subsystem prefix from SUBSYSTEMS")
     return violations
 
 

@@ -189,15 +189,19 @@ def main():
             print(json.dumps({name: out["shapes"][name]}), flush=True)
 
     soak_path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "SOAK_r09.json")
+        os.path.abspath(__file__))), "SOAK_r10.json")
     if "tpcds" not in os.environ.get("SOAK_PHASES", "shapes,tpcds"):
         from blaze_tpu.obs.attribution import artifact_section
+        from blaze_tpu.obs.timeline import timeline_artifact_section
 
         out.update(artifact_section())
+        out.update(timeline_artifact_section())
         out["peak_rss_mb"] = peak_rss_mb()
         leaked = shm_roots(shm0)
         out["shm_segments_leaked"] = len(leaked)
         assert not leaked, f"/dev/shm leak: {leaked}"
+        assert out["health"]["critical_intervals"] == 0, out["health"]
+        assert out["health"]["degraded_ratio"] <= 0.5, out["health"]
         # keep a previous run's tpcds section (phase-scoped reruns merge)
         try:
             with open(soak_path) as f:
@@ -291,16 +295,22 @@ def main():
                 }
             print(json.dumps({name: out["tpcds"][name]}), flush=True)
     from blaze_tpu.obs.attribution import artifact_section
+    from blaze_tpu.obs.timeline import timeline_artifact_section
 
     out.update(artifact_section())
+    out.update(timeline_artifact_section())
     out["peak_rss_mb"] = peak_rss_mb()
     leaked = shm_roots(shm0)
     out["shm_segments_leaked"] = len(leaked)
     print(json.dumps(out))
     with open(os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "SOAK_r09.json"), "w") as f:
+            os.path.abspath(__file__))), "SOAK_r10.json"), "w") as f:
         json.dump(out, f, indent=1)
     assert not leaked, f"/dev/shm leak: {leaked}"
+    # health-state history over the whole soak: never critical, bounded
+    # non-healthy time (obs/timeline.py)
+    assert out["health"]["critical_intervals"] == 0, out["health"]
+    assert out["health"]["degraded_ratio"] <= 0.5, out["health"]
 
 
 def _result_digest(table) -> str:

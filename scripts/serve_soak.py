@@ -33,6 +33,12 @@ chaos matrix gains ``mid_ingest_kill`` (CHAOS_r03.json): worker kills
 landing between append and refresh must never surface a stale or wrong
 cached result.
 
+Round 5 (--rate, SERVE_r05.json): the firehose — continuous appends at a
+target rows/s under the full zipfian serve load, judged on the live
+health plane (obs/timeline.py): ingest-lag series bounded and back to
+<= 1 version within the drain window, zero stale serves, zero critical
+health intervals, refreshed rollups bit-identical to full recomputes.
+
 Run: python scripts/serve_soak.py   (CPU; ~2-4 min)
 Env: SERVE_CLIENTS (64), SERVE_QUERIES (160 total), SERVE_CONCURRENT
 (0 = adaptive admission), SERVE_BUDGET_MB (192), SERVE_ROWS (120_000),
@@ -40,6 +46,7 @@ SERVE_QUEUE (8), SERVE_QUEUE_TIMEOUT_S (30).
 """
 
 import json
+import math
 import os
 import random
 import sys
@@ -164,6 +171,17 @@ def main():
                           # executed-outcome reconciliation below
                           # (--zipf is the cache soak, SERVE_r04.json)
                           cache_enabled=False,
+                          # ~1 in 8 flood queries carries a HOPELESS
+                          # deadline by design; a per-second miss-ratio
+                          # spike of 1-in-2 is this soak's normal, so the
+                          # serve SLO here judges sustained majority
+                          # misses, not the injected ones
+                          slo_specs=("serve:serve_deadline_miss_ratio<=0.5;"
+                                     "cache:cache_stale_served_rate==0;"
+                                     "ingest:ingest_lag_versions<=2;"
+                                     "shuffle:shuffle_tier_degraded_rate==0;"
+                                     "workers:worker_deaths_rate==0"),
+                          timeline_interval_s=0.5,
                           incident_dir=os.path.join(tmpdir, "incidents"),
                           incident_max_bundles=64))
         MemManager.reset()
@@ -620,8 +638,10 @@ def main():
         })
 
     from blaze_tpu.obs.attribution import artifact_section
+    from blaze_tpu.obs.timeline import timeline_artifact_section
 
     out.update(artifact_section())
+    out.update(timeline_artifact_section())
     iso_p99 = out["isolated_light"]["latency_ms"]["p99"]
     light_p99 = out["tenants"]["light"]["latency_ms"]["p99"]
     out["gates"] = {
@@ -632,6 +652,8 @@ def main():
         "shed_door_r02": 12,  # what round 2's blind clients gave up on
         "preempt_proof_bit_identical": probe["bit_identical"],
         "preempt_proof_count": probe["preempt_count"],
+        "health_critical_intervals": out["health"]["critical_intervals"],
+        "health_degraded_ratio": out["health"]["degraded_ratio"],
         **out["tripwires"],
     }
     dst = os.path.join(os.path.dirname(os.path.dirname(
@@ -658,6 +680,11 @@ def main():
     # a worker absorb went wrong)
     assert out["tracer_events_dropped"] == 0, (
         f"tracer dropped {out['tracer_events_dropped']} events during soak")
+    # health-state HISTORY, not just the end state: no subsystem may have
+    # spent a single interval critical, and non-healthy time stays bounded
+    assert out["health"]["samples"] > 0, "timeline sampler never ran"
+    assert out["health"]["critical_intervals"] == 0, out["health"]
+    assert out["health"]["degraded_ratio"] <= 0.5, out["health"]
     print(f"\nwrote {dst}")
 
 
@@ -989,8 +1016,10 @@ def zipf_main():
         })
 
     from blaze_tpu.obs.attribution import artifact_section
+    from blaze_tpu.obs.timeline import timeline_artifact_section
 
     out.update(artifact_section())
+    out.update(timeline_artifact_section())
     iso_p99 = out["isolated_light"]["latency_ms"]["p99"]
     light_p99 = out["tenants"]["light"]["latency_ms"]["p99"]
     out["gates"] = {
@@ -1011,6 +1040,8 @@ def zipf_main():
         "failed": tot["failed"],
         "leaked_mem": out["leaked_mem"],
         "shm_segments_leaked": out["shm_segments_leaked"],
+        "health_critical_intervals": out["health"]["critical_intervals"],
+        "health_degraded_ratio": out["health"]["degraded_ratio"],
     }
     dst = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "SERVE_r04.json")
@@ -1043,6 +1074,329 @@ def zipf_main():
     assert stream["cache"]["cache_refreshes"] >= 8, stream["cache"]
     assert g["leaked_mem"] == 0, "memory leaked across queries"
     assert g["shm_segments_leaked"] == 0, "/dev/shm segment roots leaked"
+    assert out["health"]["samples"] > 0, "timeline sampler never ran"
+    assert g["health_critical_intervals"] == 0, out["health"]
+    assert g["health_degraded_ratio"] <= 0.5, out["health"]
+    print(f"\nwrote {dst}")
+
+
+def rate_main(rows_per_s: int):
+    """Firehose streaming soak (--rate) -> SERVE_r05.json: an appender
+    thread streams batches into an ingest table at a target rows/s for
+    RATE_DURATION_S while the full client fleet serves cached mergeable
+    rollups over that same table through one QueryScheduler, drawn
+    zipfian over ~16 variants. Every append stales the hot entries;
+    every hit-after-stale takes the incremental refresh path — the
+    ROADMAP "streaming soak appending at rate under continuous serving"
+    round, judged on the TIMELINE (obs/timeline.py), not end state:
+    the ingest-lag series must stay bounded and return to <= 1 version
+    within the drain window after the appender stops, zero stale
+    results served, zero ``critical`` health intervals, and refreshed
+    results bit-identical to full recomputes over the final table.
+    Env: RATE_DURATION_S (20), RATE_BATCH_ROWS (5000), RATE_DRAIN_S (6),
+    SERVE_CLIENTS / SERVE_BUDGET_MB as the other rounds."""
+    import pyarrow as pa
+
+    from blaze_tpu.config import Config, set_config
+    from blaze_tpu.ir import exprs as E
+    from blaze_tpu.ir import nodes as N
+    from blaze_tpu.ir import types as T
+    from blaze_tpu.obs.telemetry import get_registry
+    from blaze_tpu.obs.timeline import get_timeline
+    from blaze_tpu.ops.base import QueryCancelled
+    from blaze_tpu.runtime.memmgr import MemManager
+    from blaze_tpu.runtime.session import Session
+    from blaze_tpu.serve import Backpressure, Overloaded, QueryScheduler
+
+    F, M, HASH = E.AggFunction, E.AggMode, E.AggExecMode.HASH_AGG
+    duration_s = float(os.environ.get("RATE_DURATION_S", 20.0))
+    drain_s = float(os.environ.get("RATE_DRAIN_S", 6.0))
+    batch_rows = int(os.environ.get("RATE_BATCH_ROWS", 5000))
+    append_interval = batch_rows / max(rows_per_s, 1)
+    VARIANTS = 16
+    WEIGHTS = [1.0 / (r + 1) ** 1.1 for r in range(VARIANTS)]
+    ADAPTIVE_CAP = max(18, os.cpu_count() or 1)
+
+    out = {"target_rows_per_s": rows_per_s, "duration_s": duration_s,
+           "drain_s": drain_s, "batch_rows": batch_rows,
+           "clients": CLIENTS, "variants": VARIANTS, "zipf_s": 1.1,
+           "budget_mb": BUDGET_MB}
+    t_all = time.perf_counter()
+    with tempfile.TemporaryDirectory(prefix="blaze_serve_rate_") as tmpdir:
+        set_config(Config(memory_total=BUDGET_MB << 20, memory_fraction=1.0,
+                          mem_wait_timeout_s=5.0,
+                          serve_tenants="dash:8",
+                          serve_adaptive_max_concurrent=ADAPTIVE_CAP,
+                          # fine-grained lag/backlog curves: the sampler
+                          # IS the instrument this round is judged by
+                          timeline_interval_s=0.25,
+                          # bounded-staleness contract, expressed in
+                          # versions at the configured append rate: a
+                          # rollup may trail the stream by up to ~10s of
+                          # appends under full load (lag tracks queue
+                          # latency — refreshes cover the versions seen
+                          # at execution start), but must never be
+                          # SERVED stale and must drain to <= 1 once
+                          # appends stop (the hard gates below)
+                          slo_specs=("serve:serve_deadline_miss_ratio<=0.5;"
+                                     "cache:cache_stale_served_rate==0;"
+                                     f"ingest:ingest_lag_versions<="
+                                     f"{max(4, math.ceil(10.0 / append_interval))};"
+                                     "shuffle:shuffle_tier_degraded_rate==0;"
+                                     "workers:worker_deaths_rate==0"),
+                          incident_dir=os.path.join(tmpdir, "incidents"),
+                          incident_max_bundles=64))
+        MemManager.reset()
+
+        rng = random.Random(7)
+
+        def mk_batch():
+            return pa.RecordBatch.from_pydict({
+                "k": [rng.randrange(16) for _ in range(batch_rows)],
+                "v": [rng.randrange(1000) for _ in range(batch_rows)]})
+
+        # a small pool of pre-built batches cycled by the appender: the
+        # soak measures the ENGINE's append+refresh pipeline, not Python
+        # row generation
+        pool = [mk_batch() for _ in range(8)]
+
+        def variant_plan(i):
+            # i-th dashboard rollup: SUM(v) by k over keys <= i — the
+            # filter sits BELOW the output agg, so every variant is
+            # mergeable (incremental.mergeable_spec) and refreshes from
+            # the appended tail alone
+            filt = N.Filter(sess.table_scan("stream"), [E.BinaryExpr(
+                E.BinaryOp.LTEQ, E.Column("k"), E.Literal(i, T.I64))])
+            g = [("k", E.Column("k"))]
+            partial = N.Agg(filt, HASH, g, [N.AggColumn(
+                E.AggExpr(F.SUM, [E.Column("v")], T.I64),
+                M.PARTIAL, "paid")])
+            ex = N.ShuffleExchange(
+                partial, N.HashPartitioning([E.Column("k")], 4))
+            return N.Agg(ex, HASH, g, [N.AggColumn(
+                E.AggExpr(F.SUM, [E.Column("v")], T.I64),
+                M.FINAL, "paid")])
+
+        def canon(table):
+            d = table.to_pydict()
+            return sorted(zip(*d.values())) if d else []
+
+        mu = threading.Lock()
+        shm0 = shm_roots()
+        with Session() as sess:
+            # seed history + JIT warmup (through the same variant shapes)
+            sess.append("stream", [mk_batch() for _ in range(12)],
+                        num_partitions=4)
+            out["history_rows"] = 12 * batch_rows
+            # JIT warmup + cache pre-fill: every variant lands a FRESH
+            # entry BEFORE the firehose starts, so the soak measures the
+            # steady state — serves finding stale entries and folding
+            # the appended tail in via incremental refresh. (A cold fill
+            # racing the appender is discarded by the epoch guard, so a
+            # cleared cache under a continuous firehose never converges.)
+            for i in range(VARIANTS):
+                sess.execute_cached(variant_plan(i))
+            get_registry().reset_values()
+            get_timeline().reset()
+
+            appender = {"rows": 0, "appends": 0, "behind_s": 0.0,
+                        "t_start": None, "t_end": None}
+            counts = {"completed": 0, "failed": 0, "shed": 0,
+                      "cancelled": 0, "door_overloads": 0}
+            lat_ms = []
+            stop_clients = threading.Event()
+
+            def append_loop():
+                appender["t_start"] = time.time()
+                next_t = time.perf_counter()
+                end = next_t + duration_s
+                i = 0
+                while time.perf_counter() < end:
+                    sess.append("stream", [pool[i % len(pool)]])
+                    i += 1
+                    appender["appends"] += 1
+                    appender["rows"] += batch_rows
+                    next_t += append_interval
+                    sleep = next_t - time.perf_counter()
+                    if sleep > 0:
+                        time.sleep(sleep)
+                    else:
+                        # the box cannot sustain the target: record how
+                        # far behind the pacer fell instead of silently
+                        # redefining the rate
+                        appender["behind_s"] = max(
+                            appender["behind_s"], -sleep)
+                appender["t_end"] = time.time()
+
+            def client(cid):
+                rngc = random.Random(500 + cid)
+                while not stop_clients.is_set():
+                    v = rngc.choices(range(VARIANTS), weights=WEIGHTS)[0]
+                    h = None
+                    for _attempt in range(40):
+                        if stop_clients.is_set():
+                            return
+                        try:
+                            h = sched.submit(variant_plan(v),
+                                             mem_estimate=12 << 20,
+                                             label=f"dash_v{v}",
+                                             tenant="dash")
+                            break
+                        except Backpressure as exc:
+                            with mu:
+                                counts["door_overloads"] += 1
+                            time.sleep(min(exc.retry_after_s
+                                           * (2 ** min(_attempt, 3)), 2.0)
+                                       * rngc.uniform(0.8, 1.2))
+                        except Overloaded:
+                            with mu:
+                                counts["door_overloads"] += 1
+                            time.sleep(rngc.uniform(0.05, 0.2))
+                    if h is None:
+                        continue
+                    try:
+                        h.result(timeout=300)
+                        with mu:
+                            counts["completed"] += 1
+                            # cache hits finish the handle AT submit, so
+                            # the two stamps can land microseconds apart
+                            # in either order — clamp to zero
+                            lat_ms.append(max(
+                                0.0,
+                                (h.finished_at - h.submitted_at) * 1e3))
+                    except Overloaded:
+                        with mu:
+                            counts["shed"] += 1
+                    except QueryCancelled:
+                        with mu:
+                            counts["cancelled"] += 1
+                    except BaseException as exc:
+                        print(f"[client {cid}] dash_v{v} failed: "
+                              f"{type(exc).__name__}: {exc}",
+                              file=sys.stderr)
+                        with mu:
+                            counts["failed"] += 1
+                    time.sleep(rngc.uniform(0, 0.01))
+
+            with QueryScheduler(sess, max_concurrent=CONCURRENT or None,
+                                max_queue=QUEUE,
+                                queue_timeout_s=QUEUE_TIMEOUT_S) as sched:
+                threads = [threading.Thread(target=client, args=(c,),
+                                            daemon=True)
+                           for c in range(CLIENTS)]
+                for t in threads:
+                    t.start()
+                app = threading.Thread(target=append_loop, daemon=True)
+                app.start()
+                app.join()
+                # drain window: serving continues with NO new appends —
+                # this is where the lag series must fall back to <= 1
+                time.sleep(drain_s)
+                stop_clients.set()
+                for t in threads:
+                    t.join()
+
+                # freshness proof over the FINAL table: the cached (and
+                # possibly tail-refreshed many times over) rollup must be
+                # bit-identical to a from-scratch recompute
+                freshness = []
+                for i in (0, 3, VARIANTS - 1):
+                    got = sess.execute_cached(variant_plan(i))
+                    full = sess.execute_to_table(variant_plan(i),
+                                                 release_on_finish=True)
+                    freshness.append({"variant": i,
+                                      "bit_identical":
+                                          canon(got) == canon(full)})
+                out["freshness"] = freshness
+                # one settled sample past the final refreshes, so the
+                # artifact's lag curve ends on the drained state
+                time.sleep(0.6)
+
+                reg = get_registry().to_raw()
+                out["cache"] = dict(sess.cache.stats_fields())
+                out["lag_probe"] = sess.cache.ingest_lag_probe()
+                out["serve_metrics"] = sched.metrics.to_dict()
+                out["peak_inflight"] = sched.peak_inflight
+
+            wall = (appender["t_end"] or time.time()) \
+                - (appender["t_start"] or time.time())
+            out["appender"] = dict(appender)
+            out["achieved_rows_per_s"] = round(
+                appender["rows"] / max(wall, 1e-9))
+            out["totals"] = dict(counts)
+            out["latency_ms"] = {"p50": pctl(lat_ms, 50),
+                                 "p95": pctl(lat_ms, 95),
+                                 "p99": pctl(lat_ms, 99)}
+            out["hits"] = _counter(reg, "blaze_serve_queries_total",
+                                   outcome="cache_hit")
+            out["executed"] = _counter(reg, "blaze_serve_queries_total",
+                                       outcome="done")
+            out["stale_served_registry"] = _counter(
+                reg, "blaze_cache_stale_total", result="served")
+            out["ingest_appends_registry"] = _counter(
+                reg, "blaze_ingest_appends_total", table="stream")
+            out["ingest_rows_registry"] = _counter(
+                reg, "blaze_ingest_rows_total", table="stream")
+
+        mm = MemManager._instance
+        out.update({
+            "leaked_mem": mm.used if mm else 0,
+            "shm_segments_leaked": len(shm_roots(shm0)),
+            "wall_s": round(time.perf_counter() - t_all, 2),
+        })
+
+    from blaze_tpu.obs.attribution import artifact_section
+    from blaze_tpu.obs.timeline import timeline_artifact_section
+
+    out.update(artifact_section())
+    out.update(timeline_artifact_section())
+    lag_series = out["timeline"].get("ingest_lag_versions") or []
+    lag_values = [v for _t, v in lag_series]
+    backlog = out["timeline"].get("cache_refresh_backlog_count") or []
+    out["gates"] = {
+        "achieved_rows_per_s": out["achieved_rows_per_s"],
+        "appends": out["appender"]["appends"],
+        "pacer_behind_s": round(out["appender"]["behind_s"], 3),
+        "lag_max_versions": max(lag_values, default=0),
+        "lag_final_versions": lag_values[-1] if lag_values else None,
+        "refresh_backlog_max": max((v for _t, v in backlog), default=0),
+        "stale_served": out["stale_served_registry"],
+        "cache_stale_served": out["cache"]["cache_stale_served"],
+        "refreshes": out["cache"]["cache_refreshes"],
+        "completed": out["totals"]["completed"],
+        "failed": out["totals"]["failed"],
+        "freshness_ok": all(f["bit_identical"]
+                            for f in out["freshness"]),
+        "health_critical_intervals": out["health"]["critical_intervals"],
+        "health_degraded_ratio": out["health"]["degraded_ratio"],
+        "leaked_mem": out["leaked_mem"],
+        "shm_segments_leaked": out["shm_segments_leaked"],
+    }
+    dst = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "SERVE_r05.json")
+    with open(dst, "w") as f:
+        json.dump(out, f, indent=2, default=str)
+    print(json.dumps(out["gates"], indent=2, default=str))
+    # evidence is on disk; now the firehose gates
+    g = out["gates"]
+    assert g["failed"] == 0, "soak had hard failures"
+    assert g["completed"] > 0 and g["appends"] > 0, g
+    # the firehose actually induced staleness the cache had to absorb...
+    assert g["lag_max_versions"] >= 1 or g["refresh_backlog_max"] >= 1, g
+    # ...and absorbed it: the lag series returned to <= 1 version once
+    # appends stopped (the drain window is the gate window)
+    assert g["lag_final_versions"] is not None \
+        and g["lag_final_versions"] <= 1, g
+    assert out["lag_probe"]["ingest_lag_versions"] <= 1, out["lag_probe"]
+    assert g["stale_served"] == 0 and g["cache_stale_served"] == 0, g
+    assert g["refreshes"] >= 1, g
+    assert g["freshness_ok"], out["freshness"]
+    assert out["health"]["samples"] > 0, "timeline sampler never ran"
+    assert g["health_critical_intervals"] == 0, out["health"]
+    assert g["health_degraded_ratio"] <= 0.5, out["health"]
+    assert g["leaked_mem"] == 0, "memory leaked across queries"
+    assert g["shm_segments_leaked"] == 0, "/dev/shm segment roots leaked"
+    assert out["tracer_events_dropped"] == 0, out["tracer_events_dropped"]
     print(f"\nwrote {dst}")
 
 
@@ -1798,6 +2152,14 @@ if __name__ == "__main__":
                     help="chaos mode: hard-kill a random worker every N "
                          "seconds under serving load and gate on recovery "
                          "(CHAOS_r01.json) instead of the plain serve soak")
+    ap.add_argument("--rate", type=int, nargs="?", const=50_000,
+                    metavar="ROWS_PER_S",
+                    help="firehose streaming soak: continuously append at "
+                         "the target rows/s (default 50000) to an ingest "
+                         "table under the full zipfian serve load, gated "
+                         "on the timeline's ingest-lag and stale-served "
+                         "series and on health-state history "
+                         "(SERVE_r05.json) instead of the plain serve soak")
     ap.add_argument("--chaos-spec", metavar="SPEC",
                     help="chaos matrix: comma-separated modes "
                          "kill:N,hang:N,enospc:N,corrupt:N,preempt:N,"
@@ -1808,6 +2170,8 @@ if __name__ == "__main__":
     args = ap.parse_args()
     if args.zipf:
         zipf_main()
+    elif args.rate is not None:
+        rate_main(args.rate)
     elif args.chaos_spec:
         chaos_matrix_main(args.chaos_spec)
     elif args.chaos_kill_every:
